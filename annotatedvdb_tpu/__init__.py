@@ -1,0 +1,22 @@
+"""annotatedvdb_tpu — a TPU-native (JAX/XLA/Pallas/pjit) variant-annotation framework.
+
+A from-scratch re-design of the capabilities of NIAGADS/AnnotatedVDB (reference:
+/root/reference) for TPU hardware: the row-by-row normalize → primary-key →
+bin-index → annotate → load pipeline of the reference becomes a batched,
+jit-compiled, mesh-sharded array program.
+
+Layout
+------
+- ``types``     : core batch dataclasses (``VariantBatch``, ``AnnotatedBatch``) and enums
+- ``ops``       : pure JAX kernels (normalization, end-location, variant class,
+                  bin index, hashing, dedup/join)
+- ``oracle``    : scalar pure-Python re-implementation of the reference semantics,
+                  used as the golden model in parity tests
+- ``models``    : the flagship annotation pipeline (the jittable "forward step")
+- ``parallel``  : device-mesh sharding, chromosome re-shard collectives
+- ``io``        : host-side ingest (VCF / VEP JSON / CADD) and egress
+- ``store``     : chromosome-sharded columnar variant store + ledger
+- ``utils``     : string/NULL conventions shared with the reference output format
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .pipeline import AnnotationPipeline, annotate_pipeline
+
+__all__ = ["AnnotationPipeline", "annotate_pipeline"]

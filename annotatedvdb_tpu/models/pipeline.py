@@ -1,0 +1,62 @@
+"""The flagship annotation pipeline: the framework's jittable "forward step".
+
+One fused XLA program per batch replaces the reference's per-variant hot loop
+(``Load/bin/load_vcf_file.py:99-171`` — parse → normalize → PK → bin-index →
+buffer, with a Postgres round-trip per duplicate check and per bin-cache
+miss).  Everything here is elementwise/gather math, so XLA fuses it into a
+few HBM-bandwidth-bound loops; there is no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from annotatedvdb_tpu.ops.annotate import annotate_kernel
+from annotatedvdb_tpu.ops.binindex import bin_index_kernel
+from annotatedvdb_tpu.types import AnnotatedBatch, VariantBatch
+
+
+def annotate_pipeline(chrom, pos, ref, alt, ref_len, alt_len) -> AnnotatedBatch:
+    """Full annotate step for one batch: normalization + end location +
+    variant class + bin index.
+
+    The bin lookup takes the raw VCF position and the inferred end location,
+    matching the reference call site
+    (``Util/lib/python/loaders/vcf_variant_loader.py:310-311``).
+    ``chrom`` rides along untouched (bin paths need it only at egress)."""
+    del chrom  # identity only; not needed by the device math
+    ann = annotate_kernel(pos, ref, alt, ref_len, alt_len)
+    bin_level, leaf_bin = bin_index_kernel(pos, ann["end_location"])
+    return AnnotatedBatch(
+        prefix_len=ann["prefix_len"],
+        norm_ref_len=ann["norm_ref_len"],
+        norm_alt_len=ann["norm_alt_len"],
+        end_location=ann["end_location"],
+        location_start=ann["location_start"],
+        location_end=ann["location_end"],
+        variant_class=ann["variant_class"],
+        is_dup_motif=ann["is_dup_motif"],
+        bin_level=bin_level,
+        leaf_bin=leaf_bin,
+        needs_digest=ann["needs_digest"],
+        host_fallback=ann["host_fallback"],
+    )
+
+
+annotate_pipeline_jit = jax.jit(annotate_pipeline)
+
+
+class AnnotationPipeline:
+    """Convenience wrapper around the shared jitted step.
+
+    ``run(batch)`` annotates a :class:`VariantBatch`; shapes are static per
+    (N, W), so batches should be padded to a fixed size by the ingest layer
+    to avoid recompiles.  All instances share one jit cache."""
+
+    def run(self, batch: VariantBatch) -> AnnotatedBatch:
+        return annotate_pipeline_jit(
+            batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+        )

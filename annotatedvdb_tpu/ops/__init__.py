@@ -1,0 +1,4 @@
+from .annotate import annotate_kernel
+from .binindex import bin_index_kernel, LEAF_SIZE, NUM_BIN_LEVELS
+
+__all__ = ["annotate_kernel", "bin_index_kernel", "LEAF_SIZE", "NUM_BIN_LEVELS"]

@@ -1,0 +1,138 @@
+"""Core annotate kernel: left-normalization, end location, variant class.
+
+The reference computes these per variant with Python string slicing
+(``Util/lib/python/variant_annotator.py:36-241``).  Here the whole batch is
+one branchless XLA program over [N, W] uint8 allele arrays:
+
+- the shared-prefix length is a cumulative-AND scan over the width axis;
+- the inversion test is a masked gather of the reversed alt;
+- the duplication-motif test is a modular gather comparing ref[1:] against
+  whole copies of the inserted motif;
+- end location / display positions / class codes are ``jnp.where`` cascades
+  reproducing the reference's branch structure exactly.
+
+Everything is elementwise or a small gather along the width axis — XLA fuses
+the whole kernel into a few HBM-bandwidth-bound loops, which is what makes
+the >=1M variants/sec/chip target (BASELINE.md) reachable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from annotatedvdb_tpu.types import MAX_PK_SEQUENCE_LENGTH, VariantClass
+
+
+def annotate_kernel(pos, ref, alt, ref_len, alt_len):
+    """Annotate one batch.
+
+    Args:
+      pos:     [N] int32 1-based positions
+      ref/alt: [N, W] uint8 raw ASCII alleles (pad 0)
+      ref_len/alt_len: [N] int32 true lengths (may exceed W; such rows are
+        flagged ``host_fallback`` and their outputs are undefined)
+
+    Returns a dict of [N] arrays: prefix_len, norm_ref_len, norm_alt_len,
+    end_location, location_start, location_end, variant_class, is_dup_motif,
+    needs_digest, host_fallback.
+    """
+    n, w = ref.shape
+    pos = pos.astype(jnp.int32)
+    rlen = ref_len.astype(jnp.int32)
+    alen = alt_len.astype(jnp.int32)
+    col = jnp.arange(w, dtype=jnp.int32)[None, :]            # [1, W]
+
+    ref_valid = col < rlen[:, None]
+    alt_valid = col < alen[:, None]
+
+    snv = (rlen == 1) & (alen == 1)
+    mnv_shape = (rlen == alen) & ~snv
+
+    # ---- left-normalization: shared leading run (variant_annotator.py:100-107)
+    # scan ref positions; alt running out counts as mismatch.
+    match = (ref == alt) & ref_valid & alt_valid
+    prefix = jnp.sum(jnp.cumsum(~match, axis=1) == 0, axis=1).astype(jnp.int32)
+    prefix = jnp.where(snv, 0, prefix)                        # SNVs untouched
+    nr = rlen - prefix
+    na = alen - prefix
+
+    # ---- inversion: ref == reverse(alt) for equal-length alleles
+    rev_idx = jnp.clip(alen[:, None] - 1 - col, 0, w - 1)
+    rev_alt = jnp.take_along_axis(alt, rev_idx, axis=1)
+    inversion = mnv_shape & jnp.all((ref == rev_alt) | ~ref_valid, axis=1)
+
+    # ---- end location (variant_annotator.py:36-79)
+    end_mnv = jnp.where(inversion, pos + rlen - 1, pos + nr - 1)
+    end_ins = jnp.where(
+        nr >= 1,
+        pos + nr,                                             # indel
+        jnp.where((nr == 0) & (rlen > 1), pos + rlen - 1, pos + 1),
+    )
+    end_del = jnp.where(nr == 0, pos + rlen - 1, pos + nr)
+    end = jnp.where(
+        snv,
+        pos,
+        jnp.where(mnv_shape, end_mnv, jnp.where(na >= 1, end_ins, end_del)),
+    ).astype(jnp.int32)
+
+    # ---- duplication-motif test (variant_annotator.py:197-201):
+    # ref[1:] must be whole copies of the inserted motif alt[prefix:].
+    # Implemented as exact tiling: (rlen-1) % na == 0 and
+    # ref[1+i] == alt[prefix + (i % na)] for all i < rlen-1.
+    orig_len = rlen - 1                                       # len(ref[1:])
+    na_safe = jnp.maximum(na, 1)
+    motif_idx = jnp.clip(prefix[:, None] + (col % na_safe[:, None]), 0, w - 1)
+    motif = jnp.take_along_axis(alt, motif_idx, axis=1)       # tiled inserted motif
+    shifted_ref = jnp.concatenate([ref[:, 1:], jnp.zeros((n, 1), jnp.uint8)], axis=1)
+    tile_cols = col < orig_len[:, None]
+    tiles = jnp.all((shifted_ref == motif) | ~tile_cols, axis=1)
+    is_dup = (
+        (orig_len > 0)
+        & (na > 0)
+        & (jnp.remainder(orig_len, na_safe) == 0)
+        & tiles
+    )
+
+    # ---- class codes (variant_annotator.py:134-241 branch structure)
+    ins_side = ~snv & ~mnv_shape & (na >= 1)
+    pure_ins = ins_side & (nr == 0) & (end == pos + 1)
+    cls = jnp.select(
+        [
+            snv,
+            inversion,
+            mnv_shape,
+            ins_side & ~pure_ins,
+            pure_ins & is_dup,
+            pure_ins,
+        ],
+        [
+            jnp.int8(VariantClass.SNV),
+            jnp.int8(VariantClass.INVERSION),
+            jnp.int8(VariantClass.MNV),
+            jnp.int8(VariantClass.INDEL),
+            jnp.int8(VariantClass.DUP),
+            jnp.int8(VariantClass.INS),
+        ],
+        default=jnp.int8(VariantClass.DEL),
+    )
+
+    # display positions: SNV/MNV anchor at pos; ins/dup/indel/del start at pos+1
+    loc_start = jnp.where(cls >= VariantClass.INS, pos + 1, pos).astype(jnp.int32)
+    loc_end = end
+
+    return {
+        "prefix_len": prefix,
+        "norm_ref_len": nr,
+        "norm_alt_len": na,
+        "end_location": end,
+        "location_start": loc_start,
+        "location_end": loc_end,
+        "variant_class": cls,
+        "is_dup_motif": is_dup & ins_side,
+        "needs_digest": (rlen + alen) > MAX_PK_SEQUENCE_LENGTH,
+        "host_fallback": (rlen > w) | (alen > w),
+    }
+
+
+annotate_kernel_jit = jax.jit(annotate_kernel)

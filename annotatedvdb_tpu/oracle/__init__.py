@@ -1,0 +1,24 @@
+"""Scalar pure-Python golden model of the reference semantics.
+
+These are *fresh* implementations of the behavior documented in SURVEY.md —
+written to match NIAGADS/AnnotatedVDB observable outputs bit-for-bit — used
+only as the oracle in parity tests and as the host fallback for rows the
+device path cannot represent (alleles wider than the device width)."""
+
+from .annotator import (
+    normalize_alleles,
+    infer_end_location,
+    display_attributes,
+    metaseq_id,
+    reverse_complement,
+)
+from .binindex import BinTree
+
+__all__ = [
+    "normalize_alleles",
+    "infer_end_location",
+    "display_attributes",
+    "metaseq_id",
+    "reverse_complement",
+    "BinTree",
+]

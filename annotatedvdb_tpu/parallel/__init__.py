@@ -1,0 +1,4 @@
+from .mesh import make_mesh, SHARD_AXIS
+from .distributed import distributed_annotate_step, reshard_by_owner
+
+__all__ = ["make_mesh", "SHARD_AXIS", "distributed_annotate_step", "reshard_by_owner"]

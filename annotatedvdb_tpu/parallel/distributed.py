@@ -1,0 +1,139 @@
+"""Distributed annotate step: chromosome re-shard + annotate + global counters.
+
+TPU-native mapping of the reference's share-nothing per-chromosome worker pool
+(SURVEY.md §2.5): instead of demuxing a VCF into per-chromosome files and
+forking processes, every shard ingests an arbitrary slice of the input,
+routes each row to its owning shard with one ``all_to_all``, annotates
+locally, and aggregates counters with ``psum``.  Chromosome ownership keeps
+the store's partition invariant (one shard owns a chromosome's rows, so
+dedup/update never crosses shards — the same lock-avoidance layout the
+reference gets from Postgres LIST partitions, ``createVariant.sql:29-50``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from annotatedvdb_tpu.models.pipeline import annotate_pipeline
+from annotatedvdb_tpu.parallel.mesh import SHARD_AXIS
+from annotatedvdb_tpu.types import NUM_CHROMOSOMES, VariantBatch
+
+
+def _bucketize(owner, arrays, n_buckets: int, capacity: int):
+    """Pack rows into [n_buckets * capacity] slots by owner (pad = dropped).
+
+    Returns (packed arrays, valid mask).  Rows beyond a bucket's capacity are
+    dropped and must be counted by the caller (no silent loss: the returned
+    ``n_dropped`` reports them)."""
+    n = owner.shape[0]
+    order = jnp.argsort(owner, stable=True)
+    owner_sorted = owner[order]
+    # first row index of each bucket in the sorted order
+    starts = jnp.searchsorted(owner_sorted, jnp.arange(n_buckets, dtype=owner.dtype))
+    rank_in_bucket = jnp.arange(n, dtype=jnp.int32) - starts[owner_sorted]
+    in_capacity = rank_in_bucket < capacity
+    slot = jnp.where(
+        in_capacity, owner_sorted * capacity + rank_in_bucket, n_buckets * capacity
+    )
+
+    def pack(x):
+        x_sorted = x[order]
+        out_shape = (n_buckets * capacity,) + x.shape[1:]
+        return jnp.zeros(out_shape, x.dtype).at[slot].set(
+            x_sorted, mode="drop", unique_indices=True
+        )
+
+    packed = jax.tree.map(pack, arrays)
+    valid = (
+        jnp.zeros((n_buckets * capacity,), jnp.bool_)
+        .at[slot]
+        .set(in_capacity, mode="drop", unique_indices=True)
+    )
+    n_dropped = jnp.sum(~in_capacity, dtype=jnp.int32)
+    return packed, valid, n_dropped
+
+
+def reshard_by_owner(owner, arrays, n_shards: int, capacity: int, axis=SHARD_AXIS):
+    """Inside shard_map: route rows to ``owner``-th shard via one all_to_all.
+
+    Each shard sends up to ``capacity`` rows to each destination; returns the
+    received rows [n_shards * capacity, ...], their validity mask, and the
+    per-shard dropped-row count (psum'd to a global)."""
+    packed, valid, n_dropped = _bucketize(owner, arrays, n_shards, capacity)
+
+    def exchange(x):
+        grouped = x.reshape((n_shards, capacity) + x.shape[1:])
+        received = jax.lax.all_to_all(grouped, axis, split_axis=0, concat_axis=0)
+        return received.reshape((n_shards * capacity,) + x.shape[1:])
+
+    received = jax.tree.map(exchange, packed)
+    valid = exchange(valid)
+    total_dropped = jax.lax.psum(n_dropped, axis)
+    return received, valid, total_dropped
+
+
+def chromosome_owner(chrom, n_shards: int):
+    """Owning shard of a chromosome code: contiguous blocks of chromosomes per
+    shard (chr1 with chr2 on shard 0, ... — later rounds can use a
+    variant-count-balanced assignment; the reference shuffles chromosome order
+    for the same load-balancing reason, ``load_cadd_scores.py:306``)."""
+    per = -(-NUM_CHROMOSOMES // n_shards)  # ceil
+    return jnp.clip((chrom.astype(jnp.int32) - 1) // per, 0, n_shards - 1)
+
+
+def distributed_annotate_step(mesh, batch: VariantBatch, capacity: int | None = None):
+    """Full sharded load step: reshard rows to chromosome owners, annotate,
+    and count classes globally.  This is the function the driver dry-runs
+    multi-chip (``__graft_entry__.dryrun_multichip``).
+
+    ``capacity`` bounds rows each shard sends per destination.  The default
+    gives 4x slack over a perfectly balanced distribution, keeping per-shard
+    post-exchange work at ~4*n_local/n_shards per source (not the full global
+    batch); overflow rows are dropped *with accounting* (``n_dropped``) and
+    callers needing lossless routing under extreme skew pass
+    ``capacity=batch.n // n_shards``."""
+    n_shards = mesh.devices.size
+    n_local = batch.n // n_shards
+    if capacity is None:
+        capacity = min(n_local, -(-4 * n_local // n_shards))
+
+    spec = P(SHARD_AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * 6,
+        out_specs=(jax.tree.map(lambda _: spec, _annotated_specs()), spec, P(), P()),
+        check_vma=False,
+    )
+    def step(chrom, pos, ref, alt, ref_len, alt_len):
+        owner = chromosome_owner(chrom, n_shards)
+        arrays = (chrom, pos, ref, alt, ref_len, alt_len)
+        (chrom, pos, ref, alt, ref_len, alt_len), valid, dropped = reshard_by_owner(
+            owner, arrays, n_shards, capacity
+        )
+        ann = annotate_pipeline(chrom, pos, ref, alt, ref_len, alt_len)
+        # global per-class counters (reference: per-worker counter dicts,
+        # variant_loader.py:387-392 — here one psum).  Pad rows (chrom 0,
+        # both in-batch padding and empty exchange slots) and truncated
+        # host-fallback rows are excluded: their kernel outputs are undefined.
+        counted = valid & (chrom > 0) & ~ann.host_fallback
+        counts = jnp.zeros((8,), jnp.int32).at[ann.variant_class].add(
+            counted.astype(jnp.int32), mode="drop"
+        )
+        counts = jax.lax.psum(counts, SHARD_AXIS)
+        valid = valid & (chrom > 0)
+        return ann, valid, counts, dropped
+
+    return step(batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+
+
+def _annotated_specs():
+    from annotatedvdb_tpu.types import AnnotatedBatch
+
+    return AnnotatedBatch(*([0] * len(AnnotatedBatch._fields)))

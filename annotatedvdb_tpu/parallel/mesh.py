@@ -1,0 +1,31 @@
+"""Device-mesh construction.
+
+The reference's only parallelism is per-chromosome OS processes sharing a
+Postgres server (``Load/bin/load_vcf_file.py:307-313``).  Here the same
+decomposition is a 1-D device mesh: batches are sharded over the ``shard``
+axis, variants are routed to their owning chromosome shard with an
+``all_to_all`` (see ``distributed.py``), and counters aggregate with ``psum``
+— collectives ride ICI instead of the Postgres TCP wire (SURVEY.md §5.8).
+Multi-host later extends the same mesh over DCN via ``jax.distributed``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (all by default)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))

@@ -1,0 +1,3 @@
+from .strings import xstr, truncate, qw, to_numeric, deep_update
+
+__all__ = ["xstr", "truncate", "qw", "to_numeric", "deep_update"]

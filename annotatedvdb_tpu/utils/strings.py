@@ -1,0 +1,70 @@
+"""String/NULL conventions shared with the reference output format.
+
+The reference imports these helpers from its external ``GenomicsDBData.Util`` /
+``niagads`` packages (SURVEY.md §1 "Critical external-dependency note") — they
+are in-scope capabilities, re-implemented here from their observed call-site
+behavior."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def xstr(value: Any, null_str: str = "", false_as_null: bool = False) -> str:
+    """Stringify with NULL conventions: None -> ``null_str``; dict/list ->
+    JSON; booleans honor ``false_as_null``.  Call-site behavior: metaseq id
+    assembly (``variant_annotator.py:126``), COPY-row NULL placeholders
+    (``variant_loader.py`` nullStr='NULL')."""
+    if value is None:
+        return null_str
+    if isinstance(value, bool):
+        if not value and false_as_null:
+            return null_str
+        return str(value)
+    if isinstance(value, (dict, list)):
+        return json.dumps(value)
+    return str(value)
+
+
+def truncate(value: str, length: int) -> str:
+    """Hard truncation to ``length`` chars (display-allele truncation,
+    ``variant_annotator.py:8-10``)."""
+    return value[:length] if value is not None else value
+
+
+def qw(s: str, returnTuple: bool = False):
+    """Perl-style word list: split on whitespace."""
+    words = s.split()
+    return tuple(words) if returnTuple else words
+
+
+def to_numeric(value):
+    """str -> int/float when it parses cleanly, else unchanged (INFO-field
+    coercion, ``vcf_parser.py`` convert_str2numeric_values call sites)."""
+    if not isinstance(value, str):
+        return value
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        return value
+
+
+def convert_str2numeric_values(d: dict) -> dict:
+    """Apply :func:`to_numeric` over a dict's values."""
+    return {k: to_numeric(v) for k, v in d.items()}
+
+
+def deep_update(base: dict, patch: dict) -> dict:
+    """Recursive dict merge, patch wins; mirrors the server-side
+    ``jsonb_merge()`` the reference leans on (``vep_variant_loader.py:227``)."""
+    for key, value in patch.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            deep_update(base[key], value)
+        else:
+            base[key] = value
+    return base

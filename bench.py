@@ -1,0 +1,60 @@
+"""Benchmark: variants annotated + bin-indexed per second on one chip.
+
+Measures the steady-state throughput of the flagship jitted pipeline
+(normalize -> end location -> variant class -> bin index) on a realistic
+variant-shape mix.  The metric matches the BASELINE.md north star
+(>= 1M variants/sec/chip on TPU v5e); ``vs_baseline`` is the ratio against
+that 1M variants/sec target, since the reference itself publishes no numbers
+(BASELINE.md "Published reference benchmarks: None").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 1 << 20          # 1M variants per step
+WIDTH = 16               # covers the dbSNP/gnomAD allele-length distribution
+WARMUP_STEPS = 3
+MEASURE_STEPS = 10
+TARGET_VARIANTS_PER_SEC = 1_000_000.0  # BASELINE.md north star
+
+
+def main():
+    import jax
+
+    from annotatedvdb_tpu.io.synth import synthetic_batch
+    from annotatedvdb_tpu.models.pipeline import annotate_pipeline_jit
+
+    batch = synthetic_batch(BATCH, width=WIDTH)
+    args = [jax.device_put(x) for x in batch]
+
+    def step():
+        out = annotate_pipeline_jit(*args)
+        jax.block_until_ready(out)
+        return out
+
+    for _ in range(WARMUP_STEPS):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        step()
+    dt = time.perf_counter() - t0
+
+    variants_per_sec = BATCH * MEASURE_STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "variants_annotated_and_bin_indexed_per_sec_per_chip",
+                "value": round(variants_per_sec, 1),
+                "unit": "variants/sec",
+                "vs_baseline": round(variants_per_sec / TARGET_VARIANTS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh so
+multi-chip sharding is exercised without TPU hardware (SURVEY.md §4d).
+
+Must run before any ``import jax`` in test modules — pytest imports conftest
+first, so setting the env here is sufficient."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override: the ambient env pins the TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import random
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return random.Random(20260729)
+
+
+BASES = "ACGT"
+
+
+def random_allele(rng, min_len=1, max_len=12):
+    return "".join(rng.choice(BASES) for _ in range(rng.randint(min_len, max_len)))
+
+
+def random_variants(rng, n, max_len=12):
+    """Mix of shapes: SNVs, MNVs, inversions, pure ins/del, indels, dups,
+    shared-prefix pairs — the cases that exercise every branch of the
+    reference's annotator."""
+    out = []
+    for _ in range(n):
+        kind = rng.randrange(8)
+        chrom = rng.choice([str(c) for c in range(1, 23)] + ["X", "Y", "M"])
+        pos = rng.randint(1, 248_000_000)
+        if kind == 0:  # SNV
+            ref = rng.choice(BASES)
+            alt = rng.choice(BASES.replace(ref, ""))
+        elif kind == 1:  # MNV (maybe accidental inversion)
+            L = rng.randint(2, max_len)
+            ref = random_allele(rng, L, L)
+            alt = random_allele(rng, L, L)
+        elif kind == 2:  # inversion
+            ref = random_allele(rng, 2, max_len)
+            alt = ref[::-1]
+        elif kind == 3:  # pure insertion (anchored)
+            ref = rng.choice(BASES)
+            alt = ref + random_allele(rng, 1, max_len - 1)
+        elif kind == 4:  # duplication: ref[1:] = k copies of inserted motif
+            motif = random_allele(rng, 1, 4)
+            k = rng.randint(1, 3)
+            anchor = rng.choice(BASES)
+            ref = anchor + motif * k
+            alt = ref + motif
+        elif kind == 5:  # deletion (anchored)
+            alt = rng.choice(BASES)
+            ref = alt + random_allele(rng, 1, max_len - 1)
+        elif kind == 6:  # indel with shared prefix
+            shared = random_allele(rng, 1, 4)
+            ref = shared + random_allele(rng, 1, 5)
+            alt = shared + random_allele(rng, 1, 5)
+        else:  # arbitrary ragged pair
+            ref = random_allele(rng, 1, max_len)
+            alt = random_allele(rng, 1, max_len)
+        out.append((chrom, pos, ref, alt))
+    return out

@@ -1,0 +1,70 @@
+"""Parity tests: closed-form bin kernel vs the recursive tree oracle
+(SURVEY.md §7.2 step 2)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from annotatedvdb_tpu.oracle.binindex import BinTree, closed_form_path, LEAF_SIZE
+from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
+
+
+def lookup(tree, intervals):
+    starts = jnp.asarray(np.array([s for s, _ in intervals], dtype=np.int32))
+    ends = jnp.asarray(np.array([e for _, e in intervals], dtype=np.int32))
+    level, leaf = bin_index_kernel_jit(starts, ends)
+    return np.asarray(level), np.asarray(leaf)
+
+
+def test_small_chromosome_parity(rng):
+    """Exhaustive-ish parity on a small fake chromosome (200kb)."""
+    tree = BinTree("chrT", 200_000)
+    intervals = []
+    for _ in range(300):
+        start = rng.randint(1, 200_000)
+        end = min(200_000, start + rng.choice([0, 1, 5, 100, 20_000, 150_000]))
+        intervals.append((start, end))
+    level, leaf = lookup(tree, intervals)
+    for i, (s, e) in enumerate(intervals):
+        want_level, want_path = tree.find_bin(s, e)
+        assert level[i] == want_level, (s, e)
+        assert closed_form_path("chrT", int(level[i]), int(leaf[i])) == want_path, (s, e)
+
+
+def test_chr1_scale_parity(rng):
+    """hg38 chr1-sized chromosome: sparse random checks against the oracle."""
+    seq_len = 248_956_422
+    tree = BinTree("chr1", seq_len)
+    intervals = []
+    for _ in range(200):
+        start = rng.randint(1, seq_len)
+        end = min(seq_len, start + rng.choice([0, 2, 30, 15_000, 70_000, 5_000_000]))
+        intervals.append((start, end))
+    # boundary cases: bin edges (bins are (lower, upper])
+    for mult in (1, 2, 4096, 4097):
+        edge = LEAF_SIZE * mult
+        intervals += [(edge, edge), (edge + 1, edge + 1), (edge, edge + 1)]
+    level, leaf = lookup(tree, intervals)
+    for i, (s, e) in enumerate(intervals):
+        want_level, want_path = tree.find_bin(s, e)
+        assert level[i] == want_level, (s, e)
+        assert closed_form_path("chr1", int(level[i]), int(leaf[i])) == want_path, (s, e)
+
+
+def test_snv_leaf_level():
+    """Point variants always land in a leaf (level 13 = nlevel 27 ltree path,
+    the cacheability condition at bin_index.py:67)."""
+    starts = jnp.asarray(np.array([1, 100, LEAF_SIZE, LEAF_SIZE + 1, 64_000_000], dtype=np.int32))
+    level, leaf = bin_index_kernel_jit(starts, starts)
+    assert (np.asarray(level) == 13).all()
+    # ltree nlevel = 1 + 2*level
+    path = closed_form_path("chr9", 13, int(np.asarray(leaf)[0]))
+    assert len(path.split(".")) == 27
+
+
+def test_wide_interval_levels():
+    """A 64Mb-spanning interval escalates to a broad bin (level <= 1)."""
+    starts = jnp.asarray(np.array([1, 1], dtype=np.int32))
+    ends = jnp.asarray(np.array([63_999_999, 64_000_001], dtype=np.int32))
+    level, _ = bin_index_kernel_jit(starts, ends)
+    assert np.asarray(level)[0] >= 1
+    assert np.asarray(level)[1] == 0
