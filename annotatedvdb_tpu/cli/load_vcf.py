@@ -1,0 +1,91 @@
+"""CLI: load a VCF into the TPU-native variant store.
+
+The ``Load/bin/load_vcf_file.py`` equivalent (flags mirror
+``load_vcf_file.py:247-286``): default is a dry run (full pipeline, no
+mutation) unless ``--commit`` is passed; ``--test`` stops after one batch;
+``--failAt`` is fault injection; the algorithm-invocation id is printed on
+exit so a wrapper can undo the load (``load_vcf_file.py:220``).
+
+Usage:  python -m annotatedvdb_tpu.cli.load_vcf --fileName x.vcf[.gz] \
+            --storeDir ./vdb [--commit] [--datasource dbSNP] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from annotatedvdb_tpu.io.vcf import read_chromosome_map
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="load a VCF into the TPU-native annotated variant store"
+    )
+    parser.add_argument("--fileName", required=True, help="VCF file (.gz ok)")
+    parser.add_argument("--storeDir", required=True, help="variant store directory")
+    parser.add_argument("--datasource", default=None, help="e.g. dbSNP / ADSP / EVA")
+    parser.add_argument("--genomeBuild", default="GRCh38")
+    parser.add_argument("--commit", action="store_true",
+                        help="persist the load (default: dry run)")
+    parser.add_argument("--test", action="store_true", help="stop after one batch")
+    parser.add_argument("--failAt", default=None, help="fail at this variant id")
+    parser.add_argument("--commitAfter", type=int, default=1 << 16,
+                        help="rows per device batch / checkpoint")
+    parser.add_argument("--chromosomeMap", default=None,
+                        help="TSV mapping seq accessions to chromosomes")
+    parser.add_argument("--noResume", action="store_true",
+                        help="ignore previous checkpoints for this file")
+    parser.add_argument("--skipExisting", action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="check the store for existing variants "
+                             "(--no-skipExisting disables, the reference's "
+                             "unchecked fast path)")
+    args = parser.parse_args(argv)
+
+    os.makedirs(args.storeDir, exist_ok=True)
+    manifest = os.path.join(args.storeDir, "manifest.json")
+    store = (
+        VariantStore.load(args.storeDir)
+        if os.path.exists(manifest)
+        else VariantStore(width=DEFAULT_ALLELE_WIDTH)
+    )
+    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    chrom_map = read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
+
+    loader = TpuVcfLoader(
+        store,
+        ledger,
+        datasource=args.datasource,
+        genome_build=args.genomeBuild,
+        batch_size=args.commitAfter,
+        skip_existing=args.skipExisting,
+        chromosome_map=chrom_map,
+        log=lambda *a: print(*a, file=sys.stderr),
+    )
+    counters = loader.load_file(
+        args.fileName,
+        commit=args.commit,
+        test=args.test,
+        fail_at=args.failAt,
+        mapping_path=args.fileName + ".mapping",
+        resume=not args.noResume,
+        # persist before every checkpoint so the durable store never lags
+        # the resume cursor (crash between them would silently skip rows)
+        persist=lambda: store.save(args.storeDir),
+    )
+    if args.commit:
+        store.save(args.storeDir)
+        print(f"COMMITTED {counters}", file=sys.stderr)
+    else:
+        print(f"ROLLING BACK (dry run) {counters}", file=sys.stderr)
+    print(counters["alg_id"])  # undo handle, like load_vcf_file.py:220
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""CLI: undo a load by algorithm-invocation id
+(``Load/bin/undo_variant_load.py`` equivalent — columnar mask delete instead
+of chunked SQL DELETE with back-off).
+
+Usage: python -m annotatedvdb_tpu.cli.undo_load --storeDir ./vdb --algId 3 --commit
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="undo a variant load")
+    parser.add_argument("--storeDir", required=True)
+    parser.add_argument("--algId", type=int, required=True)
+    parser.add_argument("--commit", action="store_true")
+    args = parser.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    removed = store.delete_by_algorithm(args.algId)
+    if args.commit:
+        store.save(args.storeDir)
+        ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+        ledger.undo(args.algId, removed)
+        print(f"COMMITTED: removed {removed} rows for algorithm {args.algId}",
+              file=sys.stderr)
+    else:
+        print(f"ROLLING BACK (dry run): would remove {removed} rows",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

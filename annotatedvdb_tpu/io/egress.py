@@ -1,0 +1,212 @@
+"""Egress: materialize device outputs into the reference's exact output shapes.
+
+String work (metaseq ids, primary keys, ltree paths, display-attribute JSON,
+COPY rows) happens only here, after the device pipeline — the reference
+builds these strings inside its per-variant hot loop
+(``vcf_variant_loader.py:318-341``).
+
+Output parity targets:
+- record primary key: ``chr:pos:ref:alt[:refsnp]`` for short alleles,
+  ``chr:pos:<VRS digest>[:refsnp]`` beyond 50bp combined
+  (``primary_key_generator.py:99-122``);
+- display attributes dict (``variant_annotator.py:134-241``) — built from
+  device class codes + normalized-length outputs, falling back to the scalar
+  oracle for rows the device flagged host_fallback;
+- COPY rows: '#'-delimited, NULL 'NULL', field order of
+  ``VCFVariantLoader.initialize_copy_sql`` (``vcf_variant_loader.py:104-113``)
+  = required fields + [ref_snp_id, is_multi_allelic, display_attributes,
+  allele_frequencies] (+ is_adsp_variant for ADSP sources).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from annotatedvdb_tpu import oracle
+from annotatedvdb_tpu.oracle.binindex import closed_form_path
+from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator
+from annotatedvdb_tpu.types import (
+    AnnotatedBatch,
+    VariantBatch,
+    VariantClass,
+    chromosome_label,
+    decode_allele,
+)
+from annotatedvdb_tpu.utils.strings import truncate, xstr
+
+VCF_COPY_FIELDS = [
+    "chromosome", "record_primary_key", "position", "metaseq_id", "bin_index",
+    "row_algorithm_id", "ref_snp_id", "is_multi_allelic", "display_attributes",
+    "allele_frequencies",
+]
+
+
+def decode_alleles(batch: VariantBatch) -> tuple[list, list]:
+    refs = [decode_allele(batch.ref[i], batch.ref_len[i]) for i in range(batch.n)]
+    alts = [decode_allele(batch.alt[i], batch.alt_len[i]) for i in range(batch.n)]
+    return refs, alts
+
+
+def metaseq_ids(batch: VariantBatch, refs=None, alts=None) -> list:
+    if refs is None:
+        refs, alts = decode_alleles(batch)
+    return [
+        f"{chromosome_label(batch.chrom[i])}:{int(batch.pos[i])}:{refs[i]}:{alts[i]}"
+        for i in range(batch.n)
+    ]
+
+
+def primary_keys(
+    batch: VariantBatch,
+    ann: AnnotatedBatch,
+    ref_snp: list,
+    digester: VrsDigestGenerator | None = None,
+    refs=None,
+    alts=None,
+) -> list:
+    """Record PKs with the reference's literal/digest split."""
+    if refs is None:
+        refs, alts = decode_alleles(batch)
+    needs_digest = np.asarray(ann.needs_digest)
+    out = []
+    for i in range(batch.n):
+        chrom = chromosome_label(batch.chrom[i])
+        parts = [chrom, str(int(batch.pos[i]))]
+        if needs_digest[i]:
+            if digester is None:
+                raise ValueError(
+                    "batch contains >50bp variants; a VrsDigestGenerator is required"
+                )
+            parts.append(
+                digester.compute_identifier(chrom, int(batch.pos[i]), refs[i], alts[i])
+            )
+        else:
+            parts.extend([refs[i], alts[i]])
+        if ref_snp[i]:
+            parts.append(ref_snp[i])
+        out.append(":".join(parts))
+    return out
+
+
+def bin_paths(batch: VariantBatch, ann: AnnotatedBatch) -> list:
+    level = np.asarray(ann.bin_level)
+    leaf = np.asarray(ann.leaf_bin)
+    return [
+        closed_form_path(
+            chromosome_label(batch.chrom[i], prefix=True), int(level[i]), int(leaf[i])
+        )
+        for i in range(batch.n)
+    ]
+
+
+_LONG = 100
+_SHORT = 8
+
+
+def display_attributes(
+    batch: VariantBatch, ann: AnnotatedBatch, rs_position=None, refs=None, alts=None
+) -> list:
+    """Per-row display-attribute dicts from device outputs.
+
+    Uses the device class code / normalized lengths / locations; string
+    assembly mirrors ``variant_annotator.py:134-241``.  Rows flagged
+    host_fallback are recomputed wholesale by the scalar oracle."""
+    if refs is None:
+        refs, alts = decode_alleles(batch)
+    cls = np.asarray(ann.variant_class)
+    host = np.asarray(ann.host_fallback)
+    prefix_len = np.asarray(ann.prefix_len)
+    loc_start = np.asarray(ann.location_start)
+    loc_end = np.asarray(ann.location_end)
+    is_dup = np.asarray(ann.is_dup_motif)
+
+    out = []
+    for i in range(batch.n):
+        ref, alt = refs[i], alts[i]
+        pos = int(batch.pos[i])
+        chrom = chromosome_label(batch.chrom[i])
+        if host[i]:
+            out.append(oracle.display_attributes(ref, alt, chrom, pos))
+            continue
+        p = int(prefix_len[i])
+        norm_ref, norm_alt = ref[p:], alt[p:]
+        d_ref, d_alt = norm_ref or "-", norm_alt or "-"
+        c = VariantClass(int(cls[i]))
+        attrs = {"location_start": int(loc_start[i]), "location_end": int(loc_end[i])}
+        if p > 0 or (norm_ref != ref or norm_alt != alt):
+            normalized = f"{chrom}:{pos}:{d_ref}:{d_alt}"
+            if normalized != f"{chrom}:{pos}:{ref}:{alt}":
+                attrs["normalized_metaseq_id"] = normalized
+        ins_prefix = "dup" if is_dup[i] else "ins"
+        if c == VariantClass.SNV:
+            attrs.update(display_allele=f"{ref}>{alt}", sequence_allele=f"{ref}/{alt}")
+        elif c == VariantClass.INVERSION:
+            attrs.update(
+                display_allele="inv" + ref,
+                sequence_allele=f"{truncate(ref, _SHORT)}/{truncate(alt, _SHORT)}",
+            )
+        elif c == VariantClass.MNV:
+            attrs.update(
+                display_allele=f"{d_ref}>{d_alt}",
+                sequence_allele=f"{truncate(d_ref, _SHORT)}/{truncate(d_alt, _SHORT)}",
+            )
+        elif c in (VariantClass.INS, VariantClass.DUP):
+            attrs.update(
+                display_allele=ins_prefix + truncate(norm_alt, _LONG),
+                sequence_allele=ins_prefix + truncate(norm_alt, _SHORT),
+            )
+        elif c == VariantClass.INDEL:
+            # deleted part: normalized ref when present, else ref minus anchor
+            deleted = norm_ref if norm_ref else ref[1:]
+            attrs.update(
+                display_allele="del"
+                + truncate(deleted, _LONG)
+                + ins_prefix
+                + truncate(norm_alt, _LONG),
+                sequence_allele=f"{truncate(d_ref, _SHORT)}/{truncate(d_alt, _SHORT)}",
+            )
+        else:  # DEL
+            attrs.update(
+                display_allele="del" + truncate(norm_ref, _LONG),
+                sequence_allele=f"{truncate(norm_ref, _SHORT)}/-",
+            )
+        attrs["variant_class"] = c.display_name
+        attrs["variant_class_abbrev"] = c.abbrev
+        out.append(attrs)
+    return out
+
+
+def copy_rows(
+    batch: VariantBatch,
+    ann: AnnotatedBatch,
+    pks: list,
+    bins: list,
+    display: list,
+    ref_snp: list,
+    frequencies: list,
+    is_multi_allelic: np.ndarray,
+    alg_id,
+    adsp: bool = False,
+    refs=None,
+    alts=None,
+) -> list:
+    """'#'-delimited COPY rows in the VCF-loader field order."""
+    mseq = metaseq_ids(batch, refs, alts)
+    rows = []
+    for i in range(batch.n):
+        values = [
+            "chr" + chromosome_label(batch.chrom[i]),
+            pks[i],
+            str(int(batch.pos[i])),
+            mseq[i],
+            bins[i],
+            xstr(alg_id),
+            xstr(ref_snp[i], null_str="NULL"),
+            xstr(bool(is_multi_allelic[i]), false_as_null=True, null_str="NULL"),
+            xstr(display[i], null_str="NULL"),
+            xstr(frequencies[i], null_str="NULL"),
+        ]
+        if adsp:
+            values.append(xstr(True))
+        rows.append("#".join(values))
+    return rows

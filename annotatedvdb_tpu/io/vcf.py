@@ -1,0 +1,215 @@
+"""Host-side VCF ingest: text chunks -> VariantBatch + per-row sidecar.
+
+Replaces the reference's per-line ``VcfEntryParser``
+(``Util/lib/python/parsers/vcf_parser.py:76-231``) with a batch reader that
+emits fixed-size ``VariantBatch`` arrays for the device pipeline plus a
+host-side sidecar (refsnp ids, FREQ-field frequencies, INFO access) for the
+egress path.  Behavioral parity notes:
+
+- multi-allelic entries expand to one row per alt allele; '.' alts are
+  skipped with a counter (``vcf_variant_loader.py:280-284``);
+- chromosome 'chr' prefixes are stripped and 'MT' folds to 'M'
+  (``vcf_parser.py:135-137``); an optional accession map translates RefSeq
+  ids (``parsers/chromosome_map_parser.py``);
+- refsnp comes from the ID column when it is an rs id, else from INFO ``RS``
+  (``vcf_parser.py:158-169``);
+- the variant id is the ID column unless '.'/rs, in which case it is the
+  full metaseq-style id (``vcf_parser.py:140-142``);
+- INFO ``FREQ=source:f1,f2|...`` per-population frequencies are matched to
+  each alt by index offset 1, zero/'.' entries dropped
+  (``vcf_parser.py:200-222``);
+- INFO strings scrub the ``\\x2c``/``\\x59``/'#' escapes that break JSON and
+  the '#' COPY delimiter (``vcf_parser.py:101-104``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import numpy as np
+
+from annotatedvdb_tpu.types import VariantBatch, chromosome_code
+from annotatedvdb_tpu.utils.strings import to_numeric
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
+    return open(path, "r", encoding="utf-8")
+
+
+def parse_info(info_str: str) -> dict:
+    """INFO field -> dict with numeric coercion and escape scrubbing."""
+    s = info_str.replace("\\x2c", ",").replace("\\x59", "/").replace("#", ":")
+    out = {}
+    for item in s.split(";"):
+        if "=" in item:
+            k, v = item.split("=", 1)
+            out[k] = to_numeric(v)
+        elif item:
+            out[item] = True
+    return out
+
+
+def parse_freq(info: dict, n_alts: int) -> list:
+    """Per-alt frequency dicts from the FREQ INFO field; None when absent/zero."""
+    raw = info.get("FREQ")
+    if raw is None:
+        return [None] * n_alts
+    pops = {}
+    for pop in str(raw).split("|"):
+        if ":" in pop:
+            name, freqs = pop.split(":", 1)
+            pops[name] = freqs.split(",")
+    out = []
+    for alt_index in range(1, n_alts + 1):
+        freqs = {}
+        for name, values in pops.items():
+            if alt_index < len(values) and values[alt_index] not in (".", "0"):
+                freqs[name] = {"gmaf": to_numeric(values[alt_index])}
+        out.append(freqs or None)
+    return out
+
+
+@dataclass
+class VcfChunk:
+    """One ingest batch: device arrays + host sidecar (aligned by row).
+
+    ``refs``/``alts`` hold the ORIGINAL allele strings — the device arrays
+    truncate at the batch width, so all host-side identity work (digest PKs,
+    display attributes, long-allele hashing) must read these, never decode
+    the device arrays."""
+
+    batch: VariantBatch
+    refs: list                 # original ref string, per row
+    alts: list                 # original alt string, per row
+    ref_snp: list              # 'rs...' string or None, per row
+    variant_id: list           # ID column or metaseq-style id, per row
+    is_multi_allelic: np.ndarray
+    frequencies: list          # per-row dict or None (FREQ field)
+    rs_position: list          # INFO RSPOS, per row
+    info: list                 # full INFO dict per row (shared across alts)
+    line_number: np.ndarray    # 1-based source line, per row
+    counters: dict = field(default_factory=dict)
+
+
+class VcfBatchReader:
+    """Stream a VCF into fixed-size per-alt row chunks.
+
+    ``batch_size`` rows per chunk (the final chunk is smaller); rows on
+    unplaceable contigs are skipped and counted, mirroring the reference's
+    standard-chromosome-only loads."""
+
+    def __init__(self, path: str, batch_size: int = 1 << 16, width: int = 49,
+                 chromosome_map: dict | None = None, identity_only: bool = False):
+        self.path = path
+        self.batch_size = batch_size
+        self.width = width
+        self.chromosome_map = chromosome_map
+        self.identity_only = identity_only
+
+    def __iter__(self) -> Iterator[VcfChunk]:
+        rows: list = []
+        counters = {"line": 0, "skipped_alt": 0, "skipped_contig": 0}
+        with _open_text(self.path) as fh:
+            for line_no, line in enumerate(fh, start=1):
+                if line.startswith("#") or not line.strip():
+                    continue
+                counters["line"] += 1
+                fields = line.rstrip("\n").split("\t")
+                chrom_str, pos_str, vid, ref, alt_str = fields[:5]
+                if self.chromosome_map is not None:
+                    chrom_str = self.chromosome_map.get(chrom_str, chrom_str)
+                code = chromosome_code(chrom_str)
+                if code == 0:
+                    counters["skipped_contig"] += 1
+                    continue
+                info = (
+                    parse_info(fields[7])
+                    if len(fields) > 7 and not self.identity_only
+                    else {}
+                )
+                alts = alt_str.split(",")
+                chrom_label = str(chrom_str)
+                if chrom_label.startswith("chr"):
+                    chrom_label = chrom_label[3:]
+                if chrom_label == "MT":
+                    chrom_label = "M"
+                ref_snp = None
+                if "rs" in vid:
+                    ref_snp = vid
+                elif "RS" in info:
+                    ref_snp = "rs" + str(info["RS"])
+                variant_id = (
+                    ":".join((chrom_label, pos_str, ref, alt_str))
+                    if vid == "." or vid.startswith("rs")
+                    else vid
+                )
+                freqs = parse_freq(info, len(alts))
+                multi = len(alts) > 1
+                for i, alt in enumerate(alts):
+                    if alt == ".":
+                        counters["skipped_alt"] += 1
+                        continue
+                    rows.append(
+                        (
+                            code,
+                            int(pos_str),
+                            ref,
+                            alt,
+                            ref_snp,
+                            variant_id,
+                            multi,
+                            freqs[i],
+                            info.get("RSPOS"),
+                            info,
+                            line_no,
+                        )
+                    )
+                # flush only at line boundaries: a checkpoint records whole
+                # lines as committed, so a multi-allelic line must never
+                # straddle two chunks
+                if len(rows) >= self.batch_size:
+                    yield self._emit(rows, counters)
+                    rows = []
+                    counters = {k: 0 for k in counters}
+        if rows:
+            yield self._emit(rows, counters)
+
+    def _emit(self, rows: list, counters: dict) -> VcfChunk:
+        batch = VariantBatch.from_tuples(
+            [(r[0], r[1], r[2], r[3]) for r in rows], width=self.width
+        )
+        # from_tuples re-derives chromosome codes from labels; codes are
+        # already resolved here, so set them directly.
+        batch = batch._replace(
+            chrom=np.array([r[0] for r in rows], dtype=np.int8)
+        )
+        return VcfChunk(
+            batch=batch,
+            refs=[r[2] for r in rows],
+            alts=[r[3] for r in rows],
+            ref_snp=[r[4] for r in rows],
+            variant_id=[r[5] for r in rows],
+            is_multi_allelic=np.array([r[6] for r in rows], dtype=bool),
+            frequencies=[r[7] for r in rows],
+            rs_position=[r[8] for r in rows],
+            info=[r[9] for r in rows],
+            line_number=np.array([r[10] for r in rows], dtype=np.int64),
+            counters=dict(counters),
+        )
+
+
+def read_chromosome_map(path: str) -> dict:
+    """TSV (accession <tab> chromosome [...]) -> {accession: chromosome}
+    (``parsers/chromosome_map_parser.py:49-62`` capability)."""
+    out = {}
+    with _open_text(path) as fh:
+        for line in fh:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) >= 2 and not line.startswith("#"):
+                out[parts[0]] = parts[1]
+    return out

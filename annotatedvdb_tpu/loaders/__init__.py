@@ -1,0 +1,3 @@
+from .vcf_loader import TpuVcfLoader
+
+__all__ = ["TpuVcfLoader"]

@@ -1,0 +1,262 @@
+"""End-to-end VCF load: the TPU-native ``load_vcf_file`` equivalent.
+
+Reference flow (``Load/bin/load_vcf_file.py:80-221`` +
+``vcf_variant_loader.py:259-391``): per line, per alt — parse, PK, duplicate
+check (one SQL round-trip), normalize, bin lookup (SQL on cache miss), build
+COPY string, flush every 500 rows.
+
+Here the batch is the unit: one jitted device program annotates the whole
+chunk (normalize + end location + class + bin), one hash + sort kernel
+dedups within the batch, one searchsorted join per chromosome shard replaces
+the per-variant exists checks, and egress strings are built only for rows
+that insert.  "Commit" = appending to the store + a ledger checkpoint of the
+input-line cursor; crash recovery replays from the last checkpoint
+idempotently (vs the reference's ``--resumeAfter`` log scan,
+``variant_loader.py:440-455``).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from annotatedvdb_tpu import oracle
+from annotatedvdb_tpu.io import egress
+from annotatedvdb_tpu.io.vcf import VcfBatchReader, VcfChunk
+from annotatedvdb_tpu.oracle.binindex import closed_form_bin
+from annotatedvdb_tpu.types import AnnotatedBatch, VariantBatch
+from annotatedvdb_tpu.models.pipeline import annotate_pipeline_jit
+from annotatedvdb_tpu.ops.dedup import mark_batch_duplicates_jit
+from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+_CHROM_MIX = np.uint32(0x9E3779B9)  # decorrelate chromosomes in batch dedup
+
+
+class TpuVcfLoader:
+    """Insert-or-skip VCF loads into a :class:`VariantStore`."""
+
+    def __init__(
+        self,
+        store: VariantStore,
+        ledger: AlgorithmLedger,
+        datasource: str | None = None,
+        genome_build: str = "GRCh38",
+        batch_size: int = 1 << 16,
+        skip_existing: bool = True,
+        digester: VrsDigestGenerator | None = None,
+        chromosome_map: dict | None = None,
+        log=print,
+    ):
+        self.store = store
+        self.ledger = ledger
+        self.datasource = datasource.lower() if datasource else None
+        self.batch_size = batch_size
+        self.skip_existing = skip_existing
+        self.digester = digester or VrsDigestGenerator(genome_build)
+        self.chromosome_map = chromosome_map
+        self.log = log
+        self.counters = {
+            "line": 0, "variant": 0, "skipped": 0, "duplicates": 0, "update": 0,
+        }
+
+    @property
+    def is_adsp(self) -> bool:
+        return self.datasource == "adsp"
+
+    def load_file(
+        self,
+        path: str,
+        commit: bool = False,
+        test: bool = False,
+        fail_at: str | None = None,
+        mapping_path: str | None = None,
+        resume: bool = True,
+        persist=None,
+    ) -> dict:
+        """Load one VCF; returns counters.
+
+        commit=False runs the full pipeline but discards mutations (the
+        reference's default-rollback dry-run integration test, SURVEY.md §4.2);
+        ``test`` stops after one batch; ``fail_at`` raises at a given variant
+        id (fault injection, ``load_vcf_file.py:224-228``).
+
+        ``persist`` (callable) is invoked before each ledger checkpoint so the
+        store's durable state never lags the resume cursor; without it,
+        checkpoints only guarantee in-process consistency (the CLI passes
+        ``store.save``)."""
+        alg_id = self.ledger.begin(
+            "TpuVcfLoader.load_file",
+            {"file": path, "datasource": self.datasource, "test": test},
+            commit,
+        )
+        resume_line = self.ledger.last_checkpoint(path) if resume else 0
+        if resume_line:
+            self.log(f"resuming {path} after committed line {resume_line}")
+        mapping_fh = open(mapping_path, "w") if mapping_path else None
+        try:
+            reader = VcfBatchReader(
+                path,
+                batch_size=self.batch_size,
+                width=self.store.width,
+                chromosome_map=self.chromosome_map,
+            )
+            for chunk in reader:
+                self.counters["line"] += chunk.counters.get("line", 0)
+                self.counters["skipped"] += chunk.counters.get("skipped_alt", 0)
+                self.counters["skipped"] += chunk.counters.get("skipped_contig", 0)
+                if resume_line and chunk.line_number[-1] <= resume_line:
+                    self.counters["skipped"] += chunk.batch.n
+                    continue
+                if fail_at is not None and fail_at in chunk.variant_id:
+                    raise RuntimeError(f"failAt variant reached: {fail_at}")
+                self._load_chunk(chunk, alg_id, commit, resume_line, mapping_fh)
+                if commit:
+                    if persist is not None:
+                        persist()
+                    self.ledger.checkpoint(
+                        alg_id, path, int(chunk.line_number[-1]), dict(self.counters)
+                    )
+                if test:
+                    self.log("test mode: stopping after first batch")
+                    break
+            self.ledger.finish(alg_id, dict(self.counters))
+        finally:
+            if mapping_fh:
+                mapping_fh.close()
+        self.counters["alg_id"] = alg_id
+        return dict(self.counters)
+
+    def _load_chunk(self, chunk: VcfChunk, alg_id, commit, resume_line, mapping_fh):
+        batch = chunk.batch
+        # ---- device pipeline: annotate + bin + hash + in-batch dedup
+        ann = annotate_pipeline_jit(
+            batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+        )
+        h = np.array(  # writable copy: long rows get re-hashed below
+            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+        )
+        host_rows = np.asarray(ann.host_fallback)
+        # long alleles are truncated in the device arrays: re-hash them from
+        # the original strings so identity never collides on a shared prefix
+        for i in np.where(host_rows)[0]:
+            h[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
+        mixed = h ^ (batch.chrom.astype(np.uint32) * _CHROM_MIX)
+        dup = np.asarray(
+            mark_batch_duplicates_jit(
+                batch.pos, mixed, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+            )
+        )
+        # replayed rows within a partially-committed chunk
+        replay = chunk.line_number <= resume_line
+
+        # ---- membership filtering first; egress strings only for inserts
+        insert_rows: list[np.ndarray] = []
+        for code in np.unique(batch.chrom):
+            rows = np.where((batch.chrom == code) & ~dup & ~replay)[0]
+            if rows.size == 0:
+                continue
+            shard = self.store.shard(code)
+            if self.skip_existing and shard.n:
+                found, _ = shard.lookup(
+                    batch.pos[rows], h[rows], batch.ref[rows], batch.alt[rows],
+                    batch.ref_len[rows], batch.alt_len[rows],
+                )
+                self.counters["duplicates"] += int(found.sum())
+                rows = rows[~found]
+            if rows.size:
+                # sorted by identity key for the sorted-merge append
+                key = (batch.pos[rows].astype(np.uint64) << np.uint64(32)) | h[rows]
+                insert_rows.append(rows[np.argsort(key, kind="stable")])
+        self.counters["duplicates"] += int(dup.sum())
+
+        if not insert_rows:
+            return
+        sel = np.concatenate(insert_rows)
+        sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
+        sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
+        refs = [chunk.refs[i] for i in sel]
+        alts = [chunk.alts[i] for i in sel]
+        ref_snp = [chunk.ref_snp[i] for i in sel]
+        rs_pos = [chunk.rs_position[i] for i in sel]
+        pks = egress.primary_keys(sub, sub_ann, ref_snp, self.digester, refs, alts)
+        display = egress.display_attributes(sub, sub_ann, rs_pos, refs, alts)
+        # device bin outputs are undefined for host-fallback rows: recompute
+        bin_level = np.asarray(sub_ann.bin_level).copy()
+        leaf_bin = np.asarray(sub_ann.leaf_bin).copy()
+        for j in np.where(np.asarray(sub_ann.host_fallback))[0]:
+            end = oracle.infer_end_location(refs[j], alts[j], int(sub.pos[j]))
+            bin_level[j], leaf_bin[j] = closed_form_bin(int(sub.pos[j]), end)
+        sub_ann = sub_ann._replace(bin_level=bin_level, leaf_bin=leaf_bin)
+        bins = egress.bin_paths(sub, sub_ann)
+        needs_digest = np.asarray(sub_ann.needs_digest)
+
+        if commit:
+            offset = 0
+            for rows in insert_rows:
+                k = rows.size
+                j = slice(offset, offset + k)
+                jj = np.arange(offset, offset + k)
+                code = batch.chrom[rows[0]]
+                self.store.shard(code).append(
+                    {
+                        "pos": sub.pos[j],
+                        "h": h[rows],
+                        "ref_len": sub.ref_len[j],
+                        "alt_len": sub.alt_len[j],
+                        "ref_snp": np.array(
+                            [_rs_number(r) for r in ref_snp[j]], np.int64
+                        ),
+                        "is_multi_allelic": chunk.is_multi_allelic[rows],
+                        "is_adsp_variant": np.full(k, 1 if self.is_adsp else -1, np.int8),
+                        "bin_level": bin_level[jj],
+                        "leaf_bin": leaf_bin[jj],
+                        "needs_digest": needs_digest[jj],
+                        "row_algorithm_id": np.full(k, alg_id, np.int32),
+                    },
+                    sub.ref[j],
+                    sub.alt[j],
+                    annotations={
+                        "display_attributes": display[offset : offset + k],
+                        "allele_frequencies": [chunk.frequencies[i] for i in rows],
+                    },
+                    digest_pk=[
+                        pks[jx] if needs_digest[jx] else None for jx in jj
+                    ],
+                )
+                offset += k
+        self.counters["variant"] += int(sel.size)
+
+        if mapping_fh is not None:
+            for j, i in enumerate(sel):
+                mapping_fh.write(
+                    json.dumps(
+                        {chunk.variant_id[i]: [
+                            {"primary_key": pks[j], "bin_index": bins[j]}
+                        ]}
+                    )
+                    + "\n"
+                )
+
+
+def _fnv32_str(ref: str, alt: str) -> np.uint32:
+    """Host FNV-1a over full allele strings (identity hash for rows wider
+    than the device arrays) — domain-separated from the device hash by
+    hashing lengths first, like ``ops/hashing.py``."""
+    h = np.uint32(2166136261)
+    prime = np.uint32(16777619)
+    data = bytes([len(ref) & 0xFF, len(alt) & 0xFF]) + ref.encode() + alt.encode()
+    for b in data:
+        h = np.uint32((int(h) ^ b) * int(prime) & 0xFFFFFFFF)
+    return h
+
+
+def _rs_number(ref_snp) -> int:
+    if not ref_snp or not str(ref_snp).startswith("rs"):
+        return -1
+    try:
+        return int(str(ref_snp)[2:])
+    except ValueError:
+        return -1

@@ -1,0 +1,112 @@
+"""Within-batch duplicate detection and batch-vs-store membership join.
+
+The reference does both through Postgres: per-variant ``exists`` checks via a
+``map_variants()`` SQL round-trip (``Util/lib/python/database/variant.py:287-309``)
+and 1000-id bulk lookups via a set-returning function (``:159-191``).  Here:
+
+- within-batch dedup = one lexicographic ``lax.sort`` on (pos, hash) carrying
+  the row index, then neighbor compare with full byte confirmation;
+- batch-vs-store membership = ``searchsorted`` of query keys into the store's
+  sorted (pos, hash) keys (store keys are built once per flush, on device,
+  and kept sorted host-side), with hash matches confirmed by byte equality
+  against the candidate row.
+
+Chromosome never enters the keys: the store is chromosome-sharded (one shard
+owns one chromosome's rows, mirroring the reference's LIST partitions,
+``createVariant.sql:24``), so all rows in a batch share a chromosome by the
+time they reach these kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_by_identity(pos, h, *payload):
+    """Sort rows by (pos, hash) lexicographically; returns sorted
+    (pos, hash, *payload).  Payload arrays must be rank-1 or rank-2 [N, W]."""
+    # lax.sort requires rank-1 operands; carry row index and gather payload.
+    idx = jnp.arange(pos.shape[0], dtype=jnp.int32)
+    pos_s, h_s, idx_s = jax.lax.sort((pos, h, idx), num_keys=2)
+    out = [x[idx_s] for x in payload]
+    return (pos_s, h_s, idx_s, *out)
+
+
+def mark_batch_duplicates(pos, h, ref, alt, ref_len, alt_len):
+    """Flag rows that duplicate an earlier row in the batch.
+
+    Returns (is_duplicate [N] bool, in original row order).  'Earlier' means
+    smaller original row index — matching the reference's first-wins
+    skip-duplicates policy on sequential file order
+    (``vcf_variant_loader.py`` duplicate counter / skipExisting flow)."""
+    n = pos.shape[0]
+    # carry original index through an identity sort that tiebreaks on index:
+    # sort by (pos, hash, index) so equal identities are in file order.
+    idx = jnp.arange(n, dtype=jnp.int32)
+    pos_s, h_s, idx_s = jax.lax.sort((pos, h, idx), num_keys=3)
+    ref_s, alt_s = ref[idx_s], alt[idx_s]
+    rlen_s, alen_s = ref_len[idx_s], alt_len[idx_s]
+
+    same_key = (pos_s[1:] == pos_s[:-1]) & (h_s[1:] == h_s[:-1])
+    same_len = (rlen_s[1:] == rlen_s[:-1]) & (alen_s[1:] == alen_s[:-1])
+    same_bytes = jnp.all(ref_s[1:] == ref_s[:-1], axis=1) & jnp.all(
+        alt_s[1:] == alt_s[:-1], axis=1
+    )
+    dup_next = same_key & same_len & same_bytes  # row i+1 duplicates row i
+    # chains of equal rows: every row after the first in a run is a duplicate.
+    dup_sorted = jnp.concatenate([jnp.zeros((1,), jnp.bool_), dup_next])
+    # scatter back to original order
+    return jnp.zeros((n,), jnp.bool_).at[idx_s].set(dup_sorted)
+
+
+def lookup_in_sorted(
+    store_pos, store_h, store_ref, store_alt, store_rlen, store_alen,
+    pos, h, ref, alt, ref_len, alt_len,
+):
+    """Membership of query rows in a (pos, hash)-sorted store slice.
+
+    Returns (found [N] bool, store_index [N] int32; -1 when absent).  The
+    store slice must be sorted by (pos, hash) with unique identities (the
+    store dedups on append).  Search is a two-level binary search: global
+    ``searchsorted`` on position, then a fixed-depth per-row binary search
+    for the hash inside the equal-position run (runs are multi-allelic
+    sites), then byte confirmation over the short run of equal (pos, hash)
+    keys."""
+    m = store_pos.shape[0]
+    lo = jnp.searchsorted(store_pos, pos, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(store_pos, pos, side="right").astype(jnp.int32)
+
+    # lower_bound of h in store_h[lo:hi) — 32 halvings cover any run length
+    l, r = lo, hi
+    for _ in range(32):
+        active = l < r
+        mid = (l + r) >> 1
+        less = store_h[jnp.clip(mid, 0, m - 1)] < h
+        l = jnp.where(active & less, mid + 1, l)
+        r = jnp.where(active & ~less, mid, r)
+
+    # confirm bytes over the (pos, hash)-equal run; different identities can
+    # collide on (pos, hash) only via a 2^-32 hash collision, so the run is
+    # effectively 1 row — probe a few to stay exact regardless.
+    found = jnp.zeros(pos.shape, jnp.bool_)
+    index = jnp.full(pos.shape, -1, jnp.int32)
+    for k in range(4):
+        i = jnp.clip(l + k, 0, m - 1)
+        cand = (
+            (l + k < hi)
+            & (store_pos[i] == pos)
+            & (store_h[i] == h)
+            & (store_rlen[i] == ref_len)
+            & (store_alen[i] == alt_len)
+            & jnp.all(store_ref[i] == ref, axis=1)
+            & jnp.all(store_alt[i] == alt, axis=1)
+        )
+        take = cand & ~found
+        found = found | cand
+        index = jnp.where(take, i, index)
+    return found, index
+
+
+mark_batch_duplicates_jit = jax.jit(mark_batch_duplicates)
+lookup_in_sorted_jit = jax.jit(lookup_in_sorted)

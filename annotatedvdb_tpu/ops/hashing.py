@@ -1,0 +1,41 @@
+"""Vectorized allele-identity hashing.
+
+The reference's variant identity is the metaseq string ``chr:pos:ref:alt``
+(``variant_annotator.py:124-126``), compared via SQL lookups.  On device the
+identity is (chrom, pos, allele hash): a 32-bit FNV-1a over
+(ref_len, alt_len, ref bytes, alt bytes).  The hash is used only to order and
+bucket rows — every hash match is confirmed with a full byte compare
+(``ops/dedup.py``), so collisions cost a false candidate, never a wrong
+answer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+FNV_OFFSET = jnp.uint32(2166136261)
+FNV_PRIME = jnp.uint32(16777619)
+
+
+def _fnv_step(h, byte):
+    return (h ^ byte.astype(jnp.uint32)) * FNV_PRIME
+
+
+def allele_hash(ref, alt, ref_len, alt_len):
+    """[N] uint32 hash of the allele identity (lengths + padded byte content).
+
+    Pad bytes are zeros and lengths are hashed first, so e.g. ref 'AA'/alt 'A'
+    and ref 'A'/alt 'AA' hash differently even though their padded
+    concatenations match."""
+    h = jnp.full(ref.shape[:1], FNV_OFFSET, jnp.uint32)
+    h = _fnv_step(h, ref_len.astype(jnp.uint32) & 0xFF)
+    h = _fnv_step(h, alt_len.astype(jnp.uint32) & 0xFF)
+    for i in range(ref.shape[1]):
+        h = _fnv_step(h, ref[:, i])
+    for i in range(alt.shape[1]):
+        h = _fnv_step(h, alt[:, i])
+    return h
+
+
+allele_hash_jit = jax.jit(allele_hash)

@@ -72,6 +72,16 @@ class BinTree:
         return best
 
 
+def closed_form_bin(start: int, end: int) -> tuple[int, int]:
+    """Scalar closed-form (level, leaf_bin) — host fallback mirror of the
+    device kernel (``ops/binindex.py``) for rows it cannot represent."""
+    a = (start - 1) // LEAF_SIZE
+    b = (end - 1) // LEAF_SIZE
+    x = a ^ b
+    level = 13 - min(13, x.bit_length())
+    return level, a
+
+
 def closed_form_path(chrom_label: str, level: int, leaf_bin: int) -> str:
     """ltree path from the closed-form (level, leaf-bin) pair the device kernel
     emits.  ``leaf_bin`` is the 0-based global level-13 bin of the start

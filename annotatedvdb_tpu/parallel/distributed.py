@@ -108,7 +108,7 @@ def distributed_annotate_step(mesh, batch: VariantBatch, capacity: int | None = 
         shard_map,
         mesh=mesh,
         in_specs=(spec,) * 6,
-        out_specs=(jax.tree.map(lambda _: spec, _annotated_specs()), spec, P(), P()),
+        out_specs=(jax.tree.map(lambda _: spec, _annotated_specs()), spec, P(), P(), P()),
         check_vma=False,
     )
     def step(chrom, pos, ref, alt, ref_len, alt_len):
@@ -127,8 +127,15 @@ def distributed_annotate_step(mesh, batch: VariantBatch, capacity: int | None = 
             counted.astype(jnp.int32), mode="drop"
         )
         counts = jax.lax.psum(counts, SHARD_AXIS)
-        valid = valid & (chrom > 0)
-        return ann, valid, counts, dropped
+        # contract: valid marks rows whose annotations are usable, so it
+        # matches `counts` exactly; host-fallback rows are reported separately
+        # for the caller's host path (row conservation:
+        # sum(counts) + n_fallback + dropped == pad-free input rows).
+        n_fallback = jax.lax.psum(
+            jnp.sum(valid & (chrom > 0) & ann.host_fallback, dtype=jnp.int32),
+            SHARD_AXIS,
+        )
+        return ann, counted, counts, dropped, n_fallback
 
     return step(batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len)
 

@@ -1,0 +1,4 @@
+from .variant_store import VariantStore, ChromosomeShard, JSONB_COLUMNS
+from .ledger import AlgorithmLedger
+
+__all__ = ["VariantStore", "ChromosomeShard", "JSONB_COLUMNS", "AlgorithmLedger"]

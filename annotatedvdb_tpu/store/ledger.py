@@ -1,0 +1,86 @@
+"""Algorithm-invocation ledger: load provenance + undo + resume checkpoints.
+
+Reference: every load inserts one row into ``AnnotatedVDB.AlgorithmInvocation``
+(script name, params JSON, commit mode) and stamps its serial id on every
+variant row so a load can be undone
+(``Util/lib/python/algorithm_invocation.py:10-52``,
+``Load/bin/undo_variant_load.py``).  Here the ledger is an append-only JSONL
+file; each entry also records per-batch **cursor checkpoints** (last committed
+line number per input file), which replaces the reference's
+``--resumeAfter <variantId>`` log-scanning resume
+(``variant_loader.py:349-354,440-455``) with idempotent batch replay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class AlgorithmLedger:
+    def __init__(self, path: str):
+        self.path = path
+        self._entries: list[dict] = []
+        if os.path.exists(path):
+            with open(path) as f:
+                self._entries = [json.loads(line) for line in f if line.strip()]
+
+    def _append(self, entry: dict) -> None:
+        self._entries.append(entry)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+    def begin(self, script: str, params: dict, commit: bool) -> int:
+        """Register a load; returns the new algorithm-invocation id (serial)."""
+        alg_id = 1 + max(
+            (e["alg_id"] for e in self._entries if "alg_id" in e), default=0
+        )
+        self._append(
+            {
+                "type": "invocation",
+                "alg_id": alg_id,
+                "script": script,
+                "params": params,
+                "commit_mode": commit,
+                "ts": time.time(),
+            }
+        )
+        return alg_id
+
+    def checkpoint(self, alg_id: int, input_file: str, line: int,
+                   counters: dict | None = None) -> None:
+        """Record a committed batch boundary (the resume cursor)."""
+        self._append(
+            {
+                "type": "checkpoint",
+                "alg_id": alg_id,
+                "file": input_file,
+                "line": line,
+                "counters": counters or {},
+                "ts": time.time(),
+            }
+        )
+
+    def finish(self, alg_id: int, counters: dict) -> None:
+        self._append(
+            {"type": "finish", "alg_id": alg_id, "counters": counters, "ts": time.time()}
+        )
+
+    def undo(self, alg_id: int, removed: int) -> None:
+        self._append(
+            {"type": "undo", "alg_id": alg_id, "removed": removed, "ts": time.time()}
+        )
+
+    def last_checkpoint(self, input_file: str) -> int:
+        """Last committed line for an input file (0 if none) — the idempotent
+        resume point."""
+        lines = [
+            e["line"]
+            for e in self._entries
+            if e.get("type") == "checkpoint" and e.get("file") == input_file
+        ]
+        return max(lines, default=0)
+
+    def invocations(self) -> list[dict]:
+        return [e for e in self._entries if e.get("type") == "invocation"]

@@ -31,10 +31,11 @@ def test_distributed_counts_match_single_device(rng):
     mesh = make_mesh(4)
     batch = VariantBatch.from_tuples(random_variants(rng, 256), width=24)
     # lossless capacity: no drops, exact count parity required
-    ann, valid, counts, dropped = distributed_annotate_step(
+    ann, valid, counts, dropped, n_fallback = distributed_annotate_step(
         mesh, batch, capacity=batch.n // 4
     )
     assert int(np.asarray(dropped)) == 0
+    assert int(np.asarray(n_fallback)) == 0
     assert int(np.asarray(counts).sum()) == batch.n
     from annotatedvdb_tpu.models.pipeline import AnnotationPipeline
 

@@ -1,0 +1,175 @@
+"""Store, dedup-kernel, and ledger tests."""
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.io.synth import synthetic_batch
+from annotatedvdb_tpu.store import VariantStore, AlgorithmLedger
+from annotatedvdb_tpu.types import VariantBatch
+
+from conftest import random_variants
+
+
+def hashes(batch):
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+
+    return np.asarray(allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len))
+
+
+def test_mark_batch_duplicates(rng):
+    from annotatedvdb_tpu.ops.dedup import mark_batch_duplicates_jit
+
+    variants = random_variants(rng, 100)
+    # duplicate some rows explicitly (identity ignores chromosome: batch-level
+    # dedup runs per chromosome shard)
+    variants = variants + [variants[3], variants[7], variants[7]]
+    batch = VariantBatch.from_tuples(variants, width=24)
+    h = hashes(batch)
+    dup = np.asarray(
+        mark_batch_duplicates_jit(batch.pos, h, batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+    )
+    # each injected copy flagged, originals kept
+    assert dup[100] and dup[101] and dup[102]
+    assert not dup[3] and not dup[7]
+    # python-oracle dedup over identity tuples must agree
+    seen, want = set(), []
+    for chrom, pos, ref, alt in variants:
+        key = (pos, ref, alt)
+        want.append(key in seen)
+        seen.add(key)
+    # rows at identical (pos, ref, alt) across different chromosomes would
+    # collide here; random_variants makes that vanishingly unlikely
+    np.testing.assert_array_equal(dup, want)
+
+
+def test_store_append_lookup_roundtrip(rng):
+    variants = random_variants(rng, 200)
+    batch = VariantBatch.from_tuples(variants, width=24)
+    h = hashes(batch)
+    store = VariantStore(width=24)
+    # split rows by chromosome into shards
+    for code in np.unique(batch.chrom):
+        m = batch.chrom == code
+        # dedup within shard first (store expects unique identities)
+        key = (batch.pos[m].astype(np.uint64) << np.uint64(32)) | h[m]
+        _, first = np.unique(key, return_index=True)
+        sel = np.where(m)[0][np.sort(first)]
+        store.shard(code).append(
+            {"pos": batch.pos[sel], "h": h[sel],
+             "ref_len": batch.ref_len[sel], "alt_len": batch.alt_len[sel],
+             "row_algorithm_id": np.full(len(sel), 1)},
+            batch.ref[sel], batch.alt[sel],
+        )
+    # every stored row must be found; identity fields must round-trip
+    for code in np.unique(batch.chrom):
+        m = batch.chrom == code
+        found, idx = store.shard(code).lookup(
+            batch.pos[m], h[m], batch.ref[m], batch.alt[m],
+            batch.ref_len[m], batch.alt_len[m],
+        )
+        assert found.all()
+        s = store.shard(code)
+        np.testing.assert_array_equal(s.cols["pos"][idx], batch.pos[m])
+    # absent rows must not be found
+    other = VariantBatch.from_tuples([("1", 42, "A", "TTT")], width=24)
+    oh = hashes(other)
+    found, idx = store.shard(int(batch.chrom[0])).lookup(
+        other.pos, oh, other.ref, other.alt, other.ref_len, other.alt_len
+    )
+    assert not found.any() and (idx == -1).all()
+
+
+def test_device_lookup_matches_host(rng):
+    """lookup_in_sorted kernel == host searchsorted membership."""
+    from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_jit
+
+    batch = synthetic_batch(512, width=16, seed=3)
+    h = hashes(batch)
+    # store = even rows (sorted); queries = all rows
+    key = (batch.pos.astype(np.uint64) << np.uint64(32)) | h
+    order = np.argsort(key[::2], kind="stable") * 2
+    s_pos, s_h = batch.pos[order], h[order]
+    s_ref, s_alt = batch.ref[order], batch.alt[order]
+    s_rl, s_al = batch.ref_len[order], batch.alt_len[order]
+    found, idx = lookup_in_sorted_jit(
+        s_pos, s_h, s_ref, s_alt, s_rl, s_al,
+        batch.pos, h, batch.ref, batch.alt, batch.ref_len, batch.alt_len,
+    )
+    found = np.asarray(found)
+    # every even row finds itself; odd rows almost surely absent
+    assert found[::2].all()
+    stored = {tuple(k) for k in np.stack([batch.pos[::2], h[::2]], 1)}
+    want_odd = np.array([(p, hh) in stored for p, hh in zip(batch.pos[1::2], h[1::2])])
+    np.testing.assert_array_equal(found[1::2], want_odd)
+
+
+def test_update_merge_semantics():
+    store = VariantStore(width=16)
+    b = synthetic_batch(4, width=16, seed=5)
+    h = hashes(b)
+    s = store.shard(1)
+    order = np.argsort((b.pos.astype(np.uint64) << np.uint64(32)) | h)
+    s.append(
+        {"pos": b.pos[order], "h": h[order], "ref_len": b.ref_len[order],
+         "alt_len": b.alt_len[order]},
+        b.ref[order], b.alt[order],
+        annotations={"allele_frequencies": [{"gnomad": {"af": 0.1}}, None, None, None]},
+    )
+    # jsonb_merge deep-merge: new source merges in, existing keys survive
+    n_up = s.update_annotation(
+        np.array([0, 1]), "allele_frequencies",
+        [{"gnomad": {"af_afr": 0.2}}, {"topmed": {"af": 0.5}}],
+    )
+    assert n_up == 2
+    assert s.annotations["allele_frequencies"][0] == {
+        "gnomad": {"af": 0.1, "af_afr": 0.2}
+    }
+    assert s.annotations["allele_frequencies"][1] == {"topmed": {"af": 0.5}}
+    # index -1 (not found) rows are skipped
+    assert s.update_annotation(np.array([-1]), "cadd_scores", [{"x": 1}]) == 0
+
+
+def test_undo_and_persistence(tmp_path, rng):
+    store = VariantStore(width=24)
+    batch = VariantBatch.from_tuples(random_variants(rng, 50), width=24)
+    h = hashes(batch)
+    for code in np.unique(batch.chrom):
+        m = np.where(batch.chrom == code)[0]
+        key = (batch.pos[m].astype(np.uint64) << np.uint64(32)) | h[m]
+        m = m[np.argsort(key)]
+        store.shard(code).append(
+            {"pos": batch.pos[m], "h": h[m], "ref_len": batch.ref_len[m],
+             "alt_len": batch.alt_len[m],
+             "row_algorithm_id": np.full(len(m), 7)},
+            batch.ref[m], batch.alt[m],
+        )
+    assert store.n == 50
+    # persistence round-trip
+    store.save(str(tmp_path / "vdb"))
+    loaded = VariantStore.load(str(tmp_path / "vdb"))
+    assert loaded.n == 50
+    code = int(batch.chrom[0])
+    np.testing.assert_array_equal(
+        loaded.shard(code).cols["pos"], store.shard(code).cols["pos"]
+    )
+    # undo drops everything stamped with alg 7
+    assert loaded.delete_by_algorithm(7) == 50
+    assert loaded.n == 0
+    assert loaded.delete_by_algorithm(7) == 0
+
+
+def test_ledger(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    ledger = AlgorithmLedger(path)
+    a1 = ledger.begin("load_vcf", {"file": "x.vcf"}, commit=True)
+    a2 = ledger.begin("load_vep", {"file": "y.json"}, commit=False)
+    assert (a1, a2) == (1, 2)
+    ledger.checkpoint(a1, "x.vcf", 500, {"variant": 480})
+    ledger.checkpoint(a1, "x.vcf", 1000, {"variant": 970})
+    ledger.finish(a1, {"variant": 970})
+    assert ledger.last_checkpoint("x.vcf") == 1000
+    assert ledger.last_checkpoint("unseen.vcf") == 0
+    # reload from disk: serial ids continue, checkpoints survive
+    ledger2 = AlgorithmLedger(path)
+    assert ledger2.begin("load_cadd", {}, True) == 3
+    assert ledger2.last_checkpoint("x.vcf") == 1000

@@ -1,0 +1,205 @@
+"""End-to-end VCF load slice tests (SURVEY.md §7.2 step 5)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu import oracle
+from annotatedvdb_tpu.io.vcf import VcfBatchReader, parse_freq, parse_info
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t10019\trs775809821\tTA\tT\t.\t.\tRS=775809821;RSPOS=10020
+1\t10039\trs978760828\tA\tC\t.\t.\tRS=978760828
+1\t10051\trs1052373574\tA\tG,T\t.\t.\tRS=1052373574;FREQ=GnomAD:0.9986,0.001353,.|Korea1K:0.9814,0.01861,0.1
+chr2\t20301\t.\tG\tGAA\t.\t.\t.
+MT\t263\trs2853515\tA\tG\t.\t.\tRS=2853515
+2\t30421\tsub1\tCCTT\tCATT\t.\t.\t.
+1\t10039\trs978760828\tA\tC\t.\t.\tRS=978760828
+3\t555\t.\tT\t.\t.\t.\t.
+chr1_KI270706v1_random\t100\t.\tA\tC\t.\t.\t.
+22\t11212877\t.\tTAAAATATCAAAGTACACCAAATACATATTATATACTGTACAC\tT\t.\t.\t.
+"""
+
+
+@pytest.fixture
+def vcf_file(tmp_path):
+    p = tmp_path / "sample.vcf"
+    p.write_text(VCF)
+    return str(p)
+
+
+def make_loader(tmp_path, **kw):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    return store, TpuVcfLoader(store, ledger, log=lambda *a: None, **kw)
+
+
+def test_reader_row_expansion(vcf_file):
+    chunks = list(VcfBatchReader(vcf_file, batch_size=100, width=49))
+    assert len(chunks) == 1
+    c = chunks[0]
+    # 10 data lines: 1 multi-allelic (2 alts), 1 '.' alt skipped, 1 alt contig
+    # skipped -> 7 lines with usable alts, multi-allelic adds 1 = 9 rows
+    assert c.batch.n == 9
+    assert c.counters["line"] == 10
+    assert c.counters["skipped_alt"] == 1
+    assert c.counters["skipped_contig"] == 1
+    # refsnp extraction: from ID and from INFO RS
+    assert c.ref_snp[0] == "rs775809821"
+    # MT folded to M (code 25)
+    assert 25 in c.batch.chrom
+    # multi-allelic FREQ matched per alt with index offset; '.' dropped
+    i_g = next(i for i in range(9) if c.variant_id[i] == "1:10051:A:G,T" and
+               c.batch.alt[i, 0] == ord("G"))
+    i_t = next(i for i in range(9) if c.variant_id[i] == "1:10051:A:G,T" and
+               c.batch.alt[i, 0] == ord("T"))
+    assert c.frequencies[i_g] == {"GnomAD": {"gmaf": 0.001353}, "Korea1K": {"gmaf": 0.01861}}
+    assert c.frequencies[i_t] == {"Korea1K": {"gmaf": 0.1}}  # GnomAD '.' dropped
+    assert c.is_multi_allelic[i_g] and c.is_multi_allelic[i_t]
+
+
+def test_info_escape_scrubbing():
+    info = parse_info(r"NOTE=a\x2cb\x59c#d;FLAG")
+    assert info["NOTE"] == "a,b/c:d"
+    assert info["FLAG"] is True
+
+
+def test_load_commit_and_dedupe(tmp_path, vcf_file):
+    store, loader = make_loader(tmp_path)
+    counters = loader.load_file(vcf_file, commit=True,
+                                mapping_path=str(tmp_path / "m.jsonl"))
+    # 9 rows, 1 exact duplicate line (rs978760828 repeated) -> 8 inserted
+    assert counters["variant"] == 8
+    assert counters["duplicates"] == 1
+    assert store.n == 8
+    # chromosome sharding: chr1 has 4 unique rows (TA>T, A>C, A>G, A>T)
+    assert store.shard(1).n == 4
+    assert store.shard(25).n == 1  # MT -> M
+    # display attributes stored and match the oracle
+    s = store.shard(2)
+    for i in range(s.n):
+        ref = bytes(s.ref[i][: s.cols["ref_len"][i]]).decode()
+        alt = bytes(s.alt[i][: s.cols["alt_len"][i]]).decode()
+        want = oracle.display_attributes(ref, alt, "2", int(s.cols["pos"][i]))
+        assert s.annotations["display_attributes"][i] == want
+    # mapping sidecar has PKs with refsnp suffixes
+    mapping = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
+    flat = {k: v for m in mapping for k, v in m.items()}
+    assert flat["1:10019:TA:T"][0]["primary_key"] == "1:10019:TA:T:rs775809821"
+    assert flat["1:10019:TA:T"][0]["bin_index"].startswith("chr1.L1.B1")
+    # loading the same file again: everything is a duplicate
+    counters2 = loader.load_file(vcf_file, commit=True, resume=False)
+    assert counters2["variant"] == counters["variant"]  # cumulative counter
+    assert store.n == 8
+
+
+def test_dry_run_mutates_nothing(tmp_path, vcf_file):
+    store, loader = make_loader(tmp_path)
+    counters = loader.load_file(vcf_file, commit=False)
+    assert counters["variant"] == 8  # counted as would-insert
+    assert store.n == 0
+
+
+def test_resume_from_checkpoint(tmp_path, vcf_file):
+    store, loader = make_loader(tmp_path, batch_size=4)
+    # fail mid-load at a variant in the second batch
+    with pytest.raises(RuntimeError, match="failAt"):
+        loader.load_file(vcf_file, commit=True, fail_at="sub1")
+    partial = store.n
+    assert 0 < partial < 8
+    # re-run: resumes after the last committed checkpoint, no double inserts
+    store2_counters = loader.load_file(vcf_file, commit=True)
+    assert store.n == 8
+    uniq = {
+        (int(c), int(p), int(h))
+        for c, s in store.shards.items()
+        for p, h in zip(s.cols["pos"], s.cols["h"])
+    }
+    assert len(uniq) == 8  # no double inserts from the replay
+
+
+def test_undo(tmp_path, vcf_file):
+    store, loader = make_loader(tmp_path)
+    counters = loader.load_file(vcf_file, commit=True)
+    alg = counters["alg_id"]
+    assert store.delete_by_algorithm(alg) == 8
+    assert store.n == 0
+
+
+def test_long_allele_digest_pk(tmp_path, vcf_file):
+    store, loader = make_loader(tmp_path)
+    loader.load_file(vcf_file, commit=True)
+    s = store.shard(22)
+    assert s.n == 1
+    # 43+1 <= 50: literal PK, no digest
+    assert not s.cols["needs_digest"][0]
+    # now a >50bp allele gets a digest PK stored on the host path
+    vcf2 = tmp_path / "long.vcf"
+    long_ref = "T" + "ACGT" * 15  # 61bp
+    vcf2.write_text(f"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n4\t900\t.\t{long_ref}\tT\t.\t.\t.\n")
+    loader.load_file(str(vcf2), commit=True)
+    s4 = store.shard(4)
+    assert s4.cols["needs_digest"][0]
+    pk = s4.digest_pk[0]
+    assert pk.startswith("4:900:") and len(pk.split(":")[2]) == 32  # sha512t24u
+
+
+def test_long_alleles_not_conflated(tmp_path):
+    """Two >width alleles sharing their first 49 bytes must stay distinct
+    (identity is re-hashed from the full strings), and digest PKs must be
+    computed over the full allele, not the device-truncated window."""
+    from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator
+
+    a = "T" + "A" * 60
+    b = "T" + "A" * 59 + "C"  # differs only at byte 61
+    vcf = tmp_path / "twins.vcf"
+    vcf.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        f"5\t777\t.\t{a}\tT\t.\t.\t.\n"
+        f"5\t777\t.\t{b}\tT\t.\t.\t.\n"
+    )
+    store, loader = make_loader(tmp_path)
+    counters = loader.load_file(str(vcf), commit=True)
+    assert counters["variant"] == 2
+    assert counters["duplicates"] == 0
+    assert store.shard(5).n == 2
+    pks = set(store.shard(5).digest_pk)
+    assert len(pks) == 2
+    want = VrsDigestGenerator("GRCh38").compute_identifier("5", 777, a, "T")
+    assert f"5:777:{want}" in pks
+
+
+def test_cli_roundtrip(tmp_path, vcf_file):
+    env_script = (
+        "import sys; sys.argv=['load_vcf','--fileName',%r,'--storeDir',%r,'--commit'];"
+        "from annotatedvdb_tpu.cli.load_vcf import main; sys.exit(main())"
+        % (vcf_file, str(tmp_path / "vdb"))
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", env_script],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "1"  # first algorithm invocation id
+    store = VariantStore.load(str(tmp_path / "vdb"))
+    assert store.n == 8
+    # undo CLI
+    undo_script = (
+        "import sys; sys.argv=['undo','--storeDir',%r,'--algId','1','--commit'];"
+        "from annotatedvdb_tpu.cli.undo_load import main; sys.exit(main())"
+        % (str(tmp_path / "vdb"),)
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", undo_script],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert VariantStore.load(str(tmp_path / "vdb")).n == 0
