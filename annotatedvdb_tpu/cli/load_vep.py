@@ -1,0 +1,60 @@
+"""CLI: annotate stored variants from Ensembl VEP JSON output
+(``Load/bin/load_vep_result.py`` equivalent; update-only).
+
+Usage: python -m annotatedvdb_tpu.cli.load_vep --fileName results.json[.gz] \
+           --storeDir ./vdb [--rankingFile ranks.txt] [--commit] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from annotatedvdb_tpu.conseq import ConsequenceRanker
+from annotatedvdb_tpu.loaders import TpuVepLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="load VEP JSON results")
+    parser.add_argument("--fileName", required=True)
+    parser.add_argument("--storeDir", required=True)
+    parser.add_argument("--rankingFile", default=None,
+                        help="consequence ranking TSV; omitted -> seeded from "
+                             "the VEP vocabulary and ranked by the ADSP rules")
+    parser.add_argument("--rankOnLoad", action="store_true",
+                        help="re-rank the ranking file on load")
+    parser.add_argument("--saveOnAddConsequence", action="store_true")
+    parser.add_argument("--datasource", default=None)
+    parser.add_argument("--commit", action="store_true")
+    parser.add_argument("--test", action="store_true")
+    parser.add_argument("--skipExisting", action="store_true",
+                        help="skip variants that already have vep_output")
+    args = parser.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    ranker = ConsequenceRanker(
+        args.rankingFile,
+        save_on_add=args.saveOnAddConsequence,
+        rank_on_load=args.rankOnLoad,
+    )
+    loader = TpuVepLoader(
+        store, ledger, ranker,
+        datasource=args.datasource,
+        skip_existing=args.skipExisting,
+        log=lambda *a: print(*a, file=sys.stderr),
+    )
+    counters = loader.load_file(args.fileName, commit=args.commit, test=args.test)
+    if args.commit:
+        store.save(args.storeDir)
+        print(f"COMMITTED {counters}", file=sys.stderr)
+    else:
+        print(f"ROLLING BACK (dry run) {counters}", file=sys.stderr)
+    print(counters["alg_id"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

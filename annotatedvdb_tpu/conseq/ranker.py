@@ -1,0 +1,200 @@
+"""ADSP consequence ranking service (host side).
+
+Re-implements the behavior of the reference's ``ConsequenceParser``
+(``Util/lib/python/parsers/adsp_consequence_parser.py``): a combo -> rank
+table loaded from a TSV, order-insensitive combo matching with memoization,
+and the learn-on-miss **dynamic re-rank** — when a novel combo appears, all
+combos are split into the four ADSP groups, each group's combos are ordered
+by an alphabetized per-term rank encoding and a three-key sort, and the whole
+table is renumbered (``adsp_consequence_parser.py:233-320``).
+
+This mutable, rare-path logic deliberately stays on host.  The hot path —
+ranking millions of consequence rows — uses the compiled device
+:class:`~annotatedvdb_tpu.conseq.table.RankTable` snapshot, refreshed after
+any re-rank (SURVEY.md §5.7 "isolate as a host-side service with versioned
+snapshots pushed to device").
+
+``int_to_alpha`` is Excel-style bijective base-26 (1->a, 27->aa), matching
+the observed sort behavior the reference gets from its external helper.
+"""
+
+from __future__ import annotations
+
+import os
+from datetime import date
+
+from annotatedvdb_tpu.conseq.groups import ConseqGroup
+
+
+def int_to_alpha(n: int) -> str:
+    """1 -> 'a', 26 -> 'z', 27 -> 'aa' (bijective base-26, lowercase)."""
+    out = []
+    while n > 0:
+        n, rem = divmod(n - 1, 26)
+        out.append(chr(ord("a") + rem))
+    return "".join(reversed(out))
+
+
+def alphabetize_combo(terms) -> str:
+    """Canonical comma-string for a combo: terms sorted alphabetically
+    (unique keys for the rank map)."""
+    if isinstance(terms, str):
+        terms = terms.split(",")
+    return ",".join(sorted(terms))
+
+
+class ConsequenceRanker:
+    def __init__(
+        self,
+        ranking_file: str | None = None,
+        save_on_add: bool = False,
+        rank_on_load: bool = False,
+    ):
+        """``ranking_file`` is a TSV with a ``consequence`` column and
+        optional ``rank`` column (load order = rank when absent); None seeds
+        from the single-term consequence vocabulary and ranks immediately."""
+        self.ranking_file = ranking_file
+        self.save_on_add = save_on_add
+        self.added: list[str] = []
+        self._match_memo: dict[str, int] = {}
+        self.version = 0
+        if ranking_file is not None:
+            # fail loudly on a bad path — silently falling back to the seed
+            # table would change every stored rank
+            self.rankings = self._parse_file(ranking_file)
+            self._rebuild_canonical()
+            if rank_on_load:
+                self._rerank()
+        else:
+            # seed: every single-term combo, ranked by the ADSP algorithm
+            self.rankings = {t: i + 1 for i, t in enumerate(ConseqGroup.all_terms())}
+            self._rerank()
+
+    @staticmethod
+    def _parse_file(path: str) -> dict:
+        out = {}
+        with open(path) as fh:
+            header = fh.readline().rstrip("\n").split("\t")
+            cols = {c: i for i, c in enumerate(header)}
+            rank = 1
+            for line in fh:
+                row = line.rstrip("\n").split("\t")
+                combo = alphabetize_combo(row[cols["consequence"]])
+                if "rank" in cols:
+                    out[combo] = int(row[cols["rank"]])
+                else:
+                    out[combo] = rank
+                    rank += 1
+        return out
+
+    def save(self, path: str | None = None) -> str:
+        """Versioned save (``adsp_consequence_parser.py:85-102``)."""
+        if path is None:
+            base = os.path.splitext(self.ranking_file or "consequence_ranking.txt")[0]
+            path = f"{base}_{date.today().strftime('%m-%d-%Y')}.txt"
+        if os.path.exists(path):
+            path = os.path.splitext(path)[0] + f"_v{len(self.added)}.txt"
+        with open(path, "w") as fh:
+            fh.write("consequence\trank\n")
+            for combo, rank in self.rankings.items():
+                fh.write(f"{combo}\t{rank}\n")
+        return path
+
+    # ---- matching ---------------------------------------------------------
+    # Table keys carry the re-rank's internal term order (the reference's
+    # keys do too, which is why it matches via is_equivalent_list scans,
+    # adsp_consequence_parser.py:182-186); here an order-insensitive
+    # canonical index replaces the O(table) scan.
+
+    def _rebuild_canonical(self) -> None:
+        self._canonical = {alphabetize_combo(k): k for k in self.rankings}
+
+    def rank_of(self, combo: str, fail_on_error: bool = False):
+        key = self._canonical.get(alphabetize_combo(combo))
+        if key is not None:
+            return self.rankings[key]
+        if fail_on_error:
+            raise IndexError(f"Consequence {combo} not found in ADSP rankings.")
+        return None
+
+    def find_matching_consequence(self, terms, fail_on_missing: bool = False) -> int:
+        """Order-insensitive combo match; learns novel combos by re-ranking
+        the whole table (``adsp_consequence_parser.py:169-200``)."""
+        if isinstance(terms, str):
+            terms = terms.split(",")
+        canon = alphabetize_combo(terms)
+        if canon not in self._match_memo:
+            rank = self.rank_of(canon)
+            if rank is None:
+                if fail_on_missing:
+                    raise IndexError(
+                        f"Consequence combination {','.join(terms)} not found "
+                        "in ADSP rankings."
+                    )
+                self._add_and_rerank(terms)
+                rank = self.rank_of(canon, fail_on_error=True)
+            self._match_memo[canon] = rank
+        return self._match_memo[canon]
+
+    def _add_and_rerank(self, terms) -> None:
+        canon = alphabetize_combo(terms)
+        if canon in self._canonical:
+            raise IndexError(
+                f"Attempted to add consequence combination {canon}, but already "
+                "in ADSP rankings."
+            )
+        # validate BEFORE mutating: an unknown VEP term must fail cleanly,
+        # not leave a poison combo that breaks every later re-rank
+        ConseqGroup.validate_terms([canon])
+        self.added.append(canon)
+        self.rankings[canon] = 0  # placeholder; renumbered by the re-rank
+        self._rerank()
+        if self.save_on_add and self.ranking_file:
+            self.save()
+
+    # ---- the four-group re-rank ------------------------------------------
+
+    def _rerank(self) -> None:
+        combos = list(self.rankings.keys())
+        ordered = []
+        for grp in ConseqGroup:
+            require_subset = grp is ConseqGroup.MODIFIER
+            members = grp.members(combos, require_subset)
+            if members:
+                ordered += self._sort_group(members, grp)
+        self.rankings = {c: i + 1 for i, c in enumerate(ordered)}
+        self._rebuild_canonical()
+        self._match_memo.clear()
+        self.version += 1
+
+    @staticmethod
+    def _sort_group(combos: list, grp: ConseqGroup) -> list:
+        """Order one group's combos: per-combo alphabetized rank-index string,
+        then the reference's three-key sort (alpha asc, length desc, first
+        char asc) (``adsp_consequence_parser.py:281-320``)."""
+        grp_dict = (
+            grp.indexed_dict()
+            if grp is ConseqGroup.MODIFIER
+            else ConseqGroup.HIGH_IMPACT.indexed_dict()
+        )
+        ref_dict = ConseqGroup.complete_indexed_dict()
+
+        indexed = []
+        for combo in combos:
+            terms = combo.split(",")
+            member = [t for t in terms if t in grp_dict]
+            nonmember = [t for t in terms if t not in grp_dict]
+            indexes = [grp_dict[t] for t in member] + [ref_dict[t] for t in nonmember]
+            alpha = sorted(int_to_alpha(x) for x in indexes)
+            # combo terms ordered by their rank indexes ('internal sort')
+            by_rank = [
+                t for t, _ in sorted(
+                    zip(member + nonmember, indexes), key=lambda kv: kv[1]
+                )
+            ]
+            indexed.append(("".join(alpha), by_rank))
+
+        indexed.sort(key=lambda x: x[0])
+        indexed.sort(key=lambda x: len(x[0]), reverse=True)
+        indexed.sort(key=lambda x: x[0][0])
+        return [",".join(terms) for _, terms in indexed]

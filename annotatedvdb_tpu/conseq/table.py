@@ -1,0 +1,107 @@
+"""Compiled device rank table: batched consequence-combo -> ADSP rank lookup.
+
+The reference ranks combos one at a time through Python set comparisons with
+memoization (``adsp_consequence_parser.py:169-200``).  Here the ranker's
+current table compiles to a device snapshot:
+
+- each term is one bit in a 64-bit vocabulary mask (stored as two uint32
+  lanes — TPU-friendly, no x64 needed);
+- combos are order-insensitive by construction (a set IS its bitmask);
+- lookup is a vectorized binary search over the sorted (hi, lo) mask table;
+- coding status is one mask AND against the CODING_CONSEQUENCES bits.
+
+Novel combos (mask not found) return rank 0; the host ranker learns them,
+bumps its version, and the caller rebuilds the snapshot — the
+learn-on-miss-mutable-global of the reference becomes an explicit
+host-service/device-snapshot split (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from annotatedvdb_tpu.conseq.groups import CODING_CONSEQUENCES
+from annotatedvdb_tpu.conseq.ranker import ConsequenceRanker
+
+
+class RankTable:
+    def __init__(self, ranker: ConsequenceRanker):
+        self.version = ranker.version
+        vocab_terms = sorted({t for c in ranker.rankings for t in c.split(",")})
+        # bit 63 is reserved as the unknown-term marker (see _mask)
+        if len(vocab_terms) > 63:
+            raise ValueError("consequence vocabulary exceeds 63 terms")
+        self.vocab = {t: i for i, t in enumerate(vocab_terms)}
+
+        masks = np.array(
+            [self._mask(c.split(",")) for c in ranker.rankings], dtype=np.uint64
+        )
+        ranks = np.array(list(ranker.rankings.values()), dtype=np.int32)
+        order = np.argsort(masks, kind="stable")
+        self._masks = masks[order]
+        self._ranks = ranks[order]
+        self.coding_mask = self._mask(
+            [t for t in CODING_CONSEQUENCES if t in self.vocab]
+        )
+        # device copies (uint32 lanes)
+        self.d_hi = jnp.asarray((self._masks >> np.uint64(32)).astype(np.uint32))
+        self.d_lo = jnp.asarray((self._masks & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        self.d_ranks = jnp.asarray(self._ranks)
+
+    def _mask(self, terms) -> np.uint64:
+        """Combo -> bitmask; any term outside the vocabulary sets the
+        reserved unknown bit (63) so the mask can never alias a known
+        combo's mask — unknown combos must return rank 0, not the rank of
+        their known subset."""
+        m = np.uint64(0)
+        for t in terms:
+            if t in self.vocab:
+                m |= np.uint64(1) << np.uint64(self.vocab[t])
+            else:
+                m |= np.uint64(1) << np.uint64(63)
+        return m
+
+    def encode(self, combos) -> np.ndarray:
+        """Host: combos (lists/comma-strings) -> [N] uint64 masks."""
+        out = np.empty(len(combos), np.uint64)
+        for i, c in enumerate(combos):
+            terms = c.split(",") if isinstance(c, str) else c
+            out[i] = self._mask(terms)
+        return out
+
+    def lookup_host(self, masks: np.ndarray) -> np.ndarray:
+        """Host-side batch lookup (numpy searchsorted); 0 = unknown combo."""
+        idx = np.searchsorted(self._masks, masks)
+        idx = np.clip(idx, 0, len(self._masks) - 1)
+        hit = self._masks[idx] == masks
+        return np.where(hit, self._ranks[idx], 0).astype(np.int32)
+
+    def lookup_device(self, hi, lo):
+        """Device batch lookup over (hi, lo) uint32 mask lanes; 0 = unknown.
+
+        Binary search over the sorted 64-bit masks using two-lane compares."""
+        return _rank_lookup(self.d_hi, self.d_lo, self.d_ranks, hi, lo)
+
+    def is_coding(self, masks: np.ndarray) -> np.ndarray:
+        return (masks & self.coding_mask) != 0
+
+
+@jax.jit
+def _rank_lookup(table_hi, table_lo, table_ranks, hi, lo):
+    m = table_hi.shape[0]
+    l = jnp.zeros(hi.shape, jnp.int32)
+    r = jnp.full(hi.shape, m, jnp.int32)
+    for _ in range(32):  # m < 2^32 combos, plenty
+        active = l < r
+        mid = (l + r) >> 1
+        mh = table_hi[jnp.clip(mid, 0, m - 1)]
+        ml = table_lo[jnp.clip(mid, 0, m - 1)]
+        less = (mh < hi) | ((mh == hi) & (ml < lo))
+        l = jnp.where(active & less, mid + 1, l)
+        r = jnp.where(active & ~less, mid, r)
+    i = jnp.clip(l, 0, m - 1)
+    hit = (table_hi[i] == hi) & (table_lo[i] == lo) & (l < m)
+    return jnp.where(hit, table_ranks[i], 0)

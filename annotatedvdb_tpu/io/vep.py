@@ -1,0 +1,160 @@
+"""VEP JSON result parsing: ADSP ranking/sorting + frequency extraction.
+
+Host-side equivalent of the reference's ``VepJsonParser``
+(``Util/lib/python/parsers/vep_parser.py``), operating on one VEP result
+dict at a time (the loader streams them in batches):
+
+- the four consequence blocks (transcript / regulatory_feature /
+  motif_feature / intergenic) are re-keyed per variant allele, each conseq
+  gets its ADSP rank + coding flag, and lists sort by
+  (rank, original VEP order) (``vep_parser.py:103-175``);
+- frequencies come from ``colocated_variants`` with COSMIC entries filtered
+  and refsnp disambiguation when several co-located variants carry
+  frequencies (``vep_parser.py:178-216``), grouped by source into
+  GnomAD / 1000Genomes / ESP buckets (``vep_parser.py:235-254``);
+- ``cleaned_result`` drops the extracted blocks so the stored ``vep_output``
+  JSONB isn't double-loaded (``vep_variant_loader.py:111-123``).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from annotatedvdb_tpu.conseq import ConsequenceRanker, is_coding_consequence
+
+CONSEQUENCE_TYPES = ["transcript", "regulatory_feature", "motif_feature", "intergenic"]
+
+_ESP_KEYS = ("aa", "ea")
+
+
+class VepResultParser:
+    def __init__(self, ranker: ConsequenceRanker):
+        self.ranker = ranker
+        self._rank_memo: dict[str, dict] = {}
+
+    # ---- consequences -----------------------------------------------------
+
+    def _ranked(self, conseq: dict) -> dict:
+        terms = conseq["consequence_terms"]
+        key = ",".join(terms)
+        if key not in self._rank_memo:
+            self._rank_memo[key] = {
+                "rank": self.ranker.find_matching_consequence(terms),
+                "consequence_is_coding": is_coding_consequence(terms),
+            }
+        conseq.update(self._rank_memo[key])
+        return conseq
+
+    def rank_and_sort(self, annotation: dict) -> dict:
+        """Mutates ``annotation``: each '<ctype>_consequences' list becomes a
+        per-allele dict of rank-sorted consequence dicts."""
+        for ctype in CONSEQUENCE_TYPES:
+            key = ctype + "_consequences"
+            conseqs = annotation.get(key)
+            if conseqs is None:
+                continue
+            by_allele: dict[str, list] = {}
+            for index, conseq in enumerate(conseqs):
+                conseq["vep_consequence_order_num"] = index
+                by_allele.setdefault(conseq["variant_allele"], []).append(
+                    self._ranked(conseq)
+                )
+            for allele in by_allele:
+                by_allele[allele].sort(
+                    key=lambda c: (c["rank"], c["vep_consequence_order_num"])
+                )
+            annotation[key] = by_allele
+        return annotation
+
+    @staticmethod
+    def allele_consequences(annotation: dict, allele: str, ctype: str | None = None):
+        """Consequences for one (normalized) allele; all types when
+        ``ctype`` is None (``vep_parser.py:299-323``)."""
+        if ctype is None:
+            out = {}
+            for ct in CONSEQUENCE_TYPES:
+                key = ct + "_consequences"
+                conseqs = annotation.get(key)
+                if conseqs and allele in conseqs:
+                    out[key] = conseqs[allele]
+            return out or None
+        conseqs = annotation.get(ctype + "_consequences")
+        return conseqs.get(allele) if conseqs else None
+
+    @classmethod
+    def most_severe_consequence(cls, annotation: dict, allele: str):
+        """First hit walking transcript -> regulatory -> motif -> intergenic
+        (``vep_parser.py:326-340``)."""
+        for ctype in CONSEQUENCE_TYPES:
+            conseqs = cls.allele_consequences(annotation, allele, ctype)
+            if conseqs:
+                return conseqs[0]
+        return None
+
+    # ---- frequencies ------------------------------------------------------
+
+    @classmethod
+    def frequencies(cls, annotation: dict, matching_variant_id=None):
+        cv = annotation.get("colocated_variants")
+        if not cv:
+            return None
+        if len(cv) > 1:
+            frequencies = None
+            for covar in cv:
+                if covar.get("allele_string") == "COSMIC_MUTATION":
+                    continue
+                if "frequencies" not in covar:
+                    continue
+                if matching_variant_id is not None:
+                    if covar.get("id") == matching_variant_id:
+                        frequencies = cls._extract_frequencies(covar)
+                else:
+                    frequencies = cls._extract_frequencies(covar)
+            return frequencies
+        if "frequencies" in cv[0]:
+            return cls._extract_frequencies(cv[0])
+        return None
+
+    @classmethod
+    def _extract_frequencies(cls, covar: dict) -> dict:
+        out = {}
+        if "minor_allele" in covar:
+            out["minor_allele"] = covar["minor_allele"]
+            if "minor_allele_freq" in covar:
+                out["minor_allele_freq"] = covar["minor_allele_freq"]
+        out["values"] = cls._group_by_source(covar.get("frequencies"))
+        return out
+
+    @staticmethod
+    def _group_by_source(frequencies):
+        if frequencies is None:
+            return None
+        result: dict = {}
+        for allele, values in frequencies.items():
+            gnomad = {k: v for k, v in values.items() if "gnomad" in k}
+            esp = {k: v for k, v in values.items() if k in _ESP_KEYS}
+            genomes = {
+                k: v for k, v in values.items()
+                if "gnomad" not in k and k not in _ESP_KEYS
+            }
+            buckets = {}
+            if gnomad:
+                buckets["GnomAD"] = gnomad
+            if genomes:
+                buckets["1000Genomes"] = genomes
+            if esp:
+                buckets["ESP"] = esp
+            if buckets:
+                result[allele] = buckets
+        return result
+
+    # ---- cleaned result ---------------------------------------------------
+
+    @staticmethod
+    def cleaned_result(annotation: dict) -> dict:
+        """Deep copy minus the extracted blocks (``vep_variant_loader.py:111-123``)."""
+        result = deepcopy(annotation)
+        result.pop("colocated_variants", None)
+        for ctype in CONSEQUENCE_TYPES:
+            result.pop(ctype + "_consequences", None)
+        return result

@@ -1,3 +1,4 @@
 from .vcf_loader import TpuVcfLoader
+from .vep_loader import TpuVepLoader
 
-__all__ = ["TpuVcfLoader"]
+__all__ = ["TpuVcfLoader", "TpuVepLoader"]

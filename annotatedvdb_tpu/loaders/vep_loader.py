@@ -1,0 +1,219 @@
+"""VEP result load: update-only annotation of existing store rows.
+
+Reference flow (``Load/bin/load_vep_result.py`` +
+``Util/lib/python/loaders/vep_variant_loader.py``): stream VEP JSON lines;
+per line, rank+sort the consequence blocks, re-parse the embedded VCF
+``input`` entry, and per alt allele — PK lookup (SQL), skip/update existing
+``vep_output``, match frequencies and consequences via the **left-normalized**
+allele ('-' placeholder for emptied alleles, the VEP convention), then batch
+``jsonb_merge`` UPDATEs.
+
+Here the per-alt rows accumulate into device batches: one annotate-kernel
+call yields the normalized-allele split points for the whole batch, one
+sorted-merge lookup per chromosome shard resolves PK rows, and updates apply
+with deep-merge semantics into the store's JSONB columns.  Consequence
+ranking rides the memoized host ranker (novel combos re-rank and are logged,
+``load_vep_result.py:190-191``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io as _io
+import json
+
+import numpy as np
+
+from annotatedvdb_tpu.conseq import ConsequenceRanker
+from annotatedvdb_tpu.io.vep import VepResultParser
+from annotatedvdb_tpu.models.pipeline import annotate_pipeline_jit
+from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+from copy import deepcopy
+
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.types import VariantBatch, chromosome_code
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return _io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
+    return open(path, "r", encoding="utf-8")
+
+
+class TpuVepLoader:
+    """Update-only loader: annotates variants already present in the store."""
+
+    def __init__(
+        self,
+        store: VariantStore,
+        ledger: AlgorithmLedger,
+        ranker: ConsequenceRanker,
+        datasource: str | None = None,
+        skip_existing: bool = False,
+        batch_size: int = 1 << 14,
+        log=print,
+    ):
+        self.store = store
+        self.ledger = ledger
+        self.parser = VepResultParser(ranker)
+        self.datasource = datasource.lower() if datasource else None
+        self.skip_existing = skip_existing
+        self.batch_size = batch_size
+        self.log = log
+        self.counters = {
+            "line": 0, "variant": 0, "skipped": 0, "duplicates": 0,
+            "update": 0, "not_found": 0,
+        }
+
+    @property
+    def is_adsp(self) -> bool:
+        return self.datasource == "adsp"
+
+    @property
+    def is_dbsnp(self) -> bool:
+        return self.datasource == "dbsnp"
+
+    def load_file(self, path: str, commit: bool = False, test: bool = False) -> dict:
+        alg_id = self.ledger.begin(
+            "TpuVepLoader.load_file", {"file": path, "datasource": self.datasource},
+            commit,
+        )
+        pending: list[dict] = []
+        n_added_before = len(self.parser.ranker.added)
+        for line in _open_text(path):
+            if not line.strip():
+                continue
+            self.counters["line"] += 1
+            pending.extend(self._parse_result(json.loads(line)))
+            if len(pending) >= self.batch_size:
+                self._apply_batch(pending, alg_id, commit)
+                pending = []
+                if test:
+                    break
+        if pending:
+            self._apply_batch(pending, alg_id, commit)
+        added = self.parser.ranker.added[n_added_before:]
+        if added:
+            self.log(f"added {len(added)} new consequence combos: {added}")
+        self.ledger.finish(alg_id, dict(self.counters))
+        self.counters["alg_id"] = alg_id
+        return dict(self.counters)
+
+    # ------------------------------------------------------------------
+
+    def _parse_result(self, annotation: dict) -> list[dict]:
+        """One VEP result -> per-alt pending update rows."""
+        self.parser.rank_and_sort(annotation)
+        entry = annotation["input"]
+        if isinstance(entry, str):
+            fields = entry.rstrip("\n").split("\t")
+        else:  # pre-parsed dict (ADSP identity-only runs)
+            fields = [entry.get(k, ".") for k in ("chrom", "pos", "id", "ref", "alt")]
+        chrom_str, pos_str, vid, ref, alt_str = [str(f) for f in fields[:5]]
+        # structured replacement for the raw input string
+        # (vep_variant_loader.py:279-281)
+        annotation["input"] = {
+            "chrom": chrom_str, "pos": int(pos_str), "id": vid,
+            "ref": ref, "alt": alt_str,
+        }
+        code = chromosome_code(chrom_str)
+        if code == 0:
+            self.counters["skipped"] += 1
+            return []
+        ref_snp = vid if vid.startswith("rs") else None
+        matching_id = ref_snp if self.is_dbsnp else None
+        freqs = VepResultParser.frequencies(annotation, matching_id)
+        freq_values = freqs["values"] if freqs else None
+        cleaned = VepResultParser.cleaned_result(annotation)
+
+        rows = []
+        for alt in alt_str.split(","):
+            if alt == ".":
+                self.counters["skipped"] += 1
+                continue
+            self.counters["variant"] += 1
+            rows.append(
+                {
+                    "chrom": code,
+                    "pos": int(pos_str),
+                    "ref": ref,
+                    "alt": alt,
+                    "annotation": annotation,
+                    "freq_values": freq_values,
+                    "cleaned": cleaned,
+                }
+            )
+        return rows
+
+    def _apply_batch(self, rows: list[dict], alg_id: int, commit: bool) -> None:
+        batch = VariantBatch.from_tuples(
+            [("1", r["pos"], r["ref"], r["alt"]) for r in rows],
+            width=self.store.width,
+        )
+        batch = batch._replace(
+            chrom=np.array([r["chrom"] for r in rows], dtype=np.int8)
+        )
+        ann = annotate_pipeline_jit(
+            batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+        )
+        h = np.array(
+            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+        )
+        prefix = np.asarray(ann.prefix_len)
+        host = np.asarray(ann.host_fallback)
+        from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
+        from annotatedvdb_tpu.oracle import normalize_alleles
+
+        for code in np.unique(batch.chrom):
+            sel = np.where(batch.chrom == code)[0]
+            for i in sel[host[sel]]:
+                h[i] = _fnv32_str(rows[i]["ref"], rows[i]["alt"])
+            shard = self.store.shard(code)
+            found, idx = shard.lookup(
+                batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
+                batch.ref_len[sel], batch.alt_len[sel],
+            )
+            for j, i in enumerate(sel):
+                if not found[j]:
+                    self.counters["not_found"] += 1
+                    continue
+                row_idx = int(idx[j])
+                r = rows[i]
+                if shard.annotations["vep_output"][row_idx] is not None:
+                    if self.skip_existing:
+                        self.counters["duplicates"] += 1
+                        continue
+                # normalized alleles key the VEP frequency/consequence maps
+                if host[i]:
+                    norm_ref, norm_alt = normalize_alleles(
+                        r["ref"], r["alt"], snv_div_minus=True
+                    )
+                else:
+                    p = int(prefix[i])
+                    norm_alt = r["alt"][p:] or "-"
+                allele_freq = None
+                if r["freq_values"] and norm_alt in r["freq_values"]:
+                    allele_freq = r["freq_values"][norm_alt]
+                ms = VepResultParser.most_severe_consequence(r["annotation"], norm_alt)
+                ranked = VepResultParser.allele_consequences(r["annotation"], norm_alt)
+                if commit:
+                    one = np.array([row_idx])
+                    # all four columns take jsonb_merge semantics (they are
+                    # JSONB_UPDATE_FIELDS in the reference,
+                    # variant_loader.py:75-76): merging {} is a no-op, so an
+                    # empty new value never wipes stored data
+                    if allele_freq is not None:
+                        shard.update_annotation(one, "allele_frequencies", [allele_freq])
+                    shard.update_annotation(
+                        one, "adsp_most_severe_consequence", [deepcopy(ms) if ms else {}]
+                    )
+                    shard.update_annotation(
+                        one, "adsp_ranked_consequences", [deepcopy(ranked) if ranked else {}]
+                    )
+                    # per-row copy: multi-allelic rows must not alias one
+                    # shared dict inside the store
+                    shard.update_annotation(one, "vep_output", [deepcopy(r["cleaned"])])
+                    shard.cols["row_algorithm_id"][row_idx] = alg_id
+                    if self.is_adsp:
+                        shard.cols["is_adsp_variant"][row_idx] = 1
+                self.counters["update"] += 1

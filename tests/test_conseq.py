@@ -1,0 +1,119 @@
+"""Consequence ranking tests — modeled on the reference's manual
+test_conseq_parser.py smoke flow (SURVEY.md §4.1), now with assertions."""
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.conseq import (
+    ConseqGroup,
+    ConsequenceRanker,
+    RankTable,
+    is_coding_consequence,
+)
+from annotatedvdb_tpu.conseq.ranker import alphabetize_combo, int_to_alpha
+
+
+def test_int_to_alpha():
+    assert int_to_alpha(1) == "a"
+    assert int_to_alpha(26) == "z"
+    assert int_to_alpha(27) == "aa"
+    assert int_to_alpha(28) == "ab"
+
+
+def test_group_membership_rules():
+    combos = [
+        "missense_variant",
+        "missense_variant,NMD_transcript_variant",
+        "intron_variant",
+        "intron_variant,non_coding_transcript_variant",
+        "splice_region_variant,non_coding_transcript_exon_variant",
+    ]
+    # HIGH_IMPACT excludes NMD/non-coding overlaps
+    assert ConseqGroup.HIGH_IMPACT.members(combos) == ["missense_variant"]
+    assert ConseqGroup.NMD.members(combos) == [
+        "missense_variant,NMD_transcript_variant"
+    ]
+    assert ConseqGroup.NON_CODING_TRANSCRIPT.members(combos) == [
+        "intron_variant,non_coding_transcript_variant",
+        "splice_region_variant,non_coding_transcript_exon_variant",
+    ]
+    # MODIFIER requires full subset
+    assert ConseqGroup.MODIFIER.members(combos, require_subset=True) == [
+        "intron_variant",
+        "intron_variant,non_coding_transcript_variant",
+    ]
+    with pytest.raises(IndexError, match="invalid consequence"):
+        ConseqGroup.validate_terms(["fake_term"])
+
+
+def test_ranker_seed_order_and_groups():
+    r = ConsequenceRanker()
+    ranks = r.rankings
+    # every single-term combo is ranked; ranks are unique (gaps are expected:
+    # combos in both the non-coding and MODIFIER groups occupy two slots in
+    # the ordered list and the indexed dict keeps the later one, matching the
+    # reference's list_to_indexed_dict behavior)
+    assert len(set(ranks.values())) == len(ranks)
+    # group ordering: any HIGH_IMPACT term outranks (smaller rank) any
+    # NMD/non-coding/modifier-only combo
+    assert ranks["missense_variant"] < ranks["NMD_transcript_variant"]
+    assert ranks["NMD_transcript_variant"] < ranks["non_coding_transcript_variant"]
+    assert ranks["stop_gained"] < ranks["intron_variant"]
+
+
+def test_novel_combo_learned_and_reranked(tmp_path):
+    r = ConsequenceRanker()
+    before = dict(r.rankings)
+    v0 = r.version
+    rank = r.find_matching_consequence(["stop_gained", "missense_variant"])
+    assert rank is not None and rank >= 1
+    assert r.version == v0 + 1
+    assert r.rank_of("stop_gained,missense_variant") == rank
+    assert r.added == ["missense_variant,stop_gained"]
+    # the stored key carries the internal rank order (stop_gained outranks
+    # missense), matching the reference's re-rank output keys
+    assert "stop_gained,missense_variant" in r.rankings
+    # order-insensitive: same combo in any order hits the memo/known key
+    assert r.find_matching_consequence(["missense_variant", "stop_gained"]) == rank
+    assert r.version == v0 + 1  # no second re-rank
+    # table renumbered consistently: one new combo, still unique ranks
+    assert len(r.rankings) == len(before) + 1
+    assert len(set(r.rankings.values())) == len(r.rankings)
+
+
+def test_ranking_file_roundtrip(tmp_path):
+    r = ConsequenceRanker()
+    r.find_matching_consequence(["intron_variant", "downstream_gene_variant"])
+    path = r.save(str(tmp_path / "ranks.txt"))
+    canon = lambda rk: {alphabetize_combo(k): v for k, v in rk.rankings.items()}
+    r2 = ConsequenceRanker(path)
+    assert canon(r2) == canon(r)
+    # rank_on_load reproduces the same ordering (idempotent re-rank)
+    r3 = ConsequenceRanker(path, rank_on_load=True)
+    assert canon(r3) == canon(r)
+
+
+def test_rank_table_host_device_parity():
+    r = ConsequenceRanker()
+    r.find_matching_consequence(["stop_gained", "splice_region_variant"])
+    t = RankTable(r)
+    combos = list(r.rankings.keys()) + ["totally_unknown_combo"]
+    masks = t.encode(combos)
+    host = t.lookup_host(masks)
+    hi = np.asarray((masks >> np.uint64(32)).astype(np.uint32))
+    lo = np.asarray((masks & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    dev = np.asarray(t.lookup_device(hi, lo))
+    np.testing.assert_array_equal(host, dev)
+    # known combos resolve to their ranks; unknown -> 0
+    for combo, got in zip(combos[:-1], host[:-1]):
+        assert got == r.rankings[combo]
+    assert host[-1] == 0
+    # order-insensitivity: shuffled term order gives the same mask
+    a = t.encode(["missense_variant,stop_gained"])
+    b = t.encode(["stop_gained,missense_variant"])
+    assert a[0] == b[0]
+
+
+def test_is_coding():
+    assert is_coding_consequence("missense_variant,intron_variant")
+    assert not is_coding_consequence(["intron_variant", "upstream_gene_variant"])

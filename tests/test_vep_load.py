@@ -1,0 +1,163 @@
+"""VEP parser + update-only VEP load tests."""
+
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.conseq import ConsequenceRanker
+from annotatedvdb_tpu.io.vep import VepResultParser
+from annotatedvdb_tpu.loaders import TpuVcfLoader, TpuVepLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+VCF = """#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t10039\trs978760828\tA\tC\t.\t.\tRS=978760828
+1\t10051\trs1052373574\tA\tG,T\t.\t.\tRS=1052373574
+2\t955\trs1234\tCA\tC\t.\t.\tRS=1234
+"""
+
+
+def vep_result(chrom, pos, vid, ref, alt, norm_alt, rank_terms, freqs=None):
+    """Minimal VEP result JSON for one variant."""
+    cv = [{"allele_string": f"{ref}/{alt}", "id": vid}]
+    if freqs:
+        cv[0]["frequencies"] = freqs
+        cv[0]["minor_allele"] = norm_alt
+        cv[0]["minor_allele_freq"] = 0.01
+    return {
+        "input": f"{chrom}\t{pos}\t{vid}\t{ref}\t{alt}\t.\t.\t.",
+        "most_severe_consequence": rank_terms[0],
+        "transcript_consequences": [
+            {
+                "variant_allele": norm_alt,
+                "consequence_terms": rank_terms,
+                "gene_id": "ENSG0001",
+            },
+            {
+                "variant_allele": norm_alt,
+                "consequence_terms": ["intron_variant"],
+                "gene_id": "ENSG0002",
+            },
+        ],
+        "colocated_variants": cv,
+    }
+
+
+@pytest.fixture
+def loaded_store(tmp_path):
+    vcf = tmp_path / "s.vcf"
+    vcf.write_text(VCF)
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    assert store.n == 4
+    return store, ledger
+
+
+def test_parser_rank_sort_and_most_severe():
+    ranker = ConsequenceRanker()
+    p = VepResultParser(ranker)
+    ann = vep_result("1", 10039, "rs978760828", "A", "C", "C",
+                     ["missense_variant"])
+    p.rank_and_sort(ann)
+    tc = ann["transcript_consequences"]
+    assert set(tc.keys()) == {"C"}
+    # missense outranks intron -> sorted first, original order preserved in field
+    assert tc["C"][0]["consequence_terms"] == ["missense_variant"]
+    assert tc["C"][0]["rank"] < tc["C"][1]["rank"]
+    assert tc["C"][0]["consequence_is_coding"] is True
+    assert tc["C"][1]["consequence_is_coding"] is False
+    ms = VepResultParser.most_severe_consequence(ann, "C")
+    assert ms["consequence_terms"] == ["missense_variant"]
+    assert VepResultParser.most_severe_consequence(ann, "G") is None
+
+
+def test_parser_frequency_grouping():
+    freqs = {"C": {"gnomad": 0.01, "gnomad_afr": 0.02, "af": 0.03, "aa": 0.04}}
+    out = VepResultParser._group_by_source(freqs)
+    assert out == {
+        "C": {
+            "GnomAD": {"gnomad": 0.01, "gnomad_afr": 0.02},
+            "1000Genomes": {"af": 0.03},
+            "ESP": {"aa": 0.04},
+        }
+    }
+
+
+def test_parser_cosmic_filtered_and_refsnp_disambiguation():
+    ann = {
+        "colocated_variants": [
+            {"allele_string": "COSMIC_MUTATION", "id": "COSV1",
+             "frequencies": {"C": {"af": 0.9}}},
+            {"allele_string": "A/C", "id": "rs111",
+             "frequencies": {"C": {"af": 0.1}}},
+            {"allele_string": "A/C", "id": "rs222",
+             "frequencies": {"C": {"af": 0.2}}},
+        ]
+    }
+    # with a matching id, only that covar's frequencies return
+    out = VepResultParser.frequencies(ann, "rs111")
+    assert out["values"] == {"C": {"1000Genomes": {"af": 0.1}}}
+    # without, last non-cosmic wins (reference iterates and overwrites)
+    out = VepResultParser.frequencies(ann)
+    assert out["values"] == {"C": {"1000Genomes": {"af": 0.2}}}
+
+
+def test_vep_load_updates_store(tmp_path, loaded_store):
+    store, ledger = loaded_store
+    results = [
+        vep_result("1", 10039, "rs978760828", "A", "C", "C",
+                   ["missense_variant", "splice_region_variant"],
+                   freqs={"C": {"gnomad": 0.015, "af": 0.02}}),
+        vep_result("1", 10051, "rs1052373574", "A", "G,T", "G",
+                   ["intron_variant"]),
+        # deletion: normalized alt is '-' (VEP convention)
+        vep_result("2", 955, "rs1234", "CA", "C", "-",
+                   ["frameshift_variant"]),
+        # unknown variant -> not_found counter
+        vep_result("2", 99999, "rs999", "G", "A", "A", ["intron_variant"]),
+    ]
+    path = tmp_path / "vep.json.gz"
+    with gzip.open(path, "wt") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+
+    ranker = ConsequenceRanker()
+    loader = TpuVepLoader(store, ledger, ranker, datasource="dbSNP",
+                          log=lambda *a: None)
+    counters = loader.load_file(str(path), commit=True)
+    # 10039, both alts of 10051 (T just gets empty conseq dicts, like the
+    # reference writing '{}'), and the 955 deletion
+    assert counters["update"] == 4
+    assert counters["not_found"] == 1  # rs999 only
+    # novel combo was learned during the load
+    assert ranker.rank_of("missense_variant,splice_region_variant") is not None
+
+    s1 = store.shard(1)
+    i = int(np.where(s1.cols["pos"] == 10039)[0][0])
+    ms = s1.annotations["adsp_most_severe_consequence"][i]
+    assert ms["consequence_terms"] == ["missense_variant", "splice_region_variant"]
+    assert ms["consequence_is_coding"] is True
+    assert s1.annotations["allele_frequencies"][i] == {
+        "GnomAD": {"gnomad": 0.015}, "1000Genomes": {"af": 0.02},
+    }
+    ranked = s1.annotations["adsp_ranked_consequences"][i]
+    assert len(ranked["transcript_consequences"]) == 2
+    # cleaned vep_output: extracted blocks removed, input structured
+    vo = s1.annotations["vep_output"][i]
+    assert "transcript_consequences" not in vo
+    assert "colocated_variants" not in vo
+    assert vo["input"]["pos"] == 10039
+    # deletion matched via '-' normalized allele
+    s2 = store.shard(2)
+    j = int(np.where(s2.cols["pos"] == 955)[0][0])
+    ms2 = s2.annotations["adsp_most_severe_consequence"][j]
+    assert ms2["consequence_terms"] == ["frameshift_variant"]
+
+    # skip_existing: second pass skips rows that already have vep_output
+    loader2 = TpuVepLoader(store, ledger, ranker, skip_existing=True,
+                           log=lambda *a: None)
+    counters2 = loader2.load_file(str(path), commit=True)
+    assert counters2["duplicates"] == 4
+    assert counters2["update"] == 0
