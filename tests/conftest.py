@@ -13,6 +13,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# A sitecustomize.py in this image re-pins jax_platforms to the TPU tunnel at
+# import time, overriding the env var — so the env alone is not enough. Update
+# the config after import; the backend is initialized lazily, so this wins as
+# long as it runs before the first jax.devices() call.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import random
 
 import numpy as np
