@@ -1,0 +1,112 @@
+"""CLI: update CADD scores for stored variants
+(``Load/bin/load_cadd_scores.py`` equivalent).
+
+Whole-store mode joins every chromosome shard against the CADD tables;
+``--fileName`` restricts the update to the variants of one VCF
+(``load_cadd_scores.py:180-257``).  Default is a dry run; pass ``--commit``
+to mutate the store.  Prints the algorithm-invocation id on exit so a
+wrapper can undo (``load_cadd_scores.py`` drivers share this convention).
+
+Usage:
+    python -m annotatedvdb_tpu.cli.load_cadd --databaseDir /cadd \
+        --storeDir ./vdb [--chr 22 | --chr autosome] [--fileName x.vcf.gz] \
+        [--commit] [--test]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from annotatedvdb_tpu.loaders.cadd_loader import TpuCaddUpdater
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.types import chromosome_code
+
+# chromosome set shorthands from the reference drivers
+# (load_vep_result.py:306-309)
+CHR_SETS = {
+    "all": [str(c) for c in range(1, 23)] + ["X", "Y", "M"],
+    "allNoM": [str(c) for c in range(1, 23)] + ["X", "Y"],
+    "autosome": [str(c) for c in range(1, 23)],
+}
+
+
+def parse_chromosomes(spec: str | None) -> list | None:
+    if spec is None:
+        return None
+    if spec in CHR_SETS:
+        return CHR_SETS[spec]
+    return [c.strip() for c in spec.split(",") if c.strip()]
+
+
+def vcf_subsets(updater: TpuCaddUpdater, path: str) -> dict[int, np.ndarray]:
+    """Map VCF variants to shard row indices (the --fileName restriction)."""
+    from annotatedvdb_tpu.io.vcf import VcfBatchReader
+    from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+
+    hits: dict[int, list] = {}
+    for chunk in VcfBatchReader(path, width=updater.store.width):
+        batch = chunk.batch
+        h = np.array(
+            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+        )
+        long_rows = np.where(
+            (batch.ref_len > updater.store.width) | (batch.alt_len > updater.store.width)
+        )[0]
+        for i in long_rows:
+            h[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
+        for code in np.unique(batch.chrom):
+            # only chromosomes the store already holds: shard() would create
+            # (and save would persist) phantom empty shards otherwise
+            if code == 0 or int(code) not in updater.store.shards:
+                continue
+            sel = np.where(batch.chrom == code)[0]
+            shard = updater.store.shard(code)
+            found, idx = shard.lookup(
+                batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
+                batch.ref_len[sel], batch.alt_len[sel],
+            )
+            hits.setdefault(int(code), []).extend(idx[found].tolist())
+    return {c: np.unique(np.array(v, dtype=np.int64)) for c, v in hits.items() if v}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--databaseDir", required=True,
+                    help="directory holding the CADD score tables")
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--fileName", help="restrict update to this VCF's variants")
+    ap.add_argument("--chr", dest="chromosomes",
+                    help="chromosome, comma list, or all/allNoM/autosome")
+    ap.add_argument("--commit", action="store_true")
+    ap.add_argument("--test", action="store_true",
+                    help="stop after one chromosome / first block")
+    ap.add_argument("--updateExisting", action="store_true",
+                    help="re-score variants that already have cadd_scores")
+    args = ap.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    updater = TpuCaddUpdater(
+        store, ledger, args.databaseDir, skip_existing=not args.updateExisting
+    )
+
+    subsets = vcf_subsets(updater, args.fileName) if args.fileName else None
+    counters = updater.update_all(
+        parse_chromosomes(args.chromosomes),
+        commit=args.commit, test=args.test, subsets=subsets,
+    )
+
+    if args.commit:
+        store.save(args.storeDir)
+    print(json.dumps(counters))
+    print(counters["alg_id"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,167 @@
+"""CADD score-table ingest: streamed per-chromosome blocks for the join kernel.
+
+The reference reads two tabix-indexed TSVs — ``whole_genome_SNVs.tsv.gz`` and
+``gnomad.genomes.r3.0.indel.tsv.gz`` (``cadd_updater.py:21-22``) — one htslib
+fetch per variant.  Here the table is streamed sequentially (the tables are
+sorted by (chrom, pos), which is what makes them tabix-indexable in the first
+place) and materialized into fixed-capacity, sentinel-padded numpy blocks
+that feed :func:`cadd_join_kernel`.
+
+Long-allele handling: device arrays are width-truncated, so byte equality is
+only exact for alleles within the width.  Any *position* that carries a row
+with an over-width allele is excluded from the device arrays wholesale and
+recorded in the block's ``host_rows`` side table (full strings, file order) —
+the updater replays the reference's matching semantics for those positions on
+the host, preserving first-match-wins order exactly.
+
+Columns follow the CADD distribution format: ``#Chrom  Pos  Ref  Alt
+RawScore  PHRED``; header lines start with ``#``.  CADD names the
+mitochondrial chromosome ``MT`` where the store uses ``M``
+(``cadd_updater.py:170-171`` does the same fold).
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import Iterator
+
+import numpy as np
+
+from annotatedvdb_tpu.types import chromosome_code, encode_allele_array
+from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, next_pow2
+
+# Canonical file names from the CADD distribution (cadd_updater.py:21-22).
+CADD_SNV_FILE = "whole_genome_SNVs.tsv.gz"
+CADD_INDEL_FILE = "gnomad.genomes.r3.0.indel.tsv.gz"
+
+
+class CaddBlock:
+    """One sentinel-padded score block (all device arrays share capacity C)."""
+
+    def __init__(self, pos, ref, alt, raw, phred, n, max_run, host_rows):
+        self.pos = pos          # [C] int32, pos-sorted, SENTINEL beyond n
+        self.ref = ref          # [C, W] uint8
+        self.alt = alt          # [C, W] uint8
+        self.raw = raw          # [C] float64 (host gather — text-parse exact)
+        self.phred = phred      # [C] float64
+        self.n = n              # real device rows
+        self.max_run = max_run  # longest same-position device run (probe check)
+        # pos -> [(ref, alt, raw, phred), ...] in file order, for positions
+        # containing an over-width allele (host replay path)
+        self.host_rows: dict[int, list] = host_rows
+        self._all_pos = sorted(
+            set(host_rows) | set(int(p) for p in pos[:n].tolist())
+        )
+
+    @property
+    def min_pos(self) -> int:
+        return self._all_pos[0] if self._all_pos else POS_SENTINEL
+
+    @property
+    def max_pos(self) -> int:
+        return self._all_pos[-1] if self._all_pos else 0
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8")
+    return open(path, "r", encoding="utf-8")
+
+
+class CaddFileReader:
+    """Streams score rows as padded per-chromosome blocks.
+
+    ``block_rows`` is the block capacity; blocks never split a same-position
+    run across a boundary (a split run could hide the matching row from the
+    probe window), so the trailing run is peeled back and re-queued for the
+    next block.  Blocks also never span a chromosome change.
+    """
+
+    def __init__(self, path: str, width: int, block_rows: int = 1 << 18):
+        self.path = path
+        self.width = width
+        self.block_rows = block_rows
+
+    def blocks_all(self) -> Iterator[tuple[int, "CaddBlock"]]:
+        """One sequential pass over the whole table, yielding
+        (chromosome_code, block) — the multi-chromosome driver path (the
+        reference instead re-opens the tabix file per chromosome worker)."""
+        rows: list[tuple[int, str, str, float, float]] = []
+        current_code = None
+        with _open_text(self.path) as fh:
+            for line in fh:
+                if line.startswith("#"):
+                    continue
+                fields = line.rstrip("\n").split("\t")
+                if len(fields) < 6:
+                    continue
+                code = chromosome_code(fields[0])
+                if code == 0:
+                    continue
+                if code != current_code:
+                    if rows:
+                        yield current_code, self._build(rows)
+                        rows = []
+                    current_code = code
+                rows.append(
+                    (int(fields[1]), fields[2], fields[3],
+                     float(fields[4]), float(fields[5]))
+                )
+                if len(rows) >= self.block_rows:
+                    emit, rows = self._split_on_run(rows)
+                    if emit:
+                        yield current_code, self._build(emit)
+        if rows:
+            yield current_code, self._build(rows)
+
+    def blocks(self, chrom_code_target: int) -> Iterator["CaddBlock"]:
+        """Blocks for a single chromosome (early exit once past it)."""
+        seen = False
+        for code, block in self.blocks_all():
+            if code == chrom_code_target:
+                seen = True
+                yield block
+            elif seen:
+                break  # sorted file: past the target chromosome
+
+    @staticmethod
+    def _split_on_run(rows):
+        """Peel the trailing same-position run back into the carry-over list."""
+        last_pos = rows[-1][0]
+        cut = len(rows)
+        while cut > 0 and rows[cut - 1][0] == last_pos:
+            cut -= 1
+        if cut == 0:  # entire block is one run; emit as-is (degenerate input)
+            return rows, []
+        return rows[:cut], rows[cut:]
+
+    def _build(self, rows) -> CaddBlock:
+        # positions carrying any over-width allele go to the host side table
+        long_pos = {
+            r[0] for r in rows if len(r[1]) > self.width or len(r[2]) > self.width
+        }
+        host_rows: dict[int, list] = {}
+        device = []
+        for r in rows:
+            if r[0] in long_pos:
+                host_rows.setdefault(r[0], []).append((r[1], r[2], r[3], r[4]))
+            else:
+                device.append(r)
+        n = len(device)
+        cap = next_pow2(max(n, 1))
+        pos = np.full((cap,), POS_SENTINEL, np.int32)
+        raw = np.zeros((cap,), np.float64)
+        phred = np.zeros((cap,), np.float64)
+        ref = np.zeros((cap, self.width), np.uint8)
+        alt = np.zeros((cap, self.width), np.uint8)
+        if n:
+            pos[:n] = [r[0] for r in device]
+            raw[:n] = [r[3] for r in device]
+            phred[:n] = [r[4] for r in device]
+            ref[:n], _ = encode_allele_array([r[1] for r in device], self.width)
+            alt[:n], _ = encode_allele_array([r[2] for r in device], self.width)
+            runs = np.diff(np.flatnonzero(np.diff(pos[:n], prepend=-1, append=-2)))
+            max_run = int(runs.max()) if runs.size else 0
+        else:
+            max_run = 0
+        return CaddBlock(pos, ref, alt, raw, phred, n, max_run, host_rows)

@@ -1,0 +1,248 @@
+"""CADD score updater: batch join of store variants against CADD tables.
+
+Reference flow (``Load/bin/load_cadd_scores.py:79-177`` +
+``Util/lib/python/loaders/cadd_updater.py``): stream every variant of a
+chromosome partition through a server-side cursor; per variant — skip if
+``cadd_scores`` is already set, pick the SNV or indel table by allele length,
+tabix-fetch the rows at its position, compare allele sets, buffer
+``{CADD_raw_score, CADD_phred}`` (or a ``{}`` placeholder when unmatched,
+``cadd_updater.py:216-221``), flush partition-targeted UPDATEs every batch.
+
+Here the chromosome shard *is* the partition: candidate rows come from one
+vectorized scan, the SNV/indel split is a mask, and each streamed score block
+joins against its position-slice of the shard in one
+:func:`cadd_join_kernel` call.  The whole-store path makes ONE sequential
+pass over each score table for all chromosomes (the reference re-opens the
+tabix file in every per-chromosome worker; a sequential columnar pass makes
+its chromosome-shuffle load balancing moot).  Updates write straight into the
+shard's ``cadd_scores`` column (replacement semantics — the reference's
+UPDATE is a plain ``SET cadd_scores = …``, not a jsonb_merge).
+
+Long alleles: variants or table rows wider than the device width are matched
+on the host with full strings (see ``io/cadd.py`` host_rows), so truncation
+can never produce a false match.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from annotatedvdb_tpu.io.cadd import (
+    CADD_INDEL_FILE,
+    CADD_SNV_FILE,
+    CaddFileReader,
+)
+from annotatedvdb_tpu.ops.cadd_join import (
+    INDEL_PROBE,
+    SNV_PROBE,
+    cadd_join_kernel,
+)
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.types import chromosome_code
+from annotatedvdb_tpu.utils.arrays import pad_pow2
+
+
+def _resolve_code(chrom) -> int:
+    code = int(chrom) if isinstance(chrom, (int, np.integer)) else chromosome_code(chrom)
+    if not 1 <= code <= 25:
+        raise ValueError(f"unrecognized chromosome {chrom!r}")
+    return code
+
+
+class _ChromState:
+    """Per-chromosome join state for one table pass."""
+
+    def __init__(self, sel: np.ndarray, shard):
+        self.sel = sel                          # shard row indices (ascending)
+        self.pos = shard.cols["pos"][sel]       # ascending: shard is pos-sorted
+        self.matched = np.zeros(sel.shape, bool)
+        self.raw = np.zeros(sel.shape, np.float64)
+        self.phred = np.zeros(sel.shape, np.float64)
+        self.examined_hi = 0                    # rows with a completed chance to match
+
+
+class TpuCaddUpdater:
+    """Joins a variant store against the two CADD score tables."""
+
+    def __init__(
+        self,
+        store: VariantStore,
+        ledger: AlgorithmLedger,
+        database_dir: str,
+        snv_file: str = CADD_SNV_FILE,
+        indel_file: str = CADD_INDEL_FILE,
+        skip_existing: bool = True,
+        log=print,
+    ):
+        self.store = store
+        self.ledger = ledger
+        self.snv_path = os.path.join(database_dir, snv_file)
+        self.indel_path = os.path.join(database_dir, indel_file)
+        self.skip_existing = skip_existing
+        self.log = log
+        self.counters = {"snv": 0, "indel": 0, "not_matched": 0,
+                         "skipped": 0, "update": 0}
+
+    # ------------------------------------------------------------------
+
+    def update_all(self, chromosomes=None, commit: bool = False,
+                   test: bool = False,
+                   subsets: dict[int, np.ndarray] | None = None) -> dict:
+        """Update every (or the given) chromosome in one pass per table.
+
+        ``subsets`` maps chromosome code -> shard row indices and restricts
+        the update to those rows — the ``--fileName`` mode of the reference
+        driver (``load_cadd_scores.py:180-257`` updates only a VCF's
+        variants).  When both ``chromosomes`` and ``subsets`` are given, the
+        intersection applies."""
+        if chromosomes:
+            codes = [_resolve_code(c) for c in chromosomes]
+            codes = [c for c in codes if c in self.store.shards]
+        else:
+            codes = sorted(self.store.shards)
+        if subsets is not None:
+            codes = [c for c in codes if c in subsets]
+        alg_id = self.ledger.begin(
+            "TpuCaddUpdater.update_all",
+            {"snv": self.snv_path, "indel": self.indel_path,
+             "chromosomes": [int(c) for c in codes]},
+            commit,
+        )
+        for kind, path, probe in self._tables():
+            states: dict[int, _ChromState] = {}
+            for code in codes:
+                sel = self._candidates(
+                    code, kind,
+                    subset=None if subsets is None else subsets[code],
+                    count_skips=(kind == "snv"),
+                )
+                if sel.size:
+                    states[code] = _ChromState(sel, self.store.shard(code))
+            if not states or not os.path.exists(path):
+                continue
+            reader = CaddFileReader(path, width=self.store.width)
+            stop = False
+            for code, block in reader.blocks_all():
+                if code in states:
+                    self._join_block(states[code], self.store.shard(code), block, probe)
+                    if test:
+                        stop = True
+                        break
+            self._finalize(states, kind, commit, complete=not stop)
+        self.ledger.finish(alg_id, dict(self.counters))
+        self.counters["alg_id"] = alg_id
+        return dict(self.counters)
+
+    # ------------------------------------------------------------------
+
+    def _tables(self):
+        return (
+            ("snv", self.snv_path, SNV_PROBE),
+            ("indel", self.indel_path, INDEL_PROBE),
+        )
+
+    def _candidates(self, code: int, kind: str, subset=None,
+                    count_skips: bool = True) -> np.ndarray:
+        """Shard rows eligible for this table: not yet scored, SNV/indel split
+        by allele length (``cadd_updater.py:188``)."""
+        shard = self.store.shard(code)
+        if shard.n == 0:
+            return np.empty((0,), np.int64)
+        rows = np.arange(shard.n) if subset is None else np.sort(np.asarray(subset))
+        if self.skip_existing:
+            has = np.array(
+                [shard.annotations["cadd_scores"][int(i)] is not None for i in rows],
+                bool,
+            )
+            if count_skips:
+                self.counters["skipped"] += int(has.sum())
+            rows = rows[~has]
+        is_indel = (
+            (shard.cols["ref_len"][rows] > 1) | (shard.cols["alt_len"][rows] > 1)
+        )
+        return rows[is_indel] if kind == "indel" else rows[~is_indel]
+
+    def _join_block(self, state: _ChromState, shard, block, probe: int) -> None:
+        vlo = np.searchsorted(state.pos, block.min_pos, side="left")
+        vhi = np.searchsorted(state.pos, block.max_pos, side="right")
+        state.examined_hi = max(state.examined_hi, vhi)
+        if vlo == vhi:
+            return
+        window = state.sel[vlo:vhi]
+        # over-width variants and variants at host-row positions replay the
+        # reference semantics on the host with full strings
+        w = self.store.width
+        over_width = (
+            (shard.cols["ref_len"][window] > w) | (shard.cols["alt_len"][window] > w)
+        )
+        host_pos = np.isin(shard.cols["pos"][window], list(block.host_rows)) \
+            if block.host_rows else np.zeros(window.shape, bool)
+        host_mask = over_width | host_pos
+        if block.n and not host_mask.all():
+            if block.max_run > probe:
+                raise ValueError(
+                    f"{block.max_run} score rows share one position, "
+                    f"exceeding the {probe}-deep probe window"
+                )
+            m, midx = cadd_join_kernel(
+                pad_pow2(shard.cols["pos"][window], 0),
+                pad_pow2(shard.ref[window], 0),
+                pad_pow2(shard.alt[window], 0),
+                block.pos, block.ref, block.alt,
+                probe=probe,
+            )
+            n_w = window.size
+            m = np.asarray(m)[:n_w] & ~host_mask
+            midx = np.asarray(midx)[:n_w]
+            take = m & ~state.matched[vlo:vhi]
+            state.matched[vlo:vhi] |= m
+            # evidence gathered host-side by index: text-parsed float64 parity
+            safe = np.clip(midx, 0, None)
+            state.raw[vlo:vhi] = np.where(take, block.raw[safe], state.raw[vlo:vhi])
+            state.phred[vlo:vhi] = np.where(
+                take, block.phred[safe], state.phred[vlo:vhi]
+            )
+        for j in np.where(host_mask & ~state.matched[vlo:vhi])[0]:
+            row = int(window[j])
+            ref, alt = shard.alleles(row)
+            for s_ref, s_alt, raw, phred in block.host_rows.get(
+                int(shard.cols["pos"][row]), []
+            ):
+                # allele-set membership, first match wins (cadd_updater.py:203-212)
+                if ref in (s_ref, s_alt) and alt in (s_ref, s_alt):
+                    state.matched[vlo + j] = True
+                    state.raw[vlo + j] = raw
+                    state.phred[vlo + j] = phred
+                    break
+
+    def _finalize(self, states: dict[int, "_ChromState"], kind: str,
+                  commit: bool, complete: bool) -> None:
+        """Write evidence.  Rows past the last examined position in an
+        interrupted (--test) run are left untouched — writing the ``{}``
+        placeholder for them would permanently hide them from later full
+        runs behind skip_existing."""
+        for code, state in states.items():
+            hi = state.sel.size if complete else state.examined_hi
+            if hi == 0:
+                continue
+            sel = state.sel[:hi]
+            matched = state.matched[:hi]
+            evidence = [
+                {"CADD_raw_score": float(state.raw[i]),
+                 "CADD_phred": float(state.phred[i])}
+                if matched[i]
+                else {}  # unmatched placeholder (cadd_updater.py:216-221)
+                for i in range(hi)
+            ]
+            n_matched = int(matched.sum())
+            self.counters[kind] += n_matched
+            self.counters["update"] += n_matched
+            self.counters["not_matched"] += int(hi) - n_matched
+            if commit:
+                # replacement, not merge: the reference UPDATE overwrites the
+                # column wholesale (cadd_updater.py:25-27)
+                self.store.shard(code).update_annotation(
+                    sel, "cadd_scores", evidence, merge=False
+                )

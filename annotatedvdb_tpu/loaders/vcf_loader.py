@@ -225,6 +225,16 @@ class TpuVcfLoader:
                     digest_pk=[
                         pks[jx] if needs_digest[jx] else None for jx in jj
                     ],
+                    # retain original strings for width-truncated rows: the
+                    # device arrays can't reconstruct them and later joins
+                    # (CADD) and VCF export need the exact alleles
+                    long_alleles=[
+                        (refs[jx], alts[jx])
+                        if (sub.ref_len[jx] > self.store.width
+                            or sub.alt_len[jx] > self.store.width)
+                        else None
+                        for jx in jj
+                    ],
                 )
                 offset += k
         self.counters["variant"] += int(sel.size)

@@ -31,7 +31,7 @@ from typing import Iterable
 
 import numpy as np
 
-from annotatedvdb_tpu.types import chromosome_label
+from annotatedvdb_tpu.types import chromosome_label, decode_allele
 from annotatedvdb_tpu.utils.strings import deep_update
 
 # The ten JSONB annotation columns of AnnotatedVDB.Variant
@@ -84,11 +84,36 @@ class ChromosomeShard:
         self.annotations: dict[str, list] = {c: [] for c in JSONB_COLUMNS}
         # digest-PK strings for the long-allele tail (host path); None else
         self.digest_pk: list = []
+        # original (ref, alt) strings for rows whose alleles exceed the device
+        # width — the truncated byte arrays can't reconstruct them, and both
+        # annotation joins and VCF export need the full alleles; None else
+        self.long_alleles: list = []
 
     # -- membership ---------------------------------------------------------
 
     def key(self) -> np.ndarray:
         return combined_key(self.cols["pos"], self.cols["h"])
+
+    def alleles(self, i: int) -> tuple[str, str]:
+        """True (ref, alt) strings for row i — exact even for the long-allele
+        tail whose device arrays are width-truncated."""
+        i = int(i)
+        if self.long_alleles[i] is not None:
+            return self.long_alleles[i]
+        ref_len = int(self.cols["ref_len"][i])
+        alt_len = int(self.cols["alt_len"][i])
+        if ref_len > self.width or alt_len > self.width:
+            # a store written before long-allele retention existed: returning
+            # the truncated prefix would silently corrupt joins/exports
+            raise ValueError(
+                f"row {i}: allele length {max(ref_len, alt_len)} exceeds device "
+                f"width {self.width} but the original strings were not retained "
+                "(store predates long-allele retention; reload from source)"
+            )
+        return (
+            decode_allele(self.ref[i], ref_len),
+            decode_allele(self.alt[i], alt_len),
+        )
 
     def lookup(self, pos, h, ref, alt, ref_len, alt_len):
         """Vectorized membership: (found [N] bool, index [N] int32)."""
@@ -122,7 +147,8 @@ class ChromosomeShard:
 
     def append(self, rows: dict, ref: np.ndarray, alt: np.ndarray,
                annotations: dict[str, list] | None = None,
-               digest_pk: list | None = None) -> None:
+               digest_pk: list | None = None,
+               long_alleles: list | None = None) -> None:
         """Merge new (already deduplicated, not-present) rows keeping sort.
 
         ``rows`` maps numeric column names -> [K] arrays (missing columns
@@ -156,10 +182,12 @@ class ChromosomeShard:
             for c in JSONB_COLUMNS
         }
         pk_sorted = [digest_pk[i] if digest_pk else None for i in order]
+        la_sorted = [long_alleles[i] if long_alleles else None for i in order]
         # list-insert at ascending positions: walk once from the back
         for c in JSONB_COLUMNS:
             self._list_insert(self.annotations[c], insert_at, ann_sorted[c])
         self._list_insert(self.digest_pk, insert_at, pk_sorted)
+        self._list_insert(self.long_alleles, insert_at, la_sorted)
         self.n += k
 
     @staticmethod
@@ -211,6 +239,7 @@ class ChromosomeShard:
         for c in JSONB_COLUMNS:
             self.annotations[c] = [v for v, k in zip(self.annotations[c], keep) if k]
         self.digest_pk = [v for v, k in zip(self.digest_pk, keep) if k]
+        self.long_alleles = [v for v, k in zip(self.long_alleles, keep) if k]
         self.n -= removed
         return removed
 
@@ -258,6 +287,8 @@ class VariantStore:
                            if s.annotations[c][i] is not None}
                     if s.digest_pk[i] is not None:
                         row["_digest_pk"] = s.digest_pk[i]
+                    if s.long_alleles[i] is not None:
+                        row["_long_alleles"] = list(s.long_alleles[i])
                     f.write(json.dumps(row) + "\n")
 
     @classmethod
@@ -275,10 +306,13 @@ class VariantStore:
             s.n = s.ref.shape[0]
             s.annotations = {c: [None] * s.n for c in JSONB_COLUMNS}
             s.digest_pk = [None] * s.n
+            s.long_alleles = [None] * s.n
             with open(os.path.join(path, f"chr{label}.ann.jsonl")) as f:
                 for i, line in enumerate(f):
                     row = json.loads(line)
                     s.digest_pk[i] = row.pop("_digest_pk", None)
+                    la = row.pop("_long_alleles", None)
+                    s.long_alleles[i] = tuple(la) if la else None
                     for c, v in row.items():
                         s.annotations[c][i] = v
         return store

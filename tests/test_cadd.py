@@ -1,0 +1,244 @@
+"""CADD score-join subsystem tests (SURVEY.md §7.2 step 6).
+
+Fixture mirrors the CADD distribution format: the SNV table carries 3 rows
+(alt bases) per position, the indel table a variable run; evidence columns
+are (RawScore, PHRED).  Expectations follow the reference's matching rules
+(``cadd_updater.py:187-221``): table choice by allele length, allele-set
+membership, first match wins, ``{}`` placeholder for unmatched, skip rows
+already scored."""
+
+import gzip
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from annotatedvdb_tpu.io.cadd import CaddFileReader
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.loaders.cadd_loader import TpuCaddUpdater
+from annotatedvdb_tpu.ops.cadd_join import cadd_join_kernel
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.types import VariantBatch
+
+VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\t.\tA\tG\t.\t.\t.
+1\t200\t.\tC\tT\t.\t.\t.
+1\t300\t.\tG\tGA\t.\t.\t.
+1\t400\t.\tTC\tT\t.\t.\t.
+2\t100\t.\tT\tA\t.\t.\t.
+M\t263\t.\tA\tG\t.\t.\t.
+"""
+
+SNV_TSV = """## CADD GRCh38-v1.7
+#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED
+1\t100\tA\tC\t0.1\t1.0
+1\t100\tA\tG\t0.2\t2.0
+1\t100\tA\tT\t0.3\t3.0
+1\t200\tC\tA\t0.4\t4.0
+1\t200\tC\tG\t0.5\t5.0
+1\t200\tC\tT\t0.6\t6.0
+2\t100\tT\tA\t0.7\t7.0
+2\t100\tT\tC\t0.8\t8.0
+2\t100\tT\tG\t0.9\t9.0
+MT\t263\tA\tG\t1.1\t11.0
+MT\t263\tA\tC\t1.2\t12.0
+MT\t263\tA\tT\t1.3\t13.0
+"""
+
+INDEL_TSV = """## CADD GRCh38-v1.7 indels
+#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED
+1\t300\tG\tGA\t2.0\t20.0
+1\t300\tG\tGAA\t2.1\t21.0
+1\t500\tAT\tA\t2.2\t22.0
+"""
+
+
+def build_store(tmp_path):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "v.vcf"
+    vcf.write_text(VCF)
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    return store, ledger
+
+
+def write_cadd_db(tmp_path):
+    db = tmp_path / "cadd"
+    db.mkdir(exist_ok=True)
+    with gzip.open(db / "whole_genome_SNVs.tsv.gz", "wt") as f:
+        f.write(SNV_TSV)
+    with gzip.open(db / "gnomad.genomes.r3.0.indel.tsv.gz", "wt") as f:
+        f.write(INDEL_TSV)
+    return str(db)
+
+
+def scores_by_metaseq(store):
+    out = {}
+    for code, shard in store.shards.items():
+        for i in range(shard.n):
+            batch = VariantBatch(
+                np.array([code], np.int8), shard.cols["pos"][i : i + 1],
+                shard.ref[i : i + 1], shard.alt[i : i + 1],
+                shard.cols["ref_len"][i : i + 1], shard.cols["alt_len"][i : i + 1],
+            )
+            out[batch.metaseq_id(0)] = shard.annotations["cadd_scores"][i]
+    return out
+
+
+def test_reader_blocks_and_runs(tmp_path):
+    db = write_cadd_db(tmp_path)
+    reader = CaddFileReader(db + "/whole_genome_SNVs.tsv.gz", width=8)
+    blocks = list(reader.blocks(1))
+    assert len(blocks) == 1
+    b = blocks[0]
+    assert b.n == 6 and b.max_run == 3
+    assert b.min_pos == 100 and b.max_pos == 200
+    # chromosome 2 stream stops after leaving chr2 (sorted-file early exit)
+    b2 = list(reader.blocks(2))[0]
+    assert b2.n == 3
+    # MT folds to M (code 25)
+    bm = list(reader.blocks(25))[0]
+    assert bm.n == 3 and bm.min_pos == 263
+
+
+def test_join_kernel_membership_and_first_match():
+    # variants: matching, swapped-orientation matching, non-matching
+    batch = VariantBatch.from_tuples(
+        [("1", 100, "A", "G"), ("1", 200, "T", "C"), ("1", 100, "A", "A")], width=8
+    )
+    spos = np.array([100, 100, 200, np.iinfo(np.int32).max], np.int32)
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    sref, _ = encode_allele_array(["A", "A", "C", ""], 8)
+    salt, _ = encode_allele_array(["G", "T", "T", ""], 8)
+    m, midx = cadd_join_kernel(
+        batch.pos, batch.ref, batch.alt, spos, sref, salt, probe=4
+    )
+    m, midx = np.asarray(m), np.asarray(midx)
+    assert m.tolist() == [True, True, True]
+    # row 1: ref/alt swapped vs table (C->T) still matches by set membership
+    assert midx[1] == 2
+    # row 2: A/A matches first row at pos 100 whose allele set contains A
+    assert midx[2] == 0
+    assert midx[0] == 0
+
+
+def test_updater_end_to_end(tmp_path):
+    store, ledger = build_store(tmp_path)
+    db = write_cadd_db(tmp_path)
+    upd = TpuCaddUpdater(store, ledger, db, log=lambda *a: None)
+    counters = upd.update_all(commit=True)
+    # SNVs: 1:100 A>G, 1:200 C>T, 2:100 T>A, M:263 A>G all match
+    assert counters["snv"] == 4
+    # indels: 1:300 G>GA matches; 1:400 TC>T does not
+    assert counters["indel"] == 1
+    assert counters["not_matched"] == 1
+    assert counters["update"] == 5
+    scores = scores_by_metaseq(store)
+    assert scores["1:100:A:G"] == {"CADD_raw_score": 0.2, "CADD_phred": 2.0}
+    assert scores["1:300:G:GA"] == {"CADD_raw_score": 2.0, "CADD_phred": 20.0}
+    assert scores["M:263:A:G"] == {"CADD_raw_score": 1.1, "CADD_phred": 11.0}
+    assert scores["1:400:TC:T"] == {}  # unmatched placeholder
+
+    # second pass: everything (matched or placeholder) is skipped
+    upd2 = TpuCaddUpdater(store, ledger, db, log=lambda *a: None)
+    counters2 = upd2.update_all(commit=True)
+    assert counters2["update"] == 0 and counters2["skipped"] == 6
+
+
+def test_updater_dry_run_mutates_nothing(tmp_path):
+    store, ledger = build_store(tmp_path)
+    db = write_cadd_db(tmp_path)
+    TpuCaddUpdater(store, ledger, db, log=lambda *a: None).update_all(commit=False)
+    assert all(v is None for v in scores_by_metaseq(store).values())
+
+
+def test_long_allele_host_replay(tmp_path):
+    """Over-width alleles must match on full strings, never truncated bytes."""
+    import pytest
+
+    long_a = "A" * 60
+    long_b = "A" * 59 + "T"  # same 49-byte prefix as long_a
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "long.vcf"
+    vcf.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        f"1\t700\t.\tG\t{long_b}\t.\t.\t.\n"
+    )
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    db = tmp_path / "cadd"
+    db.mkdir()
+    with gzip.open(db / "gnomad.genomes.r3.0.indel.tsv.gz", "wt") as f:
+        f.write(
+            "#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED\n"
+            f"1\t700\tG\t{long_a}\t3.0\t30.0\n"
+            f"1\t700\tG\t{long_b}\t3.5\t35.0\n"
+        )
+    upd = TpuCaddUpdater(store, ledger, str(db), log=lambda *a: None)
+    counters = upd.update_all(commit=True)
+    # the 49-byte prefix shared with long_a must NOT match; full-string
+    # comparison picks the second row
+    assert counters["indel"] == 1 and counters["not_matched"] == 0
+    scores = [v for v in store.shard(1).annotations["cadd_scores"] if v]
+    assert scores == [{"CADD_raw_score": 3.5, "CADD_phred": 35.0}]
+
+    with pytest.raises(ValueError):
+        upd.update_all(chromosomes=["nonsense"], commit=False)
+
+
+def test_test_mode_does_not_poison_unexamined_rows(tmp_path):
+    """--test stops after one block; rows beyond it must stay unset, not {}."""
+    store, ledger = build_store(tmp_path)
+    db = write_cadd_db(tmp_path)
+    upd = TpuCaddUpdater(store, ledger, db, log=lambda *a: None)
+    # block_rows=4 forces multiple blocks for the chr1 SNV table; patch the
+    # reader capacity through a tiny subclass of the updater's file pass
+    import annotatedvdb_tpu.loaders.cadd_loader as mod
+
+    orig = mod.CaddFileReader
+
+    class SmallReader(orig):
+        def __init__(self, path, width, block_rows=4):
+            super().__init__(path, width, block_rows=4)
+
+    mod.CaddFileReader = SmallReader
+    try:
+        upd.update_all(commit=True, test=True)
+    finally:
+        mod.CaddFileReader = orig
+    # full run afterwards must still score everything the test run skipped
+    upd2 = TpuCaddUpdater(store, ledger, db, log=lambda *a: None)
+    upd2.update_all(commit=True)
+    scores = scores_by_metaseq(store)
+    assert scores["1:200:C:T"] == {"CADD_raw_score": 0.6, "CADD_phred": 6.0}
+    assert scores["2:100:T:A"] == {"CADD_raw_score": 0.7, "CADD_phred": 7.0}
+    assert scores["M:263:A:G"] == {"CADD_raw_score": 1.1, "CADD_phred": 11.0}
+
+
+def test_cli_vcf_restricted(tmp_path):
+    store, ledger = build_store(tmp_path)
+    store_dir = tmp_path / "vdb"
+    store.save(str(store_dir))
+    # restrict to a VCF naming only two of the variants
+    sub = tmp_path / "subset.vcf"
+    sub.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "1\t100\t.\tA\tG\t.\t.\t.\n1\t400\t.\tTC\tT\t.\t.\t.\n"
+    )
+    db = write_cadd_db(tmp_path)
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.load_cadd",
+         "--databaseDir", db, "--storeDir", str(store_dir),
+         "--fileName", str(sub), "--commit"],
+        capture_output=True, text=True, check=True,
+    )
+    counters = json.loads(res.stdout.splitlines()[0])
+    assert counters["snv"] == 1 and counters["not_matched"] == 1
+    reloaded = VariantStore.load(str(store_dir))
+    scores = scores_by_metaseq(reloaded)
+    assert scores["1:100:A:G"] == {"CADD_raw_score": 0.2, "CADD_phred": 2.0}
+    assert scores["1:400:TC:T"] == {}
+    assert scores["1:200:C:T"] is None  # untouched: not in the subset VCF
