@@ -45,32 +45,14 @@ def parse_chromosomes(spec: str | None) -> list | None:
 def vcf_subsets(updater: TpuCaddUpdater, path: str) -> dict[int, np.ndarray]:
     """Map VCF variants to shard row indices (the --fileName restriction)."""
     from annotatedvdb_tpu.io.vcf import VcfBatchReader
-    from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
-    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+    from annotatedvdb_tpu.loaders.lookup import chunk_lookup
 
     hits: dict[int, list] = {}
     for chunk in VcfBatchReader(path, width=updater.store.width):
-        batch = chunk.batch
-        h = np.array(
-            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
-        )
-        long_rows = np.where(
-            (batch.ref_len > updater.store.width) | (batch.alt_len > updater.store.width)
-        )[0]
-        for i in long_rows:
-            h[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
-        for code in np.unique(batch.chrom):
-            # only chromosomes the store already holds: shard() would create
-            # (and save would persist) phantom empty shards otherwise
-            if code == 0 or int(code) not in updater.store.shards:
+        for code, shard, sel, found, idx in chunk_lookup(updater.store, chunk):
+            if shard is None:
                 continue
-            sel = np.where(batch.chrom == code)[0]
-            shard = updater.store.shard(code)
-            found, idx = shard.lookup(
-                batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
-                batch.ref_len[sel], batch.alt_len[sel],
-            )
-            hits.setdefault(int(code), []).extend(idx[found].tolist())
+            hits.setdefault(code, []).extend(idx[found].tolist())
     return {c: np.unique(np.array(v, dtype=np.int64)) for c, v in hits.items() if v}
 
 

@@ -1,0 +1,53 @@
+"""CLI: update/insert from an ADSP QC pVCF
+(``Load/bin/update_from_qc_pvcf_file.py`` equivalent).
+
+Usage:
+    python -m annotatedvdb_tpu.cli.update_qc --fileName qc.vcf[.gz] \
+        --storeDir ./vdb --version r4 [--updateExistingValues] \
+        [--commit] [--test] [--chromosomeMap map.tsv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from annotatedvdb_tpu.io.vcf import read_chromosome_map
+from annotatedvdb_tpu.loaders.qc_loader import TpuQcPvcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fileName", required=True)
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--version", required=True,
+                    help="ADSP release tag keying the adsp_qc JSONB (e.g. r4)")
+    ap.add_argument("--updateExistingValues", action="store_true")
+    ap.add_argument("--chromosomeMap")
+    ap.add_argument("--commit", action="store_true")
+    ap.add_argument("--test", action="store_true")
+    args = ap.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    loader = TpuQcPvcfLoader(
+        store, ledger, args.version,
+        update_existing=args.updateExistingValues,
+        datasource="ADSP",
+        chromosome_map=(
+            read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
+        ),
+    )
+    counters = loader.load_file(
+        args.fileName, commit=args.commit, test=args.test,
+        persist=(lambda: store.save(args.storeDir)) if args.commit else None,
+    )
+    print(json.dumps(counters))
+    print(counters["alg_id"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -93,6 +93,12 @@ class VcfChunk:
     rs_position: list          # INFO RSPOS, per row
     info: list                 # full INFO dict per row (shared across alts)
     line_number: np.ndarray    # 1-based source line, per row
+    # site columns beyond identity (QC/LoF update loads read these; the
+    # reference's VcfEntryParser keeps them as raw strings): QUAL, FILTER,
+    # FORMAT — None when the column is absent or '.'
+    qual: list = field(default_factory=list)
+    filter: list = field(default_factory=list)
+    format: list = field(default_factory=list)
     counters: dict = field(default_factory=dict)
 
 
@@ -150,6 +156,9 @@ class VcfBatchReader:
                 )
                 freqs = parse_freq(info, len(alts))
                 multi = len(alts) > 1
+                qual = fields[5] if len(fields) > 5 and fields[5] != "." else None
+                filt = fields[6] if len(fields) > 6 and fields[6] != "." else None
+                fmt = fields[8] if len(fields) > 8 and fields[8] != "." else None
                 for i, alt in enumerate(alts):
                     if alt == ".":
                         counters["skipped_alt"] += 1
@@ -167,6 +176,9 @@ class VcfBatchReader:
                             info.get("RSPOS"),
                             info,
                             line_no,
+                            qual,
+                            filt,
+                            fmt,
                         )
                     )
                 # flush only at line boundaries: a checkpoint records whole
@@ -199,6 +211,9 @@ class VcfBatchReader:
             rs_position=[r[8] for r in rows],
             info=[r[9] for r in rows],
             line_number=np.array([r[10] for r in rows], dtype=np.int64),
+            qual=[r[11] for r in rows],
+            filter=[r[12] for r in rows],
+            format=[r[13] for r in rows],
             counters=dict(counters),
         )
 

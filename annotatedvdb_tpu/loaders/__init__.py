@@ -1,4 +1,10 @@
 from .vcf_loader import TpuVcfLoader
 from .vep_loader import TpuVepLoader
+from .cadd_loader import TpuCaddUpdater
+from .update_loader import TpuUpdateLoader, UpdateStrategy
+from .qc_loader import TpuQcPvcfLoader, QcPvcfStrategy
 
-__all__ = ["TpuVcfLoader", "TpuVepLoader"]
+__all__ = [
+    "TpuVcfLoader", "TpuVepLoader", "TpuCaddUpdater",
+    "TpuUpdateLoader", "UpdateStrategy", "TpuQcPvcfLoader", "QcPvcfStrategy",
+]

@@ -110,14 +110,17 @@ class TpuCaddUpdater:
              "chromosomes": [int(c) for c in codes]},
             commit,
         )
+        # one not-yet-scored scan per chromosome, shared by both table passes
+        candidates = {
+            code: self._candidates(
+                code, subset=None if subsets is None else subsets[code]
+            )
+            for code in codes
+        }
         for kind, path, probe in self._tables():
             states: dict[int, _ChromState] = {}
             for code in codes:
-                sel = self._candidates(
-                    code, kind,
-                    subset=None if subsets is None else subsets[code],
-                    count_skips=(kind == "snv"),
-                )
+                sel = candidates[code][kind]
                 if sel.size:
                     states[code] = _ChromState(sel, self.store.shard(code))
             if not states or not os.path.exists(path):
@@ -143,26 +146,26 @@ class TpuCaddUpdater:
             ("indel", self.indel_path, INDEL_PROBE),
         )
 
-    def _candidates(self, code: int, kind: str, subset=None,
-                    count_skips: bool = True) -> np.ndarray:
-        """Shard rows eligible for this table: not yet scored, SNV/indel split
-        by allele length (``cadd_updater.py:188``)."""
-        shard = self.store.shard(code)
-        if shard.n == 0:
-            return np.empty((0,), np.int64)
+    def _candidates(self, code: int, subset=None) -> dict[str, np.ndarray]:
+        """Shard rows eligible for update, split per table: not yet scored,
+        SNV/indel by allele length (``cadd_updater.py:188``).  One pass over
+        the annotation column serves both table passes."""
+        empty = {"snv": np.empty((0,), np.int64), "indel": np.empty((0,), np.int64)}
+        shard = self.store.shards.get(int(code))
+        if shard is None or shard.n == 0:
+            return empty
         rows = np.arange(shard.n) if subset is None else np.sort(np.asarray(subset))
         if self.skip_existing:
-            has = np.array(
-                [shard.annotations["cadd_scores"][int(i)] is not None for i in rows],
-                bool,
+            has = np.fromiter(
+                (shard.annotations["cadd_scores"][int(i)] is not None for i in rows),
+                bool, count=rows.size,
             )
-            if count_skips:
-                self.counters["skipped"] += int(has.sum())
+            self.counters["skipped"] += int(has.sum())
             rows = rows[~has]
         is_indel = (
             (shard.cols["ref_len"][rows] > 1) | (shard.cols["alt_len"][rows] > 1)
         )
-        return rows[is_indel] if kind == "indel" else rows[~is_indel]
+        return {"snv": rows[~is_indel], "indel": rows[is_indel]}
 
     def _join_block(self, state: _ChromState, shard, block, probe: int) -> None:
         vlo = np.searchsorted(state.pos, block.min_pos, side="left")

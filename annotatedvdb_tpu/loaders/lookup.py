@@ -1,0 +1,54 @@
+"""Shared chunk→store identity resolution.
+
+One definition of the identity rule used everywhere a parsed chunk is joined
+against the store: device FNV hash over the width-bounded alleles, host
+re-hash from the original strings for over-width rows (their device arrays
+are truncated, so the device hash would collide on shared prefixes), then a
+per-chromosome sorted-merge lookup against the shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from annotatedvdb_tpu.io.vcf import VcfChunk
+from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+from annotatedvdb_tpu.store import VariantStore
+
+
+def chunk_hashes(store: VariantStore, chunk: VcfChunk) -> np.ndarray:
+    """[N] uint32 identity hashes with the over-width host override."""
+    from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
+
+    batch = chunk.batch
+    h = np.array(
+        allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+    )
+    over = (batch.ref_len > store.width) | (batch.alt_len > store.width)
+    for i in np.where(over)[0]:
+        h[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
+    return h
+
+
+def chunk_lookup(store: VariantStore, chunk: VcfChunk, h: np.ndarray | None = None):
+    """Yield (code, shard, sel, found, idx) per chromosome present in the
+    chunk.  ``shard`` is None (with found all-False) for chromosomes the
+    store does not hold — callers must not create shards as a side effect of
+    a lookup (empty shards would be persisted by the next save)."""
+    batch = chunk.batch
+    if h is None:
+        h = chunk_hashes(store, chunk)
+    for code in np.unique(batch.chrom):
+        sel = np.where(batch.chrom == code)[0]
+        shard = store.shards.get(int(code))
+        if shard is None:
+            yield (
+                int(code), None, sel,
+                np.zeros(sel.shape, bool), np.full(sel.shape, -1, np.int32),
+            )
+            continue
+        found, idx = shard.lookup(
+            batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
+            batch.ref_len[sel], batch.alt_len[sel],
+        )
+        yield int(code), shard, sel, found, idx

@@ -1,0 +1,68 @@
+"""ADSP QC pVCF updates: ``adsp_qc`` JSONB + ``is_adsp_variant`` flag.
+
+Reference: ``Load/bin/update_from_qc_pvcf_file.py`` — per variant of an ADSP
+QC pVCF, look up the store; known variants get
+``adsp_qc[release] = {info, filter, qual, format}`` merged in and
+``is_adsp_variant`` set from ``FILTER == 'PASS'`` (NULL otherwise, not
+false — ``:139``); rows whose ``adsp_qc`` already holds this release are
+skipped unless ``--updateExistingValues``; QC payloads containing
+``Infinity`` abort the load (``:141-145``); novel variants are inserted and
+flagged for later CADD update (``:34-72``).
+"""
+
+from __future__ import annotations
+
+import json
+
+from annotatedvdb_tpu.loaders.update_loader import TpuUpdateLoader, UpdateStrategy
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+class QcPvcfStrategy(UpdateStrategy):
+    """The ``generate_update_values`` analog
+    (``update_from_qc_pvcf_file.py:117-149``)."""
+
+    insert_novel = True
+
+    def __init__(self, version: str, update_existing: bool = False):
+        # one canonical release key: the reference writes the datasource tag
+        # but checks version.lower() (update_from_qc_pvcf_file.py:48) — mixed
+        # case would defeat the already-loaded check and fork divergent keys
+        self.version = version.lower()
+        self.update_existing = update_existing
+
+    def values(self, row: dict, existing: dict | None):
+        qc_values = {
+            self.version: {
+                "info": row["info"],
+                "filter": row["filter"],
+                "qual": row["qual"],
+                "format": row["format"],
+            }
+        }
+        # the reference aborts on Infinity anywhere in the QC payload
+        # (update_from_qc_pvcf_file.py:141-145): such values are upstream
+        # QC-pipeline bugs and would be invalid JSON
+        if "Infinity" in json.dumps(qc_values):
+            raise ValueError(
+                f"Infinity found among QC scores for {row['variant_id']}"
+            )
+        if existing is not None and not self.update_existing:
+            stored = existing.get("adsp_qc")
+            if stored is not None and self.version in stored:
+                return False, {}, {}
+        # PASS -> true; anything else leaves the flag NULL, not false
+        adsp_flag = 1 if row["filter"] == "PASS" else -1
+        return True, {"is_adsp_variant": adsp_flag}, {"adsp_qc": qc_values}
+
+
+class TpuQcPvcfLoader(TpuUpdateLoader):
+    """Convenience wrapper bundling the QC strategy."""
+
+    def __init__(self, store: VariantStore, ledger: AlgorithmLedger,
+                 version: str, update_existing: bool = False, **kw):
+        super().__init__(
+            store, ledger,
+            QcPvcfStrategy(version, update_existing=update_existing),
+            datasource=kw.pop("datasource", None), **kw,
+        )

@@ -1,0 +1,211 @@
+"""Batched VCF-driven annotation updates with pluggable value strategies.
+
+The reference threads a ``update_value_generator`` callback through
+``VCFVariantLoader`` (``vcf_variant_loader.py:120-125``): per known variant,
+the strategy returns (record PK, {update? flags}, {column: value}) and the
+loader buffers a ``jsonb_merge`` UPDATE (``:174-216``); unknown variants fall
+through to the insert path.  ``update_from_qc_pvcf_file.py:117-149`` is the
+canonical strategy.
+
+Here the same contract is batch-shaped: chunks stream through the vectorized
+shard lookup (the 50k-accumulate / 1000-id ``bulk_lookup`` dance of
+``update_from_qc_pvcf_file.py:31,96-114`` collapses into one sorted-merge per
+chromosome), strategies see one row dict at a time, and novel rows are
+re-chunked through the standard :class:`TpuVcfLoader` insert path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+
+import numpy as np
+
+from annotatedvdb_tpu.io.vcf import VcfBatchReader, VcfChunk
+from annotatedvdb_tpu.loaders.lookup import chunk_lookup
+from annotatedvdb_tpu.loaders.vcf_loader import TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+class UpdateStrategy:
+    """Per-row update policy (the ``update_value_generator`` analog).
+
+    ``values(row, existing)`` receives the parsed row dict and, for known
+    variants, a view of the stored row; it returns
+    ``(do_update, flag_updates, jsonb_updates)`` where ``flag_updates`` maps
+    numeric store columns (e.g. ``is_adsp_variant``) to int values and
+    ``jsonb_updates`` maps JSONB columns to dicts (merged with jsonb_merge
+    semantics).  ``do_update=False`` counts the row as skipped."""
+
+    #: insert variants not found in the store (update_from_qc_pvcf_file
+    #: inserts novel variants; SnpEff LoF updates never insert)
+    insert_novel = False
+
+    def values(self, row: dict, existing: dict | None):
+        raise NotImplementedError
+
+
+class TpuUpdateLoader:
+    """Streams a VCF and applies an :class:`UpdateStrategy` per known row."""
+
+    def __init__(
+        self,
+        store: VariantStore,
+        ledger: AlgorithmLedger,
+        strategy: UpdateStrategy,
+        datasource: str | None = None,
+        batch_size: int = 1 << 15,
+        chromosome_map: dict | None = None,
+        log=print,
+        insert_loader: TpuVcfLoader | None = None,
+    ):
+        self.store = store
+        self.ledger = ledger
+        self.strategy = strategy
+        self.batch_size = batch_size
+        self.chromosome_map = chromosome_map
+        self.log = log
+        self.insert_loader = insert_loader or TpuVcfLoader(
+            store, ledger, datasource=datasource, skip_existing=False,
+            log=log,
+        )
+        self.counters = {
+            "line": 0, "variant": 0, "update": 0, "skipped": 0, "not_found": 0,
+            "inserted": 0,
+        }
+
+    def load_file(self, path: str, commit: bool = False, test: bool = False,
+                  persist=None, resume: bool = True) -> dict:
+        alg_id = self.ledger.begin(
+            type(self.strategy).__name__ + ".load_file", {"file": path}, commit
+        )
+        resume_line = self.ledger.last_checkpoint(path) if resume else 0
+        if resume_line:
+            self.log(f"resuming {path} after committed line {resume_line}")
+        reader = VcfBatchReader(
+            path, batch_size=self.batch_size, width=self.store.width,
+            chromosome_map=self.chromosome_map,
+        )
+        for chunk in reader:
+            self.counters["line"] += chunk.counters.get("line", 0)
+            # chunks fully covered by a previous committed checkpoint replay
+            # as no-ops (idempotent resume; partially-covered chunks are
+            # impossible because checkpoints land on chunk boundaries)
+            if resume_line and chunk.line_number[-1] <= resume_line:
+                self.counters["skipped"] += chunk.batch.n
+                continue
+            self._apply_chunk(chunk, alg_id, commit)
+            if commit:
+                if persist is not None:
+                    persist()
+                self.ledger.checkpoint(
+                    alg_id, path, int(chunk.line_number[-1]), dict(self.counters)
+                )
+            if test:
+                self.log("test mode: stopping after first batch")
+                break
+        self.ledger.finish(alg_id, dict(self.counters))
+        self.counters["alg_id"] = alg_id
+        return dict(self.counters)
+
+    # ------------------------------------------------------------------
+
+    def _row_dict(self, chunk: VcfChunk, i: int) -> dict:
+        return {
+            "chrom": int(chunk.batch.chrom[i]),
+            "pos": int(chunk.batch.pos[i]),
+            "ref": chunk.refs[i],
+            "alt": chunk.alts[i],
+            "info": chunk.info[i],
+            "qual": chunk.qual[i],
+            "filter": chunk.filter[i],
+            "format": chunk.format[i],
+            "variant_id": chunk.variant_id[i],
+        }
+
+    def _apply_chunk(self, chunk: VcfChunk, alg_id: int, commit: bool) -> None:
+        novel: list[int] = []
+        for code, shard, sel, found, idx in chunk_lookup(self.store, chunk):
+            for j, i in enumerate(sel):
+                self.counters["variant"] += 1
+                if not found[j]:
+                    novel.append(int(i))
+                    continue
+                row_idx = int(idx[j])
+                existing = {
+                    c: shard.annotations[c][row_idx]
+                    for c in shard.annotations
+                }
+                existing["is_adsp_variant"] = int(
+                    shard.cols["is_adsp_variant"][row_idx]
+                )
+                do_update, flags, jsonb = self.strategy.values(
+                    self._row_dict(chunk, int(i)), existing
+                )
+                if not do_update:
+                    self.counters["skipped"] += 1
+                    continue
+                self.counters["update"] += 1
+                if not commit:
+                    continue
+                one = np.array([row_idx])
+                for col, value in jsonb.items():
+                    shard.update_annotation(one, col, [value])
+                for col, value in flags.items():
+                    shard.cols[col][row_idx] = value
+                shard.cols["row_algorithm_id"][row_idx] = alg_id
+
+        if novel and self.strategy.insert_novel:
+            self._insert_novel(chunk, novel, alg_id, commit)
+        elif novel:
+            self.counters["not_found"] += len(novel)
+
+    def _insert_novel(self, chunk: VcfChunk, novel: list[int], alg_id: int,
+                      commit: bool) -> None:
+        """Insert unknown variants through the standard VCF insert path, then
+        apply the strategy's values to the fresh rows (the reference's insert
+        path folds the update fields into the COPY,
+        ``update_from_qc_pvcf_file.py:34-72``)."""
+        sub = _subset_chunk(chunk, novel)
+        inserted_before = self.insert_loader.counters["variant"]
+        self.insert_loader._load_chunk(sub, alg_id, commit, 0, None)
+        self.counters["inserted"] += (
+            self.insert_loader.counters["variant"] - inserted_before
+        )
+        for code, shard, sel, found, idx in chunk_lookup(self.store, sub):
+            for j, i in enumerate(sel):
+                if not found[j]:
+                    continue  # dry run: nothing was inserted
+                row_idx = int(idx[j])
+                do_update, flags, jsonb = self.strategy.values(
+                    self._row_dict(sub, int(i)), None
+                )
+                if not do_update or not commit:
+                    continue
+                one = np.array([row_idx])
+                for col, value in jsonb.items():
+                    shard.update_annotation(one, col, [value])
+                for col, value in flags.items():
+                    shard.cols[col][row_idx] = value
+
+
+def _subset_chunk(chunk: VcfChunk, rows: list[int]) -> VcfChunk:
+    from annotatedvdb_tpu.types import VariantBatch
+
+    sel = np.asarray(rows)
+    return dc_replace(
+        chunk,
+        batch=VariantBatch(*(np.asarray(x)[sel] for x in chunk.batch)),
+        refs=[chunk.refs[i] for i in rows],
+        alts=[chunk.alts[i] for i in rows],
+        ref_snp=[chunk.ref_snp[i] for i in rows],
+        variant_id=[chunk.variant_id[i] for i in rows],
+        is_multi_allelic=chunk.is_multi_allelic[sel],
+        frequencies=[chunk.frequencies[i] for i in rows],
+        rs_position=[chunk.rs_position[i] for i in rows],
+        info=[chunk.info[i] for i in rows],
+        line_number=chunk.line_number[sel],
+        qual=[chunk.qual[i] for i in rows],
+        filter=[chunk.filter[i] for i in rows],
+        format=[chunk.format[i] for i in rows],
+        counters={},
+    )

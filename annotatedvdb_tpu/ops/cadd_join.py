@@ -25,6 +25,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from annotatedvdb_tpu.utils.arrays import POS_SENTINEL  # noqa: F401  (contract)
+
 # Probe depths per table: the SNV table carries exactly 3 rows (alt bases) per
 # position; the indel table's per-position runs are short but variable — 32
 # covers the gnomAD r3 distribution with a wide margin.  A run longer than the
@@ -32,8 +34,6 @@ import jax.numpy as jnp
 # per-position run it streamed stays within the probe depth.
 SNV_PROBE = 4
 INDEL_PROBE = 32
-
-POS_SENTINEL = jnp.iinfo(jnp.int32).max
 
 
 def _rows_equal(a, b):

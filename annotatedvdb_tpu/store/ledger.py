@@ -73,12 +73,20 @@ class AlgorithmLedger:
         )
 
     def last_checkpoint(self, input_file: str) -> int:
-        """Last committed line for an input file (0 if none) — the idempotent
-        resume point."""
+        """Last committed line for an input file among UNFINISHED invocations
+        (0 if none) — the idempotent resume point.  Checkpoints of completed
+        loads don't count: a finished file re-submitted is a new load (the
+        loader's own skip/duplicate policy decides what to do with its rows),
+        not a crash recovery."""
+        finished = {
+            e["alg_id"] for e in self._entries if e.get("type") == "finish"
+        }
         lines = [
             e["line"]
             for e in self._entries
-            if e.get("type") == "checkpoint" and e.get("file") == input_file
+            if e.get("type") == "checkpoint"
+            and e.get("file") == input_file
+            and e.get("alg_id") not in finished
         ]
         return max(lines, default=0)
 

@@ -1,0 +1,147 @@
+"""ADSP QC pVCF update tests (reference ``update_from_qc_pvcf_file.py``)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.loaders import TpuQcPvcfLoader, TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+BASE_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\t.\tA\tG\t.\t.\t.
+1\t200\t.\tC\tT\t.\t.\t.
+2\t100\t.\tT\tA\t.\t.\t.
+"""
+
+QC_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT
+1\t100\t.\tA\tG\t50\tPASS\tABHet=0.5;AC=3\tGT:DP
+1\t200\t.\tC\tT\t10\tLowQual\tAC=1\tGT
+2\t500\t.\tG\tC\t99\tPASS\tAC=7\tGT
+"""
+
+
+def build_store(tmp_path):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "base.vcf"
+    vcf.write_text(BASE_VCF)
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    return store, ledger
+
+
+def find_row(store, code, pos):
+    shard = store.shard(code)
+    i = int(np.searchsorted(shard.cols["pos"], pos))
+    assert shard.cols["pos"][i] == pos
+    return shard, i
+
+
+def test_qc_update_and_novel_insert(tmp_path):
+    store, ledger = build_store(tmp_path)
+    qc = tmp_path / "qc.vcf"
+    qc.write_text(QC_VCF)
+    loader = TpuQcPvcfLoader(store, ledger, "r4", log=lambda *a: None)
+    counters = loader.load_file(str(qc), commit=True)
+    assert counters["update"] == 2
+    assert store.n == 4  # novel 2:500 G>C inserted
+
+    shard, i = find_row(store, 1, 100)
+    qc_ann = shard.annotations["adsp_qc"][i]
+    assert qc_ann == {
+        "r4": {"info": {"ABHet": 0.5, "AC": 3}, "filter": "PASS",
+               "qual": "50", "format": "GT:DP"}
+    }
+    assert shard.cols["is_adsp_variant"][i] == 1  # PASS -> true
+
+    # LowQual row: flag stays NULL (-1), not false (reference :139)
+    shard, i = find_row(store, 1, 200)
+    assert shard.cols["is_adsp_variant"][i] == -1
+    assert shard.annotations["adsp_qc"][i]["r4"]["filter"] == "LowQual"
+
+    # novel insert got QC values + PASS flag
+    shard, i = find_row(store, 2, 500)
+    assert shard.cols["is_adsp_variant"][i] == 1
+    assert shard.annotations["adsp_qc"][i]["r4"]["qual"] == "99"
+    assert shard.annotations["display_attributes"][i] is not None  # full insert path
+
+    # untouched row keeps NULL qc
+    shard, i = find_row(store, 2, 100)
+    assert shard.annotations["adsp_qc"][i] is None
+
+
+def test_qc_skip_existing_release_and_merge(tmp_path):
+    store, ledger = build_store(tmp_path)
+    qc = tmp_path / "qc.vcf"
+    qc.write_text(QC_VCF)
+    TpuQcPvcfLoader(store, ledger, "r4", log=lambda *a: None).load_file(
+        str(qc), commit=True
+    )
+    # same release again: all known rows skipped
+    c2 = TpuQcPvcfLoader(store, ledger, "r4", log=lambda *a: None).load_file(
+        str(qc), commit=True
+    )
+    assert c2["update"] == 0 and c2["skipped"] == 3
+
+    # new release merges alongside the old one (jsonb_merge semantics)
+    c3 = TpuQcPvcfLoader(store, ledger, "r5", log=lambda *a: None).load_file(
+        str(qc), commit=True
+    )
+    assert c3["update"] == 3
+    shard, i = find_row(store, 1, 100)
+    assert set(shard.annotations["adsp_qc"][i]) == {"r4", "r5"}
+
+    # --updateExistingValues forces the update
+    c4 = TpuQcPvcfLoader(
+        store, ledger, "r4", update_existing=True, log=lambda *a: None
+    ).load_file(str(qc), commit=True)
+    assert c4["update"] == 3
+
+
+def test_qc_infinity_rejected(tmp_path):
+    store, ledger = build_store(tmp_path)
+    qc = tmp_path / "qc.vcf"
+    qc.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\n"
+        "1\t100\t.\tA\tG\t50\tPASS\tAB=Infinity\tGT\n"
+    )
+    loader = TpuQcPvcfLoader(store, ledger, "r4", log=lambda *a: None)
+    with pytest.raises(ValueError, match="Infinity"):
+        loader.load_file(str(qc), commit=True)
+
+
+def test_qc_dry_run(tmp_path):
+    store, ledger = build_store(tmp_path)
+    qc = tmp_path / "qc.vcf"
+    qc.write_text(QC_VCF)
+    counters = TpuQcPvcfLoader(store, ledger, "r4", log=lambda *a: None).load_file(
+        str(qc), commit=False
+    )
+    assert counters["update"] == 2
+    assert store.n == 3  # no insert
+    shard, i = find_row(store, 1, 100)
+    assert shard.annotations["adsp_qc"][i] is None
+
+
+def test_qc_cli(tmp_path):
+    store, ledger = build_store(tmp_path)
+    store_dir = tmp_path / "vdb"
+    store.save(str(store_dir))
+    qc = tmp_path / "qc.vcf"
+    qc.write_text(QC_VCF)
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.update_qc",
+         "--fileName", str(qc), "--storeDir", str(store_dir),
+         "--version", "r4", "--commit"],
+        capture_output=True, text=True, check=True,
+    )
+    counters = json.loads(res.stdout.splitlines()[0])
+    assert counters["update"] == 2
+    reloaded = VariantStore.load(str(store_dir))
+    assert reloaded.n == 4
+    shard, i = find_row(reloaded, 1, 100)
+    assert shard.annotations["adsp_qc"][i]["r4"]["filter"] == "PASS"

@@ -166,10 +166,15 @@ def test_ledger(tmp_path):
     assert (a1, a2) == (1, 2)
     ledger.checkpoint(a1, "x.vcf", 500, {"variant": 480})
     ledger.checkpoint(a1, "x.vcf", 1000, {"variant": 970})
-    ledger.finish(a1, {"variant": 970})
+    # mid-load (crash recovery window): checkpoints drive resume
     assert ledger.last_checkpoint("x.vcf") == 1000
+    ledger.finish(a1, {"variant": 970})
+    # finished loads don't resume: re-submitting the file is a new load
+    assert ledger.last_checkpoint("x.vcf") == 0
     assert ledger.last_checkpoint("unseen.vcf") == 0
-    # reload from disk: serial ids continue, checkpoints survive
+    # reload from disk: serial ids continue, unfinished checkpoints survive
     ledger2 = AlgorithmLedger(path)
     assert ledger2.begin("load_cadd", {}, True) == 3
-    assert ledger2.last_checkpoint("x.vcf") == 1000
+    a4 = ledger2.begin("load_vcf", {"file": "x.vcf"}, commit=True)
+    ledger2.checkpoint(a4, "x.vcf", 200, {})
+    assert AlgorithmLedger(path).last_checkpoint("x.vcf") == 200
