@@ -1,0 +1,51 @@
+"""CLI: update ``loss_of_function`` from a SnpEff-annotated VCF
+(``Load/bin/load_snpeff_lof.py`` equivalent — the reference entry point is
+dead code behind a ``NotImplementedError``; this one runs).
+
+Usage:
+    python -m annotatedvdb_tpu.cli.load_snpeff_lof --fileName snpeff.vcf[.gz] \
+        --storeDir ./vdb [--updateExisting] [--commit] [--test] \
+        [--chromosomeMap map.tsv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from annotatedvdb_tpu.io.vcf import read_chromosome_map
+from annotatedvdb_tpu.loaders.lof_loader import TpuSnpEffLofLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fileName", required=True)
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--updateExisting", action="store_true",
+                    help="overwrite existing loss_of_function values")
+    ap.add_argument("--chromosomeMap")
+    ap.add_argument("--commit", action="store_true")
+    ap.add_argument("--test", action="store_true")
+    args = ap.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    loader = TpuSnpEffLofLoader(
+        store, ledger, update_existing=args.updateExisting,
+        chromosome_map=(
+            read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
+        ),
+    )
+    counters = loader.load_file(
+        args.fileName, commit=args.commit, test=args.test,
+        persist=(lambda: store.save(args.storeDir)) if args.commit else None,
+    )
+    print(json.dumps(counters))
+    print(counters["alg_id"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,8 +3,10 @@ from .vep_loader import TpuVepLoader
 from .cadd_loader import TpuCaddUpdater
 from .update_loader import TpuUpdateLoader, UpdateStrategy
 from .qc_loader import TpuQcPvcfLoader, QcPvcfStrategy
+from .lof_loader import TpuSnpEffLofLoader, SnpEffLofStrategy
 
 __all__ = [
     "TpuVcfLoader", "TpuVepLoader", "TpuCaddUpdater",
     "TpuUpdateLoader", "UpdateStrategy", "TpuQcPvcfLoader", "QcPvcfStrategy",
+    "TpuSnpEffLofLoader", "SnpEffLofStrategy",
 ]

@@ -1,0 +1,86 @@
+"""SnpEff loss-of-function updates: ``LOF=`` / ``NMD=`` → ``loss_of_function``.
+
+Reference: ``Load/bin/load_snpeff_lof.py`` — parses SnpEff annotation strings
+``LOF=(gene|geneId|numTranscripts|fraction)`` (``:112-134``), builds
+``{'LOF': [...], 'NMD': [...]}`` update values per known variant
+(``:136-173``), and never inserts novel variants (update-only).  Lines
+without ``;LOF=`` or ``;NMD=`` are skipped before any lookup (``:264-266``).
+The reference entry point is dead code (unconditional ``raise
+NotImplementedError`` at ``:408``); the parsing/update logic it preserves is
+what this module re-expresses, live.
+
+Rows with an existing ``loss_of_function`` value are skipped unless
+``update_existing=True``; updates apply with jsonb_merge semantics (new
+LOF/NMD keys merge over the stored dict), matching the reference's
+jsonb_merge UPDATE path (``:152-166``, ``vep_variant_loader.py:227``).
+"""
+
+from __future__ import annotations
+
+from annotatedvdb_tpu.loaders.update_loader import TpuUpdateLoader, UpdateStrategy
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def parse_lof_string(value) -> list | None:
+    """Parse a SnpEff LOF/NMD annotation value into record dicts.
+
+    ``(SFI1|ENSG00000198089|30|0.17),(…)`` →
+    ``[{gene_symbol, gene_id, num_transcripts,
+    fraction_affected_transcripts}, …]`` (``load_snpeff_lof.py:112-134``).
+    Values not in the 4-field form (e.g. a bare ``;LOF;`` flag) yield ``None``
+    rather than aborting a whole load on one malformed line.
+    """
+    if value is None or value is True:
+        return None
+    records = []
+    for annotation in str(value).split(","):
+        parts = annotation.replace("(", "").replace(")", "").split("|")
+        if len(parts) < 4:
+            return None
+        try:
+            records.append({
+                "gene_symbol": parts[0],
+                "gene_id": parts[1],
+                "num_transcripts": int(parts[2]),
+                "fraction_affected_transcripts": float(parts[3]),
+            })
+        except ValueError:
+            return None
+    return records
+
+
+class SnpEffLofStrategy(UpdateStrategy):
+    """The ``generate_update_values`` analog (``load_snpeff_lof.py:136-173``)."""
+
+    insert_novel = False  # LoF updates never insert (reference :40 TODO note)
+
+    def __init__(self, update_existing: bool = False):
+        self.update_existing = update_existing
+
+    def values(self, row: dict, existing: dict | None):
+        info = row["info"]
+        lof = parse_lof_string(info.get("LOF"))
+        nmd = parse_lof_string(info.get("NMD"))
+        if lof is None and nmd is None:
+            return False, {}, {}
+        if existing is not None:
+            stored = existing.get("loss_of_function")
+            if stored is not None and not self.update_existing:
+                return False, {}, {}
+        update_values = {}
+        if lof is not None:
+            update_values["LOF"] = lof
+        if nmd is not None:
+            update_values["NMD"] = nmd
+        return True, {}, {"loss_of_function": update_values}
+
+
+class TpuSnpEffLofLoader(TpuUpdateLoader):
+    """Update-only SnpEff LoF/NMD loader."""
+
+    def __init__(self, store: VariantStore, ledger: AlgorithmLedger,
+                 update_existing: bool = False, **kw):
+        super().__init__(
+            store, ledger, SnpEffLofStrategy(update_existing=update_existing),
+            **kw,
+        )

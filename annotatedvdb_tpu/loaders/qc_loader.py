@@ -32,6 +32,10 @@ class QcPvcfStrategy(UpdateStrategy):
         self.update_existing = update_existing
 
     def values(self, row: dict, existing: dict | None):
+        if existing is not None and not self.update_existing:
+            stored = existing.get("adsp_qc")
+            if stored is not None and self.version in stored:
+                return False, {}, {}
         qc_values = {
             self.version: {
                 "info": row["info"],
@@ -43,14 +47,12 @@ class QcPvcfStrategy(UpdateStrategy):
         # the reference aborts on Infinity anywhere in the QC payload
         # (update_from_qc_pvcf_file.py:141-145): such values are upstream
         # QC-pipeline bugs and would be invalid JSON
-        if "Infinity" in json.dumps(qc_values):
+        try:
+            json.dumps(qc_values, allow_nan=False)
+        except ValueError:
             raise ValueError(
-                f"Infinity found among QC scores for {row['variant_id']}"
+                f"Infinity/NaN found among QC scores for {row['variant_id']}"
             )
-        if existing is not None and not self.update_existing:
-            stored = existing.get("adsp_qc")
-            if stored is not None and self.version in stored:
-                return False, {}, {}
         # PASS -> true; anything else leaves the flag NULL, not false
         adsp_flag = 1 if row["filter"] == "PASS" else -1
         return True, {"is_adsp_variant": adsp_flag}, {"adsp_qc": qc_values}

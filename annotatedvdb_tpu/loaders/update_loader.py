@@ -40,6 +40,10 @@ class UpdateStrategy:
     #: inserts novel variants; SnpEff LoF updates never insert)
     insert_novel = False
 
+    #: numeric store columns the strategy wants in its ``existing`` view
+    #: (annotation JSONB columns are always included)
+    numeric_columns: tuple = ()
+
     def values(self, row: dict, existing: dict | None):
         raise NotImplementedError
 
@@ -135,9 +139,8 @@ class TpuUpdateLoader:
                     c: shard.annotations[c][row_idx]
                     for c in shard.annotations
                 }
-                existing["is_adsp_variant"] = int(
-                    shard.cols["is_adsp_variant"][row_idx]
-                )
+                for c in self.strategy.numeric_columns:
+                    existing[c] = int(shard.cols[c][row_idx])
                 do_update, flags, jsonb = self.strategy.values(
                     self._row_dict(chunk, int(i)), existing
                 )

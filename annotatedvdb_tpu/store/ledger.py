@@ -73,22 +73,33 @@ class AlgorithmLedger:
         )
 
     def last_checkpoint(self, input_file: str) -> int:
-        """Last committed line for an input file among UNFINISHED invocations
-        (0 if none) — the idempotent resume point.  Checkpoints of completed
-        loads don't count: a finished file re-submitted is a new load (the
-        loader's own skip/duplicate policy decides what to do with its rows),
+        """Resume cursor for an input file: the line of its most recently
+        appended checkpoint, and only if that checkpoint's invocation never
+        finished (0 otherwise).  Only the latest invocation counts — a
+        checkpoint left by a crashed load is superseded once a later
+        invocation completes the file, so re-submitting a finished file is a
+        fresh load (the loader's own skip/duplicate policy governs its rows),
         not a crash recovery."""
         finished = {
             e["alg_id"] for e in self._entries if e.get("type") == "finish"
         }
-        lines = [
-            e["line"]
-            for e in self._entries
-            if e.get("type") == "checkpoint"
-            and e.get("file") == input_file
-            and e.get("alg_id") not in finished
-        ]
-        return max(lines, default=0)
+        for pos in range(len(self._entries) - 1, -1, -1):
+            e = self._entries[pos]
+            if e.get("type") != "checkpoint" or e.get("file") != input_file:
+                continue
+            if e["alg_id"] in finished:
+                return 0
+            # a later invocation on the same file that finished supersedes a
+            # crashed checkpoint even if it wrote no checkpoints of its own
+            # (a resume run whose chunks were all already covered)
+            later_finished = any(
+                inv.get("type") == "invocation"
+                and inv.get("params", {}).get("file") == input_file
+                and inv["alg_id"] in finished
+                for inv in self._entries[pos + 1:]
+            )
+            return 0 if later_finished else e["line"]
+        return 0
 
     def invocations(self) -> list[dict]:
         return [e for e in self._entries if e.get("type") == "invocation"]

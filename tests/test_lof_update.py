@@ -1,0 +1,122 @@
+"""SnpEff LoF/NMD update tests (reference ``load_snpeff_lof.py``)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from annotatedvdb_tpu.loaders import TpuSnpEffLofLoader, TpuVcfLoader
+from annotatedvdb_tpu.loaders.lof_loader import parse_lof_string
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+BASE_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\t.\tA\tG\t.\t.\t.
+1\t200\t.\tC\tT\t.\t.\t.
+2\t100\t.\tT\tA\t.\t.\t.
+"""
+
+LOF_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\t.\tA\tG\t.\t.\tAC=3;LOF=(SFI1|ENSG00000198089|30|0.17)
+1\t200\t.\tC\tT\t.\t.\tNMD=(PRAME|ENSG00000185686|14|0.57);AC=1
+1\t300\t.\tG\tC\t.\t.\tLOF=(GENE|ENSG0|1|1.0)
+2\t100\t.\tT\tA\t.\t.\tAC=9
+"""
+
+
+def build_store(tmp_path):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "base.vcf"
+    vcf.write_text(BASE_VCF)
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    return store, ledger
+
+
+def find_row(store, code, pos):
+    shard = store.shard(code)
+    i = int(np.searchsorted(shard.cols["pos"], pos))
+    assert shard.cols["pos"][i] == pos
+    return shard, i
+
+
+def test_parse_lof_string():
+    # load_snpeff_lof.py:112-134 format, incl. multi-record values
+    recs = parse_lof_string("(SFI1|ENSG00000198089|30|0.17),(X|ENSGX|2|0.5)")
+    assert recs == [
+        {"gene_symbol": "SFI1", "gene_id": "ENSG00000198089",
+         "num_transcripts": 30, "fraction_affected_transcripts": 0.17},
+        {"gene_symbol": "X", "gene_id": "ENSGX",
+         "num_transcripts": 2, "fraction_affected_transcripts": 0.5},
+    ]
+    assert parse_lof_string(None) is None
+    # malformed values (bare ;LOF; flag, short/non-numeric records) are
+    # skipped, not fatal mid-load
+    assert parse_lof_string(True) is None
+    assert parse_lof_string("(GENE|ENSG0)") is None
+    assert parse_lof_string("(GENE|ENSG0|x|y)") is None
+
+
+def test_lof_update(tmp_path):
+    store, ledger = build_store(tmp_path)
+    lof = tmp_path / "lof.vcf"
+    lof.write_text(LOF_VCF)
+    counters = TpuSnpEffLofLoader(store, ledger, log=lambda *a: None).load_file(
+        str(lof), commit=True
+    )
+    # 1:100 LOF, 1:200 NMD updated; 1:300 unknown (update-only — NOT inserted);
+    # 2:100 known but has neither LOF nor NMD -> skipped
+    assert counters["update"] == 2
+    assert counters["skipped"] >= 1
+    assert counters["not_found"] == 1
+    assert store.n == 3
+
+    shard, i = find_row(store, 1, 100)
+    assert shard.annotations["loss_of_function"][i] == {
+        "LOF": [{"gene_symbol": "SFI1", "gene_id": "ENSG00000198089",
+                 "num_transcripts": 30,
+                 "fraction_affected_transcripts": 0.17}]
+    }
+    shard, i = find_row(store, 1, 200)
+    assert "NMD" in shard.annotations["loss_of_function"][i]
+    assert "LOF" not in shard.annotations["loss_of_function"][i]
+    shard, i = find_row(store, 2, 100)
+    assert shard.annotations["loss_of_function"][i] is None
+
+
+def test_lof_skip_existing_unless_update_existing(tmp_path):
+    store, ledger = build_store(tmp_path)
+    lof = tmp_path / "lof.vcf"
+    lof.write_text(LOF_VCF)
+    TpuSnpEffLofLoader(store, ledger, log=lambda *a: None).load_file(
+        str(lof), commit=True
+    )
+    c2 = TpuSnpEffLofLoader(store, ledger, log=lambda *a: None).load_file(
+        str(lof), commit=True
+    )
+    assert c2["update"] == 0  # existing values not overwritten by default
+
+    c3 = TpuSnpEffLofLoader(
+        store, ledger, update_existing=True, log=lambda *a: None
+    ).load_file(str(lof), commit=True)
+    assert c3["update"] == 2
+
+
+def test_lof_cli(tmp_path):
+    store, ledger = build_store(tmp_path)
+    store_dir = tmp_path / "vdb"
+    store.save(str(store_dir))
+    lof = tmp_path / "lof.vcf"
+    lof.write_text(LOF_VCF)
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.load_snpeff_lof",
+         "--fileName", str(lof), "--storeDir", str(store_dir), "--commit"],
+        capture_output=True, text=True, check=True,
+    )
+    counters = json.loads(res.stdout.splitlines()[0])
+    assert counters["update"] == 2
+    reloaded = VariantStore.load(str(store_dir))
+    shard, i = find_row(reloaded, 1, 100)
+    assert "LOF" in shard.annotations["loss_of_function"][i]

@@ -178,3 +178,29 @@ def test_ledger(tmp_path):
     a4 = ledger2.begin("load_vcf", {"file": "x.vcf"}, commit=True)
     ledger2.checkpoint(a4, "x.vcf", 200, {})
     assert AlgorithmLedger(path).last_checkpoint("x.vcf") == 200
+
+
+def test_ledger_crashed_invocation_superseded_by_later_finish(tmp_path):
+    """A checkpoint left by a crashed load must not resurrect as a resume
+    point after a later invocation completes the same file."""
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    a1 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    ledger.checkpoint(a1, "f.vcf", 1000, {})  # crash: a1 never finishes
+    a2 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    assert ledger.last_checkpoint("f.vcf") == 1000  # a2 resumes from a1
+    ledger.checkpoint(a2, "f.vcf", 5000, {})
+    ledger.finish(a2, {})
+    # file fully loaded: a fresh submission starts at line 0, not 1000
+    assert ledger.last_checkpoint("f.vcf") == 0
+
+
+def test_ledger_resume_run_with_no_checkpoints_still_supersedes(tmp_path):
+    """If the crash happened after the final chunk's checkpoint, the resume
+    run replays everything as no-ops and writes no checkpoints of its own —
+    its finish must still clear the crashed cursor."""
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    a1 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    ledger.checkpoint(a1, "f.vcf", 1000, {})  # final chunk; crash before finish
+    a2 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    ledger.finish(a2, {})  # all chunks were covered; no new checkpoints
+    assert ledger.last_checkpoint("f.vcf") == 0
