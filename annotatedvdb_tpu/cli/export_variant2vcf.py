@@ -1,0 +1,107 @@
+"""CLI: dump the variant store back to VCF files for bulk re-processing
+(``Util/bin/export_variant2vcf.py`` equivalent).
+
+Per chromosome, writes ``<chr>_<n>.vcf`` shards of at most
+``--variantsPerFile`` rows (reference: 10M, ``:24``), with the record
+primary key in the ID column so downstream updates can join back.  Variants
+whose alleles carry the invalid single-letter codes ``I|R|D|N`` are diverted
+to ``<chr>_invalid.txt`` (``:27,75-77``).
+
+Usage:
+    python -m annotatedvdb_tpu.cli.export_variant2vcf \
+        --storeDir ./vdb --outputDir ./export [--chr 22]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+
+from annotatedvdb_tpu.store import VariantStore
+from annotatedvdb_tpu.types import chromosome_label
+
+VCF_HEADER = ["#CHRM", "POS", "ID", "REF", "ALT", "QUAL", "FILTER", "INFO"]
+VARIANTS_PER_FILE = 10_000_000
+_INVALID_ALLELE = re.compile(r"^[IRDN]$")
+
+
+def shard_primary_key(shard, i: int) -> str:
+    """Row's record PK: retained digest PK for the long-allele tail, else
+    literal ``chr:pos:ref:alt[:rs]`` (``primary_key_generator.py:99-122``)."""
+    if shard.digest_pk[i] is not None:
+        return shard.digest_pk[i]
+    ref, alt = shard.alleles(i)
+    label = chromosome_label(shard.chrom_code)
+    parts = [label, str(int(shard.cols["pos"][i])), ref, alt]
+    rs = int(shard.cols["ref_snp"][i])
+    if rs >= 0:
+        parts.append(f"rs{rs}")
+    return ":".join(parts)
+
+
+def export_chromosome(store: VariantStore, code: int, out_dir: str,
+                      variants_per_file: int) -> dict:
+    label = chromosome_label(code)
+    shard = store.shards[code]
+    counters = {"exported": 0, "invalid": 0, "files": 0}
+    file_count, rows_in_file, fh = 0, 0, None
+    invalid_path = os.path.join(out_dir, f"{label}_invalid.txt")
+    with open(invalid_path, "w") as invalid_fh:
+        try:
+            for i in range(shard.n):
+                ref, alt = shard.alleles(i)
+                pk = shard_primary_key(shard, i)
+                if _INVALID_ALLELE.match(ref) or _INVALID_ALLELE.match(alt):
+                    print(pk, file=invalid_fh)
+                    counters["invalid"] += 1
+                    continue
+                if fh is None or rows_in_file >= variants_per_file:
+                    if fh:
+                        fh.close()
+                    file_count += 1
+                    fh = open(
+                        os.path.join(out_dir, f"{label}_{file_count}.vcf"), "w"
+                    )
+                    print(*VCF_HEADER, sep="\t", file=fh)
+                    rows_in_file = 0
+                print(label, int(shard.cols["pos"][i]), pk, ref, alt,
+                      ".", ".", ".", sep="\t", file=fh)
+                rows_in_file += 1
+                counters["exported"] += 1
+        finally:
+            if fh:
+                fh.close()
+    counters["files"] = file_count
+    return counters
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--outputDir", required=True)
+    ap.add_argument("--chr", default="all",
+                    help="chromosome to export (default: all)")
+    ap.add_argument("--variantsPerFile", type=int, default=VARIANTS_PER_FILE)
+    args = ap.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    os.makedirs(args.outputDir, exist_ok=True)
+    codes = sorted(store.shards)
+    if args.chr != "all":
+        from annotatedvdb_tpu.types import chromosome_code
+        codes = [c for c in codes if c == chromosome_code(args.chr)]
+    total = {"exported": 0, "invalid": 0, "files": 0}
+    for code in codes:
+        counters = export_chromosome(
+            store, code, args.outputDir, args.variantsPerFile
+        )
+        for k in total:
+            total[k] += counters[k]
+        print(f"chr{chromosome_label(code)}: {counters}")
+    print(total)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

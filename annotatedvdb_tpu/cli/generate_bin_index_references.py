@@ -1,0 +1,73 @@
+"""CLI: materialize the hierarchical bin-index reference table
+(``BinIndex/bin/generate_bin_index_references.py`` equivalent).
+
+The reference recursively subdivides each chromosome into a 14-level bin
+tree (increments halving 64 Mb -> 15.625 kb, ``:93``) and inserts rows
+``(chromosome, level, global_bin, global_bin_path, location '(lower,upper]')``
+into a ``BinIndexRef`` Postgres table (``:79-83,98``).  The TPU framework
+does not need the table at runtime — bin lookups are closed-form on device
+(``ops/binindex.py``) — so this emits the identical rows as TSV for parity
+checks and for Postgres-compatible egress (COPY-able into BinIndexRef).
+
+Usage:
+    python -m annotatedvdb_tpu.cli.generate_bin_index_references \
+        -m hg19_chr_map.txt [-o bin_index_ref.tsv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from annotatedvdb_tpu.oracle.binindex import BinTree
+
+
+def read_chr_map(path: str) -> dict:
+    """chrom label -> sequence length (tab-delim, no header;
+    ``generate_bin_index_references.py:17-25``)."""
+    out = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.rstrip()
+            if not line:
+                continue
+            chrom, length = line.split("\t")[:2]
+            out[chrom] = int(length)
+    return out
+
+
+def emit_rows(chr_map: dict, out) -> int:
+    """Depth-first rows matching the reference's insert order; global_bin is
+    the 1-based running count across all chromosomes (``:56-58``)."""
+    global_bin = 0
+    for chrom, seq_length in chr_map.items():
+        tree = BinTree(chrom, seq_length)
+        for level, path, lower, upper in tree.rows:
+            global_bin += 1
+            print(
+                chrom, level, global_bin, path, f"({lower},{upper}]",
+                sep="\t", file=out,
+            )
+    return global_bin
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-m", "--chromosomeMap", required=True,
+                    help="tab-delim chrom<TAB>length, no header")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output TSV (default stdout)")
+    args = ap.parse_args(argv)
+
+    chr_map = read_chr_map(args.chromosomeMap)
+    if args.output:
+        with open(args.output, "w") as out:
+            n = emit_rows(chr_map, out)
+    else:
+        n = emit_rows(chr_map, sys.stdout)
+    print(f"generated {n} bins for {len(chr_map)} chromosomes", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,56 @@
+"""CLI: generic TSV-driven annotation updates
+(``Load/bin/update_variant_annotation.py`` equivalent).
+
+The input is tab-delimited with a ``variant`` column (metaseq id, refSNP id,
+or record primary key per ``--variantIdType``) plus columns named after
+Variant-table fields; update fields are inferred from the header.
+
+Usage:
+    python -m annotatedvdb_tpu.cli.update_variant_annotation \
+        --fileName ann.tsv --storeDir ./vdb [--variantIdType METASEQ] \
+        [--datasource NIAGADS] [--skipExisting] [--commit] [--test]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from annotatedvdb_tpu.loaders.txt_loader import TpuTextLoader, VARIANT_ID_TYPES
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fileName", required=True)
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--variantIdType", default="METASEQ",
+                    choices=VARIANT_ID_TYPES)
+    ap.add_argument("--datasource", default=None)
+    ap.add_argument("--skipExisting", action="store_true",
+                    help="skip known variants instead of updating them")
+    ap.add_argument("--commit", action="store_true")
+    ap.add_argument("--test", action="store_true")
+    args = ap.parse_args(argv)
+
+    store = VariantStore.load(args.storeDir)
+    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    loader = TpuTextLoader(
+        store, ledger,
+        variant_id_type=args.variantIdType,
+        datasource=args.datasource,
+        update_existing=not args.skipExisting,
+        skip_existing=args.skipExisting,
+    )
+    counters = loader.load_file(
+        args.fileName, commit=args.commit, test=args.test,
+        persist=(lambda: store.save(args.storeDir)) if args.commit else None,
+    )
+    print(json.dumps(counters))
+    print(counters["alg_id"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -219,12 +219,10 @@ class VcfBatchReader:
 
 
 def read_chromosome_map(path: str) -> dict:
-    """TSV (accession <tab> chromosome [...]) -> {accession: chromosome}
-    (``parsers/chromosome_map_parser.py:49-62`` capability)."""
-    out = {}
-    with _open_text(path) as fh:
-        for line in fh:
-            parts = line.rstrip("\n").split("\t")
-            if len(parts) >= 2 and not line.startswith("#"):
-                out[parts[0]] = parts[1]
-    return out
+    """TSV (headered or accession <tab> chromosome) -> {accession: chromosome}
+    (``parsers/chromosome_map_parser.py:49-62``).  Thin wrapper over
+    :class:`~annotatedvdb_tpu.io.chromosome_map.ChromosomeMap` so there is
+    exactly one parser for the format."""
+    from annotatedvdb_tpu.io.chromosome_map import ChromosomeMap
+
+    return ChromosomeMap(path).chromosome_map()

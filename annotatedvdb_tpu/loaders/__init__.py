@@ -4,9 +4,10 @@ from .cadd_loader import TpuCaddUpdater
 from .update_loader import TpuUpdateLoader, UpdateStrategy
 from .qc_loader import TpuQcPvcfLoader, QcPvcfStrategy
 from .lof_loader import TpuSnpEffLofLoader, SnpEffLofStrategy
+from .txt_loader import TpuTextLoader
 
 __all__ = [
     "TpuVcfLoader", "TpuVepLoader", "TpuCaddUpdater",
     "TpuUpdateLoader", "UpdateStrategy", "TpuQcPvcfLoader", "QcPvcfStrategy",
-    "TpuSnpEffLofLoader", "SnpEffLofStrategy",
+    "TpuSnpEffLofLoader", "SnpEffLofStrategy", "TpuTextLoader",
 ]

@@ -1,0 +1,339 @@
+"""Tab-delimited annotation loads: header-driven column updates/inserts.
+
+Reference: ``Util/lib/python/loaders/txt_variant_loader.py`` +
+``Load/bin/update_variant_annotation.py`` — a TSV whose header names
+``AnnotatedVDB.Variant`` columns, keyed by a ``variant`` column holding a
+metaseq id, refSNP id, or record primary key.  Update fields are inferred
+from ``header ∩ ALLOWABLE_COPY_FIELDS`` (``txt_variant_loader.py:94-115``);
+JSONB columns update with jsonb_merge semantics, ``bin_index`` casts to
+ltree, scalars assign directly (``:118-152``); known variants update, novel
+metaseq-identified variants insert with full annotation (PK, bin index,
+display attributes, ``:214-256``).
+
+Batch-shaped here: rows accumulate per chromosome and resolve through one
+vectorized shard lookup (or an ``np.isin`` scan for refSNP keys) instead of
+one ``is_duplicate`` SQL round-trip per line; novel rows re-chunk through
+the standard :class:`TpuVcfLoader` insert path.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import re
+
+import numpy as np
+
+from annotatedvdb_tpu.io.vcf import VcfChunk
+from annotatedvdb_tpu.loaders.vcf_loader import TpuVcfLoader, _fnv32_str, _rs_number
+from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
+from annotatedvdb_tpu.types import (
+    VariantBatch, chromosome_code, encode_allele_array,
+)
+from annotatedvdb_tpu.utils.strings import to_numeric
+
+#: Variant-table columns a TSV header may target
+#: (``variant_loader.py:63-69`` ALLOWABLE_COPY_FIELDS minus the
+#: identity/bookkeeping fields the loader itself owns).
+UPDATABLE_FIELDS = [
+    "is_multi_allelic", "is_adsp_variant", "ref_snp_id",
+] + JSONB_COLUMNS
+
+#: id flavors accepted in the ``variant`` column
+#: (``database/variant.py`` VARIANT_ID_TYPES).
+VARIANT_ID_TYPES = ["METASEQ", "PRIMARY_KEY", "REFSNP"]
+
+_ALLELE_RE = re.compile(r"^[ACGTUN-]+$", re.IGNORECASE)
+
+
+def parse_variant_id(variant_id: str, id_type: str):
+    """Split a ``variant`` column value into its identity parts.
+
+    Returns ``(chrom_code, pos, ref, alt, rs)`` where ``ref``/``alt`` are
+    None for refSNP and digest-PK ids (``txt_variant_loader.py:160-186``).
+    """
+    if id_type == "REFSNP":
+        return None, None, None, None, variant_id
+    parts = variant_id.split(":")
+    if len(parts) < 2:
+        raise ValueError(f"unparseable variant id: {variant_id!r}")
+    code = chromosome_code(parts[0])
+    pos = int(parts[1])
+    ref = alt = rs = None
+    if len(parts) >= 4 and _ALLELE_RE.match(parts[2]) and _ALLELE_RE.match(parts[3]):
+        ref, alt = parts[2].upper(), parts[3].upper()
+        if len(parts) >= 5:
+            rs = parts[4]
+    elif len(parts) >= 3:
+        # digest-form primary key chr:pos:<VRS digest>[:rs]
+        if id_type == "METASEQ":
+            raise ValueError(f"metaseq id without alleles: {variant_id!r}")
+        if len(parts) >= 4:
+            rs = parts[3]
+    return code, pos, ref, alt, rs
+
+
+def coerce_update_value(field: str, value):
+    """TSV cell -> store value; 'NULL' and '' mean no value
+    (``txt_variant_loader.py:199-203`` NULL handling)."""
+    if value is None or value in ("NULL", ""):
+        return None
+    if field in JSONB_COLUMNS:
+        if isinstance(value, str):
+            try:
+                return json.loads(value)
+            except json.JSONDecodeError as err:
+                raise ValueError(
+                    f"column {field}: invalid JSON {value!r}: {err}"
+                ) from err
+        return value
+    if field in ("is_adsp_variant", "is_multi_allelic"):
+        v = str(value).strip().lower()
+        return 1 if v in ("true", "t", "1") else 0
+    if field == "ref_snp_id":
+        return str(value)
+    return to_numeric(value)
+
+
+class TpuTextLoader:
+    """Update/insert variants from a column-named tab-delimited file."""
+
+    def __init__(
+        self,
+        store: VariantStore,
+        ledger: AlgorithmLedger,
+        variant_id_type: str = "METASEQ",
+        datasource: str | None = None,
+        update_existing: bool = True,
+        skip_existing: bool = False,
+        batch_size: int = 1 << 15,
+        log=print,
+    ):
+        if variant_id_type not in VARIANT_ID_TYPES:
+            raise ValueError(f"variant_id_type must be one of {VARIANT_ID_TYPES}")
+        self.store = store
+        self.ledger = ledger
+        self.variant_id_type = variant_id_type
+        self.datasource = datasource.lower() if datasource else None
+        self.update_existing = update_existing
+        self.skip_existing = skip_existing
+        self.batch_size = batch_size
+        self.log = log
+        self.insert_loader = TpuVcfLoader(
+            store, ledger, datasource=datasource, skip_existing=False, log=log
+        )
+        self.update_fields: list[str] = []
+        self.counters = {
+            "line": 0, "variant": 0, "update": 0, "skipped": 0,
+            "duplicates": 0, "not_found": 0, "inserted": 0,
+        }
+
+    @property
+    def is_adsp(self) -> bool:
+        return self.datasource == "adsp"
+
+    # ------------------------------------------------------------------
+
+    def load_file(self, path: str, commit: bool = False, test: bool = False,
+                  persist=None, resume: bool = True) -> dict:
+        alg_id = self.ledger.begin(
+            "TpuTextLoader.load_file",
+            {"file": path, "id_type": self.variant_id_type, "test": test},
+            commit,
+        )
+        resume_line = self.ledger.last_checkpoint(path) if resume else 0
+        if resume_line:
+            self.log(f"resuming {path} after committed line {resume_line}")
+        with open(path, newline="") as fh:
+            reader = csv.DictReader(fh, delimiter="\t")
+            if reader.fieldnames is None or "variant" not in reader.fieldnames:
+                raise ValueError(f"{path}: no 'variant' column in header")
+            # header ∩ allowable = the update fields (txt_variant_loader:94-115)
+            self.update_fields = [
+                f for f in reader.fieldnames if f in UPDATABLE_FIELDS
+            ]
+            pending: list[tuple[int, dict]] = []
+            for line_no, row in enumerate(reader, start=2):  # 1 = header
+                self.counters["line"] += 1
+                if resume_line and line_no <= resume_line:
+                    self.counters["skipped"] += 1
+                    continue
+                pending.append((line_no, row))
+                if len(pending) >= self.batch_size:
+                    self._apply_batch(pending, alg_id, commit)
+                    if commit:
+                        if persist is not None:
+                            persist()
+                        self.ledger.checkpoint(
+                            alg_id, path, pending[-1][0], dict(self.counters)
+                        )
+                    pending = []
+                    if test:
+                        self.log("test mode: stopping after first batch")
+                        break
+            if pending:
+                self._apply_batch(pending, alg_id, commit)
+                if commit:
+                    if persist is not None:
+                        persist()
+                    self.ledger.checkpoint(
+                        alg_id, path, pending[-1][0], dict(self.counters)
+                    )
+        self.ledger.finish(alg_id, dict(self.counters))
+        self.counters["alg_id"] = alg_id
+        return dict(self.counters)
+
+    # ------------------------------------------------------------------
+
+    def _apply_batch(self, pending: list, alg_id: int, commit: bool) -> None:
+        parsed = []  # (line_no, row, code, pos, ref, alt, rs)
+        for line_no, row in pending:
+            self.counters["variant"] += 1
+            try:
+                code, pos, ref, alt, rs = parse_variant_id(
+                    row["variant"], self.variant_id_type
+                )
+            except ValueError as err:
+                self.log(f"line {line_no}: {err}; skipping")
+                self.counters["skipped"] += 1
+                continue
+            parsed.append((line_no, row, code, pos, ref, alt, rs))
+
+        # REFSNP ids resolve in one np.isin pass per shard, not per row
+        rs_index = (
+            self._build_rs_index(parsed)
+            if self.variant_id_type == "REFSNP" else None
+        )
+
+        novel = []
+        for entry in parsed:
+            found_at = self._resolve(entry, rs_index)
+            if found_at is None:
+                if self.variant_id_type == "METASEQ":
+                    novel.append(entry)
+                else:
+                    self.counters["not_found"] += 1
+                continue
+            self.counters["duplicates"] += 1
+            if self.skip_existing or not self.update_existing:
+                self.counters["skipped"] += 1
+                continue
+            self._apply_update(found_at, entry[1], alg_id, commit)
+
+        if novel:
+            self._insert_novel(novel, alg_id, commit)
+
+    def _build_rs_index(self, parsed: list) -> dict:
+        """rs number -> (shard, row) for every rs id in the batch: one
+        vectorized membership pass per shard."""
+        wanted = np.unique(
+            [n for n in (_rs_number(e[6]) for e in parsed) if n >= 0]
+        ).astype(np.int64)
+        index: dict[int, tuple] = {}
+        if wanted.size == 0:
+            return index
+        for shard in self.store.shards.values():
+            hits = np.where(np.isin(shard.cols["ref_snp"], wanted))[0]
+            for i in hits:
+                index.setdefault(int(shard.cols["ref_snp"][i]), (shard, int(i)))
+        return index
+
+    def _resolve(self, entry, rs_index: dict | None = None):
+        """Locate one variant in the store; returns (shard, row) or None."""
+        _, _, code, pos, ref, alt, rs = entry
+        if self.variant_id_type == "REFSNP":
+            if rs_index is None:
+                rs_index = self._build_rs_index([entry])
+            return rs_index.get(_rs_number(rs))
+        if code not in self.store.shards:
+            return None
+        shard = self.store.shards[code]
+        if ref is not None:
+            refs, ref_len = encode_allele_array([ref], shard.width)
+            alts, alt_len = encode_allele_array([alt], shard.width)
+            if ref_len[0] > shard.width or alt_len[0] > shard.width:
+                h = np.array([_fnv32_str(ref, alt)], np.uint32)
+            else:
+                h = np.asarray(
+                    allele_hash_jit(refs, alts, ref_len, alt_len)
+                )
+            found, idx = shard.lookup(
+                np.array([pos], np.int32), h, refs, alts, ref_len, alt_len
+            )
+            return (shard, int(idx[0])) if found[0] else None
+        # digest-form PK: linear scan of the (rare) digest tail; match on the
+        # digest segment + position — never on the raw input chromosome
+        # token, which may be 'chr1'/'MT' while stored PKs use '1'/'M'
+        variant_digest = entry[1]["variant"].split(":")[2]
+        for i, pk in enumerate(shard.digest_pk):
+            if pk is not None and shard.cols["pos"][i] == pos \
+                    and pk.split(":")[2] == variant_digest:
+                return shard, i
+        return None
+
+    def _apply_update(self, found_at, row: dict, alg_id: int, commit: bool):
+        shard, i = found_at
+        self.counters["update"] += 1
+        if not commit:
+            return
+        one = np.array([i])
+        for f in self.update_fields:
+            value = coerce_update_value(f, row.get(f))
+            if value is None:
+                continue
+            if f in JSONB_COLUMNS:
+                shard.update_annotation(one, f, [value])
+            elif f == "ref_snp_id":
+                shard.cols["ref_snp"][i] = _rs_number(value)
+            else:
+                shard.cols[f][i] = value
+        if self.is_adsp:
+            shard.cols["is_adsp_variant"][i] = 1
+        shard.cols["row_algorithm_id"][i] = alg_id
+
+    def _insert_novel(self, novel: list, alg_id: int, commit: bool) -> None:
+        """Insert metaseq-identified rows through the standard VCF insert
+        path, then apply the TSV's annotation values to the fresh rows
+        (``txt_variant_loader.py:214-256``)."""
+        chunk = _chunk_from_rows(novel, self.store.width)
+        before = self.insert_loader.counters["variant"]
+        self.insert_loader._load_chunk(chunk, alg_id, commit, 0, None)
+        self.counters["inserted"] += (
+            self.insert_loader.counters["variant"] - before
+        )
+        if not commit:
+            self.counters["update"] += len(novel)
+            return
+        for entry in novel:
+            found_at = self._resolve(entry)
+            if found_at is not None:
+                self._apply_update(found_at, entry[1], alg_id, commit)
+
+
+def _chunk_from_rows(novel: list, width: int) -> VcfChunk:
+    rows = [(e[2], e[3], e[4], e[5]) for e in novel]  # code,pos,ref,alt
+    batch = VariantBatch.from_tuples(rows, width=width)
+    batch = batch._replace(chrom=np.array([r[0] for r in rows], np.int8))
+    n = len(rows)
+    return VcfChunk(
+        batch=batch,
+        refs=[e[4] for e in novel],
+        alts=[e[5] for e in novel],
+        ref_snp=[
+            e[6] or (e[1].get("ref_snp_id") if e[1].get("ref_snp_id")
+                     not in (None, "", "NULL") else None)
+            for e in novel
+        ],
+        variant_id=[e[1]["variant"] for e in novel],
+        is_multi_allelic=np.zeros(n, bool),
+        frequencies=[None] * n,
+        rs_position=[None] * n,
+        info=[{}] * n,
+        line_number=np.array([e[0] for e in novel], np.int64),
+        qual=[None] * n,
+        filter=[None] * n,
+        format=[None] * n,
+        counters={},
+    )

@@ -80,7 +80,8 @@ class TpuUpdateLoader:
     def load_file(self, path: str, commit: bool = False, test: bool = False,
                   persist=None, resume: bool = True) -> dict:
         alg_id = self.ledger.begin(
-            type(self.strategy).__name__ + ".load_file", {"file": path}, commit
+            type(self.strategy).__name__ + ".load_file",
+            {"file": path, "test": test}, commit,
         )
         resume_line = self.ledger.last_checkpoint(path) if resume else 0
         if resume_line:

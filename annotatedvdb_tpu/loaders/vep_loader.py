@@ -75,7 +75,8 @@ class TpuVepLoader:
 
     def load_file(self, path: str, commit: bool = False, test: bool = False) -> dict:
         alg_id = self.ledger.begin(
-            "TpuVepLoader.load_file", {"file": path, "datasource": self.datasource},
+            "TpuVepLoader.load_file",
+            {"file": path, "datasource": self.datasource, "test": test},
             commit,
         )
         pending: list[dict] = []

@@ -89,12 +89,16 @@ class AlgorithmLedger:
                 continue
             if e["alg_id"] in finished:
                 return 0
-            # a later invocation on the same file that finished supersedes a
-            # crashed checkpoint even if it wrote no checkpoints of its own
-            # (a resume run whose chunks were all already covered)
+            # a later COMMIT invocation on the same file that finished
+            # supersedes a crashed checkpoint even if it wrote no checkpoints
+            # of its own (a resume run whose chunks were all already covered).
+            # Dry runs (commit_mode False) and --test runs stop early / persist
+            # nothing, so they never count as completing the file.
             later_finished = any(
                 inv.get("type") == "invocation"
                 and inv.get("params", {}).get("file") == input_file
+                and inv.get("commit_mode")
+                and not inv.get("params", {}).get("test")
                 and inv["alg_id"] in finished
                 for inv in self._entries[pos + 1:]
             )

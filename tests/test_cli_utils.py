@@ -1,0 +1,193 @@
+"""Bin-index reference generation, VCF export, per-chromosome split, and
+chromosome-map parsing (reference ``generate_bin_index_references.py``,
+``export_variant2vcf.py``, ``split_vcf_by_chr.py``,
+``chromosome_map_parser.py``)."""
+
+import gzip
+import subprocess
+import sys
+
+from annotatedvdb_tpu.cli.export_variant2vcf import shard_primary_key
+from annotatedvdb_tpu.cli.generate_bin_index_references import (
+    emit_rows, read_chr_map,
+)
+from annotatedvdb_tpu.cli.split_vcf_by_chr import split_file
+from annotatedvdb_tpu.io.chromosome_map import ChromosomeMap
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+
+def test_bin_index_reference_rows(tmp_path):
+    """Rows must match the reference recursion: depth-first order, (]
+    intervals clamped at sequence length, labels chrN.L1.Bk..."""
+    out = tmp_path / "bins.tsv"
+    chr_map = {"chr21": 48_129_895}
+    with open(out, "w") as fh:
+        n = emit_rows(chr_map, fh)
+    rows = [line.split("\t") for line in out.read_text().splitlines()]
+    assert len(rows) == n
+    # level 0: whole chromosome
+    assert rows[0] == ["chr21", "0", "1", "chr21", "(0,48129895]"]
+    # first level-1 bin: 64Mb clamped to sequence length
+    assert rows[1] == ["chr21", "1", "2", "chr21.L1.B1", "(0,48129895]"]
+    # first level-2 bin: 32Mb
+    assert rows[2][3] == "chr21.L1.B1.L2.B1"
+    assert rows[2][4] == "(0,32000000]"
+    # depth-first: the second level-2 bin appears only after the entire
+    # subtree of the first (levels 3..13)
+    paths = [r[3] for r in rows]
+    i2 = paths.index("chr21.L1.B1.L2.B2")
+    assert all(p.startswith("chr21.L1.B1.L2.B1") for p in paths[2:i2])
+    # leaf size 15625: first leaf ends at 15625
+    leaves = [r for r in rows if r[1] == "13"]
+    assert leaves[0][4] == "(0,15625]"
+    # every interval is (lower, upper] with lower < upper
+    for r in rows:
+        assert r[4].startswith("(") and r[4].endswith("]")
+        lower, upper = r[4][1:-1].split(",")
+        assert int(lower) < int(upper)
+
+
+def test_bin_index_cli_and_chr_map(tmp_path):
+    chr_map_file = tmp_path / "map.txt"
+    chr_map_file.write_text("chr21\t48129895\nchr22\t51304566\n")
+    assert read_chr_map(str(chr_map_file)) == {
+        "chr21": 48129895, "chr22": 51304566,
+    }
+    out = tmp_path / "bins.tsv"
+    res = subprocess.run(
+        [sys.executable, "-m",
+         "annotatedvdb_tpu.cli.generate_bin_index_references",
+         "-m", str(chr_map_file), "-o", str(out)],
+        capture_output=True, text=True, check=True,
+    )
+    lines = out.read_text().splitlines()
+    assert lines[0].startswith("chr21\t0\t1\tchr21\t")
+    # global_bin numbering continues across chromosomes
+    first_chr22 = next(l for l in lines if l.startswith("chr22"))
+    assert int(first_chr22.split("\t")[2]) > 1
+    assert "generated" in res.stderr
+
+
+BASE_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\trs11\tA\tG\t.\t.\t.
+1\t200\t.\tC\tT\t.\t.\t.
+1\t300\t.\tA\tR\t.\t.\t.
+2\t100\t.\tT\tA\t.\t.\t.
+"""
+
+
+def build_store(tmp_path):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "base.vcf"
+    vcf.write_text(BASE_VCF)
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    return store, ledger
+
+
+def test_export_variant2vcf(tmp_path):
+    store, _ = build_store(tmp_path)
+    store_dir = tmp_path / "vdb"
+    store.save(str(store_dir))
+    out_dir = tmp_path / "export"
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.export_variant2vcf",
+         "--storeDir", str(store_dir), "--outputDir", str(out_dir)],
+        capture_output=True, text=True, check=True,
+    )
+    chr1 = (out_dir / "1_1.vcf").read_text().splitlines()
+    assert chr1[0].startswith("#CHRM")
+    assert chr1[1].split("\t") == ["1", "100", "1:100:A:G:rs11", "A", "G",
+                                   ".", ".", "."]
+    assert chr1[2].split("\t")[2] == "1:200:C:T"
+    assert len(chr1) == 3  # invalid R allele diverted
+    invalid = (out_dir / "1_invalid.txt").read_text().splitlines()
+    assert invalid == ["1:300:A:R"]
+    assert (out_dir / "2_1.vcf").exists()
+
+
+def test_export_file_sharding(tmp_path):
+    store, _ = build_store(tmp_path)
+    store_dir = tmp_path / "vdb"
+    store.save(str(store_dir))
+    out_dir = tmp_path / "export"
+    subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.export_variant2vcf",
+         "--storeDir", str(store_dir), "--outputDir", str(out_dir),
+         "--variantsPerFile", "1", "--chr", "1"],
+        capture_output=True, text=True, check=True,
+    )
+    assert (out_dir / "1_1.vcf").exists() and (out_dir / "1_2.vcf").exists()
+    assert not (out_dir / "2_1.vcf").exists()  # --chr filter
+
+
+def test_shard_primary_key_digest(tmp_path):
+    """Long-allele rows export their retained digest PK, not the literal."""
+    store = VariantStore(width=8)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "long.vcf"
+    vcf.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "1\t100\t.\tA\t" + "ACGT" * 15 + "\t.\t.\t.\n"
+    )
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    shard = store.shard(1)
+    pk = shard_primary_key(shard, 0)
+    assert pk.startswith("1:100:") and "ACGTACGT" not in pk  # digest form
+
+
+SPLIT_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+NC_000001.10\t100\t.\tA\tG\t.\t.\t.
+NC_000001.10\t200\t.\tC\tT\t.\t.\t.
+NC_000023.10\t50\t.\tG\tA\t.\t.\t.
+NC_999999.1\t10\t.\tT\tC\t.\t.\t.
+"""
+
+
+def test_split_vcf_by_chr(tmp_path):
+    src = tmp_path / "all.vcf.gz"
+    with gzip.open(src, "wt") as fh:
+        fh.write(SPLIT_VCF)
+    map_file = tmp_path / "map.tsv"
+    map_file.write_text(
+        "source_id\tchromosome\tchromosome_order_num\tlength\n"
+        "NC_000001.10\tchr1\t1\t249250621\n"
+        "NC_000023.10\tchrX\t23\t155270560\n"
+    )
+    cm = ChromosomeMap(str(map_file))
+    counters = split_file(
+        str(src), str(tmp_path / "out"), cm.chromosome_map(),
+        log=lambda *a: None,
+    )
+    assert counters == {"line": 4, "unmapped": 1}
+    chr1 = (tmp_path / "out" / "chr1.vcf").read_text().splitlines()
+    assert len(chr1) == 3 and chr1[1].startswith("NC_000001.10\t100")
+    chrx = (tmp_path / "out" / "chrX.vcf").read_text().splitlines()
+    assert len(chrx) == 2
+    # every standard chromosome gets a file, even if empty
+    chr9 = (tmp_path / "out" / "chr9.vcf").read_text().splitlines()
+    assert chr9 == ["#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+
+
+def test_chromosome_map_parser(tmp_path):
+    map_file = tmp_path / "map.tsv"
+    map_file.write_text(
+        "source_id\tchromosome\tchromosome_order_num\tlength\n"
+        "NC_000001.10\tchr1\t1\t249250621\n"
+        "NC_000024.9\tchrY\t24\t59373566\n"
+    )
+    cm = ChromosomeMap(str(map_file))
+    assert cm.get("NC_000001.10") == "1"  # 'chr' stripped
+    assert cm.get_sequence_id("1") == "NC_000001.10"
+    assert cm.get_sequence_id("Y") == "NC_000024.9"
+    assert cm.get_sequence_id("7") is None
+    assert "NC_000024.9" in cm
+
+    # headerless two-column variant
+    plain = tmp_path / "plain.tsv"
+    plain.write_text("NC_000001.10\t1\nNC_000024.9\tY\n")
+    cm2 = ChromosomeMap(str(plain))
+    assert cm2.get("NC_000024.9") == "Y"

@@ -204,3 +204,18 @@ def test_ledger_resume_run_with_no_checkpoints_still_supersedes(tmp_path):
     a2 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
     ledger.finish(a2, {})  # all chunks were covered; no new checkpoints
     assert ledger.last_checkpoint("f.vcf") == 0
+
+
+def test_ledger_dry_run_and_test_runs_do_not_supersede(tmp_path):
+    """A dry run (commit=False) or --test run finishing after a crashed
+    commit load must NOT erase its resume cursor — neither completes the
+    file."""
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    a1 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    ledger.checkpoint(a1, "f.vcf", 1000, {})  # crash
+    a2 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=False)
+    ledger.finish(a2, {})  # dry run
+    assert ledger.last_checkpoint("f.vcf") == 1000
+    a3 = ledger.begin("load_qc", {"file": "f.vcf", "test": True}, commit=True)
+    ledger.finish(a3, {})  # --test run: stopped after one batch
+    assert ledger.last_checkpoint("f.vcf") == 1000

@@ -1,0 +1,177 @@
+"""TSV annotation-load tests (reference ``txt_variant_loader.py`` +
+``update_variant_annotation.py``)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.loaders import TpuTextLoader, TpuVcfLoader
+from annotatedvdb_tpu.loaders.txt_loader import (
+    coerce_update_value, parse_variant_id,
+)
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+BASE_VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\trs11\tA\tG\t.\t.\t.
+1\t200\t.\tC\tT\t.\t.\t.
+2\t100\trs22\tT\tA\t.\t.\t.
+"""
+
+
+def build_store(tmp_path):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    vcf = tmp_path / "base.vcf"
+    vcf.write_text(BASE_VCF)
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
+    return store, ledger
+
+
+def find_row(store, code, pos):
+    shard = store.shard(code)
+    i = int(np.searchsorted(shard.cols["pos"], pos))
+    assert shard.cols["pos"][i] == pos
+    return shard, i
+
+
+def write_tsv(path, header, rows):
+    lines = ["\t".join(header)] + ["\t".join(r) for r in rows]
+    path.write_text("\n".join(lines) + "\n")
+
+
+def test_parse_variant_id():
+    assert parse_variant_id("1:100:A:G", "METASEQ") == (1, 100, "A", "G", None)
+    assert parse_variant_id("X:5:AC:-", "METASEQ") == (23, 5, "AC", "-", None)
+    assert parse_variant_id("1:100:A:G:rs11", "PRIMARY_KEY") == (
+        1, 100, "A", "G", "rs11"
+    )
+    # digest-form PK: alleles unknown
+    code, pos, ref, alt, rs = parse_variant_id(
+        "1:100:GnDKL2Ax6uVVmPPDKEC17BsPB4ACKEHx:rs99", "PRIMARY_KEY"
+    )
+    assert (code, pos, ref, alt, rs) == (1, 100, None, None, "rs99")
+    assert parse_variant_id("rs22", "REFSNP")[4] == "rs22"
+    with pytest.raises(ValueError):
+        parse_variant_id("1:100:GnDKL2Ax6uVVmPPDKEC17BsPB4ACKEHx", "METASEQ")
+
+
+def test_coerce_update_value():
+    assert coerce_update_value("gwas_flags", '{"AD": true}') == {"AD": True}
+    assert coerce_update_value("gwas_flags", "NULL") is None
+    assert coerce_update_value("is_adsp_variant", "true") == 1
+    assert coerce_update_value("is_adsp_variant", "False") == 0
+    assert coerce_update_value("ref_snp_id", "rs123") == "rs123"
+    with pytest.raises(ValueError, match="invalid JSON"):
+        coerce_update_value("gwas_flags", "{notjson")
+
+
+def test_tsv_update_known_variants(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(
+        tsv,
+        ["variant", "gwas_flags", "ref_snp_id"],
+        [
+            ["1:100:A:G", '{"ADGC": {"pvalue": 1e-8}}', "NULL"],
+            ["1:200:C:T", '{"IGAP": {"pvalue": 0.5}}', "rs33"],
+        ],
+    )
+    loader = TpuTextLoader(store, ledger, log=lambda *a: None)
+    counters = loader.load_file(str(tsv), commit=True)
+    assert counters["update"] == 2
+    assert counters["inserted"] == 0
+    assert store.n == 3
+
+    shard, i = find_row(store, 1, 100)
+    assert shard.annotations["gwas_flags"][i] == {"ADGC": {"pvalue": 1e-8}}
+    shard, i = find_row(store, 1, 200)
+    assert shard.cols["ref_snp"][i] == 33  # ref_snp_id column applied
+
+    # second file merges (jsonb_merge), not replaces
+    tsv2 = tmp_path / "ann2.tsv"
+    write_tsv(tsv2, ["variant", "gwas_flags"],
+              [["1:100:A:G", '{"IGAP": {"pvalue": 0.01}}']])
+    TpuTextLoader(store, ledger, log=lambda *a: None).load_file(
+        str(tsv2), commit=True
+    )
+    shard, i = find_row(store, 1, 100)
+    assert set(shard.annotations["gwas_flags"][i]) == {"ADGC", "IGAP"}
+
+
+def test_tsv_insert_novel_metaseq(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "other_annotation"],
+              [["2:900:G:GAT", '{"src": "x"}']])
+    counters = TpuTextLoader(store, ledger, log=lambda *a: None).load_file(
+        str(tsv), commit=True
+    )
+    assert counters["inserted"] == 1
+    shard, i = find_row(store, 2, 900)
+    assert shard.annotations["other_annotation"][i] == {"src": "x"}
+    # full insert path ran: display attributes + bin index present
+    assert shard.annotations["display_attributes"][i] is not None
+    assert shard.cols["bin_level"][i] >= 0
+
+
+def test_tsv_refsnp_lookup_and_not_found(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "gwas_flags"],
+              [["rs22", '{"hit": 1}'], ["rs404", '{"miss": 1}']])
+    counters = TpuTextLoader(
+        store, ledger, variant_id_type="REFSNP", log=lambda *a: None
+    ).load_file(str(tsv), commit=True)
+    assert counters["update"] == 1
+    assert counters["not_found"] == 1  # refSNP ids can't insert (no alleles)
+    shard, i = find_row(store, 2, 100)
+    assert shard.annotations["gwas_flags"][i] == {"hit": 1}
+
+
+def test_tsv_skip_existing(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "gwas_flags"], [["1:100:A:G", '{"x": 1}']])
+    counters = TpuTextLoader(
+        store, ledger, update_existing=False, skip_existing=True,
+        log=lambda *a: None,
+    ).load_file(str(tsv), commit=True)
+    assert counters["skipped"] == 1 and counters["update"] == 0
+    shard, i = find_row(store, 1, 100)
+    assert shard.annotations["gwas_flags"][i] is None
+
+
+def test_tsv_dry_run(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "gwas_flags"],
+              [["1:100:A:G", '{"x": 1}'], ["2:900:G:GAT", '{"y": 2}']])
+    counters = TpuTextLoader(store, ledger, log=lambda *a: None).load_file(
+        str(tsv), commit=False
+    )
+    assert counters["update"] >= 1
+    assert store.n == 3  # nothing inserted
+    shard, i = find_row(store, 1, 100)
+    assert shard.annotations["gwas_flags"][i] is None
+
+
+def test_tsv_cli(tmp_path):
+    store, ledger = build_store(tmp_path)
+    store_dir = tmp_path / "vdb"
+    store.save(str(store_dir))
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "gwas_flags"], [["1:100:A:G", '{"AD": true}']])
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.update_variant_annotation",
+         "--fileName", str(tsv), "--storeDir", str(store_dir), "--commit"],
+        capture_output=True, text=True, check=True,
+    )
+    counters = json.loads(res.stdout.splitlines()[0])
+    assert counters["update"] == 1
+    reloaded = VariantStore.load(str(store_dir))
+    shard, i = find_row(reloaded, 1, 100)
+    assert shard.annotations["gwas_flags"][i] == {"AD": True}
