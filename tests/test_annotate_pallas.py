@@ -1,0 +1,86 @@
+"""Parity tests for the fused Pallas annotate+bin kernel vs the jnp kernels.
+
+Runs in Mosaic interpreter mode on the CPU test mesh; the same kernel is
+compile- and parity-verified on real TPU hardware by ``bench.py`` (which
+prefers the Pallas path when it is available and falls back to jnp)."""
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.ops.annotate import annotate_kernel_jit
+from annotatedvdb_tpu.ops.annotate_pallas import annotate_bin_pallas
+from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
+from annotatedvdb_tpu.types import VariantBatch
+
+from conftest import random_variants
+from test_annotate import HARD_VARIANTS
+
+# curated branch-coverage cases: SNV, MNV, inversion, palindrome, ins, dup
+# (single + multi-copy), indel, del, shared-prefix normalization, identical
+# alleles, allele longer than width (host fallback)
+EDGE_VARIANTS = [
+    ("1", 100, "A", "G"),
+    ("2", 200, "AC", "GT"),
+    ("3", 300, "ACGT", "ACGT"),
+    ("4", 62_500_000, "AAGCTT", "AAGCTT"[::-1]),
+    ("5", 400, "ATAT", "ATAT"[::-1]),       # palindrome: inversion & identical
+    ("6", 500, "A", "AGG"),
+    ("7", 600, "AGG", "A"),
+    ("8", 700, "ACA", "ACACA"),
+    ("9", 800, "AGCGC", "AGC"),
+    ("10", 900, "AGC", "AGCGCGC"),          # dup: inserted GCGC vs ref[1:] GC
+    ("11", 1000, "ATTT", "GTT"),
+    ("12", 1100, "CAAA", "CAAAA"),
+    ("13", 15_625, "A", "ACCCCCCCCCCCCCCCCCCCCC"),  # crosses a leaf-bin edge
+    ("14", 15_626, "AT", "A"),
+    ("X", 1_000_000, "ACGTACGTACGTACGTACGT", "A"),
+    ("Y", 1, "A", "C"),
+]
+
+
+def _run_both(variants, width):
+    batch = VariantBatch.from_tuples(variants, width=width)
+    ref_out = annotate_kernel_jit(
+        batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+    )
+    lvl, leaf = bin_index_kernel_jit(batch.pos, ref_out["end_location"])
+    pal = annotate_bin_pallas(
+        batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len,
+        block_n=128, interpret=True,
+    )
+    return ref_out, lvl, leaf, pal
+
+
+def _assert_parity(ref_out, lvl, leaf, pal):
+    ok = ~np.asarray(ref_out["host_fallback"])
+    for key in ref_out:
+        a = np.asarray(ref_out[key])
+        p = np.asarray(pal[key])
+        mismatch = (a != p) & ok
+        assert not mismatch.any(), f"{key}: rows {np.where(mismatch)[0][:5]}"
+    assert (np.asarray(pal["host_fallback"]) == np.asarray(ref_out["host_fallback"])).all()
+    assert (np.asarray(pal["bin_level"])[ok] == np.asarray(lvl)[ok]).all()
+    assert (np.asarray(pal["leaf_bin"])[ok] == np.asarray(leaf)[ok]).all()
+
+
+def test_pallas_parity_edge_cases():
+    _assert_parity(*_run_both(EDGE_VARIANTS, width=16))
+
+
+def test_pallas_parity_hard_indels_host_fallback():
+    # the reference's hard indels exceed any device width -> flagged fallback
+    ref_out, lvl, leaf, pal = _run_both(EDGE_VARIANTS + HARD_VARIANTS, width=16)
+    assert np.asarray(pal["host_fallback"])[-len(HARD_VARIANTS):].all()
+    _assert_parity(ref_out, lvl, leaf, pal)
+
+
+@pytest.mark.parametrize("width", [8, 16])
+def test_pallas_parity_random(rng, width):
+    variants = random_variants(rng, 300, max_len=width + 4)
+    _assert_parity(*_run_both(variants, width=width))
+
+
+def test_pallas_parity_unaligned_batch(rng):
+    # N not a multiple of block_n exercises the pad/slice path
+    variants = random_variants(rng, 77, max_len=12)
+    _assert_parity(*_run_both(variants, width=16))
