@@ -90,7 +90,12 @@ def main(argv=None) -> int:
     codes = sorted(store.shards)
     if args.chr != "all":
         from annotatedvdb_tpu.types import chromosome_code
-        codes = [c for c in codes if c == chromosome_code(args.chr)]
+        code = chromosome_code(args.chr)
+        if code == 0:
+            ap.error(f"unrecognized chromosome {args.chr!r}")
+        codes = [c for c in codes if c == code]
+        if not codes:
+            print(f"chromosome {args.chr} has no rows in this store; nothing to export")
     total = {"exported": 0, "invalid": 0, "files": 0}
     for code in codes:
         counters = export_chromosome(

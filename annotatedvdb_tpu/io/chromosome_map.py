@@ -30,9 +30,13 @@ class ChromosomeMap:
             if "source_id" in cols and "chromosome" in cols:
                 reader = csv.DictReader(fh, fieldnames=cols, delimiter="\t")
                 for row in reader:
-                    self._map[row["source_id"]] = (
-                        row["chromosome"].replace("chr", "")
-                    )
+                    source_id = row.get("source_id")
+                    chromosome = row.get("chromosome")
+                    # tolerate short/comment/blank lines (DictReader fills
+                    # missing columns with None)
+                    if not source_id or not chromosome or source_id.startswith("#"):
+                        continue
+                    self._map[source_id] = chromosome.replace("chr", "")
             else:
                 for line in [first] + fh.readlines():
                     parts = line.rstrip("\n").split("\t")

@@ -25,13 +25,11 @@ import re
 import numpy as np
 
 from annotatedvdb_tpu.io.vcf import VcfChunk
-from annotatedvdb_tpu.loaders.vcf_loader import TpuVcfLoader, _fnv32_str, _rs_number
-from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+from annotatedvdb_tpu.loaders.lookup import chunk_lookup
+from annotatedvdb_tpu.loaders.vcf_loader import TpuVcfLoader, _rs_number
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
-from annotatedvdb_tpu.types import (
-    VariantBatch, chromosome_code, encode_allele_array,
-)
+from annotatedvdb_tpu.types import VariantBatch, chromosome_code
 from annotatedvdb_tpu.utils.strings import to_numeric
 
 #: Variant-table columns a TSV header may target
@@ -60,18 +58,22 @@ def parse_variant_id(variant_id: str, id_type: str):
     if len(parts) < 2:
         raise ValueError(f"unparseable variant id: {variant_id!r}")
     code = chromosome_code(parts[0])
+    if code == 0:
+        # non-standard contigs are skipped the way VCF ingest skips them
+        # (io/vcf.py counts skipped_contig); letting code 0 through would
+        # crash egress (chromosome_label raises on the sentinel)
+        raise ValueError(f"unplaceable chromosome {parts[0]!r}: {variant_id!r}")
     pos = int(parts[1])
     ref = alt = rs = None
     if len(parts) >= 4 and _ALLELE_RE.match(parts[2]) and _ALLELE_RE.match(parts[3]):
         ref, alt = parts[2].upper(), parts[3].upper()
         if len(parts) >= 5:
             rs = parts[4]
-    elif len(parts) >= 3:
+    elif len(parts) >= 4:
         # digest-form primary key chr:pos:<VRS digest>[:rs]
-        if id_type == "METASEQ":
-            raise ValueError(f"metaseq id without alleles: {variant_id!r}")
-        if len(parts) >= 4:
-            rs = parts[3]
+        rs = parts[3]
+    if id_type == "METASEQ" and ref is None:
+        raise ValueError(f"metaseq id without alleles: {variant_id!r}")
     return code, pos, ref, alt, rs
 
 
@@ -201,15 +203,20 @@ class TpuTextLoader:
                 continue
             parsed.append((line_no, row, code, pos, ref, alt, rs))
 
-        # REFSNP ids resolve in one np.isin pass per shard, not per row
+        # REFSNP ids resolve in one np.isin pass per shard, allele-form ids
+        # in one vectorized shard.lookup per chromosome — never per row
         rs_index = (
             self._build_rs_index(parsed)
             if self.variant_id_type == "REFSNP" else None
         )
+        meta_index = (
+            self._build_meta_index(parsed)
+            if self.variant_id_type != "REFSNP" else None
+        )
 
         novel = []
-        for entry in parsed:
-            found_at = self._resolve(entry, rs_index)
+        for j, entry in enumerate(parsed):
+            found_at = self._lookup_entry(j, entry, rs_index, meta_index)
             if found_at is None:
                 if self.variant_id_type == "METASEQ":
                     novel.append(entry)
@@ -240,42 +247,52 @@ class TpuTextLoader:
                 index.setdefault(int(shard.cols["ref_snp"][i]), (shard, int(i)))
         return index
 
-    def _resolve(self, entry, rs_index: dict | None = None):
-        """Locate one variant in the store; returns (shard, row) or None."""
-        _, _, code, pos, ref, alt, rs = entry
+    def _build_meta_index(self, parsed: list) -> dict:
+        """parsed-list position -> (shard, row) for allele-form ids: one
+        vectorized ``shard.lookup`` per chromosome (via the shared
+        :func:`chunk_lookup` identity rule) instead of a per-row dispatch."""
+        items = [(j, e) for j, e in enumerate(parsed) if e[4] is not None]
+        index: dict[int, tuple] = {}
+        if not items:
+            return index
+        chunk = _chunk_from_rows([e for _, e in items], self.store.width)
+        for _code, shard, sel, found, idx in chunk_lookup(self.store, chunk):
+            if shard is None:
+                continue
+            for k, row in enumerate(sel):
+                if found[k]:
+                    index[items[int(row)][0]] = (shard, int(idx[k]))
+        return index
+
+    def _lookup_entry(self, j: int, entry, rs_index: dict | None,
+                      meta_index: dict | None):
+        """Locate one batch entry in the store; returns (shard, row) or None."""
+        _, _, code, pos, ref, _, rs = entry
         if self.variant_id_type == "REFSNP":
-            if rs_index is None:
-                rs_index = self._build_rs_index([entry])
-            return rs_index.get(_rs_number(rs))
+            return rs_index.get(_rs_number(rs)) if rs_index else None
+        if ref is not None:
+            return meta_index.get(j) if meta_index else None
         if code not in self.store.shards:
             return None
-        shard = self.store.shards[code]
-        if ref is not None:
-            refs, ref_len = encode_allele_array([ref], shard.width)
-            alts, alt_len = encode_allele_array([alt], shard.width)
-            if ref_len[0] > shard.width or alt_len[0] > shard.width:
-                h = np.array([_fnv32_str(ref, alt)], np.uint32)
-            else:
-                h = np.asarray(
-                    allele_hash_jit(refs, alts, ref_len, alt_len)
-                )
-            found, idx = shard.lookup(
-                np.array([pos], np.int32), h, refs, alts, ref_len, alt_len
-            )
-            return (shard, int(idx[0])) if found[0] else None
         # digest-form PK: linear scan of the (rare) digest tail; match on the
         # digest segment + position — never on the raw input chromosome
         # token, which may be 'chr1'/'MT' while stored PKs use '1'/'M'
-        variant_digest = entry[1]["variant"].split(":")[2]
+        shard = self.store.shards[code]
+        pk_parts = entry[1]["variant"].split(":")
+        if len(pk_parts) < 3:
+            return None
+        variant_digest = pk_parts[2]
         for i, pk in enumerate(shard.digest_pk):
             if pk is not None and shard.cols["pos"][i] == pos \
                     and pk.split(":")[2] == variant_digest:
                 return shard, i
         return None
 
-    def _apply_update(self, found_at, row: dict, alg_id: int, commit: bool):
+    def _apply_update(self, found_at, row: dict, alg_id: int, commit: bool,
+                      count: bool = True):
         shard, i = found_at
-        self.counters["update"] += 1
+        if count:
+            self.counters["update"] += 1
         if not commit:
             return
         one = np.array([i])
@@ -304,12 +321,14 @@ class TpuTextLoader:
             self.insert_loader.counters["variant"] - before
         )
         if not commit:
-            self.counters["update"] += len(novel)
             return
-        for entry in novel:
-            found_at = self._resolve(entry)
+        # apply the TSV's annotation values to the fresh rows; these count
+        # only as 'inserted', never additionally as 'update'
+        meta_index = self._build_meta_index(novel)
+        for j, entry in enumerate(novel):
+            found_at = meta_index.get(j)
             if found_at is not None:
-                self._apply_update(found_at, entry[1], alg_id, commit)
+                self._apply_update(found_at, entry[1], alg_id, commit, count=False)
 
 
 def _chunk_from_rows(novel: list, width: int) -> VcfChunk:

@@ -83,11 +83,29 @@ class AlgorithmLedger:
         finished = {
             e["alg_id"] for e in self._entries if e.get("type") == "finish"
         }
+        undone = {
+            e["alg_id"] for e in self._entries if e.get("type") == "undo"
+        }
+        invocations = {
+            e["alg_id"]: e for e in self._entries if e.get("type") == "invocation"
+        }
+
+        def is_partial(alg_id: int) -> bool:
+            # --test runs stop after one batch, so even a clean finish does
+            # not mean the file completed: their checkpoints stay live as
+            # resume cursors
+            inv = invocations.get(alg_id)
+            return bool(inv and inv.get("params", {}).get("test"))
+
         for pos in range(len(self._entries) - 1, -1, -1):
             e = self._entries[pos]
             if e.get("type") != "checkpoint" or e.get("file") != input_file:
                 continue
-            if e["alg_id"] in finished:
+            if e["alg_id"] in undone:
+                # an undone invocation's rows were deleted — its checkpoint
+                # is dead, and older checkpoints (if any) take over
+                continue
+            if e["alg_id"] in finished and not is_partial(e["alg_id"]):
                 return 0
             # a later COMMIT invocation on the same file that finished
             # supersedes a crashed checkpoint even if it wrote no checkpoints

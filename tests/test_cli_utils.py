@@ -7,6 +7,8 @@ import gzip
 import subprocess
 import sys
 
+import pytest
+
 from annotatedvdb_tpu.cli.export_variant2vcf import shard_primary_key
 from annotatedvdb_tpu.cli.generate_bin_index_references import (
     emit_rows, read_chr_map,
@@ -191,3 +193,26 @@ def test_chromosome_map_parser(tmp_path):
     plain.write_text("NC_000001.10\t1\nNC_000024.9\tY\n")
     cm2 = ChromosomeMap(str(plain))
     assert cm2.get("NC_000024.9") == "Y"
+
+
+def test_chromosome_map_tolerates_short_lines(tmp_path):
+    path = tmp_path / "map.txt"
+    path.write_text(
+        "source_id\tchromosome\tchromosome_order_num\tlength\n"
+        "NC_000001.10\tchr1\t1\t249250621\n"
+        "# a comment line\n"
+        "NC_000002.11\n"          # short line: only a source id
+        "NC_000003.11\tchr3\t3\t198022430\n"
+    )
+    cmap = ChromosomeMap(str(path))
+    assert cmap.chromosome_map() == {"NC_000001.10": "1", "NC_000003.11": "3"}
+
+
+def test_export_rejects_unknown_chromosome(tmp_path):
+    from annotatedvdb_tpu.cli import export_variant2vcf as cli
+    store_dir = tmp_path / "vdb"
+    VariantStore(width=16).save(str(store_dir))
+    with pytest.raises(SystemExit) as err:
+        cli.main(["--storeDir", str(store_dir),
+                  "--outputDir", str(tmp_path / "out"), "--chr", "23q"])
+    assert err.value.code == 2

@@ -219,3 +219,31 @@ def test_ledger_dry_run_and_test_runs_do_not_supersede(tmp_path):
     a3 = ledger.begin("load_qc", {"file": "f.vcf", "test": True}, commit=True)
     ledger.finish(a3, {})  # --test run: stopped after one batch
     assert ledger.last_checkpoint("f.vcf") == 1000
+
+
+def test_ledger_test_run_own_checkpoint_stays_live(tmp_path):
+    """A --test --commit run that persisted its first batch leaves a LIVE
+    resume cursor: its own finish does not mark the file complete, so the
+    later full run must not replay (and duplicate) that batch."""
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    a1 = ledger.begin("load_tsv", {"file": "f.tsv", "test": True}, commit=True)
+    ledger.checkpoint(a1, "f.tsv", 32768, {})
+    ledger.finish(a1, {})  # test run "finishes" after one batch
+    assert ledger.last_checkpoint("f.tsv") == 32768
+    # the full run then resumes past the committed batch and completes
+    a2 = ledger.begin("load_tsv", {"file": "f.tsv"}, commit=True)
+    ledger.checkpoint(a2, "f.tsv", 100_000, {})
+    ledger.finish(a2, {})
+    assert ledger.last_checkpoint("f.tsv") == 0
+
+
+def test_ledger_undone_checkpoint_is_dead(tmp_path):
+    """Undoing an invocation (rows deleted) must kill its resume cursor —
+    otherwise a later full run would skip the undone batch forever."""
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    a1 = ledger.begin("load_tsv", {"file": "f.tsv", "test": True}, commit=True)
+    ledger.checkpoint(a1, "f.tsv", 32768, {})
+    ledger.finish(a1, {})
+    assert ledger.last_checkpoint("f.tsv") == 32768  # test-run cursor live
+    ledger.undo(a1, removed=32768)
+    assert ledger.last_checkpoint("f.tsv") == 0      # dead after undo

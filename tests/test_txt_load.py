@@ -175,3 +175,60 @@ def test_tsv_cli(tmp_path):
     reloaded = VariantStore.load(str(store_dir))
     shard, i = find_row(reloaded, 1, 100)
     assert shard.annotations["gwas_flags"][i] == {"AD": True}
+
+
+def test_parse_variant_id_malformed_and_contigs():
+    # 2-part id: valid digest-less PK prefix is NOT acceptable as metaseq
+    with pytest.raises(ValueError, match="without alleles"):
+        parse_variant_id("1:100", "METASEQ")
+    # non-standard contig: skipped like VCF ingest's skipped_contig
+    with pytest.raises(ValueError, match="unplaceable"):
+        parse_variant_id("GL000219.1:100:A:G", "METASEQ")
+    # 2-part PRIMARY_KEY parses (digest unknown) and resolves to not-found
+    assert parse_variant_id("1:100", "PRIMARY_KEY") == (1, 100, None, None, None)
+
+
+def test_tsv_malformed_ids_are_skipped_not_fatal(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "gwas_flags"],
+              [["1:100", '{"x": 1}'],                  # metaseq without alleles
+               ["GL000219.1:100:A:G", '{"x": 1}'],     # unplaceable contig
+               ["1:100:A:G", '{"x": 2}']])             # valid
+    counters = TpuTextLoader(store, ledger, log=lambda *a: None).load_file(
+        str(tsv), commit=True
+    )
+    assert counters["skipped"] == 2
+    assert counters["update"] == 1
+    shard, i = find_row(store, 1, 100)
+    assert shard.annotations["gwas_flags"][i] == {"x": 2}
+
+
+def test_tsv_short_primary_key_counts_not_found(tmp_path):
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    write_tsv(tsv, ["variant", "gwas_flags"], [["1:100", '{"x": 1}']])
+    loader = TpuTextLoader(store, ledger, variant_id_type="PRIMARY_KEY",
+                           log=lambda *a: None)
+    counters = loader.load_file(str(tsv), commit=True)
+    assert counters["not_found"] == 1
+    assert counters["update"] == 0
+
+
+def test_tsv_dry_run_counts_novel_once(tmp_path):
+    """Dry-run and commit runs must agree: novel rows count as inserted,
+    never additionally as update."""
+    store, ledger = build_store(tmp_path)
+    tsv = tmp_path / "ann.tsv"
+    rows = [["5:777:T:TG", '{"n": 1}'], ["5:778:C:A", '{"n": 2}']]
+    write_tsv(tsv, ["variant", "gwas_flags"], rows)
+    dry = TpuTextLoader(store, ledger, log=lambda *a: None).load_file(
+        str(tsv), commit=False, resume=False
+    )
+    assert dry["inserted"] == 2 and dry["update"] == 0
+    wet = TpuTextLoader(store, ledger, log=lambda *a: None).load_file(
+        str(tsv), commit=True, resume=False
+    )
+    assert wet["inserted"] == 2 and wet["update"] == 0
+    shard, i = find_row(store, 5, 777)
+    assert shard.annotations["gwas_flags"][i] == {"n": 1}
