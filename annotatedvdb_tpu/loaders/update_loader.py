@@ -92,6 +92,12 @@ class TpuUpdateLoader:
         )
         for chunk in reader:
             self.counters["line"] += chunk.counters.get("line", 0)
+            self.counters["malformed"] = (
+                self.counters.get("malformed", 0)
+                + chunk.counters.get("malformed", 0)
+            )
+            if chunk.batch.n == 0:  # trailing counters-only chunk
+                continue
             # chunks fully covered by a previous committed checkpoint replay
             # as no-ops (idempotent resume; partially-covered chunks are
             # impossible because checkpoints land on chunk boundaries)

@@ -107,6 +107,12 @@ class TpuVcfLoader:
                 self.counters["line"] += chunk.counters.get("line", 0)
                 self.counters["skipped"] += chunk.counters.get("skipped_alt", 0)
                 self.counters["skipped"] += chunk.counters.get("skipped_contig", 0)
+                self.counters["malformed"] = (
+                    self.counters.get("malformed", 0)
+                    + chunk.counters.get("malformed", 0)
+                )
+                if chunk.batch.n == 0:  # trailing counters-only chunk
+                    continue
                 if resume_line and chunk.line_number[-1] <= resume_line:
                     self.counters["skipped"] += chunk.batch.n
                     continue

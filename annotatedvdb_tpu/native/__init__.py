@@ -1,0 +1,79 @@
+"""ctypes binding for the native ingest runtime (``native/avdb_native.cpp``).
+
+The shared library builds lazily on first use with the system ``g++`` into a
+content-hashed cache next to this package, so a source change triggers a
+rebuild and stale binaries are never loaded.  Import never fails: when no
+compiler is available, ``load()`` returns None and callers keep the pure
+Python path (``io/vcf.py`` engine="python").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SOURCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "avdb_native.cpp",
+)
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+
+_lock = threading.Lock()
+_lib = None
+_lib_error: str | None = None
+
+
+def _build() -> str:
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_CACHE_DIR, f"avdb_native-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    tmp = so_path + f".tmp{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SOURCE],
+        check=True, capture_output=True,
+    )
+    os.replace(tmp, so_path)  # atomic under concurrent builders
+    return so_path
+
+
+def load():
+    """The loaded CDLL, building if needed; None when unavailable."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as err:
+            _lib_error = str(err)
+            return None
+        c = ctypes
+        lib.avdb_parse_vcf_chunk.restype = c.c_int64
+        lib.avdb_parse_vcf_chunk.argtypes = [
+            c.c_char_p, c.c_int64, c.c_int32, c.c_int64, c.c_int64,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,   # chrom,pos,ref,alt
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,   # rlen,alen,multi,line
+            c.c_void_p, c.c_void_p,                            # ref_off, alt_off
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,   # id, qual
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,   # filter, info
+            c.c_void_p, c.c_void_p,                            # format
+            c.c_void_p, c.c_void_p,                            # altcol
+            c.c_void_p, c.c_void_p,                            # alt_index, n_alts
+            c.c_void_p, c.c_void_p, c.c_void_p,               # counters, consumed, need_more
+        ]
+        lib.avdb_parse_rs.restype = c.c_int32
+        lib.avdb_parse_rs.argtypes = [c.c_char_p, c.c_int32, c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,341 @@
+"""Native-engine VCF scanner: C++ tokenizer -> VcfChunk batches.
+
+Drives ``avdb_parse_vcf_chunk`` (``native/avdb_native.cpp``) over large
+decompressed byte windows and assembles the same :class:`VcfChunk` the pure
+Python reader emits (``io/vcf.py``), so the two engines are drop-in
+interchangeable (parity-tested in ``tests/test_native_ingest.py``).  The
+device-batch columns come straight out of the C++ tokenizer; host-sidecar
+strings (ids, INFO, original over-width alleles) materialize lazily from the
+byte spans the tokenizer reports.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import gzip
+
+import numpy as np
+
+from annotatedvdb_tpu import native
+from annotatedvdb_tpu.types import VariantBatch, chromosome_label
+
+READ_SIZE = 8 << 20  # decompressed bytes per window
+
+
+class _Arrays:
+    """Preallocated per-batch output buffers for the C call."""
+
+    def __init__(self, cap: int, width: int):
+        self.cap = cap
+        self.chrom = np.zeros(cap, np.int8)
+        self.pos = np.zeros(cap, np.int32)
+        self.ref = np.zeros((cap, width), np.uint8)
+        self.alt = np.zeros((cap, width), np.uint8)
+        self.ref_len = np.zeros(cap, np.int32)
+        self.alt_len = np.zeros(cap, np.int32)
+        self.multi = np.zeros(cap, np.uint8)
+        self.line_no = np.zeros(cap, np.int64)
+        self.ref_off = np.zeros(cap, np.int64)
+        self.alt_off = np.zeros(cap, np.int64)
+        self.id_off = np.zeros(cap, np.int64)
+        self.id_len = np.zeros(cap, np.int32)
+        self.qual_off = np.zeros(cap, np.int64)
+        self.qual_len = np.zeros(cap, np.int32)
+        self.filter_off = np.zeros(cap, np.int64)
+        self.filter_len = np.zeros(cap, np.int32)
+        self.info_off = np.zeros(cap, np.int64)
+        self.info_len = np.zeros(cap, np.int32)
+        self.format_off = np.zeros(cap, np.int64)
+        self.format_len = np.zeros(cap, np.int32)
+        self.altcol_off = np.zeros(cap, np.int64)
+        self.altcol_len = np.zeros(cap, np.int32)
+        self.alt_index = np.zeros(cap, np.int32)
+        self.n_alts = np.zeros(cap, np.int32)
+
+    def pointers(self):
+        def p(a):
+            return a.ctypes.data_as(ctypes.c_void_p)
+
+        return [
+            p(self.chrom), p(self.pos), p(self.ref), p(self.alt),
+            p(self.ref_len), p(self.alt_len), p(self.multi), p(self.line_no),
+            p(self.ref_off), p(self.alt_off),
+            p(self.id_off), p(self.id_len), p(self.qual_off), p(self.qual_len),
+            p(self.filter_off), p(self.filter_len),
+            p(self.info_off), p(self.info_len),
+            p(self.format_off), p(self.format_len),
+            p(self.altcol_off), p(self.altcol_len),
+            p(self.alt_index), p(self.n_alts),
+        ]
+
+
+def scan_native(path: str, batch_size: int, width: int, identity_only: bool):
+    """Yield (arrays, n_rows, window_bytes, counters_dict) per batch.
+
+    ``window_bytes`` is the bytes object the span columns index into; it must
+    outlive any span materialization for the batch."""
+    lib = native.load()
+    if lib is None:  # pragma: no cover - exercised only without a compiler
+        raise RuntimeError("native ingest library unavailable")
+
+    opener = gzip.open if path.endswith(".gz") else open
+    arrays = _Arrays(batch_size, width)
+    counters = np.zeros(4, np.int64)
+    consumed = ctypes.c_int64(0)
+    need_more = ctypes.c_int32(0)
+
+    with opener(path, "rb") as fh:
+        tail = b""
+        line_base = 0
+        eof = False
+        while not eof or tail:
+            window = tail
+            if not eof:
+                block = fh.read(READ_SIZE)
+                if block:
+                    window = tail + block
+                else:
+                    eof = True
+                    # final partial line (no trailing newline): terminate it
+                    if window and not window.endswith(b"\n"):
+                        window += b"\n"
+            elif window and not window.endswith(b"\n"):
+                window += b"\n"
+            if not window:
+                break
+            # drain the window; the tokenizer may fill the row buffer more
+            # than once per window.  Pointer arithmetic (not window[start:])
+            # avoids re-copying the tail of an 8MB window per fill.
+            window_addr = ctypes.cast(
+                ctypes.c_char_p(window), ctypes.c_void_p
+            ).value
+            start = 0
+            while True:
+                counters[:] = 0
+                n = lib.avdb_parse_vcf_chunk(
+                    ctypes.cast(window_addr + start, ctypes.c_char_p),
+                    len(window) - start, width, arrays.cap,
+                    line_base,
+                    *arrays.pointers(),
+                    counters.ctypes.data_as(ctypes.c_void_p),
+                    ctypes.byref(consumed), ctypes.byref(need_more),
+                )
+                if need_more.value and n == 0 and consumed.value == 0:
+                    # one source line holds more alt rows than the buffer:
+                    # grow and retry (the Python engine likewise lets a chunk
+                    # exceed batch_size rather than split a line)
+                    arrays = _Arrays(arrays.cap * 2, width)
+                    continue
+                # count lines consumed for stable line numbers
+                line_base += window.count(b"\n", start, start + consumed.value)
+                if n:
+                    yield arrays, int(n), window, start, {
+                        "line": int(counters[0]),
+                        "skipped_contig": int(counters[1]),
+                        "skipped_alt": int(counters[2]),
+                        "malformed": int(counters[3]),
+                    }
+                elif counters.any():
+                    # lines consumed but zero rows (all filtered): surface
+                    # the counters so totals stay exact
+                    yield arrays, 0, window, start, {
+                        "line": int(counters[0]),
+                        "skipped_contig": int(counters[1]),
+                        "skipped_alt": int(counters[2]),
+                        "malformed": int(counters[3]),
+                    }
+                start += consumed.value
+                if not need_more.value:
+                    break
+            tail = window[start:]
+            if eof and tail and consumed.value == 0 and not need_more.value:
+                # no newline progress possible: malformed remainder
+                break
+
+
+_MISSING = object()
+
+
+class LazyColumn:
+    """A list-compatible per-row column materialized on first access.
+
+    The native tokenizer reports byte spans, not strings; consumers that
+    never touch a field (e.g. QUAL/FORMAT in a dbSNP load, INFO in an
+    identity-only load) pay nothing.  Supports the access patterns the
+    loaders use: ``col[i]``, iteration, ``len``, ``in`` (fail-at scans),
+    ``==`` against lists (tests)."""
+
+    __slots__ = ("_n", "_fn", "_cache")
+
+    def __init__(self, n: int, fn):
+        self._n = n
+        self._fn = fn
+        self._cache: list | None = None  # allocated on first access
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if self._cache is None:
+            self._cache = [_MISSING] * self._n
+        v = self._cache[i]
+        if v is _MISSING:
+            v = self._cache[i] = self._fn(i)
+        return v
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield self[i]
+
+    def __contains__(self, item):
+        return any(v == item for v in self)
+
+    def __eq__(self, other):
+        if isinstance(other, (list, tuple, LazyColumn)):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other)
+            )
+        return NotImplemented
+
+    def __repr__(self):
+        return f"LazyColumn({list(self)!r})"
+
+
+def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
+                      counters: dict, width: int, identity_only: bool):
+    """Assemble a :class:`~annotatedvdb_tpu.io.vcf.VcfChunk` from one native
+    batch.  Device arrays are copied out (the buffers are reused by the next
+    fill); sidecar columns are lazy views over the window bytes."""
+    from annotatedvdb_tpu.io.vcf import VcfChunk, parse_freq, parse_info
+
+    batch = VariantBatch(
+        chrom=arrays.chrom[:n].copy(),
+        pos=arrays.pos[:n].copy(),
+        ref=arrays.ref[:n].copy(),
+        alt=arrays.alt[:n].copy(),
+        ref_len=arrays.ref_len[:n].copy(),
+        alt_len=arrays.alt_len[:n].copy(),
+    )
+    # snapshot the span indexes (small int arrays; the _Arrays buffers are
+    # overwritten by the next fill, the window bytes are immutable)
+    ref_off = arrays.ref_off[:n].copy()
+    alt_off = arrays.alt_off[:n].copy()
+    id_off = arrays.id_off[:n].copy()
+    id_len = arrays.id_len[:n].copy()
+    qual_off = arrays.qual_off[:n].copy()
+    qual_len = arrays.qual_len[:n].copy()
+    filter_off = arrays.filter_off[:n].copy()
+    filter_len = arrays.filter_len[:n].copy()
+    info_off = arrays.info_off[:n].copy()
+    info_len = arrays.info_len[:n].copy()
+    format_off = arrays.format_off[:n].copy()
+    format_len = arrays.format_len[:n].copy()
+    altcol_off = arrays.altcol_off[:n].copy()
+    altcol_len = arrays.altcol_len[:n].copy()
+    alt_index = arrays.alt_index[:n].copy()
+    n_alts = arrays.n_alts[:n].copy()
+    line_no = arrays.line_no[:n].copy()
+    mv = memoryview(window)
+
+    def span(off, length, i):
+        o = base + int(off[i])
+        return bytes(mv[o:o + int(length[i])]).decode("ascii", errors="replace")
+
+    refs = LazyColumn(n, lambda i: span(ref_off, batch.ref_len, i))
+    alts = LazyColumn(n, lambda i: span(alt_off, batch.alt_len, i))
+
+    # INFO parses at most once per source line (rows of a line share it)
+    line_cache: dict = {}
+
+    def info_at(i):
+        if identity_only or int(info_len[i]) <= 0:
+            return {}, [None] * int(n_alts[i])
+        key = int(line_no[i])
+        hit = line_cache.get(key)
+        if hit is None:
+            info = parse_info(span(info_off, info_len, i))
+            hit = line_cache[key] = (info, parse_freq(info, int(n_alts[i])))
+        return hit
+
+    def ref_snp_at(i):
+        # substring rule first, exactly like the Python reader / reference
+        # (vcf_parser.py:158-169): an ID containing 'rs' IS the refsnp
+        vid = span(id_off, id_len, i)
+        if "rs" in vid:
+            return vid
+        info = info_at(i)[0]
+        if "RS" in info:
+            return "rs" + str(info["RS"])
+        return None
+
+    def variant_id_at(i):
+        vid = span(id_off, id_len, i)
+        if vid == "." or vid.startswith("rs"):
+            return ":".join((
+                chromosome_label(batch.chrom[i]), str(int(batch.pos[i])),
+                refs[i], span(altcol_off, altcol_len, i),
+            ))
+        return vid
+
+    def opt(off, length):
+        return lambda i: span(off, length, i) if off[i] >= 0 else None
+
+    return VcfChunk(
+        batch=batch,
+        refs=refs,
+        alts=alts,
+        ref_snp=LazyColumn(n, ref_snp_at),
+        variant_id=LazyColumn(n, variant_id_at),
+        is_multi_allelic=arrays.multi[:n].astype(bool),
+        frequencies=LazyColumn(n, lambda i: info_at(i)[1][int(alt_index[i])]),
+        rs_position=LazyColumn(n, lambda i: info_at(i)[0].get("RSPOS")),
+        info=LazyColumn(n, lambda i: info_at(i)[0]),
+        line_number=line_no,
+        qual=LazyColumn(n, opt(qual_off, qual_len)),
+        filter=LazyColumn(n, opt(filter_off, filter_len)),
+        format=LazyColumn(n, opt(format_off, format_len)),
+        counters=dict(counters),
+    )
+
+
+def iter_native_chunks(path: str, batch_size: int, width: int,
+                       identity_only: bool):
+    """VcfChunk iterator over the native scanner (engine='native')."""
+    pending_counters = {"line": 0, "skipped_contig": 0, "skipped_alt": 0,
+                        "malformed": 0}
+    for arrays, n, window, base, counters in scan_native(
+            path, batch_size, width, identity_only):
+        for k, v in counters.items():
+            pending_counters[k] = pending_counters.get(k, 0) + v
+        if n == 0:
+            continue
+        chunk = chunk_from_native(
+            arrays, n, window, base, pending_counters, width, identity_only
+        )
+        pending_counters = {k: 0 for k in pending_counters}
+        yield chunk
+    if any(pending_counters.values()):
+        # counters from lines after the last emitted row (or from a file
+        # whose data lines were all filtered) ride a zero-row chunk so load
+        # totals reconcile — same contract as the Python engine
+        yield _empty_chunk(width, pending_counters)
+
+
+def _empty_chunk(width: int, counters: dict):
+    from annotatedvdb_tpu.io.vcf import VcfChunk
+
+    batch = VariantBatch(
+        chrom=np.zeros(0, np.int8), pos=np.zeros(0, np.int32),
+        ref=np.zeros((0, width), np.uint8), alt=np.zeros((0, width), np.uint8),
+        ref_len=np.zeros(0, np.int32), alt_len=np.zeros(0, np.int32),
+    )
+    return VcfChunk(
+        batch=batch, refs=[], alts=[], ref_snp=[], variant_id=[],
+        is_multi_allelic=np.zeros(0, bool), frequencies=[], rs_position=[],
+        info=[], line_number=np.zeros(0, np.int64), qual=[], filter=[],
+        format=[], counters=dict(counters),
+    )

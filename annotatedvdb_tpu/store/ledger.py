@@ -118,6 +118,7 @@ class AlgorithmLedger:
                 and inv.get("commit_mode")
                 and not inv.get("params", {}).get("test")
                 and inv["alg_id"] in finished
+                and inv["alg_id"] not in undone  # an undone run covers nothing
                 for inv in self._entries[pos + 1:]
             )
             return 0 if later_finished else e["line"]

@@ -1,0 +1,254 @@
+// avdb_native: host-side ingest runtime for the TPU variant-annotation
+// framework.
+//
+// The reference's ingest is a per-line Python VcfEntryParser
+// (Util/lib/python/parsers/vcf_parser.py:76-231) feeding a per-variant hot
+// loop; its only "native" ingest is mmap + gzip (load_vcf_file.py:99-102).
+// Here the tokenizer itself is native: it scans a decompressed text chunk,
+// expands multi-allelic sites, and writes the device-ready columnar batch
+// (chromosome codes, positions, width-bounded allele bytes + true lengths)
+// straight into caller-provided numpy buffers — no per-row Python objects.
+//
+// Contract (mirrors annotatedvdb_tpu/io/vcf.py VcfBatchReader):
+//   - lines starting '#' and blank lines are skipped;
+//   - CHROM strips a "chr" prefix, "MT" folds to "M"; codes are 1..22,
+//     X=23, Y=24, M=25; code 0 (unplaceable contig) skips the line and
+//     counts skipped_contig;
+//   - ALT splits on ','; a "." alt is skipped and counts skipped_alt;
+//   - only COMPLETE lines are consumed (a multi-allelic site never
+//     straddles chunks); the caller re-feeds the unconsumed tail;
+//   - string-typed columns (ID, INFO, QUAL/FILTER/FORMAT, REF/ALT over the
+//     device width) come back as (offset, length) spans into the caller's
+//     buffer so Python materializes only what it needs.
+//
+// Build: g++ -O3 -shared -fPIC (see annotatedvdb_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline int8_t chrom_code(const char* s, int len) {
+    if (len >= 3 && s[0] == 'c' && s[1] == 'h' && s[2] == 'r') {
+        s += 3;
+        len -= 3;
+    }
+    if (len == 1) {
+        switch (s[0]) {
+            case 'X': return 23;
+            case 'Y': return 24;
+            case 'M': return 25;
+            default: break;
+        }
+        if (s[0] >= '1' && s[0] <= '9') return static_cast<int8_t>(s[0] - '0');
+        return 0;
+    }
+    if (len == 2) {
+        if (s[0] == 'M' && s[1] == 'T') return 25;
+        if (s[0] >= '1' && s[0] <= '2' && s[1] >= '0' && s[1] <= '9') {
+            int v = (s[0] - '0') * 10 + (s[1] - '0');
+            if (v >= 10 && v <= 22) return static_cast<int8_t>(v);
+        }
+    }
+    return 0;
+}
+
+// parse a non-negative decimal; returns -1 on any non-digit byte
+inline int64_t parse_pos(const char* s, int len) {
+    if (len <= 0) return -1;
+    int64_t v = 0;
+    for (int i = 0; i < len; ++i) {
+        char c = s[i];
+        if (c < '0' || c > '9') return -1;
+        v = v * 10 + (c - '0');
+        if (v > INT64_C(0x7fffffff)) return -1;
+    }
+    return v;
+}
+
+struct Span {
+    const char* ptr;
+    int len;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Counters layout (int64):
+//   [0] lines parsed (data lines seen, valid or not)
+//   [1] skipped_contig
+//   [2] skipped_alt
+//   [3] malformed (fewer than 5 columns or bad POS)
+//
+// Returns the number of rows written.  *consumed is the byte count of fully
+// processed lines; *need_more is set to 1 when the row buffers filled up
+// before the chunk was exhausted (caller flushes and re-feeds from
+// *consumed).
+int64_t avdb_parse_vcf_chunk(
+    const char* buf, int64_t n_bytes, int32_t width, int64_t max_rows,
+    int64_t line_base,
+    // per-row outputs (device batch)
+    int8_t* chrom, int32_t* pos, uint8_t* ref, uint8_t* alt,
+    int32_t* ref_len, int32_t* alt_len, uint8_t* multi,
+    int64_t* line_no,
+    // per-row spans into buf (host sidecar, lazily materialized)
+    int64_t* ref_off, int64_t* alt_off,
+    int64_t* id_off, int32_t* id_len,
+    int64_t* qual_off, int32_t* qual_len,
+    int64_t* filter_off, int32_t* filter_len,
+    int64_t* info_off, int32_t* info_len,
+    int64_t* format_off, int32_t* format_len,
+    // full ALT column span (multi-allelic variant ids need it verbatim)
+    int64_t* altcol_off, int32_t* altcol_len,
+    // site index of each row within its line (alt ordinal) + alt count
+    int32_t* alt_index, int32_t* n_alts_out,
+    int64_t* counters, int64_t* consumed, int32_t* need_more) {
+    int64_t rows = 0;
+    int64_t offset = 0;
+    int64_t line = line_base;
+    *need_more = 0;
+
+    while (offset < n_bytes) {
+        const char* nl = static_cast<const char*>(
+            memchr(buf + offset, '\n', static_cast<size_t>(n_bytes - offset)));
+        if (nl == nullptr) break;  // incomplete final line: leave for caller
+        const char* p = buf + offset;
+        int64_t len = nl - p;
+        int64_t next_offset = offset + len + 1;
+        ++line;
+
+        if (len == 0 || p[0] == '#') {
+            offset = next_offset;
+            continue;
+        }
+        // strip a trailing '\r' (CRLF VCFs)
+        if (len > 0 && p[len - 1] == '\r') --len;
+        bool blank = true;
+        for (int64_t i = 0; i < len && blank; ++i)
+            blank = (p[i] == ' ' || p[i] == '\t');
+        if (blank) {
+            offset = next_offset;
+            continue;
+        }
+        counters[0]++;
+
+        // tokenize up to 9 tab-separated fields
+        Span fields[9];
+        int nf = 0;
+        const char* start = p;
+        const char* end = p + len;
+        for (const char* q = p; q <= end && nf < 9; ++q) {
+            if (q == end || *q == '\t') {
+                fields[nf].ptr = start;
+                fields[nf].len = static_cast<int>(q - start);
+                ++nf;
+                start = q + 1;
+            }
+        }
+        if (nf < 5) {
+            counters[3]++;
+            offset = next_offset;
+            continue;
+        }
+        int8_t code = chrom_code(fields[0].ptr, fields[0].len);
+        if (code == 0) {
+            counters[1]++;
+            offset = next_offset;
+            continue;
+        }
+        int64_t position = parse_pos(fields[1].ptr, fields[1].len);
+        if (position < 0) {
+            counters[3]++;
+            offset = next_offset;
+            continue;
+        }
+
+        // count alts for capacity + multi-allelic flag
+        int n_alts = 1;
+        for (int i = 0; i < fields[4].len; ++i)
+            if (fields[4].ptr[i] == ',') ++n_alts;
+        if (rows + n_alts > max_rows) {
+            counters[0]--;  // the line is re-fed (and re-counted) next call
+            *need_more = 1;
+            break;  // line does not fit: flush and re-feed
+        }
+
+        const Span& id_f = fields[2];  // ID
+        const Span& rr = fields[3];    // REF
+        bool has_qual = nf > 5 && !(fields[5].len == 1 && fields[5].ptr[0] == '.');
+        bool has_filter = nf > 6 && !(fields[6].len == 1 && fields[6].ptr[0] == '.');
+        bool has_info = nf > 7 && !(fields[7].len == 1 && fields[7].ptr[0] == '.');
+        bool has_format = nf > 8 && !(fields[8].len == 1 && fields[8].ptr[0] == '.');
+
+        const char* alt_start = fields[4].ptr;
+        const char* alt_end = fields[4].ptr + fields[4].len;
+        int ordinal = 0;
+        for (const char* q = alt_start; q <= alt_end; ++q) {
+            if (q == alt_end || *q == ',') {
+                int alen = static_cast<int>(q - alt_start);
+                ++ordinal;
+                if (alen == 1 && alt_start[0] == '.') {
+                    counters[2]++;
+                } else {
+                    int64_t r = rows++;
+                    chrom[r] = code;
+                    pos[r] = static_cast<int32_t>(position);
+                    ref_len[r] = rr.len;
+                    alt_len[r] = alen;
+                    int rcopy = rr.len < width ? rr.len : width;
+                    int acopy = alen < width ? alen : width;
+                    memcpy(ref + r * width, rr.ptr, static_cast<size_t>(rcopy));
+                    if (rcopy < width)
+                        memset(ref + r * width + rcopy, 0,
+                               static_cast<size_t>(width - rcopy));
+                    memcpy(alt + r * width, alt_start, static_cast<size_t>(acopy));
+                    if (acopy < width)
+                        memset(alt + r * width + acopy, 0,
+                               static_cast<size_t>(width - acopy));
+                    multi[r] = n_alts > 1 ? 1 : 0;
+                    line_no[r] = line;
+                    ref_off[r] = rr.ptr - buf;
+                    alt_off[r] = alt_start - buf;
+                    id_off[r] = id_f.ptr - buf;
+                    id_len[r] = id_f.len;
+                    qual_off[r] = has_qual ? fields[5].ptr - buf : -1;
+                    qual_len[r] = has_qual ? fields[5].len : 0;
+                    filter_off[r] = has_filter ? fields[6].ptr - buf : -1;
+                    filter_len[r] = has_filter ? fields[6].len : 0;
+                    info_off[r] = has_info ? fields[7].ptr - buf : -1;
+                    info_len[r] = has_info ? fields[7].len : 0;
+                    format_off[r] = has_format ? fields[8].ptr - buf : -1;
+                    format_len[r] = has_format ? fields[8].len : 0;
+                    altcol_off[r] = fields[4].ptr - buf;
+                    altcol_len[r] = fields[4].len;
+                    alt_index[r] = ordinal - 1;
+                    n_alts_out[r] = n_alts;
+                }
+                alt_start = q + 1;
+            }
+        }
+        offset = next_offset;
+        // NOTE: rr.len (REF) is written in full to ref_len even when it
+        // exceeds width — the device flags such rows host_fallback, exactly
+        // like the Python reader.
+    }
+    *consumed = offset;
+    return rows;
+}
+
+// Fast scan for the ID column's refsnp: returns 1 and writes the rs number
+// when the span looks like "rs<digits>", else 0.  (INFO RS= extraction stays
+// in Python — it needs the full INFO parse anyway.)
+int32_t avdb_parse_rs(const char* s, int32_t len, int64_t* out) {
+    if (len < 3 || s[0] != 'r' || s[1] != 's') return 0;
+    int64_t v = 0;
+    for (int32_t i = 2; i < len; ++i) {
+        if (s[i] < '0' || s[i] > '9') return 0;
+        v = v * 10 + (s[i] - '0');
+    }
+    *out = v;
+    return 1;
+}
+
+}  // extern "C"

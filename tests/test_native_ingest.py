@@ -1,0 +1,181 @@
+"""Native C++ VCF tokenizer vs the pure Python reader: chunk-level parity.
+
+The native engine (``native/avdb_native.cpp`` via
+``annotatedvdb_tpu/native``) must emit byte-identical chunks so the two
+engines are freely interchangeable behind ``VcfBatchReader(engine=...)``.
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu import native
+from annotatedvdb_tpu.io.vcf import VcfBatchReader
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++)"
+)
+
+# exercises: multi-allelic expansion, '.' alt skipping, unplaceable contigs,
+# chr prefixes, MT folding, rs ids in ID and INFO, FREQ parsing, missing
+# trailing columns, '.' QUAL/FILTER, over-width alleles, malformed POS,
+# blank lines, no trailing newline
+TRICKY_VCF = """\
+##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT
+1\t100\trs1\tA\tG\t50\tPASS\tRS=1;RSPOS=100;FREQ=GnomAD:0.5,0.25|TOPMED:.,0.1\tGT
+chr2\t200\t.\tC\tT,CA,.\t.\t.\tRS=2
+MT\t300\tweird_rs_id\tG\tA\t.\tLOWQ\t.
+GL000219.1\t400\t.\tA\tC\t.\t.\t.
+3\t500\tcustomid\tACGT\tA
+4\tnotanumber\t.\tA\tC\t.\t.\t.
+5\t600\t.\t{LONG}\tT\t.\t.\tAF=0.1
+
+X\t700\trs7\tT\t.\t.\t.\t.
+Y\t800\t.\tAT\tATAT\t9.5\tq10;s50\tDP=100
+""".replace("{LONG}", "A" * 80)
+
+
+def write_vcf(tmp_path, text, gz=False):
+    if gz:
+        p = tmp_path / "t.vcf.gz"
+        with gzip.open(p, "wt") as f:
+            f.write(text)
+    else:
+        p = tmp_path / "t.vcf"
+        p.write_text(text)
+    return str(p)
+
+
+def read_all(path, **kw):
+    return list(VcfBatchReader(path, **kw))
+
+
+def assert_chunks_equal(a_chunks, b_chunks):
+    def flat(chunks, attr):
+        out = []
+        for c in chunks:
+            out.extend(getattr(c, attr))
+        return out
+
+    for attr in ("refs", "alts", "ref_snp", "variant_id", "qual", "filter",
+                 "format", "rs_position", "frequencies", "info"):
+        assert flat(a_chunks, attr) == flat(b_chunks, attr), attr
+    for arr in ("chrom", "pos", "ref_len", "alt_len", "ref", "alt"):
+        pa = np.concatenate([np.asarray(getattr(c.batch, arr)) for c in a_chunks])
+        na = np.concatenate([np.asarray(getattr(c.batch, arr)) for c in b_chunks])
+        assert (pa == na).all(), arr
+    for attr in ("is_multi_allelic", "line_number"):
+        pa = np.concatenate([np.asarray(getattr(c, attr)) for c in a_chunks])
+        na = np.concatenate([np.asarray(getattr(c, attr)) for c in b_chunks])
+        assert (pa == na).all(), attr
+    for key in ("line", "skipped_contig", "skipped_alt"):
+        assert (
+            sum(c.counters.get(key, 0) for c in a_chunks)
+            == sum(c.counters.get(key, 0) for c in b_chunks)
+        ), key
+
+
+@pytest.mark.parametrize("identity_only", [False, True])
+@pytest.mark.parametrize("gz", [False, True])
+def test_native_python_parity(tmp_path, identity_only, gz):
+    path = write_vcf(tmp_path, TRICKY_VCF, gz=gz)
+    py = read_all(path, engine="python", identity_only=identity_only, width=16)
+    nat = read_all(path, engine="native", identity_only=identity_only, width=16)
+    assert sum(c.batch.n for c in py) == sum(c.batch.n for c in nat)
+    assert_chunks_equal(py, nat)
+
+
+def test_native_batch_boundaries(tmp_path):
+    """Tiny batch_size forces capacity re-feeds; a multi-allelic line must
+    never straddle chunks, and nothing is double-counted."""
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    py = read_all(path, engine="python", batch_size=2, width=16)
+    nat = read_all(path, engine="native", batch_size=2, width=16)
+    assert_chunks_equal(py, nat)
+    # rows of one source line (multi-allelic expansion) share a chunk
+    seen = {}
+    for ci, c in enumerate(nat):
+        for ln in np.asarray(c.line_number):
+            seen.setdefault(int(ln), set()).add(ci)
+    assert all(len(v) == 1 for v in seen.values())
+
+
+def test_native_over_width_fallback(tmp_path):
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    (chunk,) = read_all(path, engine="native", width=16)
+    long_rows = np.where(np.asarray(chunk.batch.ref_len) > 16)[0]
+    assert long_rows.size == 1
+    i = int(long_rows[0])
+    assert chunk.refs[i] == "A" * 80          # original string via lazy span
+    assert chunk.batch.ref_len[i] == 80       # true length beyond the width
+
+
+def test_native_counters(tmp_path):
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    (chunk,) = read_all(path, engine="native", width=16)
+    assert chunk.counters["skipped_contig"] == 1   # GL000219.1
+    assert chunk.counters["skipped_alt"] == 2      # '.' in multi-allelic + X's '.'
+    assert chunk.counters["malformed"] == 1        # POS 'notanumber'
+
+
+def test_native_engine_forced_errors_without_library(monkeypatch, tmp_path):
+    import annotatedvdb_tpu.native as nat_mod
+
+    monkeypatch.setattr(nat_mod, "available", lambda: False)
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    with pytest.raises(RuntimeError, match="native ingest engine unavailable"):
+        list(VcfBatchReader(path, engine="native"))
+    # auto falls back silently
+    assert list(VcfBatchReader(path, engine="auto", width=16))
+
+
+# trailing filtered lines + an out-of-int32-range position: both engines must
+# count them identically even though no data row follows
+TRAILING_SKIP_VCF = """\
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\trs1\tA\tG\t.\t.\t.
+2\t3000000000\t.\tA\tC\t.\t.\t.
+GL000219.1\t400\t.\tA\tC\t.\t.\t.
+"""
+
+
+@pytest.mark.parametrize("engine", ["python", "native"])
+def test_trailing_skip_counters_survive(tmp_path, engine):
+    p = tmp_path / "t.vcf"
+    p.write_text(TRAILING_SKIP_VCF)
+    chunks = list(VcfBatchReader(str(p), engine=engine, width=16, batch_size=1))
+    totals = {}
+    for c in chunks:
+        for k, v in c.counters.items():
+            totals[k] = totals.get(k, 0) + v
+    assert totals["line"] == 3
+    assert totals["malformed"] == 1       # pos > 2^31
+    assert totals["skipped_contig"] == 1
+    assert sum(c.batch.n for c in chunks) == 1
+
+
+def test_loader_tolerates_trailing_counter_chunk(tmp_path):
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    p = tmp_path / "t.vcf"
+    p.write_text(TRAILING_SKIP_VCF)
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    counters = TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(
+        str(p), commit=True
+    )
+    assert counters["variant"] == 1
+    assert counters["malformed"] == 1
+    assert counters["skipped"] == 1       # the contig line
+    assert counters["line"] == 3
+
+
+def test_native_forced_with_chromosome_map_raises(tmp_path):
+    p = tmp_path / "t.vcf"
+    p.write_text(TRAILING_SKIP_VCF)
+    with pytest.raises(RuntimeError, match="chromosome_map"):
+        list(VcfBatchReader(str(p), engine="native",
+                            chromosome_map={"NC_1": "1"}))

@@ -247,3 +247,18 @@ def test_ledger_undone_checkpoint_is_dead(tmp_path):
     assert ledger.last_checkpoint("f.tsv") == 32768  # test-run cursor live
     ledger.undo(a1, removed=32768)
     assert ledger.last_checkpoint("f.tsv") == 0      # dead after undo
+
+
+def test_ledger_undone_superseder_revives_older_cursor(tmp_path):
+    """Undoing the run that completed a file revives an older crashed run's
+    live checkpoint — the undone run no longer covers those lines."""
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    a1 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    ledger.checkpoint(a1, "f.vcf", 500, {})  # crash: a1 never finishes
+    a2 = ledger.begin("load_qc", {"file": "f.vcf"}, commit=True)
+    ledger.checkpoint(a2, "f.vcf", 900, {})
+    ledger.finish(a2, {})
+    assert ledger.last_checkpoint("f.vcf") == 0  # a2 completed the file
+    ledger.undo(a2, removed=900)
+    # a2's coverage is gone; a1's crashed cursor is live again
+    assert ledger.last_checkpoint("f.vcf") == 500
