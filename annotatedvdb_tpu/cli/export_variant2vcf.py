@@ -27,17 +27,9 @@ _INVALID_ALLELE = re.compile(r"^[IRDN]$")
 
 
 def shard_primary_key(shard, i: int) -> str:
-    """Row's record PK: retained digest PK for the long-allele tail, else
-    literal ``chr:pos:ref:alt[:rs]`` (``primary_key_generator.py:99-122``)."""
-    if shard.digest_pk[i] is not None:
-        return shard.digest_pk[i]
-    ref, alt = shard.alleles(i)
-    label = chromosome_label(shard.chrom_code)
-    parts = [label, str(int(shard.cols["pos"][i])), ref, alt]
-    rs = int(shard.cols["ref_snp"][i])
-    if rs >= 0:
-        parts.append(f"rs{rs}")
-    return ":".join(parts)
+    """Row's record PK (delegates to the shared
+    :meth:`ChromosomeShard.primary_key` definition)."""
+    return shard.primary_key(i)
 
 
 def export_chromosome(store: VariantStore, code: int, out_dir: str,

@@ -1,0 +1,1 @@
+from annotatedvdb_tpu.sql.schema import full_schema  # noqa: F401

@@ -1,0 +1,331 @@
+"""PostgreSQL-compatible schema egress: DDL generators for the reference's
+storage layer.
+
+The framework's working store is the in-memory/npz columnar
+:class:`~annotatedvdb_tpu.store.variant_store.VariantStore`; this module
+generates the SQL needed to materialize the SAME schema the reference
+installs (``Load/lib/sql/annotatedvdb_schema/``), so downstream consumers of
+``AnnotatedVDB.Variant`` can point at an exported database without noticing
+the backend swap.  DDL is generated (not hand-maintained files) so the
+column/partition lists stay tied to the package's single source of truth
+(``JSONB_COLUMNS``, the chromosome code table).
+
+Also reconstructs the external symbols the reference repo uses but does not
+define (SURVEY.md §1 "critical external-dependency note"): ``find_bin_index``
+(here closed-form arithmetic instead of a BinIndexRef tree walk — same ltree
+answers, no table scan), the ``BinIndexRef`` DDL, and ``jsonb_merge``.
+
+Reference citations per object:
+- Variant table/partitions/trigger/indexes:
+  ``tables/createVariant.sql:4-94``
+- AlgorithmInvocation: ``tables/createAlgorithmInvocation.sql:4-15``
+- autovacuum toggle: ``tables/alterAutoVacuum.sql:2-19``
+- virtual columns: ``functions/createVariantVirtualColumns.sql:1-26``
+- metaseq lookups: ``functions/createFindVariantByMetaseqId.sql:1-39``
+- dedup patch: ``patches/removeDuplicates.sql:1-44``
+- bootstrap: ``createAnnotatedVDBSchema.sql:1-19``
+"""
+
+from __future__ import annotations
+
+from annotatedvdb_tpu.ops.binindex import LEAF_SIZE, NUM_BIN_LEVELS
+from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
+from annotatedvdb_tpu.types import _CODE_TO_CHROM  # code -> '1'..'22','X','Y','M'
+
+SCHEMA = "AnnotatedVDB"
+
+#: chromosome partition labels in code order (chr1..chr22, chrX, chrY, chrM)
+PARTITION_LABELS = ["chr" + _CODE_TO_CHROM[c] for c in sorted(_CODE_TO_CHROM)]
+
+
+def create_schema_sql() -> str:
+    return f"""-- schema bootstrap (createAnnotatedVDBSchema.sql:1-19 equivalent)
+CREATE SCHEMA IF NOT EXISTS {SCHEMA};
+CREATE EXTENSION IF NOT EXISTS ltree;
+"""
+
+
+def create_variant_table_sql() -> str:
+    jsonb_cols = "\n".join(f"    {c} JSONB," for c in JSONB_COLUMNS)
+    partitions = "\n".join(
+        f"CREATE UNLOGGED TABLE IF NOT EXISTS {SCHEMA}.Variant_{label} "
+        f"PARTITION OF {SCHEMA}.Variant FOR VALUES IN ('{label}');"
+        for label in PARTITION_LABELS
+    )
+    return f"""-- AnnotatedVDB.Variant (createVariant.sql:4-50 equivalent)
+-- LIST partitioning by chromosome: per-chromosome workers never contend on
+-- a partition.  The leaf partitions are UNLOGGED (bulk loads skip WAL); the
+-- parent must not be (PostgreSQL 17+ rejects UNLOGGED partitioned parents).
+CREATE TABLE IF NOT EXISTS {SCHEMA}.Variant (
+    chromosome           VARCHAR(10) NOT NULL,
+    record_primary_key   TEXT NOT NULL,
+    position             INTEGER NOT NULL,
+    is_multi_allelic     BOOLEAN,
+    is_adsp_variant      BOOLEAN,
+    ref_snp_id           TEXT,
+    metaseq_id           TEXT,
+    bin_index            LTREE,
+{jsonb_cols}
+    row_algorithm_id     INTEGER
+) PARTITION BY LIST (chromosome);
+
+{partitions}
+"""
+
+
+def create_variant_indexes_sql() -> str:
+    return f"""-- createVariant.sql:90-94 equivalent index set
+CREATE INDEX IF NOT EXISTS variant_pk_hash_idx
+    ON {SCHEMA}.Variant USING HASH (record_primary_key);
+CREATE INDEX IF NOT EXISTS variant_refsnp_hash_idx
+    ON {SCHEMA}.Variant USING HASH (ref_snp_id);
+CREATE INDEX IF NOT EXISTS variant_metaseq_left_idx
+    ON {SCHEMA}.Variant (LEFT(metaseq_id, 50));
+CREATE INDEX IF NOT EXISTS variant_bin_gist_idx
+    ON {SCHEMA}.Variant USING GIST (bin_index);
+CREATE INDEX IF NOT EXISTS variant_row_alg_idx
+    ON {SCHEMA}.Variant (row_algorithm_id);
+"""
+
+
+def create_algorithm_invocation_sql() -> str:
+    return f"""-- undo ledger (createAlgorithmInvocation.sql:4-15 equivalent)
+CREATE TABLE IF NOT EXISTS {SCHEMA}.AlgorithmInvocation (
+    algorithm_invocation_id  SERIAL PRIMARY KEY,
+    script_name              TEXT,
+    script_parameters        TEXT,
+    commit_mode              BOOLEAN,
+    run_time                 TIMESTAMP DEFAULT NOW()
+);
+"""
+
+
+def create_find_bin_index_sql() -> str:
+    """Closed-form ``find_bin_index(chr, start, end)``.
+
+    The reference resolves bins by querying a materialized 14-level
+    ``BinIndexRef`` tree (external ``find_bin_index``, used at
+    ``BinIndex/lib/python/bin_index.py:9-14``).  Since the tree is a fixed
+    halving hierarchy (64 Mb -> 15.625 kb,
+    ``generate_bin_index_references.py:93``), the deepest enclosing bin is
+    pure integer arithmetic — this PLpgSQL mirrors the device kernel
+    (``ops/binindex.py``) and the path builder
+    (``oracle/binindex.py:closed_form_path``)."""
+    return f"""CREATE OR REPLACE FUNCTION find_bin_index(
+    chrm TEXT, loc_start BIGINT, loc_end BIGINT
+) RETURNS LTREE AS $$
+DECLARE
+    leaf_a BIGINT := (loc_start - 1) / {LEAF_SIZE};
+    leaf_b BIGINT := (loc_end - 1) / {LEAF_SIZE};
+    x BIGINT := leaf_a # leaf_b;
+    lvl INT := {NUM_BIN_LEVELS};
+    g BIGINT;
+    b INT;
+    path TEXT;
+    l INT;
+BEGIN
+    WHILE x > 0 LOOP
+        lvl := lvl - 1;
+        x := x >> 1;
+    END LOOP;
+    IF lvl < 0 THEN
+        lvl := 0;
+    END IF;
+    path := CASE WHEN chrm LIKE 'chr%' THEN chrm ELSE 'chr' || chrm END;
+    FOR l IN 1..lvl LOOP
+        g := leaf_a >> ({NUM_BIN_LEVELS} - l);
+        IF l = 1 THEN
+            b := g + 1;
+        ELSE
+            b := (g & 1) + 1;
+        END IF;
+        path := path || '.L' || l || '.B' || b;
+    END LOOP;
+    RETURN path::ltree;
+END;
+$$ LANGUAGE plpgsql IMMUTABLE;
+"""
+
+
+def create_bin_index_ref_sql() -> str:
+    """``BinIndexRef`` DDL (external table the reference inserts into at
+    ``generate_bin_index_references.py:79-98``); rows come from
+    ``cli/generate_bin_index_references.py``."""
+    return """CREATE TABLE IF NOT EXISTS BinIndexRef (
+    bin_index_ref_id   SERIAL PRIMARY KEY,
+    chromosome         VARCHAR(10) NOT NULL,
+    level              INTEGER NOT NULL,
+    global_bin_index   INTEGER NOT NULL,
+    global_bin_path    LTREE NOT NULL,
+    location           INT8RANGE NOT NULL
+);
+CREATE INDEX IF NOT EXISTS bin_index_ref_path_idx
+    ON BinIndexRef USING GIST (global_bin_path);
+"""
+
+
+def create_jsonb_merge_sql() -> str:
+    """Recursive deep-merge — reconstruction of the external ``jsonb_merge``
+    the reference's VEP updater calls
+    (``vep_variant_loader.py:227``): object keys merge recursively, with the
+    right side winning scalar conflicts (matching
+    ``utils/strings.deep_update``)."""
+    return """CREATE OR REPLACE FUNCTION jsonb_merge(a JSONB, b JSONB)
+RETURNS JSONB AS $$
+SELECT CASE
+    WHEN a IS NULL THEN b
+    WHEN b IS NULL THEN a
+    WHEN jsonb_typeof(a) = 'object' AND jsonb_typeof(b) = 'object' THEN (
+        -- COALESCE: merging two empty objects must yield '{}', not the SQL
+        -- NULL that jsonb_object_agg produces over zero rows
+        SELECT COALESCE(jsonb_object_agg(
+            COALESCE(ka, kb),
+            CASE
+                WHEN va IS NULL THEN vb
+                WHEN vb IS NULL THEN va
+                ELSE jsonb_merge(va, vb)
+            END
+        ), '{}'::jsonb)
+        FROM jsonb_each(a) e1(ka, va)
+        FULL JOIN jsonb_each(b) e2(kb, vb) ON ka = kb
+    )
+    ELSE b
+END;
+$$ LANGUAGE sql IMMUTABLE;
+"""
+
+
+def create_bin_index_trigger_sql() -> str:
+    return f"""-- set_bin_index trigger (createVariant.sql:55-68 equivalent):
+-- fills a NULL bin_index from the display_attributes location span
+CREATE OR REPLACE FUNCTION {SCHEMA}.set_bin_index() RETURNS TRIGGER AS $$
+BEGIN
+    IF NEW.bin_index IS NULL THEN
+        NEW.bin_index := find_bin_index(
+            NEW.chromosome,
+            COALESCE((NEW.display_attributes->>'location_start')::bigint,
+                     NEW.position),
+            COALESCE((NEW.display_attributes->>'location_end')::bigint,
+                     NEW.position)
+        );
+    END IF;
+    RETURN NEW;
+END;
+$$ LANGUAGE plpgsql;
+
+DROP TRIGGER IF EXISTS variant_set_bin_index ON {SCHEMA}.Variant;
+CREATE TRIGGER variant_set_bin_index
+    BEFORE INSERT ON {SCHEMA}.Variant
+    FOR EACH ROW EXECUTE FUNCTION {SCHEMA}.set_bin_index();
+"""
+
+
+def create_autovacuum_sql() -> str:
+    whens = "\n".join(
+        f"    EXECUTE format('ALTER TABLE {SCHEMA}.Variant_{label} "
+        "SET (autovacuum_enabled = %s)', flag);"
+        for label in PARTITION_LABELS
+    )
+    return f"""-- bulk-load tuning (alterAutoVacuum.sql:2-19 equivalent)
+CREATE OR REPLACE FUNCTION {SCHEMA}.alter_variant_autovacuum(flag BOOLEAN)
+RETURNS VOID AS $$
+BEGIN
+{whens}
+END;
+$$ LANGUAGE plpgsql;
+"""
+
+
+def create_virtual_columns_sql() -> str:
+    return f"""-- computed attributes callable as v.<name>
+-- (createVariantVirtualColumns.sql:1-26 equivalent)
+CREATE OR REPLACE FUNCTION legacy_record_primary_key(v {SCHEMA}.Variant)
+RETURNS TEXT AS $$
+    SELECT LEFT(v.metaseq_id, 50)
+           || CASE WHEN v.ref_snp_id IS NOT NULL THEN '_' || v.ref_snp_id
+                   ELSE '' END;
+$$ LANGUAGE sql STABLE;
+
+CREATE OR REPLACE FUNCTION has_genomicsdb_annotation(v {SCHEMA}.Variant)
+RETURNS BOOLEAN AS $$
+    SELECT v.cadd_scores IS NOT NULL
+        OR v.adsp_most_severe_consequence IS NOT NULL
+        OR v.allele_frequencies IS NOT NULL
+        OR v.loss_of_function IS NOT NULL
+        OR v.gwas_flags IS NOT NULL;
+$$ LANGUAGE sql STABLE;
+
+CREATE OR REPLACE FUNCTION variant_class_abbrev(v {SCHEMA}.Variant)
+RETURNS TEXT AS $$
+    SELECT v.display_attributes->>'variant_class_abbrev';
+$$ LANGUAGE sql STABLE;
+
+CREATE OR REPLACE FUNCTION adsp_ms_consequence(v {SCHEMA}.Variant)
+RETURNS TEXT AS $$
+    SELECT v.adsp_most_severe_consequence->>'conseq';
+$$ LANGUAGE sql STABLE;
+"""
+
+
+def create_metaseq_lookup_sql() -> str:
+    return f"""-- metaseq lookups (createFindVariantByMetaseqId.sql:1-39 equivalent);
+-- the LEFT-50 predicate rides the btree index, chromosome prunes partitions
+CREATE OR REPLACE FUNCTION generate_alt_metaseq_id(metaseq TEXT)
+RETURNS TEXT AS $$
+    SELECT split_part(metaseq, ':', 1) || ':' || split_part(metaseq, ':', 2)
+           || ':' || split_part(metaseq, ':', 4) || ':' || split_part(metaseq, ':', 3);
+$$ LANGUAGE sql IMMUTABLE;
+
+CREATE OR REPLACE FUNCTION find_variant_by_metaseq_id(metaseq TEXT)
+RETURNS SETOF {SCHEMA}.Variant AS $$
+    SELECT * FROM {SCHEMA}.Variant v
+    WHERE LEFT(v.metaseq_id, 50) = LEFT(metaseq, 50)
+      AND v.metaseq_id = metaseq
+      AND v.chromosome = 'chr' || split_part(metaseq, ':', 1);
+$$ LANGUAGE sql STABLE;
+
+CREATE OR REPLACE FUNCTION find_variant_by_metaseq_id_variations(metaseq TEXT)
+RETURNS SETOF {SCHEMA}.Variant AS $$
+    SELECT * FROM find_variant_by_metaseq_id(metaseq)
+    UNION ALL
+    SELECT * FROM find_variant_by_metaseq_id(generate_alt_metaseq_id(metaseq));
+$$ LANGUAGE sql STABLE;
+"""
+
+
+def dedup_patch_sql() -> str:
+    parts = "\n".join(
+        f"""    DELETE FROM {SCHEMA}.Variant_{label} t USING (
+        SELECT record_primary_key, MIN(ctid) AS keep_ctid
+        FROM {SCHEMA}.Variant_{label}
+        GROUP BY record_primary_key HAVING COUNT(*) > 1
+    ) d
+    WHERE t.record_primary_key = d.record_primary_key
+      AND t.ctid <> d.keep_ctid;"""
+        for label in PARTITION_LABELS
+    )
+    return f"""-- per-partition duplicate collapse (patches/removeDuplicates.sql:1-44
+-- equivalent): keep the first physical row per record_primary_key
+DO $$
+BEGIN
+{parts}
+END;
+$$;
+"""
+
+
+def full_schema() -> list[tuple[str, str]]:
+    """Ordered (name, sql) pairs — the install sequence."""
+    return [
+        ("01_schema", create_schema_sql()),
+        ("02_jsonb_merge", create_jsonb_merge_sql()),
+        ("03_find_bin_index", create_find_bin_index_sql()),
+        ("04_bin_index_ref", create_bin_index_ref_sql()),
+        ("05_variant_table", create_variant_table_sql()),
+        ("06_bin_index_trigger", create_bin_index_trigger_sql()),
+        ("07_variant_indexes", create_variant_indexes_sql()),
+        ("08_algorithm_invocation", create_algorithm_invocation_sql()),
+        ("09_autovacuum", create_autovacuum_sql()),
+        ("10_virtual_columns", create_virtual_columns_sql()),
+        ("11_metaseq_lookup", create_metaseq_lookup_sql()),
+    ]

@@ -94,6 +94,23 @@ class ChromosomeShard:
     def key(self) -> np.ndarray:
         return combined_key(self.cols["pos"], self.cols["h"])
 
+    def primary_key(self, i: int) -> str:
+        """Row's record PK: retained digest PK for the long-allele tail, else
+        literal ``chr:pos:ref:alt[:rs]`` (``primary_key_generator.py:99-122``).
+        The single definition shared by every egress path."""
+        i = int(i)
+        if self.digest_pk[i] is not None:
+            return self.digest_pk[i]
+        ref, alt = self.alleles(i)
+        parts = [
+            chromosome_label(self.chrom_code),
+            str(int(self.cols["pos"][i])), ref, alt,
+        ]
+        rs = int(self.cols["ref_snp"][i])
+        if rs >= 0:
+            parts.append(f"rs{rs}")
+        return ":".join(parts)
+
     def alleles(self, i: int) -> tuple[str, str]:
         """True (ref, alt) strings for row i — exact even for the long-allele
         tail whose device arrays are width-truncated."""
