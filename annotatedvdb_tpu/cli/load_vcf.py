@@ -38,6 +38,10 @@ def main(argv=None):
                         help="rows per device batch / checkpoint")
     parser.add_argument("--chromosomeMap", default=None,
                         help="TSV mapping seq accessions to chromosomes")
+    parser.add_argument("--refGenome", default=None,
+                        help="packed genome .npz (cli.index_genome); enables "
+                             "ref-allele validation + canonical GA4GH digests "
+                             "(the reference's --seqrepoProxyPath)")
     parser.add_argument("--noResume", action="store_true",
                         help="ignore previous checkpoints for this file")
     parser.add_argument("--skipExisting", action=argparse.BooleanOptionalAction,
@@ -56,12 +60,18 @@ def main(argv=None):
     )
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
     chrom_map = read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
+    genome = None
+    if args.refGenome:
+        from annotatedvdb_tpu.genome import ReferenceGenome
+
+        genome = ReferenceGenome.load(args.refGenome)
 
     loader = TpuVcfLoader(
         store,
         ledger,
         datasource=args.datasource,
         genome_build=args.genomeBuild,
+        genome=genome,
         batch_size=args.commitAfter,
         skip_existing=args.skipExisting,
         chromosome_map=chrom_map,

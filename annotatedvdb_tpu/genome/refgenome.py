@@ -1,0 +1,347 @@
+"""2-bit packed reference genome: the framework's SeqRepo equivalent.
+
+The reference validates ref alleles and derives GA4GH sequence digests
+through biocommons SeqRepo (a sqlite+FASTA native store,
+``Util/lib/python/primary_key_generator.py:28-30,74-96``).  TPU-native
+replacement per SURVEY.md §2.4: the genome lives as a 2-bit packed uint8
+array (4 bases/byte, ~800MB for GRCh38 — HBM-resident on a v5e) plus a
+1-bit ambiguity mask, with
+
+- host ``fetch`` for the rare scalar paths (VRS digest PKs, display),
+- a vectorized device kernel ``validate_ref_batch`` that checks a whole
+  ``VariantBatch``'s ref alleles against the genome in one gather pass —
+  replacing the per-variant SeqRepo file reads the reference performs
+  inside its hot loop,
+- true GA4GH sequence digests (``sha512t24u`` of the uppercase sequence,
+  exactly SeqRepo's scheme) so VRS ids become canonical when a genome is
+  indexed.
+
+Build once from FASTA with :meth:`ReferenceGenome.from_fasta` (or the
+``index_genome`` CLI), persist with ``save``/``load`` (npz).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+import numpy as np
+
+from annotatedvdb_tpu.types import chromosome_code, chromosome_label
+
+_CODE = {65: 0, 67: 1, 71: 2, 84: 3,     # A C G T
+         97: 0, 99: 1, 103: 2, 116: 3}   # a c g t
+_BASES = np.frombuffer(b"ACGT", dtype=np.uint8)
+
+# byte -> 2-bit code, and byte -> is-ambiguous, as lookup tables
+_CODE_LUT = np.zeros(256, np.uint8)
+_AMBIG_LUT = np.ones(256, bool)
+for b, c in _CODE.items():
+    _CODE_LUT[b] = c
+    _AMBIG_LUT[b] = False
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path, "r")
+
+
+class ReferenceGenome:
+    """Packed genome over the 25 standard chromosomes.
+
+    ``packed``: uint8, 4 bases/byte, little-endian within the byte
+    (base j's code sits at bit ``2*(j%4)``); every chromosome starts at a
+    byte boundary.  ``n_mask``: uint8, 1 bit/base (bit ``j%8``), set for
+    any non-ACGT input base."""
+
+    def __init__(self):
+        self.packed = np.zeros(0, np.uint8)
+        self.n_mask = np.zeros(0, np.uint8)
+        # per-code byte offsets into packed / n_mask and base lengths
+        self.byte_offset: dict[int, int] = {}
+        self.mask_offset: dict[int, int] = {}
+        self.length: dict[int, int] = {}
+        # chromosomes containing non-ACGTN IUPAC bases: their 2-bit
+        # round-trip is lossy (every ambiguity code reads back as 'N'), so
+        # their digests must never be presented as canonical GA4GH ids
+        self.lossy: dict[int, bool] = {}
+        self._digests: dict[int, str] = {}
+
+    # ------------------------------------------------------------- build
+
+    @classmethod
+    def from_fasta(cls, path: str, log=lambda *a: None) -> "ReferenceGenome":
+        genome = cls()
+        packed_parts: list[np.ndarray] = []
+        mask_parts: list[np.ndarray] = []
+        byte_pos = 0
+        mask_pos = 0
+
+        def flush(code: int, seq_parts: list):
+            nonlocal byte_pos, mask_pos
+            if code == 0 or not seq_parts:
+                return
+            seq = np.concatenate(seq_parts)
+            n = seq.size
+            codes = _CODE_LUT[seq]
+            ambig = _AMBIG_LUT[seq]
+            pad = (-n) % 4
+            if pad:
+                codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+            shifts = (np.arange(codes.size, dtype=np.uint32) % 4) * 2
+            packed = np.zeros(codes.size // 4, np.uint8)
+            np.bitwise_or.at(
+                packed, np.arange(codes.size) // 4,
+                (codes.astype(np.uint16) << shifts).astype(np.uint8),
+            )
+            mpad = (-n) % 8
+            bits = np.concatenate([ambig, np.zeros(mpad, bool)]) if mpad else ambig
+            mask = np.packbits(bits, bitorder="little")
+            genome.byte_offset[code] = byte_pos
+            genome.mask_offset[code] = mask_pos
+            genome.length[code] = n
+            is_n = (seq == ord("N")) | (seq == ord("n"))
+            genome.lossy[code] = bool(np.any(ambig & ~is_n))
+            packed_parts.append(packed)
+            mask_parts.append(mask)
+            byte_pos += packed.size
+            mask_pos += mask.size
+            log(f"indexed chr{chromosome_label(code)}: {n} bases")
+
+        current_code = 0
+        seq_parts: list = []
+        with _open_text(path) as fh:
+            for line in fh:
+                if line.startswith(">"):
+                    flush(current_code, seq_parts)
+                    seq_parts = []
+                    name = line[1:].split()[0]
+                    current_code = chromosome_code(name)
+                    if current_code in genome.length:
+                        current_code = 0  # duplicate header: keep the first
+                elif current_code:
+                    seq_parts.append(
+                        np.frombuffer(line.strip().encode("ascii"), np.uint8)
+                    )
+            flush(current_code, seq_parts)
+        genome.packed = (
+            np.concatenate(packed_parts) if packed_parts else np.zeros(0, np.uint8)
+        )
+        genome.n_mask = (
+            np.concatenate(mask_parts) if mask_parts else np.zeros(0, np.uint8)
+        )
+        return genome
+
+    # ------------------------------------------------------- persistence
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        meta = {
+            "byte_offset": self.byte_offset,
+            "mask_offset": self.mask_offset,
+            "length": self.length,
+            "lossy": self.lossy,
+            "digests": self._digests,
+        }
+        np.savez_compressed(
+            path, packed=self.packed, n_mask=self.n_mask,
+            meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ReferenceGenome":
+        if not path.endswith(".npz"):
+            path += ".npz"
+        with np.load(path) as z:
+            genome = cls()
+            genome.packed = z["packed"]
+            genome.n_mask = z["n_mask"]
+            meta = json.loads(bytes(z["meta"]).decode())
+        genome.byte_offset = {int(k): v for k, v in meta["byte_offset"].items()}
+        genome.mask_offset = {int(k): v for k, v in meta["mask_offset"].items()}
+        genome.length = {int(k): v for k, v in meta["length"].items()}
+        # absent (older index): assume lossy so digests stay non-canonical
+        genome.lossy = {
+            code: bool(meta.get("lossy", {}).get(str(code), True))
+            for code in genome.length
+        }
+        genome._digests = {int(k): v for k, v in meta.get("digests", {}).items()}
+        return genome
+
+    # ------------------------------------------------------------- fetch
+
+    def fetch(self, chrom, start0: int, end0: int) -> str:
+        """Bases [start0, end0) of a chromosome (0-based, N restored) —
+        the SeqRepo-proxy interface VRS validation uses."""
+        code = chrom if isinstance(chrom, int) else chromosome_code(chrom)
+        if code not in self.length:
+            raise KeyError(f"chromosome {chrom!r} not in genome")
+        start0 = max(0, start0)
+        end0 = min(end0, self.length[code])
+        if end0 <= start0:
+            return ""
+        idx = np.arange(start0, end0, dtype=np.int64)
+        byte = self.packed[self.byte_offset[code] + (idx >> 2)]
+        codes = (byte >> ((idx & 3) * 2).astype(np.uint8)) & 3
+        out = _BASES[codes]
+        mbyte = self.n_mask[self.mask_offset[code] + (idx >> 3)]
+        masked = (mbyte >> (idx & 7).astype(np.uint8)) & 1
+        out = np.where(masked.astype(bool), np.uint8(ord("N")), out)
+        return bytes(out).decode("ascii")
+
+    def reference_bases(self, chrom, start0: int, end0: int) -> str:
+        """Callable signature expected by
+        :class:`~annotatedvdb_tpu.ops.vrs.VrsDigestGenerator`."""
+        return self.fetch(chrom, start0, end0)
+
+    def sequence_digest(self, chrom) -> str:
+        """GA4GH-scheme sequence digest (sha512t24u of the uppercase
+        sequence), cached; streamed in bounded chunks so a GRCh38
+        chromosome never materializes GB-scale index temporaries.
+
+        Only canonical for chromosomes whose bases round-trip exactly
+        (``not lossy[code]``) — :meth:`lazy_digests` enforces that."""
+        import base64
+        import hashlib
+
+        code = chrom if isinstance(chrom, int) else chromosome_code(chrom)
+        if code not in self._digests:
+            h = hashlib.sha512()
+            step = 1 << 24  # 16M bases per hash update
+            for start in range(0, self.length[code], step):
+                chunk = self.fetch(code, start, start + step)
+                h.update(chunk.encode("ascii"))
+            self._digests[code] = base64.urlsafe_b64encode(
+                h.digest()[:24]
+            ).decode("ascii")
+        return self._digests[code]
+
+    def sequence_digests(self) -> dict:
+        """{'1': digest, ...} for VrsDigestGenerator(sequence_digests=...).
+        Eager — digests every chromosome; prefer :meth:`lazy_digests`."""
+        return {
+            chromosome_label(code): self.sequence_digest(code)
+            for code in sorted(self.length)
+        }
+
+    def lazy_digests(self) -> "_LazyDigests":
+        """Mapping for ``VrsDigestGenerator(sequence_digests=...)`` that
+        computes each chromosome digest on first use (a GRCh38 chromosome is
+        a ~250MB hash — only the digest-PK tail ever needs it)."""
+        return _LazyDigests(self)
+
+    # ------------------------------------------------------- device path
+
+    def device_arrays(self):
+        """(packed, n_mask, byte_offsets[26], mask_offsets[26], lengths[26])
+        as jnp arrays for :func:`validate_ref_batch`, uploaded once and
+        cached.  Codes absent from the genome get length 0 (their rows
+        always fail validation)."""
+        cached = getattr(self, "_device_cache", None)
+        if cached is not None:
+            return cached
+        import jax.numpy as jnp
+
+        byte_off = np.zeros(26, np.int32)
+        mask_off = np.zeros(26, np.int32)
+        lengths = np.zeros(26, np.int32)
+        for code, off in self.byte_offset.items():
+            byte_off[code] = off
+            mask_off[code] = self.mask_offset[code]
+            lengths[code] = self.length[code]
+        self._device_cache = (
+            jnp.asarray(self.packed), jnp.asarray(self.n_mask),
+            jnp.asarray(byte_off), jnp.asarray(mask_off), jnp.asarray(lengths),
+        )
+        return self._device_cache
+
+
+def validate_ref_kernel(packed, n_mask, byte_off, mask_off, lengths,
+                        chrom, pos, ref, ref_len):
+    """Vectorized ref-allele validation: [N] bool.
+
+    A row passes when every stated ref base (uppercased) equals the genome
+    base — or is 'N' where the genome is ambiguous — and the allele span
+    lies inside the chromosome.  Rows wider than the device width W are the
+    host-fallback tail; they validate on the scalar path.
+
+    All indices are int32: per-chromosome BYTE offsets keep the largest
+    index under 2^31 even for the ~3.1G-base GRCh38 (SURVEY §7.1)."""
+    import jax.numpy as jnp
+
+    n, w = ref.shape
+    chrom = chrom.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+    rlen = ref_len.astype(jnp.int32)
+    col = jnp.arange(w, dtype=jnp.int32)[None, :]
+    local = (pos - 1)[:, None] + col                     # [N, W] 0-based
+    in_allele = col < rlen[:, None]
+    in_chrom = (pos - 1 >= 0)[:, None] & (local < lengths[chrom][:, None])
+    safe = jnp.where(in_allele & in_chrom, local, 0)
+
+    byte = packed[byte_off[chrom][:, None] + (safe >> 2)]
+    codes = (byte >> ((safe & 3) * 2).astype(jnp.uint8)) & 3
+    genome_base = jnp.asarray(_BASES)[codes]
+    mbyte = n_mask[mask_off[chrom][:, None] + (safe >> 3)]
+    ambig = ((mbyte >> (safe & 7).astype(jnp.uint8)) & 1).astype(bool)
+
+    ref_upper = jnp.where((ref >= 97) & (ref <= 122), ref - 32, ref)
+    base_ok = jnp.where(
+        ambig, ref_upper == ord("N"), ref_upper == genome_base
+    )
+    ok = jnp.where(in_allele, base_ok & in_chrom, True)
+    valid_chrom = lengths[chrom] > 0
+    return jnp.all(ok, axis=1) & valid_chrom & (rlen <= w)
+
+
+class _LazyDigests:
+    """dict-like sequence-digest source computed on first access.
+
+    Chromosomes with non-ACGTN bases are reported absent: their 2-bit
+    round-trip digest would differ from the true GA4GH digest, and the
+    consumer (``VrsDigestGenerator.sequence_id``) then falls back to its
+    clearly-non-canonical 'SQF.' ids instead of minting wrong 'SQ.' ones."""
+
+    def __init__(self, genome: ReferenceGenome):
+        self._genome = genome
+
+    def __contains__(self, chrom) -> bool:
+        code = chromosome_code(str(chrom))
+        return code in self._genome.length and not self._genome.lossy.get(code, True)
+
+    def __getitem__(self, chrom) -> str:
+        if chrom not in self:
+            raise KeyError(chrom)
+        return self._genome.sequence_digest(chromosome_code(str(chrom)))
+
+
+_validate_jit = None
+
+
+def validate_ref_batch(genome: ReferenceGenome, batch,
+                       refs: list | None = None) -> np.ndarray:
+    """Host wrapper: validate a VariantBatch's ref alleles; [N] bool.
+
+    Rows whose ref exceeds the device width re-validate on the host from
+    ``refs`` (their device arrays are truncated)."""
+    global _validate_jit
+    import jax
+
+    if _validate_jit is None:
+        _validate_jit = jax.jit(validate_ref_kernel)
+    arrays = genome.device_arrays()
+    ok = np.asarray(
+        _validate_jit(*arrays, batch.chrom, batch.pos, batch.ref, batch.ref_len)
+    ).copy()
+    if refs is not None:
+        over = np.asarray(batch.ref_len) > batch.width
+        for i in np.where(over)[0]:
+            code = int(batch.chrom[i])
+            if code not in genome.length:
+                continue
+            start0 = int(batch.pos[i]) - 1
+            ref = refs[i].upper()
+            ok[i] = genome.fetch(code, start0, start0 + len(ref)) == ref
+    return ok

@@ -77,9 +77,22 @@ def primary_keys(
                 raise ValueError(
                     "batch contains >50bp variants; a VrsDigestGenerator is required"
                 )
-            parts.append(
-                digester.compute_identifier(chrom, int(batch.pos[i]), refs[i], alts[i])
-            )
+            pos = int(batch.pos[i])
+            try:
+                digest = digester.compute_identifier(chrom, pos, refs[i], alts[i])
+            except ValueError:
+                # allele-swap fallback for failed validation, then an
+                # unvalidated digest as last resort — a bad row must not
+                # abort the load (``vcf_variant_loader.py:234-256``)
+                try:
+                    digest = digester.compute_identifier(
+                        chrom, pos, alts[i], refs[i]
+                    )
+                except ValueError:
+                    digest = digester.compute_identifier(
+                        chrom, pos, refs[i], alts[i], validate=False
+                    )
+            parts.append(digest)
         else:
             parts.extend([refs[i], alts[i]])
         if ref_snp[i]:

@@ -48,14 +48,27 @@ class TpuVcfLoader:
         skip_existing: bool = True,
         digester: VrsDigestGenerator | None = None,
         chromosome_map: dict | None = None,
+        genome=None,
         log=print,
     ):
+        """``genome``: optional
+        :class:`~annotatedvdb_tpu.genome.ReferenceGenome`; enables batched
+        device-side ref-allele validation (mismatches are counted and
+        logged, mirroring the reference's validation-on-PK-generation,
+        ``vcf_variant_loader.py:234-256``) and canonical GA4GH digests."""
         self.store = store
         self.ledger = ledger
         self.datasource = datasource.lower() if datasource else None
         self.batch_size = batch_size
         self.skip_existing = skip_existing
+        if digester is None and genome is not None:
+            digester = VrsDigestGenerator(
+                genome_build,
+                sequence_digests=genome.lazy_digests(),
+                reference_bases=genome.reference_bases,
+            )
         self.digester = digester or VrsDigestGenerator(genome_build)
+        self.genome = genome
         self.chromosome_map = chromosome_map
         self.log = log
         self.counters = {
@@ -187,6 +200,23 @@ class TpuVcfLoader:
         alts = [chunk.alts[i] for i in sel]
         ref_snp = [chunk.ref_snp[i] for i in sel]
         rs_pos = [chunk.rs_position[i] for i in sel]
+
+        if self.genome is not None:
+            # validate only the rows actually being inserted (post dedup /
+            # replay / existing filters) so counts match 'variant' semantics
+            from annotatedvdb_tpu.genome.refgenome import validate_ref_batch
+
+            ok = validate_ref_batch(self.genome, sub, refs)
+            n_bad = int((~ok).sum())
+            if n_bad:
+                self.counters["ref_mismatch"] = (
+                    self.counters.get("ref_mismatch", 0) + n_bad
+                )
+                bad = np.where(~ok)[0][:5]
+                self.log(
+                    f"{n_bad} ref-allele mismatches vs genome, e.g. "
+                    + ", ".join(chunk.variant_id[int(sel[j])] for j in bad)
+                )
         pks = egress.primary_keys(sub, sub_ann, ref_snp, self.digester, refs, alts)
         display = egress.display_attributes(sub, sub_ann, rs_pos, refs, alts)
         # device bin outputs are undefined for host-fallback rows: recompute

@@ -90,7 +90,10 @@ class VrsDigestGenerator:
         if self.reference_bases is None:
             return True
         start0 = pos - 1
-        return self.reference_bases(chrom, start0, start0 + len(ref)) == ref
+        genome = self.reference_bases(chrom, start0, start0 + len(ref))
+        # case-insensitive, matching the device kernel
+        # (genome/refgenome.py validate_ref_kernel)
+        return genome.upper() == ref.upper()
 
     def allele(self, chrom: str, pos: int, ref: str, alt: str) -> dict:
         """VRS 1.x Allele object with inlined location digest (the
@@ -115,13 +118,15 @@ class VrsDigestGenerator:
             "type": "Allele",
         }
 
-    def compute_identifier(self, chrom: str, pos: int, ref: str, alt: str) -> str:
+    def compute_identifier(self, chrom: str, pos: int, ref: str, alt: str,
+                           validate: bool = True) -> str:
         """The digest embedded in long-allele PKs — the reference strips the
         'ga4gh:VA.' prefix and keeps the digest
-        (``primary_key_generator.py:163-164``)."""
-        if not self.validate_reference(chrom, pos, ref):
+        (``primary_key_generator.py:163-164``).  ``validate=False`` skips the
+        genome check (the reference's requireValidation=False mode)."""
+        if validate and not self.validate_reference(chrom, pos, ref):
             # allele-swap fallback handled by the caller
-            # (vcf_variant_loader.py:244-256); here we just refuse
+            # (io/egress.py primary_keys); here we just refuse
             raise ValueError(f"reference mismatch at {chrom}:{pos}")
         a = self.allele(chrom, pos, ref, alt)
         serial = {

@@ -1,0 +1,151 @@
+"""Reference-genome subsystem: 2-bit packing, fetch, device validation,
+GA4GH digests, loader integration (SeqRepo-equivalent, SURVEY §2.4)."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.genome import ReferenceGenome
+from annotatedvdb_tpu.genome.refgenome import validate_ref_batch
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator, sha512t24u
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.types import VariantBatch
+
+CHR1 = "ACGTACGTACGTNNNACGTACGTGGGCCCTTTAAA" * 3   # 105 bases, Ns at 12-14
+CHR2 = "TTTTGGGGCCCCAAAA" * 2                      # 32 bases
+FASTA = f">chr1 test\n{CHR1[:50]}\n{CHR1[50:]}\n>2\n{CHR2}\n>chrUn_gl000220\nACGT\n"
+
+
+@pytest.fixture(scope="module")
+def genome(tmp_path_factory):
+    p = tmp_path_factory.mktemp("g") / "ref.fa.gz"
+    with gzip.open(p, "wt") as f:
+        f.write(FASTA)
+    return ReferenceGenome.from_fasta(str(p))
+
+
+def test_build_and_fetch(genome):
+    assert genome.length == {1: len(CHR1), 2: len(CHR2)}  # chrUn skipped
+    assert genome.fetch("1", 0, 12) == CHR1[:12]
+    assert genome.fetch("chr1", 10, 20) == CHR1[10:20]    # crosses the Ns
+    assert "NNN" in genome.fetch(1, 0, len(CHR1))
+    assert genome.fetch("2", 0, len(CHR2)) == CHR2
+    # clamped at bounds
+    assert genome.fetch("2", len(CHR2) - 4, len(CHR2) + 10) == CHR2[-4:]
+    with pytest.raises(KeyError):
+        genome.fetch("X", 0, 5)
+
+
+def test_save_load_roundtrip(genome, tmp_path):
+    genome.save(str(tmp_path / "g.npz"))
+    back = ReferenceGenome.load(str(tmp_path / "g"))
+    assert back.length == genome.length
+    assert back.fetch("1", 0, len(CHR1)) == genome.fetch("1", 0, len(CHR1))
+
+
+def test_sequence_digest_is_seqrepo_scheme(genome):
+    want = sha512t24u(CHR1.encode("ascii"))
+    assert genome.sequence_digest("1") == want
+    lazy = genome.lazy_digests()
+    assert "1" in lazy and "X" not in lazy
+    assert lazy["1"] == want
+
+
+def test_device_validation_matches_fetch(genome):
+    variants = [
+        ("1", 1, CHR1[0], "G"),               # valid SNV at pos 1
+        ("1", 5, CHR1[4:9], "A"),             # valid 5bp ref
+        ("1", 5, "TTTTT", "A"),               # wrong ref
+        ("1", 13, "N", "A"),                  # genome N, stated N -> ok
+        ("1", 13, "A", "G"),                  # genome N, stated A -> fail
+        ("2", 30, CHR2[29:32], "T"),          # runs to the chromosome end
+        ("2", 31, CHR2[30:] + "AA", "T"),     # overruns the chromosome
+        ("X", 5, "A", "G"),                   # chromosome absent
+        ("1", 3, CHR1[2:7].lower(), "a"),     # case-insensitive ref
+    ]
+    batch = VariantBatch.from_tuples(variants, width=16)
+    ok = validate_ref_batch(genome, batch)
+    assert list(ok) == [True, True, False, True, False, True, False, False, True]
+
+
+def test_over_width_rows_validate_on_host(genome):
+    long_ref = CHR1[20:60]                    # 40bp > width 16
+    variants = [("1", 21, long_ref, "A"), ("1", 21, "G" * 40, "A")]
+    batch = VariantBatch.from_tuples(variants, width=16)
+    ok = validate_ref_batch(genome, batch, refs=[v[2] for v in variants])
+    assert list(ok) == [True, False]
+
+
+def test_vrs_digests_canonical_with_genome(genome):
+    gen = VrsDigestGenerator(
+        sequence_digests=genome.lazy_digests(),
+        reference_bases=genome.reference_bases,
+    )
+    assert gen.sequence_id("1") == "SQ." + genome.sequence_digest(1)
+    pk = gen.compute_identifier("1", 5, CHR1[4:9], "A")
+    assert len(pk) == 32  # base64url of 24 bytes
+    with pytest.raises(ValueError, match="reference mismatch"):
+        gen.compute_identifier("1", 5, "TTTTT", "A")
+
+
+def test_loader_counts_ref_mismatches(genome, tmp_path):
+    vcf = tmp_path / "t.vcf"
+    vcf.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        f"1\t1\t.\t{CHR1[0]}\tG\t.\t.\t.\n"
+        f"1\t5\t.\tTTTTT\tA\t.\t.\t.\n"
+    )
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    msgs = []
+    loader = TpuVcfLoader(store, ledger, genome=genome, log=msgs.append)
+    counters = loader.load_file(str(vcf), commit=True)
+    assert counters["ref_mismatch"] == 1
+    assert counters["variant"] == 2   # mismatches are counted, not dropped
+    assert any("ref-allele mismatches" in m for m in msgs)
+
+
+def test_digest_pk_allele_swap_and_unvalidated_fallback(genome, tmp_path):
+    """A >50bp variant with a mismatched ref must not abort the load: the
+    PK falls back to the swapped orientation, then to an unvalidated
+    digest (``vcf_variant_loader.py:234-256`` behavior)."""
+    good_long = CHR1[:30]
+    vcf = tmp_path / "t.vcf"
+    vcf.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        f"1\t1\t.\t{good_long}\t{'G' * 30}\t.\t.\t.\n"     # valid long ref
+        f"1\t1\t.\t{'G' * 30}\t{good_long}\t.\t.\t.\n"     # swap validates
+        f"1\t2\t.\t{'G' * 30}\t{'C' * 30}\t.\t.\t.\n"      # nothing validates
+    )
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "ledger.jsonl"))
+    loader = TpuVcfLoader(store, ledger, genome=genome, log=lambda *a: None)
+    counters = loader.load_file(str(vcf), commit=True)
+    assert counters["variant"] == 3            # none aborted
+    assert counters["ref_mismatch"] == 2       # rows 2 and 3
+    shard = store.shards[1]
+    assert sum(pk is not None for pk in shard.digest_pk) == 3
+
+
+def test_lossy_chromosome_digests_not_canonical(tmp_path):
+    p = tmp_path / "iupac.fa"
+    p.write_text(">1\nACGTRYACGTNNAC\n>2\nACGTACGT\n")   # chr1 has R/Y codes
+    g = ReferenceGenome.from_fasta(str(p))
+    assert g.lossy[1] is True and g.lossy[2] is False
+    lazy = g.lazy_digests()
+    assert "1" not in lazy and "2" in lazy
+    gen = VrsDigestGenerator(sequence_digests=lazy)
+    assert gen.sequence_id("1").startswith("SQF.")   # non-canonical fallback
+    assert gen.sequence_id("2").startswith("SQ.")
+    # lossy flag survives persistence
+    g.save(str(tmp_path / "g.npz"))
+    assert ReferenceGenome.load(str(tmp_path / "g.npz")).lossy == g.lossy
+
+
+def test_streamed_digest_matches_one_shot(genome):
+    # module-scope genome caches digests; use a fresh instance
+    import gzip as _gzip
+    from annotatedvdb_tpu.ops.vrs import sha512t24u as _d
+    assert genome.sequence_digest(2) == _d(CHR2.encode())
