@@ -1,0 +1,78 @@
+"""Pipeline observability: per-stage timers + jax.profiler trace capture.
+
+The reference's only performance instrumentation is ad-hoc ``datetime.now()``
+pairs around buffer-build vs COPY in debug mode
+(``Load/bin/load_vcf_file.py:108-111,136-140,165-168``).  Here every loader
+carries a :class:`StageTimer` that attributes wall-clock to named pipeline
+stages (ingest / annotate / lookup / egress / append / flush) and can emit
+rate summaries at a log cadence; ``device_trace`` wraps ``jax.profiler`` so
+a ``--profile <dir>`` flag captures an XLA trace viewable in TensorBoard /
+Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class StageTimer:
+    """Accumulates wall-clock + item counts per named stage.
+
+    Usage::
+
+        with timer.stage("annotate", items=batch.n):
+            ...
+
+    ``summary()`` reports seconds, share of measured time, and items/sec.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self.seconds: dict[str, float] = {}
+        self.items: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str, items: int = 0):
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            self.items[name] = self.items.get(name, 0) + items
+
+    def total(self) -> float:
+        return sum(self.seconds.values())
+
+    def summary(self) -> str:
+        total = self.total() or 1e-12
+        parts = []
+        for name in sorted(self.seconds, key=self.seconds.get, reverse=True):
+            s = self.seconds[name]
+            line = f"{name}: {s:.2f}s ({100 * s / total:.0f}%)"
+            if self.items.get(name):
+                line += f" {self.items[name] / s:,.0f}/s"
+            parts.append(line)
+        return " | ".join(parts)
+
+    def as_dict(self) -> dict:
+        return {
+            name: {
+                "seconds": round(self.seconds[name], 4),
+                "items": self.items.get(name, 0),
+            }
+            for name in self.seconds
+        }
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str | None):
+    """jax.profiler capture when ``trace_dir`` is set; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
