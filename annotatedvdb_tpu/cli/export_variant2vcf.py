@@ -69,6 +69,11 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # host-only CLI: pin CPU outright (no accelerator probe needed)
+    pin_platform("cpu")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--storeDir", required=True)
     ap.add_argument("--outputDir", required=True)

@@ -52,6 +52,11 @@ def emit_rows(chr_map: dict, out) -> int:
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # host-only CLI: pin CPU outright (no accelerator probe needed)
+    pin_platform("cpu")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-m", "--chromosomeMap", required=True,
                     help="tab-delim chrom<TAB>length, no header")

@@ -20,6 +20,11 @@ from annotatedvdb_tpu.types import chromosome_label
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # environment-robust platform pin (probe accelerator, CPU fallback)
+    pin_platform("auto")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fasta", required=True)
     ap.add_argument("--output", required=True, help="output .npz path")

@@ -25,6 +25,11 @@ from annotatedvdb_tpu.sql.schema import full_schema
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # host-only CLI: pin CPU outright (no accelerator probe needed)
+    pin_platform("cpu")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--outputDir", required=True,
                     help="directory for schema/ (and data/ + load.sql)")

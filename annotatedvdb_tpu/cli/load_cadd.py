@@ -57,6 +57,11 @@ def vcf_subsets(updater: TpuCaddUpdater, path: str) -> dict[int, np.ndarray]:
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # environment-robust platform pin (probe accelerator, CPU fallback)
+    pin_platform("auto")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--databaseDir", required=True,
                     help="directory holding the CADD score tables")

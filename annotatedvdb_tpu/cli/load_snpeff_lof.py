@@ -20,6 +20,11 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # environment-robust platform pin (probe accelerator, CPU fallback)
+    pin_platform("auto")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fileName", required=True)
     ap.add_argument("--storeDir", required=True)

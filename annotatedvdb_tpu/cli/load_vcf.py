@@ -23,6 +23,11 @@ from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
 
 
 def main(argv=None):
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # environment-robust platform pin (probe accelerator, CPU fallback)
+    pin_platform("auto")
+
     parser = argparse.ArgumentParser(
         description="load a VCF into the TPU-native annotated variant store"
     )

@@ -17,6 +17,11 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 
 
 def main(argv=None):
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # environment-robust platform pin (probe accelerator, CPU fallback)
+    pin_platform("auto")
+
     parser = argparse.ArgumentParser(description="load VEP JSON results")
     parser.add_argument("--fileName", required=True)
     parser.add_argument("--storeDir", required=True)

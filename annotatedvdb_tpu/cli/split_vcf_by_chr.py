@@ -59,6 +59,11 @@ def split_file(path: str, out_dir: str, chrm_map: dict | None = None,
 
 
 def main(argv=None) -> int:
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # host-only CLI: pin CPU outright (no accelerator probe needed)
+    pin_platform("cpu")
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-f", "--fileName", required=True)
     ap.add_argument("-o", "--outputDir", required=True)

@@ -15,6 +15,11 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 
 
 def main(argv=None):
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    # host-only CLI: pin CPU outright (no accelerator probe needed)
+    pin_platform("cpu")
+
     parser = argparse.ArgumentParser(description="undo a variant load")
     parser.add_argument("--storeDir", required=True)
     parser.add_argument("--algId", type=int, required=True)

@@ -69,8 +69,17 @@ def best_annotate_pipeline():
 
     Prefers the Pallas kernel on TPU (verifying compile + parity against the
     jnp kernel on a probe batch); anything else — CPU test meshes, interpret
-    environments, future backends — gets the portable jnp pipeline."""
-    if jax.default_backend() != "tpu":
+    environments, future backends — gets the portable jnp pipeline.
+
+    The backend query itself is guarded: on a wedged TPU tunnel
+    ``jax.default_backend()`` raises (callers should have run
+    ``utils.runtime.pin_platform`` first, which prevents the *hang* case —
+    this try only covers a fast init error slipping through)."""
+    try:
+        # the image's TPU tunnel registers its platform as "axon"
+        if jax.default_backend() not in ("tpu", "axon"):
+            return annotate_pipeline_jit, "jnp"
+    except Exception:
         return annotate_pipeline_jit, "jnp"
     try:
         from annotatedvdb_tpu.io.synth import synthetic_batch

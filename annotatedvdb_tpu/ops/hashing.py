@@ -13,9 +13,13 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-FNV_OFFSET = jnp.uint32(2166136261)
-FNV_PRIME = jnp.uint32(16777619)
+# numpy scalars, NOT jnp: a module-level jnp constant initializes the JAX
+# backend at import time, before entry points can pin the platform (this
+# hung every CLI subprocess when the TPU tunnel was wedged)
+FNV_OFFSET = np.uint32(2166136261)
+FNV_PRIME = np.uint32(16777619)
 
 
 def _fnv_step(h, byte):

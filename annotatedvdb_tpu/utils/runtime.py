@@ -1,0 +1,130 @@
+"""Environment-robust JAX platform selection for every entry point.
+
+The deployment image ships a ``sitecustomize.py`` that pins
+``jax_platforms="axon,cpu"`` (the TPU tunnel first) at interpreter start,
+*overriding* the ``JAX_PLATFORMS`` env var.  When the tunnel is wedged, the
+first backend touch (``jax.devices()`` / ``jax.default_backend()``) either
+raises or hangs indefinitely — an in-process hang cannot be recovered, so the
+accelerator must be probed in a subprocess with a hard timeout.
+
+Every CLI ``main()``, ``bench.py``, and ``__graft_entry__`` calls
+:func:`pin_platform` before its first backend touch:
+
+- explicit choice (``AVDB_JAX_PLATFORM`` env or the ``prefer`` argument) is
+  pinned directly, no probe;
+- ``prefer="auto"`` probes the accelerator in a subprocess (timeout
+  ``AVDB_TPU_PROBE_TIMEOUT_S``, default 90 s).  Probe success leaves the
+  site's platform selection intact (the registered platform may be named
+  ``axon``, not ``tpu`` — re-pinning by name would break init); failure pins
+  ``cpu`` via ``jax.config.update`` (the env var alone is not honored, see
+  above).
+
+The decision is cached in ``AVDB_JAX_PLATFORM`` so child processes (the CLI
+subprocess tests, per-chromosome fan-out) skip the probe.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_ACCEL_NAMES = ("tpu", "axon")
+
+_PROBE_SRC = (
+    "import jax, sys\n"
+    "d = jax.devices()\n"
+    "sys.stdout.write(d[0].platform)\n"
+)
+
+
+def _probe_timeout() -> float:
+    try:
+        return float(os.environ.get("AVDB_TPU_PROBE_TIMEOUT_S", "90"))
+    except ValueError:
+        return 90.0
+
+
+def probe_accelerator(timeout: float | None = None) -> str | None:
+    """Platform name of the default device, probed in a subprocess.
+
+    Returns ``None`` if backend init fails, hangs past ``timeout``, or
+    resolves to plain ``cpu``.  The subprocess inherits the environment, so
+    it exercises exactly the init path this process would take."""
+    if timeout is None:
+        timeout = _probe_timeout()
+    try:
+        # environment inherited untouched: the probe must take exactly the
+        # init path this process would (a user's JAX_PLATFORMS=cpu included)
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if proc.returncode != 0:
+        return None
+    platform = proc.stdout.strip().lower()
+    return platform if platform and platform != "cpu" else None
+
+
+def _pin_cpu(n_virtual_devices: int | None = None) -> None:
+    if n_virtual_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={n_virtual_devices}"
+        # replace an existing count (any value) rather than appending a dup
+        parts = [
+            p
+            for p in os.environ.get("XLA_FLAGS", "").split()
+            if not p.startswith("--xla_force_host_platform_device_count")
+        ]
+        os.environ["XLA_FLAGS"] = " ".join(parts + [flag])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pin_platform(prefer: str = "auto", timeout: float | None = None) -> str:
+    """Pin the JAX platform robustly; returns the chosen platform name.
+
+    Must run before the first backend touch (jit dispatch, ``jax.devices()``,
+    ``jax.default_backend()``); after backend init the choice is frozen."""
+    explicit = os.environ.get("AVDB_JAX_PLATFORM", "").strip().lower()
+    choice = explicit or (prefer or "auto").strip().lower()
+    probed = False
+    if choice == "auto":
+        choice = probe_accelerator(timeout) or "cpu"
+        probed = True
+    os.environ["AVDB_JAX_PLATFORM"] = choice
+    if choice == "cpu":
+        _pin_cpu()
+    elif not probed and choice not in _ACCEL_NAMES:
+        # explicit non-default platform name (e.g. "cuda"): pin it by name
+        import jax
+
+        jax.config.update("jax_platforms", choice)
+        os.environ["JAX_PLATFORMS"] = choice
+    # probed accelerator (whatever its name): the probe already proved the
+    # ambient platform selection initializes — leave it untouched.  Note the
+    # probe is one extra full backend init per cold process tree; fan-out
+    # orchestrators should export AVDB_JAX_PLATFORM once to skip it.
+    return choice
+
+
+def force_cpu_mesh(n_devices: int) -> None:
+    """Pin a virtual ``n_devices``-device CPU platform (multi-chip dry runs,
+    SURVEY.md §4d).  Must run before backend init; raises if the backend is
+    already up with too few CPU devices to honor the request."""
+    _pin_cpu(n_virtual_devices=n_devices)
+    os.environ["AVDB_JAX_PLATFORM"] = "cpu"
+    import jax
+
+    n = len(jax.devices("cpu"))
+    if n < n_devices:
+        raise RuntimeError(
+            f"virtual CPU mesh needs {n_devices} devices but the backend "
+            f"initialized with {n}; force_cpu_mesh() must run before any "
+            "JAX backend touch in this process"
+        )

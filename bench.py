@@ -23,6 +23,14 @@ TARGET_VARIANTS_PER_SEC = 1_000_000.0  # BASELINE.md north star
 
 
 def main():
+    # Pin the platform BEFORE any backend touch: round 1's bench died with
+    # rc=1 because the TPU tunnel errored during jax.default_backend().
+    # pin_platform probes the accelerator in a subprocess (hard timeout) and
+    # falls back to CPU, so a number is always recorded.
+    from annotatedvdb_tpu.utils.runtime import pin_platform
+
+    platform = pin_platform("auto")
+
     import jax
 
     from annotatedvdb_tpu.io.synth import synthetic_batch
@@ -30,7 +38,7 @@ def main():
 
     # on TPU this selects the fused Pallas kernel (verified for compile +
     # parity on a probe batch first); elsewhere the portable jnp pipeline
-    pipeline_fn, _backend = best_annotate_pipeline()
+    pipeline_fn, kernel_kind = best_annotate_pipeline()
 
     batch = synthetic_batch(BATCH, width=WIDTH)
     args = [jax.device_put(x) for x in batch]
@@ -57,6 +65,9 @@ def main():
                 "value": round(variants_per_sec, 1),
                 "unit": "variants/sec",
                 "vs_baseline": round(variants_per_sec / TARGET_VARIANTS_PER_SEC, 3),
+                "backend": jax.default_backend(),
+                "platform_pin": platform,
+                "kernel": kernel_kind,
             }
         )
     )

@@ -7,6 +7,9 @@ first, so setting the env here is sufficient."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # hard override: the ambient env pins the TPU platform
+# CLI subprocess tests inherit this: utils.runtime.pin_platform short-circuits
+# on it (no accelerator probe, instant CPU pin) so no test can hang on the tunnel
+os.environ["AVDB_JAX_PLATFORM"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
