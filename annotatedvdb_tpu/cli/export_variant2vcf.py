@@ -36,6 +36,7 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
                       variants_per_file: int) -> dict:
     label = chromosome_label(code)
     shard = store.shards[code]
+    shard.compact()  # position-sorted export order + flat views
     counters = {"exported": 0, "invalid": 0, "files": 0}
     file_count, rows_in_file, fh = 0, 0, None
     invalid_path = os.path.join(out_dir, f"{label}_invalid.txt")

@@ -43,10 +43,15 @@ def parse_chromosomes(spec: str | None) -> list | None:
 
 
 def vcf_subsets(updater: TpuCaddUpdater, path: str) -> dict[int, np.ndarray]:
-    """Map VCF variants to shard row indices (the --fileName restriction)."""
+    """Map VCF variants to shard row indices (the --fileName restriction).
+
+    Compacts the store first: the join pass operates on compacted shards, and
+    compaction renumbers global row ids — ids gathered here must already be
+    post-compaction (``update_all`` rejects subsets on uncompacted shards)."""
     from annotatedvdb_tpu.io.vcf import VcfBatchReader
     from annotatedvdb_tpu.loaders.lookup import chunk_lookup
 
+    updater.store.compact()
     hits: dict[int, list] = {}
     for chunk in VcfBatchReader(path, width=updater.store.width):
         for code, shard, sel, found, idx in chunk_lookup(updater.store, chunk):

@@ -110,6 +110,21 @@ class TpuCaddUpdater:
              "chromosomes": [int(c) for c in codes]},
             commit,
         )
+        # whole-shard pass: compact once so rows are position-sorted and the
+        # flat views below are valid (no appends happen during a CADD join)
+        for code in codes:
+            shard = self.store.shards.get(code)
+            if shard is None:
+                continue
+            if subsets is not None and len(shard.segments) > 1:
+                # subset ids were gathered against a different segment layout;
+                # compacting here would renumber them under the caller
+                raise ValueError(
+                    f"chr{code}: subset row ids require a compacted shard — "
+                    "compact the store before collecting subsets "
+                    "(cli.load_cadd.vcf_subsets does this)"
+                )
+            shard.compact()
         # one not-yet-scored scan per chromosome, shared by both table passes
         candidates = {
             code: self._candidates(
@@ -156,8 +171,9 @@ class TpuCaddUpdater:
             return empty
         rows = np.arange(shard.n) if subset is None else np.sort(np.asarray(subset))
         if self.skip_existing:
+            scores_col = shard.annotations["cadd_scores"]
             has = np.fromiter(
-                (shard.annotations["cadd_scores"][int(i)] is not None for i in rows),
+                (scores_col[int(i)] is not None for i in rows),
                 bool, count=rows.size,
             )
             self.counters["skipped"] += int(has.sum())

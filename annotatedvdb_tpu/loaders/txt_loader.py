@@ -215,8 +215,10 @@ class TpuTextLoader:
         )
 
         novel = []
+        digest_cache: dict = {}  # per-batch materialized digest columns
         for j, entry in enumerate(parsed):
-            found_at = self._lookup_entry(j, entry, rs_index, meta_index)
+            found_at = self._lookup_entry(j, entry, rs_index, meta_index,
+                                          digest_cache)
             if found_at is None:
                 if self.variant_id_type == "METASEQ":
                     novel.append(entry)
@@ -242,9 +244,10 @@ class TpuTextLoader:
         if wanted.size == 0:
             return index
         for shard in self.store.shards.values():
-            hits = np.where(np.isin(shard.cols["ref_snp"], wanted))[0]
+            rs_col = shard.column("ref_snp")
+            hits = np.where(np.isin(rs_col, wanted))[0]
             for i in hits:
-                index.setdefault(int(shard.cols["ref_snp"][i]), (shard, int(i)))
+                index.setdefault(int(rs_col[i]), (shard, int(i)))
         return index
 
     def _build_meta_index(self, parsed: list) -> dict:
@@ -265,7 +268,7 @@ class TpuTextLoader:
         return index
 
     def _lookup_entry(self, j: int, entry, rs_index: dict | None,
-                      meta_index: dict | None):
+                      meta_index: dict | None, digest_cache: dict | None = None):
         """Locate one batch entry in the store; returns (shard, row) or None."""
         _, _, code, pos, ref, _, rs = entry
         if self.variant_id_type == "REFSNP":
@@ -282,8 +285,15 @@ class TpuTextLoader:
         if len(pk_parts) < 3:
             return None
         variant_digest = pk_parts[2]
-        for i, pk in enumerate(shard.digest_pk):
-            if pk is not None and shard.cols["pos"][i] == pos \
+        if digest_cache is None:
+            digest_cache = {}
+        if code not in digest_cache:  # materialize columns once per batch
+            digest_cache[code] = (
+                shard.column("pos"), shard.object_column("_digest_pk")
+            )
+        pos_col, pk_col = digest_cache[code]
+        for i, pk in enumerate(pk_col):
+            if pk is not None and pos_col[i] == pos \
                     and pk.split(":")[2] == variant_digest:
                 return shard, i
         return None
@@ -303,12 +313,12 @@ class TpuTextLoader:
             if f in JSONB_COLUMNS:
                 shard.update_annotation(one, f, [value])
             elif f == "ref_snp_id":
-                shard.cols["ref_snp"][i] = _rs_number(value)
+                shard.set_col("ref_snp", one, _rs_number(value))
             else:
-                shard.cols[f][i] = value
+                shard.set_col(f, one, value)
         if self.is_adsp:
-            shard.cols["is_adsp_variant"][i] = 1
-        shard.cols["row_algorithm_id"][i] = alg_id
+            shard.set_col("is_adsp_variant", one, 1)
+        shard.set_col("row_algorithm_id", one, alg_id)
 
     def _insert_novel(self, novel: list, alg_id: int, commit: bool) -> None:
         """Insert metaseq-identified rows through the standard VCF insert

@@ -24,6 +24,7 @@ from annotatedvdb_tpu.io.vcf import VcfBatchReader, VcfChunk
 from annotatedvdb_tpu.loaders.lookup import chunk_lookup
 from annotatedvdb_tpu.loaders.vcf_loader import TpuVcfLoader
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
 
 
 class UpdateStrategy:
@@ -143,11 +144,10 @@ class TpuUpdateLoader:
                     continue
                 row_idx = int(idx[j])
                 existing = {
-                    c: shard.annotations[c][row_idx]
-                    for c in shard.annotations
+                    c: shard.get_ann(c, row_idx) for c in JSONB_COLUMNS
                 }
                 for c in self.strategy.numeric_columns:
-                    existing[c] = int(shard.cols[c][row_idx])
+                    existing[c] = int(shard.get_col(c, [row_idx])[0])
                 do_update, flags, jsonb = self.strategy.values(
                     self._row_dict(chunk, int(i)), existing
                 )
@@ -161,8 +161,8 @@ class TpuUpdateLoader:
                 for col, value in jsonb.items():
                     shard.update_annotation(one, col, [value])
                 for col, value in flags.items():
-                    shard.cols[col][row_idx] = value
-                shard.cols["row_algorithm_id"][row_idx] = alg_id
+                    shard.set_col(col, one, value)
+                shard.set_col("row_algorithm_id", one, alg_id)
 
         if novel and self.strategy.insert_novel:
             self._insert_novel(chunk, novel, alg_id, commit)
@@ -195,7 +195,7 @@ class TpuUpdateLoader:
                 for col, value in jsonb.items():
                     shard.update_annotation(one, col, [value])
                 for col, value in flags.items():
-                    shard.cols[col][row_idx] = value
+                    shard.set_col(col, one, value)
 
 
 def _subset_chunk(chunk: VcfChunk, rows: list[int]) -> VcfChunk:

@@ -180,7 +180,7 @@ class TpuVepLoader:
                     continue
                 row_idx = int(idx[j])
                 r = rows[i]
-                if shard.annotations["vep_output"][row_idx] is not None:
+                if shard.get_ann("vep_output", row_idx) is not None:
                     if self.skip_existing:
                         self.counters["duplicates"] += 1
                         continue
@@ -214,7 +214,7 @@ class TpuVepLoader:
                     # per-row copy: multi-allelic rows must not alias one
                     # shared dict inside the store
                     shard.update_annotation(one, "vep_output", [deepcopy(r["cleaned"])])
-                    shard.cols["row_algorithm_id"][row_idx] = alg_id
+                    shard.set_col("row_algorithm_id", one, alg_id)
                     if self.is_adsp:
-                        shard.cols["is_adsp_variant"][row_idx] = 1
+                        shard.set_col("is_adsp_variant", one, 1)
                 self.counters["update"] += 1

@@ -1,4 +1,4 @@
-"""Chromosome-sharded columnar variant store.
+"""Chromosome-sharded columnar variant store with log-structured segments.
 
 TPU-native replacement for the reference's ``AnnotatedVDB.Variant`` Postgres
 table (UNLOGGED, LIST-partitioned by chromosome, JSONB annotation columns,
@@ -6,21 +6,32 @@ table (UNLOGGED, LIST-partitioned by chromosome, JSONB annotation columns,
 
 - one shard per chromosome (the partition invariant that lets loads of
   different chromosomes proceed without contention — the property the
-  reference engineers around Postgres locks,
-  ``cadd_updater.py:105-107``);
-- numeric identity/location columns are numpy arrays kept sorted by
-  (pos, allele-hash), so membership checks and annotation joins are
-  searchsorted merges instead of per-row SQL round-trips
-  (``database/variant.py:287-309``);
-- annotation columns are per-row Python dicts (the JSONB analog), updated
-  with deep-merge semantics mirroring the server-side ``jsonb_merge()``
-  the reference leans on (``vep_variant_loader.py:227``);
+  reference engineers around Postgres locks, ``cadd_updater.py:105-107``);
+- each shard is a list of **sorted segments** (LSM-style): a flush appends
+  one new segment in O(batch) and a size-tiered cascade merge keeps the
+  segment count logarithmic, so per-batch flush cost is flat — the columnar
+  analog of Postgres appending heap pages + the occasional VACUUM, instead
+  of rewriting the whole partition per COPY;
+- membership checks and annotation joins are searchsorted merges against
+  each segment (replacing per-row SQL round-trips,
+  ``database/variant.py:287-309``); large segment × large batch joins run
+  the device kernel (``ops/dedup.lookup_in_sorted``) against an HBM-resident
+  copy of the segment's identity columns;
+- annotation columns are object arrays of per-row dicts (the JSONB analog),
+  updated with deep-merge semantics mirroring the server-side
+  ``jsonb_merge()`` the reference leans on (``vep_variant_loader.py:227``);
 - every row carries ``row_algorithm_id`` for undo
-  (``undo_variant_load.py:21-67``).
+  (``undo_variant_load.py:21-67``);
+- persistence is incremental: ``save`` writes only new/dirty segments
+  (one npz + sparse-JSONL pair each), so a per-checkpoint persist costs
+  O(new rows), not O(store).
 
-Durability is an explicit ``save``/``load`` of npz + JSONL (the reference's
-"commit" maps to flushing batches into the shard + checkpointing the load
-cursor; see ``loaders/``).
+Row addressing: ``lookup`` returns **global row ids** — a row's offset in
+segment-list order.  Ids stay valid until the next ``append``/``compact``/
+``delete`` on the shard (merges renumber rows); callers must re-lookup after
+mutating.  Whole-shard passes (CADD join, Postgres egress, VCF export) call
+``compact()`` once up front, after which ids are position-sorted and the
+flat ``cols``/``ref``/``alt``/``annotations`` views are available.
 """
 
 from __future__ import annotations
@@ -49,6 +60,11 @@ JSONB_COLUMNS = [
     "other_annotation",
 ]
 
+# Non-JSONB per-row object columns (host-side tails).
+_DIGEST_PK = "_digest_pk"
+_LONG_ALLELES = "_long_alleles"
+OBJECT_COLUMNS = JSONB_COLUMNS + [_DIGEST_PK, _LONG_ALLELES]
+
 _NUMERIC_COLUMNS = [
     ("pos", np.int32),
     ("h", np.uint32),
@@ -63,85 +79,118 @@ _NUMERIC_COLUMNS = [
     ("row_algorithm_id", np.int32),
 ]
 
+# Identity columns: immutable after append; everything else may be updated
+# in place without invalidating lookups or device caches.
+_IDENTITY_COLUMNS = ("pos", "h", "ref_len", "alt_len")
+
+# Device-kernel lookup thresholds: below these, numpy wins on dispatch cost.
+DEVICE_SEGMENT_MIN = 1 << 15
+DEVICE_QUERY_MIN = 1 << 12
+
+# Latch: flips False on the first device-lookup failure so a missing/broken
+# backend costs one attempt per process, not one per membership check.
+_DEVICE_LOOKUP_OK = True
+
 
 def combined_key(pos: np.ndarray, h: np.ndarray) -> np.ndarray:
     """uint64 (pos << 32 | hash) — host-side sort/join key."""
     return (pos.astype(np.uint64) << np.uint64(32)) | h.astype(np.uint64)
 
 
-class ChromosomeShard:
-    """One chromosome's rows, sorted by (pos, hash)."""
+class Segment:
+    """One sorted run of rows: numeric columns + packed alleles + object cols.
 
-    def __init__(self, chrom_code: int, width: int):
-        self.chrom_code = int(chrom_code)
-        self.width = width
-        self.n = 0
-        self.cols: dict[str, np.ndarray] = {
-            name: np.empty((0,), dtype) for name, dtype in _NUMERIC_COLUMNS
-        }
-        self.ref = np.empty((0, width), np.uint8)
-        self.alt = np.empty((0, width), np.uint8)
-        self.annotations: dict[str, list] = {c: [] for c in JSONB_COLUMNS}
-        # digest-PK strings for the long-allele tail (host path); None else
-        self.digest_pk: list = []
-        # original (ref, alt) strings for rows whose alleles exceed the device
-        # width — the truncated byte arrays can't reconstruct them, and both
-        # annotation joins and VCF export need the full alleles; None else
-        self.long_alleles: list = []
+    Rows are sorted by (pos, hash); within equal keys, original append order
+    is preserved (first-wins duplicate semantics)."""
+
+    __slots__ = ("n", "cols", "ref", "alt", "obj", "seg_id", "dirty",
+                 "_key", "_device")
+
+    def __init__(self, cols, ref, alt, obj, seg_id=None):
+        self.n = int(ref.shape[0])
+        self.cols = cols
+        self.ref = ref
+        self.alt = alt
+        self.obj = obj
+        self.seg_id = seg_id       # persistence id; None = never saved
+        self.dirty = True
+        self._key = None
+        self._device = None
+
+    @property
+    def key(self) -> np.ndarray:
+        if self._key is None:
+            self._key = combined_key(self.cols["pos"], self.cols["h"])
+        return self._key
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, rows: dict, ref, alt, annotations=None, digest_pk=None,
+              long_alleles=None) -> "Segment":
+        """Create a sorted segment from one flush's rows (any input order)."""
+        k = rows["pos"].shape[0]
+        cols = {}
+        for name, dtype in _NUMERIC_COLUMNS:
+            if name in rows:
+                cols[name] = np.asarray(rows[name], dtype)
+            elif name in ("ref_snp", "is_adsp_variant"):
+                cols[name] = np.full((k,), -1, dtype)
+            else:
+                cols[name] = np.zeros((k,), dtype)
+        order = np.argsort(combined_key(cols["pos"], cols["h"]), kind="stable")
+        cols = {name: col[order] for name, col in cols.items()}
+
+        obj = {}
+        for c in JSONB_COLUMNS:
+            src = annotations.get(c) if annotations else None
+            obj[c] = _obj_array(src, order)
+        obj[_DIGEST_PK] = _obj_array(digest_pk, order)
+        obj[_LONG_ALLELES] = _obj_array(long_alleles, order)
+        return cls(cols, np.asarray(ref)[order], np.asarray(alt)[order], obj)
+
+    @classmethod
+    def merge(cls, older: "Segment", newer: "Segment") -> "Segment":
+        """Stable two-way merge (older rows first on equal keys)."""
+        ka, kb = older.key, newer.key
+        pos_a = np.searchsorted(kb, ka, side="left") + np.arange(older.n)
+        pos_b = np.searchsorted(ka, kb, side="right") + np.arange(newer.n)
+        n = older.n + newer.n
+
+        def merge_col(a, b):
+            out = np.empty((n,) + a.shape[1:], a.dtype)
+            out[pos_a] = a
+            out[pos_b] = b
+            return out
+
+        cols = {name: merge_col(older.cols[name], newer.cols[name])
+                for name, _ in _NUMERIC_COLUMNS}
+        obj = {}
+        for c in OBJECT_COLUMNS:
+            a, b = older.obj[c], newer.obj[c]
+            obj[c] = None if a is None and b is None else merge_col(
+                _dense(a, older.n), _dense(b, newer.n)
+            )
+        return cls(cols, merge_col(older.ref, newer.ref),
+                   merge_col(older.alt, newer.alt), obj)
 
     # -- membership ---------------------------------------------------------
 
-    def key(self) -> np.ndarray:
-        return combined_key(self.cols["pos"], self.cols["h"])
-
-    def primary_key(self, i: int) -> str:
-        """Row's record PK: retained digest PK for the long-allele tail, else
-        literal ``chr:pos:ref:alt[:rs]`` (``primary_key_generator.py:99-122``).
-        The single definition shared by every egress path."""
-        i = int(i)
-        if self.digest_pk[i] is not None:
-            return self.digest_pk[i]
-        ref, alt = self.alleles(i)
-        parts = [
-            chromosome_label(self.chrom_code),
-            str(int(self.cols["pos"][i])), ref, alt,
-        ]
-        rs = int(self.cols["ref_snp"][i])
-        if rs >= 0:
-            parts.append(f"rs{rs}")
-        return ":".join(parts)
-
-    def alleles(self, i: int) -> tuple[str, str]:
-        """True (ref, alt) strings for row i — exact even for the long-allele
-        tail whose device arrays are width-truncated."""
-        i = int(i)
-        if self.long_alleles[i] is not None:
-            return self.long_alleles[i]
-        ref_len = int(self.cols["ref_len"][i])
-        alt_len = int(self.cols["alt_len"][i])
-        if ref_len > self.width or alt_len > self.width:
-            # a store written before long-allele retention existed: returning
-            # the truncated prefix would silently corrupt joins/exports
-            raise ValueError(
-                f"row {i}: allele length {max(ref_len, alt_len)} exceeds device "
-                f"width {self.width} but the original strings were not retained "
-                "(store predates long-allele retention; reload from source)"
-            )
-        return (
-            decode_allele(self.ref[i], ref_len),
-            decode_allele(self.alt[i], alt_len),
-        )
-
-    def lookup(self, pos, h, ref, alt, ref_len, alt_len):
-        """Vectorized membership: (found [N] bool, index [N] int32)."""
+    def probe(self, qkey, pos, h, ref, alt, ref_len, alt_len):
+        """(found [N] bool, local index [N] int32; -1 when absent)."""
+        global _DEVICE_LOOKUP_OK
         if self.n == 0:
-            return (
-                np.zeros(pos.shape, np.bool_),
-                np.full(pos.shape, -1, np.int32),
-            )
-        qkey = combined_key(pos, h)
-        skey = self.key()
-        lo = np.searchsorted(skey, qkey, side="left")
+            return np.zeros(pos.shape, np.bool_), np.full(pos.shape, -1, np.int32)
+        if (_DEVICE_LOOKUP_OK
+                and self.n >= DEVICE_SEGMENT_MIN
+                and pos.shape[0] >= DEVICE_QUERY_MIN):
+            try:
+                return self._probe_device(pos, h, ref, alt, ref_len, alt_len)
+            except Exception:
+                # device unusable (no backend / OOM): numpy is always
+                # correct; latch so the hot path doesn't retry per lookup
+                _DEVICE_LOOKUP_OK = False
+        lo = np.searchsorted(self.key, qkey, side="left")
         found = np.zeros(pos.shape, np.bool_)
         index = np.full(pos.shape, -1, np.int32)
         # equal-(pos,hash) runs are length 1 barring 2^-32 collisions; probe 4
@@ -149,7 +198,7 @@ class ChromosomeShard:
             i = np.clip(lo + k, 0, self.n - 1)
             cand = (
                 (lo + k < self.n)
-                & (skey[i] == qkey)
+                & (self.key[i] == qkey)
                 & (self.cols["ref_len"][i] == ref_len)
                 & (self.cols["alt_len"][i] == alt_len)
                 & (self.ref[i] == ref).all(axis=1)
@@ -160,113 +209,334 @@ class ChromosomeShard:
             found |= cand
         return found, index
 
+    def _probe_device(self, pos, h, ref, alt, ref_len, alt_len):
+        """Large-batch membership on device (``ops/dedup.lookup_in_sorted``),
+        against an HBM-resident cache of this segment's identity columns.
+        Query arrays are padded to a power of two (sentinel positions can't
+        match) so compile count stays logarithmic in batch size."""
+        from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_jit
+        from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
+
+        if self._device is None:
+            import jax
+
+            # store side padded to pow2 as well (sentinel sorts last, can't
+            # match a real position) so compile count is O(log n * log q)
+            self._device = tuple(
+                jax.device_put(x) for x in (
+                    pad_pow2(self.cols["pos"], POS_SENTINEL),
+                    pad_pow2(self.cols["h"], 0),
+                    pad_pow2(self.ref, 0), pad_pow2(self.alt, 0),
+                    pad_pow2(self.cols["ref_len"], 0),
+                    pad_pow2(self.cols["alt_len"], 0),
+                )
+            )
+        nq = pos.shape[0]
+        found, index = lookup_in_sorted_jit(
+            *self._device,
+            pad_pow2(pos, POS_SENTINEL), pad_pow2(h, 0),
+            pad_pow2(ref, 0), pad_pow2(alt, 0),
+            pad_pow2(ref_len, 0), pad_pow2(alt_len, 0),
+        )
+        return np.asarray(found)[:nq], np.asarray(index)[:nq]
+
+    # -- mutation -----------------------------------------------------------
+
+    def filter(self, keep: np.ndarray) -> "Segment":
+        seg = Segment(
+            {name: col[keep] for name, col in self.cols.items()},
+            self.ref[keep], self.alt[keep],
+            {c: (None if a is None else a[keep]) for c, a in self.obj.items()},
+        )
+        return seg
+
+    def obj_dense(self, name: str) -> np.ndarray:
+        """Object column, materialized into the segment if still all-None."""
+        if self.obj[name] is None:
+            self.obj[name] = np.full((self.n,), None, object)
+        return self.obj[name]
+
+
+def _obj_array(values, order: np.ndarray) -> np.ndarray | None:
+    """Object column from per-row values; None when the column is all-None
+    (lazily-materialized columns keep annotation-free segments free)."""
+    if values is None or all(v is None for v in values):
+        return None
+    out = np.empty((len(order),), object)
+    for j, i in enumerate(order):
+        out[j] = values[i]
+    return out
+
+
+def _dense(arr: np.ndarray | None, n: int) -> np.ndarray:
+    return np.full((n,), None, object) if arr is None else arr
+
+
+class ChromosomeShard:
+    """One chromosome's rows: a list of sorted segments, oldest first."""
+
+    def __init__(self, chrom_code: int, width: int):
+        self.chrom_code = int(chrom_code)
+        self.width = width
+        self.segments: list[Segment] = []
+        self._starts_cache: np.ndarray | None = None
+
+    @property
+    def n(self) -> int:
+        return sum(s.n for s in self.segments)
+
+    def _starts(self) -> np.ndarray:
+        """Global-id base offset of each segment (segment-list order)."""
+        if self._starts_cache is None:
+            self._starts_cache = np.concatenate(
+                [[0], np.cumsum([s.n for s in self.segments])]
+            ).astype(np.int64)
+        return self._starts_cache
+
+    def _locate(self, ids) -> tuple[np.ndarray, np.ndarray]:
+        """Global ids -> (segment index, local offset), vectorized."""
+        ids = np.asarray(ids, np.int64)
+        starts = self._starts()
+        seg = np.searchsorted(starts, ids, side="right") - 1
+        return seg, ids - starts[seg]
+
+    # -- flat single-segment views (whole-shard passes) ---------------------
+    # CADD join, Postgres egress, and VCF export iterate the shard in
+    # position-sorted order; they call compact() once, after which global ids
+    # coincide with sorted order and these views are O(1).  Accessing a flat
+    # view COMPACTS the shard, which renumbers global ids — never hold ids
+    # from a previous lookup across a flat-view access (the per-id
+    # get_col/set_col/get_ann accessors are the safe interleaving API).
+
+    def _single(self) -> Segment:
+        if len(self.segments) != 1:
+            self.compact()
+        if not self.segments:  # empty shard: materialize one empty segment
+            self.segments.append(Segment.build(
+                {"pos": np.empty((0,), np.int32)},
+                np.empty((0, self.width), np.uint8),
+                np.empty((0, self.width), np.uint8),
+            ))
+            self._starts_cache = None
+        return self.segments[0]
+
+    @property
+    def cols(self) -> dict:
+        return self._single().cols
+
+    @property
+    def ref(self) -> np.ndarray:
+        return self._single().ref
+
+    @property
+    def alt(self) -> np.ndarray:
+        return self._single().alt
+
+    @property
+    def annotations(self) -> dict:
+        seg = self._single()
+        return {c: seg.obj_dense(c) for c in JSONB_COLUMNS}
+
+    @property
+    def digest_pk(self) -> np.ndarray:
+        return self._single().obj_dense(_DIGEST_PK)
+
+    @property
+    def long_alleles(self) -> np.ndarray:
+        return self._single().obj_dense(_LONG_ALLELES)
+
+    def compact(self) -> None:
+        """Merge all segments into one (position-sorted global ids)."""
+        while len(self.segments) > 1:
+            newer = self.segments.pop()
+            self.segments[-1] = Segment.merge(self.segments[-1], newer)
+        self._starts_cache = None
+
+    # -- whole-column views (any segment count, global-id order) ------------
+
+    def column(self, name: str) -> np.ndarray:
+        """Full numeric column concatenated in global-id order."""
+        if not self.segments:
+            return np.empty((0,), dict(_NUMERIC_COLUMNS)[name])
+        return np.concatenate([s.cols[name] for s in self.segments])
+
+    def object_column(self, name: str) -> np.ndarray:
+        """Full object column concatenated in global-id order (a copy —
+        mutate through :meth:`update_annotation`, not this view)."""
+        if not self.segments:
+            return np.empty((0,), object)
+        return np.concatenate([_dense(s.obj[name], s.n) for s in self.segments])
+
+    # -- per-row access by global id ----------------------------------------
+
+    def get_col(self, name: str, ids):
+        seg, off = self._locate(ids)
+        out = np.empty(seg.shape, dtype=dict(_NUMERIC_COLUMNS)[name])
+        for si in np.unique(seg):
+            m = seg == si
+            out[m] = self.segments[si].cols[name][off[m]]
+        return out
+
+    def set_col(self, name: str, ids, values) -> None:
+        if name in _IDENTITY_COLUMNS:
+            raise ValueError(f"identity column {name} is immutable")
+        seg, off = self._locate(ids)
+        values = np.broadcast_to(np.asarray(values), seg.shape)
+        for si in np.unique(seg):
+            m = seg == si
+            s = self.segments[si]
+            s.cols[name][off[m]] = values[m]
+            s.dirty = True
+
+    def get_ann(self, column: str, i):
+        seg, off = self._locate([i])
+        col = self.segments[int(seg[0])].obj[column]
+        return None if col is None else col[int(off[0])]
+
+    def primary_key(self, i: int) -> str:
+        """Row's record PK: retained digest PK for the long-allele tail, else
+        literal ``chr:pos:ref:alt[:rs]`` (``primary_key_generator.py:99-122``).
+        The single definition shared by every egress path."""
+        seg, off = self._locate([i])
+        s, j = self.segments[int(seg[0])], int(off[0])
+        if s.obj[_DIGEST_PK] is not None and s.obj[_DIGEST_PK][j] is not None:
+            return s.obj[_DIGEST_PK][j]
+        ref, alt = self.alleles(int(i))
+        parts = [
+            chromosome_label(self.chrom_code),
+            str(int(s.cols["pos"][j])), ref, alt,
+        ]
+        rs = int(s.cols["ref_snp"][j])
+        if rs >= 0:
+            parts.append(f"rs{rs}")
+        return ":".join(parts)
+
+    def alleles(self, i: int) -> tuple[str, str]:
+        """True (ref, alt) strings for row i — exact even for the long-allele
+        tail whose device arrays are width-truncated."""
+        seg, off = self._locate([i])
+        s, j = self.segments[int(seg[0])], int(off[0])
+        if s.obj[_LONG_ALLELES] is not None and s.obj[_LONG_ALLELES][j] is not None:
+            return tuple(s.obj[_LONG_ALLELES][j])
+        ref_len = int(s.cols["ref_len"][j])
+        alt_len = int(s.cols["alt_len"][j])
+        if ref_len > self.width or alt_len > self.width:
+            # a store written before long-allele retention existed: returning
+            # the truncated prefix would silently corrupt joins/exports
+            raise ValueError(
+                f"row {i}: allele length {max(ref_len, alt_len)} exceeds device "
+                f"width {self.width} but the original strings were not retained "
+                "(store predates long-allele retention; reload from source)"
+            )
+        return (
+            decode_allele(s.ref[j], ref_len),
+            decode_allele(s.alt[j], alt_len),
+        )
+
+    # -- membership ---------------------------------------------------------
+
+    def lookup(self, pos, h, ref, alt, ref_len, alt_len):
+        """Vectorized membership: (found [N] bool, global id [N] int64).
+
+        Oldest segment wins when an identity appears in several segments
+        (first-wins duplicate policy).  Returned ids are invalidated by the
+        next ``append``/``compact``/``delete``."""
+        found = np.zeros(pos.shape, np.bool_)
+        index = np.full(pos.shape, -1, np.int64)
+        if not self.segments:
+            return found, index
+        qkey = combined_key(pos, h)
+        starts = self._starts()
+        for si, seg in enumerate(self.segments):
+            if found.all():
+                break
+            f, idx = seg.probe(qkey, pos, h, ref, alt, ref_len, alt_len)
+            take = f & ~found
+            index = np.where(take, idx.astype(np.int64) + starts[si], index)
+            found |= f
+        return found, index
+
     # -- mutation -----------------------------------------------------------
 
     def append(self, rows: dict, ref: np.ndarray, alt: np.ndarray,
                annotations: dict[str, list] | None = None,
                digest_pk: list | None = None,
                long_alleles: list | None = None) -> None:
-        """Merge new (already deduplicated, not-present) rows keeping sort.
+        """Flush new (already deduplicated, not-present) rows as one segment.
 
-        ``rows`` maps numeric column names -> [K] arrays (missing columns
-        filled with NULL defaults)."""
-        k = rows["pos"].shape[0]
-        if k == 0:
+        O(batch) plus an amortized-logarithmic cascade merge — never an O(n)
+        rewrite of the shard (the ``np.insert``-per-flush scale wall this
+        replaces).  ``rows`` maps numeric column names -> [K] arrays (missing
+        columns filled with NULL defaults)."""
+        if rows["pos"].shape[0] == 0:
             return
-        new_cols = {}
-        for name, dtype in _NUMERIC_COLUMNS:
-            if name in rows:
-                new_cols[name] = np.asarray(rows[name], dtype)
-            elif name == "ref_snp":
-                new_cols[name] = np.full((k,), -1, dtype)
-            elif name == "is_adsp_variant":
-                new_cols[name] = np.full((k,), -1, dtype)
-            else:
-                new_cols[name] = np.zeros((k,), dtype)
-
-        new_key = combined_key(new_cols["pos"], new_cols["h"])
-        order = np.argsort(new_key, kind="stable")
-        insert_at = np.searchsorted(self.key(), new_key[order], side="left")
-
-        for name, _ in _NUMERIC_COLUMNS:
-            self.cols[name] = np.insert(self.cols[name], insert_at, new_cols[name][order])
-        self.ref = np.insert(self.ref, insert_at, ref[order], axis=0)
-        self.alt = np.insert(self.alt, insert_at, alt[order], axis=0)
-
-        ann_sorted = {
-            c: [(annotations[c][i] if annotations and c in annotations else None)
-                for i in order]
-            for c in JSONB_COLUMNS
-        }
-        pk_sorted = [digest_pk[i] if digest_pk else None for i in order]
-        la_sorted = [long_alleles[i] if long_alleles else None for i in order]
-        # list-insert at ascending positions: walk once from the back
-        for c in JSONB_COLUMNS:
-            self._list_insert(self.annotations[c], insert_at, ann_sorted[c])
-        self._list_insert(self.digest_pk, insert_at, pk_sorted)
-        self._list_insert(self.long_alleles, insert_at, la_sorted)
-        self.n += k
-
-    @staticmethod
-    def _list_insert(target: list, positions: np.ndarray, values: list) -> None:
-        """Insert values at (pre-insertion) positions in one O(n+k) rebuild
-        (repeated list.insert would be O(n*k) and dominate large loads)."""
-        n, k = len(target), len(values)
-        merged = np.empty(n + k, dtype=object)
-        new_pos = positions + np.arange(k)  # post-insertion indices
-        merged[new_pos] = values
-        old_mask = np.ones(n + k, dtype=bool)
-        old_mask[new_pos] = False
-        merged[old_mask] = target
-        target[:] = merged.tolist()
+        self.segments.append(
+            Segment.build(rows, ref, alt, annotations, digest_pk, long_alleles)
+        )
+        # size-tiered cascade: keep strictly geometric segment sizes so the
+        # segment count stays O(log n) and total merge work O(n log n)
+        while (len(self.segments) >= 2
+               and self.segments[-2].n <= 2 * self.segments[-1].n):
+            newer = self.segments.pop()
+            self.segments[-1] = Segment.merge(self.segments[-1], newer)
+        self._starts_cache = None
 
     def update_annotation(self, index: np.ndarray, column: str,
                           values: Iterable, merge: bool = True) -> int:
-        """Set/merge a JSONB column at given row indices; returns update count.
+        """Set/merge a JSONB column at given global ids; returns update count.
 
         ``merge=True`` applies jsonb_merge deep-merge semantics (patch wins);
         ``merge=False`` replaces, matching plain-assignment UPDATEs."""
-        col = self.annotations[column]
+        index = np.asarray(index, np.int64)
+        seg_idx, off = self._locate(index)
         count = 0
-        for i, v in zip(index, values):
-            i = int(i)
+        for i, si, j, v in zip(index, seg_idx, off, values):
             if i < 0:
                 continue
-            if merge and isinstance(col[i], dict) and isinstance(v, dict):
-                deep_update(col[i], v)
+            s = self.segments[int(si)]
+            col = s.obj_dense(column)
+            j = int(j)
+            if merge and isinstance(col[j], dict) and isinstance(v, dict):
+                deep_update(col[j], v)
             else:
-                col[i] = v
+                col[j] = v
+            s.dirty = True
             count += 1
         return count
 
     def set_flag(self, index: np.ndarray, column: str, values) -> None:
+        index = np.asarray(index, np.int64)
         mask = index >= 0
-        self.cols[column][index[mask]] = np.asarray(values)[mask] \
-            if np.ndim(values) else values
+        self.set_col(
+            column, index[mask],
+            np.asarray(values)[mask] if np.ndim(values) else values,
+        )
 
     def delete_by_algorithm(self, alg_id: int) -> int:
-        keep = self.cols["row_algorithm_id"] != alg_id
-        removed = int((~keep).sum())
-        if removed == 0:
-            return 0
-        for name, _ in _NUMERIC_COLUMNS:
-            self.cols[name] = self.cols[name][keep]
-        self.ref = self.ref[keep]
-        self.alt = self.alt[keep]
-        for c in JSONB_COLUMNS:
-            self.annotations[c] = [v for v, k in zip(self.annotations[c], keep) if k]
-        self.digest_pk = [v for v, k in zip(self.digest_pk, keep) if k]
-        self.long_alleles = [v for v, k in zip(self.long_alleles, keep) if k]
-        self.n -= removed
+        removed = 0
+        kept: list[Segment] = []
+        for s in self.segments:
+            keep = s.cols["row_algorithm_id"] != alg_id
+            k = int((~keep).sum())
+            if k == 0:
+                kept.append(s)
+                continue
+            removed += k
+            if k < s.n:
+                kept.append(s.filter(keep))
+        if removed:
+            self.segments = kept
+            self._starts_cache = None
         return removed
 
 
 class VariantStore:
-    """All chromosome shards + persistence."""
+    """All chromosome shards + incremental persistence."""
 
     def __init__(self, width: int):
         self.width = width
         self.shards: dict[int, ChromosomeShard] = {}
+        self._next_seg_id = 1
 
     def shard(self, chrom_code: int) -> ChromosomeShard:
         code = int(chrom_code)
@@ -284,52 +554,97 @@ class VariantStore:
         DELETE back-off which a columnar mask doesn't need)."""
         return sum(s.delete_by_algorithm(alg_id) for s in self.shards.values())
 
+    def compact(self) -> None:
+        for s in self.shards.values():
+            s.compact()
+
     # -- persistence --------------------------------------------------------
+    #
+    # Layout v2: manifest.json lists each shard's segment ids in order;
+    # every segment is one npz (numeric cols + alleles) plus one sparse
+    # JSONL (object columns, only rows that have any).  ``save`` writes
+    # only segments that are new or dirty and prunes orphaned files, so a
+    # per-checkpoint persist is O(new rows) — the reference's analog is the
+    # WAL-less UNLOGGED-table commit, not a full table rewrite.
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
-        manifest = {"width": self.width, "chromosomes": sorted(self.shards)}
+        live_files = {"manifest.json"}
+        manifest = {"format": 2, "width": self.width, "shards": {}}
+        for code, shard in sorted(self.shards.items()):
+            label = chromosome_label(code)
+            seg_ids = []
+            for seg in shard.segments:
+                if seg.seg_id is None:
+                    seg.seg_id = self._next_seg_id
+                    self._next_seg_id = max(self._next_seg_id + 1, seg.seg_id + 1)
+                stem = f"chr{label}.{seg.seg_id:06d}"
+                if seg.dirty or not os.path.exists(
+                        os.path.join(path, stem + ".npz")):
+                    self._write_segment(path, stem, seg)
+                    seg.dirty = False
+                seg_ids.append(seg.seg_id)
+                live_files.update({stem + ".npz", stem + ".ann.jsonl"})
+            manifest["shards"][label] = seg_ids
+        manifest["next_seg_id"] = self._next_seg_id
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f)
-        for code, s in self.shards.items():
-            label = chromosome_label(code)
-            np.savez_compressed(
-                os.path.join(path, f"chr{label}.npz"),
-                ref=s.ref, alt=s.alt,
-                **{name: s.cols[name] for name, _ in _NUMERIC_COLUMNS},
-            )
-            with open(os.path.join(path, f"chr{label}.ann.jsonl"), "w") as f:
-                for i in range(s.n):
-                    row = {c: s.annotations[c][i] for c in JSONB_COLUMNS
-                           if s.annotations[c][i] is not None}
-                    if s.digest_pk[i] is not None:
-                        row["_digest_pk"] = s.digest_pk[i]
-                    if s.long_alleles[i] is not None:
-                        row["_long_alleles"] = list(s.long_alleles[i])
+        for fname in os.listdir(path):
+            if fname not in live_files and (
+                    fname.endswith(".npz") or fname.endswith(".ann.jsonl")):
+                os.remove(os.path.join(path, fname))
+
+    @staticmethod
+    def _write_segment(path: str, stem: str, seg: Segment) -> None:
+        np.savez_compressed(
+            os.path.join(path, stem + ".npz"),
+            ref=seg.ref, alt=seg.alt,
+            **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
+        )
+        with open(os.path.join(path, stem + ".ann.jsonl"), "w") as f:
+            present = [(c, seg.obj[c]) for c in OBJECT_COLUMNS
+                       if seg.obj[c] is not None]
+            for i in range(seg.n) if present else ():
+                row = {}
+                for c, col in present:
+                    if col[i] is not None:
+                        row[c] = (list(col[i]) if c == _LONG_ALLELES
+                                  else col[i])
+                if row:
+                    row["i"] = i
                     f.write(json.dumps(row) + "\n")
 
     @classmethod
     def load(cls, path: str) -> "VariantStore":
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
+        if manifest.get("format") != 2:
+            raise ValueError(
+                "unsupported store format (pre-segment layout); reload from "
+                "source VCFs"
+            )
         store = cls(manifest["width"])
-        for code in manifest["chromosomes"]:
-            label = chromosome_label(code)
-            data = np.load(os.path.join(path, f"chr{label}.npz"))
-            s = store.shard(code)
-            s.ref, s.alt = data["ref"], data["alt"]
-            for name, _ in _NUMERIC_COLUMNS:
-                s.cols[name] = data[name]
-            s.n = s.ref.shape[0]
-            s.annotations = {c: [None] * s.n for c in JSONB_COLUMNS}
-            s.digest_pk = [None] * s.n
-            s.long_alleles = [None] * s.n
-            with open(os.path.join(path, f"chr{label}.ann.jsonl")) as f:
-                for i, line in enumerate(f):
-                    row = json.loads(line)
-                    s.digest_pk[i] = row.pop("_digest_pk", None)
-                    la = row.pop("_long_alleles", None)
-                    s.long_alleles[i] = tuple(la) if la else None
-                    for c, v in row.items():
-                        s.annotations[c][i] = v
+        store._next_seg_id = manifest.get("next_seg_id", 1)
+        from annotatedvdb_tpu.types import chromosome_code
+
+        for label, seg_ids in manifest["shards"].items():
+            shard = store.shard(chromosome_code(label))
+            for seg_id in seg_ids:
+                stem = f"chr{label}.{seg_id:06d}"
+                data = np.load(os.path.join(path, stem + ".npz"))
+                cols = {name: data[name] for name, _ in _NUMERIC_COLUMNS}
+                n = data["ref"].shape[0]
+                obj: dict = {c: None for c in OBJECT_COLUMNS}
+                with open(os.path.join(path, stem + ".ann.jsonl")) as f:
+                    for line in f:
+                        row = json.loads(line)
+                        i = row.pop("i")
+                        for c, v in row.items():
+                            if obj[c] is None:
+                                obj[c] = np.full((n,), None, object)
+                            obj[c][i] = tuple(v) if c == _LONG_ALLELES else v
+                seg = Segment(cols, data["ref"], data["alt"], obj, seg_id=seg_id)
+                seg.dirty = False
+                shard.segments.append(seg)
+            shard._starts_cache = None
         return store

@@ -1,0 +1,148 @@
+"""Store scale-wall regression: flush cost must stay flat as the shard grows.
+
+The round-2 store rewrote its full sorted arrays on every flush
+(``np.insert`` per batch — O(n) per flush, O(n^2/batch) per load), which
+cannot reach the BASELINE 90M-row gate.  The segmented store appends one
+sorted segment per flush with an amortized-logarithmic cascade merge, so a
+load's per-batch cost must not grow with store size.  These tests guard that
+property at a size where the quadratic behavior is unmistakable (the
+10M-row full-scale run lives in ``bench.py --scale``, not in CI).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.store import VariantStore
+
+WIDTH = 16
+BATCH = 1 << 14
+N_BATCHES = 64  # 1M rows: quadratic flush cost would show a >10x drift
+
+
+def _batches(n_batches: int, batch: int, seed: int = 11):
+    """Pre-sorted unique-identity batches for one chromosome (chr1), shaped
+    like the loader's append input (hash column = low bits of a counter, so
+    identities are unique and spread)."""
+    rng = np.random.default_rng(seed)
+    base = 0
+    for b in range(n_batches):
+        pos = np.sort(rng.integers(1, 248_000_000, BATCH)).astype(np.int32)
+        h = (np.arange(BATCH, dtype=np.uint32) + np.uint32(b * BATCH)) * np.uint32(
+            2654435761
+        )
+        order = np.argsort(
+            (pos.astype(np.uint64) << np.uint64(32)) | h, kind="stable"
+        )
+        ref = np.zeros((batch, WIDTH), np.uint8)
+        alt = np.zeros((batch, WIDTH), np.uint8)
+        ref[:, 0] = 65
+        alt[:, 0] = 71
+        rows = {
+            "pos": pos[order],
+            "h": h[order],
+            "ref_len": np.ones(batch, np.int32),
+            "alt_len": np.ones(batch, np.int32),
+            "row_algorithm_id": np.full(batch, 1, np.int32),
+        }
+        base += batch
+        yield rows, ref, alt
+
+
+def test_flush_cost_stays_flat():
+    store = VariantStore(width=WIDTH)
+    shard = store.shard(1)
+    times = []
+    for rows, ref, alt in _batches(N_BATCHES, BATCH):
+        t0 = time.perf_counter()
+        shard.append(rows, ref, alt)
+        times.append(time.perf_counter() - t0)
+    assert shard.n == N_BATCHES * BATCH
+
+    # cascade merges spike individual batches; medians of the two halves
+    # must stay comparable.  With the old np.insert store the second half
+    # is ~3x the first at this size (and grows without bound).
+    first = float(np.median(times[: N_BATCHES // 2]))
+    second = float(np.median(times[N_BATCHES // 2:]))
+    assert second < 3.0 * first + 1e-3, (
+        f"per-flush cost grew {second / first:.1f}x over the load "
+        f"({first * 1e3:.2f}ms -> {second * 1e3:.2f}ms): scale wall regressed"
+    )
+
+    # segment count stays logarithmic, so lookup cost is bounded
+    assert len(shard.segments) <= 2 + int(np.log2(N_BATCHES))
+
+    # total merge work is amortized: the whole load must be far below the
+    # O(n^2/batch) regime (~N_BATCHES/6 x the flat cost at this size)
+    assert sum(times) < N_BATCHES * (first * 6 + 1e-3)
+
+
+def test_incremental_save_is_flat(tmp_path):
+    """Per-checkpoint persistence writes only new/dirty segments."""
+    store = VariantStore(width=WIDTH)
+    shard = store.shard(1)
+    out = str(tmp_path / "vdb")
+    write_costs = []
+    for rows, ref, alt in _batches(12, BATCH, seed=13):
+        shard.append(rows, ref, alt)
+        dirty_rows = sum(s.n for s in shard.segments if s.dirty)
+        t0 = time.perf_counter()
+        store.save(out)
+        write_costs.append((dirty_rows, time.perf_counter() - t0))
+    # after a save everything is clean: an immediate re-save writes nothing
+    t0 = time.perf_counter()
+    store.save(out)
+    noop = time.perf_counter() - t0
+    assert noop < min(c for _, c in write_costs) + 1e-3
+    loaded = VariantStore.load(out)
+    assert loaded.n == store.n
+    np.testing.assert_array_equal(
+        loaded.shard(1).column("pos"), shard.column("pos")
+    )
+
+
+def test_append_interleaved_with_lookup(rng):
+    """Membership answers stay exact across segment cascades."""
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+    from annotatedvdb_tpu.types import VariantBatch
+
+    from conftest import random_variants
+
+    store = VariantStore(width=24)
+    shard = store.shard(1)
+    seen = []
+    for step in range(8):
+        variants = [("1", v[1], v[2], v[3])
+                    for v in random_variants(rng, 64, max_len=10)]
+        batch = VariantBatch.from_tuples(variants, width=24)
+        h = np.asarray(
+            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+        )
+        found, _ = shard.lookup(
+            batch.pos, h, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+        )
+        fresh = ~found
+        # in-batch dedup so appended identities are unique
+        key = (batch.pos.astype(np.uint64) << np.uint64(32)) | h
+        _, first = np.unique(key, return_index=True)
+        keep = np.zeros(batch.n, bool)
+        keep[first] = True
+        sel = np.where(fresh & keep)[0]
+        shard.append(
+            {"pos": batch.pos[sel], "h": h[sel],
+             "ref_len": batch.ref_len[sel], "alt_len": batch.alt_len[sel]},
+            batch.ref[sel], batch.alt[sel],
+        )
+        seen.extend(variants[int(i)] for i in sel)
+    # every row ever appended is found afterwards
+    all_b = VariantBatch.from_tuples(seen, width=24)
+    all_h = np.asarray(
+        allele_hash_jit(all_b.ref, all_b.alt, all_b.ref_len, all_b.alt_len)
+    )
+    found, idx = shard.lookup(
+        all_b.pos, all_h, all_b.ref, all_b.alt, all_b.ref_len, all_b.alt_len
+    )
+    assert found.all()
+    assert shard.n == len(seen)
+    np.testing.assert_array_equal(shard.get_col("pos", idx), all_b.pos)
