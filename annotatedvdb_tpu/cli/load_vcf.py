@@ -54,6 +54,11 @@ def main(argv=None):
                         help="check the store for existing variants "
                              "(--no-skipExisting disables, the reference's "
                              "unchecked fast path)")
+    parser.add_argument("--maxWorkers", default="auto",
+                        help="devices to annotate across: auto (all), off "
+                             "(single device), or a count — the mesh analog "
+                             "of the reference's per-chromosome process pool "
+                             "(load_vcf_file.py:270)")
     args = parser.parse_args(argv)
 
     os.makedirs(args.storeDir, exist_ok=True)
@@ -71,6 +76,28 @@ def main(argv=None):
 
         genome = ReferenceGenome.load(args.refGenome)
 
+    mesh = None
+    if args.maxWorkers != "off":
+        import jax
+
+        n_dev = len(jax.devices())
+        if args.maxWorkers == "auto":
+            want = n_dev
+        else:
+            try:
+                want = int(args.maxWorkers)
+            except ValueError:
+                parser.error(f"--maxWorkers must be auto, off, or a count, "
+                             f"not {args.maxWorkers!r}")
+            if want < 1:
+                parser.error("--maxWorkers count must be >= 1")
+            want = min(want, n_dev)
+        if want > 1:
+            from annotatedvdb_tpu.parallel import make_mesh
+
+            mesh = make_mesh(want)
+            print(f"annotating across {want} devices", file=sys.stderr)
+
     loader = TpuVcfLoader(
         store,
         ledger,
@@ -80,6 +107,7 @@ def main(argv=None):
         batch_size=args.commitAfter,
         skip_existing=args.skipExisting,
         chromosome_map=chrom_map,
+        mesh=mesh,
         log=lambda *a: print(*a, file=sys.stderr),
     )
     counters = loader.load_file(

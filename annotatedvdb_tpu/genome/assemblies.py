@@ -1,0 +1,69 @@
+"""Shipped chromosome-length maps for the supported genome builds.
+
+The reference ships a single hg19 length table
+(``/root/reference/Load/data/hg19_chr_map.txt:1-25``) that drives offline
+bin-reference generation; anything GRCh38 must be user-supplied.  Here both
+builds are package data (``annotatedvdb_tpu/data/*_chr_map.txt``, same
+``chrN<TAB>length`` shape) and load by name, so bin generation, genome
+bounds checks, and the variant-count-balanced shard assignment
+(``parallel/distributed.py``) work out of the box.
+
+Lengths are the standard public assembly values (GRCh38 primary assembly /
+GRCh37-hg19); chromosome keys are integer codes (``types.chromosome_code``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from annotatedvdb_tpu.types import chromosome_code
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "data")
+
+#: build name (case-insensitive) -> shipped asset file
+BUILD_FILES = {
+    "grch38": "grch38_chr_map.txt",
+    "hg38": "grch38_chr_map.txt",
+    "grch37": "hg19_chr_map.txt",
+    "hg19": "hg19_chr_map.txt",
+}
+
+_cache: dict[str, dict[int, int]] = {}
+
+
+def parse_chr_map(path: str) -> dict[int, int]:
+    """``chrN<TAB>length`` TSV -> {chromosome code: length}."""
+    out: dict[int, int] = {}
+    with open(path) as fh:
+        for line in fh:
+            fields = line.split()
+            if len(fields) < 2 or line.startswith("#"):
+                continue
+            code = chromosome_code(fields[0])
+            if code:
+                out[code] = int(fields[1])
+    return out
+
+
+def chromosome_lengths(build: str = "GRCh38") -> dict[int, int]:
+    """Chromosome code -> length for a shipped build (or a map-file path)."""
+    key = build.lower()
+    if key not in _cache:
+        if key in BUILD_FILES:
+            path = os.path.join(_DATA_DIR, BUILD_FILES[key])
+        elif os.path.exists(build):
+            path = build  # user-supplied map file, reference-compatible
+        else:
+            raise ValueError(
+                f"unknown genome build {build!r}: expected one of "
+                f"{sorted(set(BUILD_FILES))} or a chr-map file path"
+            )
+        lengths = parse_chr_map(path)
+        if len(lengths) != 25:
+            raise ValueError(f"{path}: expected 25 chromosomes, got {len(lengths)}")
+        _cache[key] = lengths
+    return _cache[key]
+
+
+def genome_length(build: str = "GRCh38") -> int:
+    return sum(chromosome_lengths(build).values())

@@ -26,7 +26,7 @@ import numpy as np
 
 from annotatedvdb_tpu.conseq import ConsequenceRanker
 from annotatedvdb_tpu.io.vep import VepResultParser
-from annotatedvdb_tpu.models.pipeline import annotate_pipeline_jit
+from annotatedvdb_tpu.models.pipeline import annotate_fn
 from annotatedvdb_tpu.ops.hashing import allele_hash_jit
 from copy import deepcopy
 
@@ -154,7 +154,7 @@ class TpuVepLoader:
         batch = batch._replace(
             chrom=np.array([r["chrom"] for r in rows], dtype=np.int8)
         )
-        ann = annotate_pipeline_jit(
+        ann = annotate_fn()(
             batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
         )
         h = np.array(

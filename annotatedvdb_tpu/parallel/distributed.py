@@ -8,11 +8,24 @@ locally, and aggregates counters with ``psum``.  Chromosome ownership keeps
 the store's partition invariant (one shard owns a chromosome's rows, so
 dedup/update never crosses shards — the same lock-avoidance layout the
 reference gets from Postgres LIST partitions, ``createVariant.sql:29-50``).
+
+Ownership is **variant-count balanced**: chromosomes are assigned to shards
+by greedy longest-first packing over GRCh38 chromosome lengths (a static
+proxy for variant counts), the deterministic analog of the reference's
+chromosome-order shuffle (``load_cadd_scores.py:306``).
+
+The default exchange capacity is **lossless**: each source shard can send
+its entire local slice to a single owner, so chromosome-sorted input (the
+common case — VCFs are sorted) routes without drops.  Callers chasing
+throughput on chromosome-interleaved input may pass a smaller ``capacity``;
+overflow is then dropped *with accounting* (``n_dropped``).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -77,67 +90,155 @@ def reshard_by_owner(owner, arrays, n_shards: int, capacity: int, axis=SHARD_AXI
     return received, valid, total_dropped
 
 
+@lru_cache(maxsize=None)
+def chromosome_owner_table(n_shards: int, build: str = "GRCh38") -> tuple:
+    """[NUM_CHROMOSOMES + 1] owner table: greedy longest-first packing of
+    chromosomes onto shards weighted by chromosome length — ~proportional to
+    variant count, so shard loads stay within ~1.5x of each other (chr1 is
+    ~15x chr21; contiguous blocks would skew ~5x).  Index 0 (pad rows) maps
+    to shard 0."""
+    from annotatedvdb_tpu.genome.assemblies import chromosome_lengths
+
+    lengths = chromosome_lengths(build)
+    table = [0] * (NUM_CHROMOSOMES + 1)
+    load = [0] * n_shards
+    for code in sorted(lengths, key=lambda c: -lengths[c]):
+        s = min(range(n_shards), key=load.__getitem__)
+        table[code] = s
+        load[s] += lengths[code]
+    return tuple(table)
+
+
 def chromosome_owner(chrom, n_shards: int):
-    """Owning shard of a chromosome code: contiguous blocks of chromosomes per
-    shard (chr1 with chr2 on shard 0, ... — later rounds can use a
-    variant-count-balanced assignment; the reference shuffles chromosome order
-    for the same load-balancing reason, ``load_cadd_scores.py:306``)."""
-    per = -(-NUM_CHROMOSOMES // n_shards)  # ceil
-    return jnp.clip((chrom.astype(jnp.int32) - 1) // per, 0, n_shards - 1)
+    """Owning shard of each row's chromosome code (balanced static table)."""
+    table = jnp.asarray(chromosome_owner_table(n_shards), jnp.int32)
+    return table[jnp.clip(chrom.astype(jnp.int32), 0, NUM_CHROMOSOMES)]
 
 
-def distributed_annotate_step(mesh, batch: VariantBatch, capacity: int | None = None):
+POSITION_BLOCK_BITS = 14  # 16kb blocks: fine-grained spread, bin-cache friendly
+
+
+def position_block_owner(chrom, pos, n_shards: int) -> np.ndarray:
+    """Host-side owner map for annotate-only fan-out: round-robin 16kb
+    position blocks across shards.  Chromosome-sorted input (every VCF) then
+    spreads evenly instead of serializing onto one chromosome owner — the
+    right routing while dedup/store remain host-side and no device holds
+    persistent per-chromosome state.  Chromosome enters the rotation so
+    chromosomes don't all start on shard 0."""
+    blocks = (np.asarray(pos).astype(np.int64) >> POSITION_BLOCK_BITS)
+    return ((blocks + np.asarray(chrom).astype(np.int64)) % n_shards).astype(
+        np.int32
+    )
+
+
+def exact_capacity(owner: np.ndarray, n_shards: int) -> int:
+    """Smallest per-(source, destination) slot count that loses no rows for
+    this owner map, rounded up to a power of two (bounds the set of compiled
+    exchange shapes)."""
+    from annotatedvdb_tpu.utils.arrays import next_pow2
+
+    per_source = np.asarray(owner).reshape(n_shards, -1)
+    cap = 1
+    for s in range(n_shards):
+        counts = np.bincount(per_source[s], minlength=n_shards)
+        cap = max(cap, int(counts.max()))
+    return next_pow2(cap)
+
+
+def distributed_annotate_step(
+    mesh, batch: VariantBatch, capacity: int | None = None, row_id=None,
+    owner: np.ndarray | None = None,
+):
     """Full sharded load step: reshard rows to chromosome owners, annotate,
     and count classes globally.  This is the function the driver dry-runs
-    multi-chip (``__graft_entry__.dryrun_multichip``).
+    multi-chip (``__graft_entry__.dryrun_multichip``) and the path
+    ``TpuVcfLoader`` takes on a multi-device mesh.
 
-    ``capacity`` bounds rows each shard sends per destination.  The default
-    gives 4x slack over a perfectly balanced distribution, keeping per-shard
-    post-exchange work at ~4*n_local/n_shards per source (not the full global
-    batch); overflow rows are dropped *with accounting* (``n_dropped``) and
-    callers needing lossless routing under extreme skew pass
-    ``capacity=batch.n // n_shards``."""
+    Returns ``(ann, row_id_out, counts, n_dropped, n_fallback)``:
+
+    - ``ann``: annotated arrays in post-exchange order;
+    - ``row_id_out``: for each post-exchange slot, the caller-supplied row id
+      of the input row occupying it (−1 for empty slots, pad rows, and
+      dropped rows) — the host scatters annotations back to input order
+      with it;
+    - ``counts``: global per-class psum over device-annotated rows;
+    - ``n_dropped``: rows lost to capacity overflow (0 with the lossless
+      default);
+    - ``n_fallback``: rows flagged for the host long-allele path.
+
+    ``owner`` is an optional host-computed [N] shard assignment (e.g.
+    :func:`position_block_owner` for annotate-only fan-out); without it,
+    rows route to their chromosome's owner (the device-resident-store
+    layout).  ``capacity`` bounds rows each shard sends per destination; the
+    default is the host-computed exact lossless minimum for the owner map
+    (for the chromosome map on sorted input that is ``n_local`` — the whole
+    slice may route to one owner).  Row conservation invariant:
+    ``sum(counts) + n_fallback + n_dropped == non-pad input rows``."""
     n_shards = mesh.devices.size
+    if batch.n % n_shards:
+        raise ValueError(
+            f"batch size {batch.n} not divisible by {n_shards} shards — pad "
+            "with chrom-0 rows first (TpuVcfLoader does this)"
+        )
     n_local = batch.n // n_shards
     if capacity is None:
-        capacity = min(n_local, -(-4 * n_local // n_shards))
+        if owner is not None:
+            capacity = min(exact_capacity(owner, n_shards), n_local)
+        else:
+            host_owner = np.asarray(chromosome_owner_table(n_shards))[
+                np.clip(np.asarray(batch.chrom, np.int32), 0, NUM_CHROMOSOMES)
+            ]
+            capacity = min(exact_capacity(host_owner, n_shards), n_local)
+    if row_id is None:
+        row_id = np.arange(batch.n, dtype=np.int32)
+    owner_in = (
+        np.asarray(owner, np.int32) if owner is not None
+        else np.full(batch.n, -1, np.int32)  # -1: chromosome routing in-trace
+    )
+    use_chrom_owner = owner is None
 
     spec = P(SHARD_AXIS)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(spec,) * 6,
-        out_specs=(jax.tree.map(lambda _: spec, _annotated_specs()), spec, P(), P(), P()),
+        in_specs=(spec,) * 8,
+        out_specs=(
+            jax.tree.map(lambda _: spec, _annotated_specs()),
+            spec, P(), P(), P(),
+        ),
         check_vma=False,
     )
-    def step(chrom, pos, ref, alt, ref_len, alt_len):
-        owner = chromosome_owner(chrom, n_shards)
-        arrays = (chrom, pos, ref, alt, ref_len, alt_len)
-        (chrom, pos, ref, alt, ref_len, alt_len), valid, dropped = reshard_by_owner(
-            owner, arrays, n_shards, capacity
+    def step(chrom, pos, ref, alt, ref_len, alt_len, rid, owner_rows):
+        owner = (
+            chromosome_owner(chrom, n_shards) if use_chrom_owner else owner_rows
+        )
+        arrays = (chrom, pos, ref, alt, ref_len, alt_len, rid)
+        (chrom, pos, ref, alt, ref_len, alt_len, rid), valid, dropped = (
+            reshard_by_owner(owner, arrays, n_shards, capacity)
         )
         ann = annotate_pipeline(chrom, pos, ref, alt, ref_len, alt_len)
         # global per-class counters (reference: per-worker counter dicts,
         # variant_loader.py:387-392 — here one psum).  Pad rows (chrom 0,
         # both in-batch padding and empty exchange slots) and truncated
         # host-fallback rows are excluded: their kernel outputs are undefined.
-        counted = valid & (chrom > 0) & ~ann.host_fallback
+        real = valid & (chrom > 0)
+        counted = real & ~ann.host_fallback
         counts = jnp.zeros((8,), jnp.int32).at[ann.variant_class].add(
             counted.astype(jnp.int32), mode="drop"
         )
         counts = jax.lax.psum(counts, SHARD_AXIS)
-        # contract: valid marks rows whose annotations are usable, so it
-        # matches `counts` exactly; host-fallback rows are reported separately
-        # for the caller's host path (row conservation:
-        # sum(counts) + n_fallback + dropped == pad-free input rows).
         n_fallback = jax.lax.psum(
-            jnp.sum(valid & (chrom > 0) & ann.host_fallback, dtype=jnp.int32),
-            SHARD_AXIS,
+            jnp.sum(real & ann.host_fallback, dtype=jnp.int32), SHARD_AXIS
         )
-        return ann, counted, counts, dropped, n_fallback
+        # row ids for the host-side scatter; -1 marks unusable slots
+        rid_out = jnp.where(real, rid, -1)
+        return ann, rid_out, counts, dropped, n_fallback
 
-    return step(batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+    return step(
+        batch.chrom, batch.pos, batch.ref, batch.alt,
+        batch.ref_len, batch.alt_len, row_id, owner_in,
+    )
 
 
 def _annotated_specs():

@@ -1,0 +1,111 @@
+"""Distributed end-to-end load: the mesh path through the production loader.
+
+VERDICT round 2 item 1's done-criterion: a multi-device CPU test loads a
+sorted single-chromosome VCF end-to-end through the same code path the CLI
+uses (``TpuVcfLoader(mesh=...)``), asserting zero drops and store parity
+with the single-device load.  Chromosome-sorted input is the adversarial
+case for resharding — every row routes to one owner — which the lossless
+default capacity must absorb.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+BASES = "ACGT"
+
+
+def write_sorted_vcf(path, n=1000, chrom="22", seed=5):
+    rng = random.Random(seed)
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    pos = 10_000
+    for i in range(n):
+        pos += rng.randint(1, 40)
+        kind = rng.randrange(4)
+        if kind == 0:
+            ref = rng.choice(BASES)
+            alt = rng.choice(BASES.replace(ref, ""))
+        elif kind == 1:
+            ref = rng.choice(BASES)
+            alt = ref + "".join(rng.choice(BASES) for _ in range(rng.randint(1, 6)))
+        elif kind == 2:
+            alt = rng.choice(BASES)
+            ref = alt + "".join(rng.choice(BASES) for _ in range(rng.randint(1, 6)))
+        else:
+            ref = "".join(rng.choice(BASES) for _ in range(3))
+            alt = "".join(rng.choice(BASES) for _ in range(3))
+        lines.append(f"{chrom}\t{pos}\trs{i}\t{ref}\t{alt}\t.\t.\tRS={i}")
+    # long-allele tail exercises the host-fallback path through the exchange
+    lines.append(f"{chrom}\t{pos + 50}\t.\t{'A' * 60}\tG\t.\t.\t.")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def load_with(tmp_path, vcf, tag, mesh):
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / f"ledger_{tag}.jsonl"))
+    loader = TpuVcfLoader(store, ledger, mesh=mesh, batch_size=256,
+                          log=lambda *a: None)
+    counters = loader.load_file(vcf, commit=True)
+    return store, counters
+
+
+def test_mesh_load_matches_single_device(tmp_path):
+    """Sorted single-chromosome VCF: mesh load == single-device load."""
+    from annotatedvdb_tpu.parallel import make_mesh
+
+    vcf = write_sorted_vcf(tmp_path / "chr22.vcf")
+    s1, c1 = load_with(tmp_path, vcf, "single", mesh=None)
+    s8, c8 = load_with(tmp_path, vcf, "mesh", mesh=make_mesh(8))
+
+    for key in ("line", "variant", "skipped", "duplicates"):
+        assert c1[key] == c8[key], f"counter {key}: {c1[key]} != {c8[key]}"
+    assert s1.n == s8.n == c1["variant"]
+
+    sh1, sh8 = s1.shard(22), s8.shard(22)
+    sh1.compact(), sh8.compact()
+    for col in ("pos", "h", "ref_len", "alt_len", "ref_snp", "bin_level",
+                "leaf_bin", "needs_digest"):
+        np.testing.assert_array_equal(sh1.cols[col], sh8.cols[col], err_msg=col)
+    np.testing.assert_array_equal(sh1.ref, sh8.ref)
+    np.testing.assert_array_equal(sh1.alt, sh8.alt)
+    # record PKs (including the digest-tail row) agree row-for-row
+    for i in range(0, sh1.n, 97):
+        assert sh1.primary_key(i) == sh8.primary_key(i)
+    digest1 = [pk for pk in sh1.digest_pk if pk is not None]
+    digest8 = [pk for pk in sh8.digest_pk if pk is not None]
+    assert digest1 == digest8 and len(digest1) == 1
+
+
+def test_mesh_load_multi_chromosome(tmp_path):
+    """Interleaved chromosomes route across owners without loss."""
+    from annotatedvdb_tpu.parallel import make_mesh
+
+    rng = random.Random(11)
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    per_chrom = {}
+    for i in range(800):
+        chrom = rng.choice([str(c) for c in range(1, 23)] + ["X", "Y", "M"])
+        pos = per_chrom.get(chrom, 1000) + rng.randint(1, 50)
+        per_chrom[chrom] = pos
+        ref = rng.choice(BASES)
+        alt = rng.choice(BASES.replace(ref, ""))
+        lines.append(f"{chrom}\t{pos}\t.\t{ref}\t{alt}\t.\t.\t.")
+    vcf = tmp_path / "multi.vcf"
+    vcf.write_text("\n".join(lines) + "\n")
+
+    s1, c1 = load_with(tmp_path, str(vcf), "single", mesh=None)
+    s4, c4 = load_with(tmp_path, str(vcf), "mesh", mesh=make_mesh(4))
+    assert c1["variant"] == c4["variant"]
+    assert sorted(s1.shards) == sorted(s4.shards)
+    for code in s1.shards:
+        a, b = s1.shard(code), s4.shard(code)
+        a.compact(), b.compact()
+        np.testing.assert_array_equal(a.cols["pos"], b.cols["pos"])
+        np.testing.assert_array_equal(a.cols["h"], b.cols["h"])
