@@ -9,9 +9,14 @@ does not need the table at runtime — bin lookups are closed-form on device
 (``ops/binindex.py``) — so this emits the identical rows as TSV for parity
 checks and for Postgres-compatible egress (COPY-able into BinIndexRef).
 
+Chromosome lengths default to the shipped GRCh38 map
+(``annotatedvdb_tpu/data/grch38_chr_map.txt``); ``--genomeBuild hg19``
+selects the shipped hg19 table (byte-compatible with the reference's
+``Load/data/hg19_chr_map.txt``), and ``-m`` overrides with a custom map.
+
 Usage:
     python -m annotatedvdb_tpu.cli.generate_bin_index_references \
-        -m hg19_chr_map.txt [-o bin_index_ref.tsv]
+        [--genomeBuild GRCh38 | -m custom_chr_map.txt] [-o bin_index_ref.tsv]
 """
 
 from __future__ import annotations
@@ -58,13 +63,24 @@ def main(argv=None) -> int:
     pin_platform("cpu")
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("-m", "--chromosomeMap", required=True,
-                    help="tab-delim chrom<TAB>length, no header")
+    ap.add_argument("-m", "--chromosomeMap", default=None,
+                    help="tab-delim chrom<TAB>length, no header "
+                         "(overrides --genomeBuild)")
+    ap.add_argument("--genomeBuild", default="GRCh38",
+                    help="shipped length table to use: GRCh38 (default) or hg19")
     ap.add_argument("-o", "--output", default=None,
                     help="output TSV (default stdout)")
     args = ap.parse_args(argv)
 
-    chr_map = read_chr_map(args.chromosomeMap)
+    if args.chromosomeMap:
+        chr_map = read_chr_map(args.chromosomeMap)
+    else:
+        from annotatedvdb_tpu.genome.assemblies import build_map_path
+
+        try:
+            chr_map = read_chr_map(build_map_path(args.genomeBuild))
+        except ValueError as err:
+            ap.error(str(err))
     if args.output:
         with open(args.output, "w") as out:
             n = emit_rows(chr_map, out)

@@ -26,10 +26,13 @@ def main(argv=None):
     parser.add_argument("--fileName", required=True)
     parser.add_argument("--storeDir", required=True)
     parser.add_argument("--rankingFile", default=None,
-                        help="consequence ranking TSV; omitted -> seeded from "
-                             "the VEP vocabulary and ranked by the ADSP rules")
-    parser.add_argument("--rankOnLoad", action="store_true",
-                        help="re-rank the ranking file on load")
+                        help="consequence ranking TSV; omitted -> the shipped "
+                             "294-combo ADSP seed (the reference's "
+                             "Load/data/custom_consequence_ranking.txt), "
+                             "ranked on load")
+    parser.add_argument("--rankOnLoad", action="store_true", default=None,
+                        help="re-rank the ranking file on load (implied for "
+                             "the shipped default seed)")
     parser.add_argument("--saveOnAddConsequence", action="store_true")
     parser.add_argument("--datasource", default=None)
     parser.add_argument("--commit", action="store_true")

@@ -59,7 +59,9 @@ class ConseqGroup(enum.Enum):
 
     @classmethod
     def complete_indexed_dict(cls) -> dict:
-        return {t: i + 1 for i, t in enumerate(cls.all_terms())}
+        """0-based term -> index (``list_to_indexed_dict`` semantics;
+        duplicate terms keep their last position)."""
+        return {t: i for i, t in enumerate(cls.all_terms())}
 
     @classmethod
     def validate_terms(cls, combos) -> bool:
@@ -75,7 +77,7 @@ class ConseqGroup(enum.Enum):
         return True
 
     def indexed_dict(self) -> dict:
-        return {t: i + 1 for i, t in enumerate(self.value)}
+        return {t: i for i, t in enumerate(self.value)}
 
     def members(self, combos, require_subset: bool = False) -> list:
         """Combos belonging to this group under the ADSP rules."""

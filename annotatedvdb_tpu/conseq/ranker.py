@@ -14,24 +14,47 @@ ranking millions of consequence rows — uses the compiled device
 any re-rank (SURVEY.md §5.7 "isolate as a host-side service with versioned
 snapshots pushed to device").
 
-``int_to_alpha`` is Excel-style bijective base-26 (1->a, 27->aa), matching
-the observed sort behavior the reference gets from its external helper.
+``int_to_alpha`` is base-26 digits with 'a' = 0 (0->a, 26->ba), and group
+indexes / rank values are 0-based — the external-helper semantics
+reconstructed from the reference's published rank expectation (see
+``int_to_alpha``'s docstring and ``tests/test_conseq.py``).
 """
 
 from __future__ import annotations
 
+import csv
 import os
 from datetime import date
 
 from annotatedvdb_tpu.conseq.groups import ConseqGroup
 
+#: The shipped ADSP consequence-ranking seed: the 294-combo table the
+#: reference distributes (``Load/data/custom_consequence_ranking.txt`` —
+#: header ``consequence adsp_ranking adsp_impact ensembl_ranking
+#: ensembl_impact genomicsdb_consequence``), reproduced as package data so
+#: default rankings match the published ADSP ranking out of the box.
+DEFAULT_RANKING_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)),
+    "data", "adsp_consequence_ranking.txt",
+)
+
 
 def int_to_alpha(n: int) -> str:
-    """1 -> 'a', 26 -> 'z', 27 -> 'aa' (bijective base-26, lowercase)."""
+    """0 -> 'a', 25 -> 'z', 26 -> 'ba' (base-26 digits, lowercase).
+
+    Matches the reference's external helper as reconstructed from the
+    published expectation (``test_conseq_parser.py:23-27``): re-ranking the
+    pre-2022 ranking table must give
+    ``splice_acceptor_variant,splice_donor_variant,3_prime_UTR_variant,
+    intron_variant`` rank 5 — which holds exactly for 0-based group
+    indexes, 0-based rank values, and this digit encoding (see
+    ``tests/test_conseq.py::test_reference_rank_parity``)."""
     out = []
-    while n > 0:
-        n, rem = divmod(n - 1, 26)
+    while True:
+        n, rem = divmod(n, 26)
         out.append(chr(ord("a") + rem))
+        if n == 0:
+            break
     return "".join(reversed(out))
 
 
@@ -48,49 +71,70 @@ class ConsequenceRanker:
         self,
         ranking_file: str | None = None,
         save_on_add: bool = False,
-        rank_on_load: bool = False,
+        rank_on_load: bool | None = None,
     ):
-        """``ranking_file`` is a TSV with a ``consequence`` column and
-        optional ``rank`` column (load order = rank when absent); None seeds
-        from the single-term consequence vocabulary and ranks immediately."""
+        """``ranking_file`` is a TSV with a ``consequence`` column (quoted
+        comma combos) and optional ``rank`` column (load order = rank when
+        absent); None loads the shipped ADSP 294-combo seed
+        (:data:`DEFAULT_RANKING_FILE`) — first-time use of the seed re-ranks
+        on load, matching the reference drivers' ``rankOnLoad=True``
+        (``load_vep_result.py`` initialize flow)."""
+        if ranking_file is None:
+            ranking_file = DEFAULT_RANKING_FILE
+            if rank_on_load is None:
+                rank_on_load = True
         self.ranking_file = ranking_file
         self.save_on_add = save_on_add
         self.added: list[str] = []
         self._match_memo: dict[str, int] = {}
         self.version = 0
-        if ranking_file is not None:
-            # fail loudly on a bad path — silently falling back to the seed
-            # table would change every stored rank
-            self.rankings = self._parse_file(ranking_file)
-            self._rebuild_canonical()
-            if rank_on_load:
-                self._rerank()
-        else:
-            # seed: every single-term combo, ranked by the ADSP algorithm
-            self.rankings = {t: i + 1 for i, t in enumerate(ConseqGroup.all_terms())}
+        # fail loudly on a bad path — silently falling back to the seed
+        # table would change every stored rank
+        self.rankings = self._parse_file(ranking_file)
+        self._rebuild_canonical()
+        if rank_on_load:
             self._rerank()
+
+    @classmethod
+    def from_vocabulary(cls) -> "ConsequenceRanker":
+        """Seed from the bare single-term VEP vocabulary (no combo table) and
+        rank immediately — for exercising the ranking algorithm itself."""
+        self = cls.__new__(cls)
+        self.ranking_file = None
+        self.save_on_add = False
+        self.added = []
+        self._match_memo = {}
+        self.version = 0
+        self.rankings = {t: i + 1 for i, t in enumerate(ConseqGroup.all_terms())}
+        self._rerank()
+        return self
 
     @staticmethod
     def _parse_file(path: str) -> dict:
+        """csv.DictReader parse (combos are quoted comma-strings in the
+        shipped table, ``adsp_consequence_parser.py:105-126`` semantics):
+        an explicit ``rank`` column wins; otherwise load order is rank."""
         out = {}
-        with open(path) as fh:
-            header = fh.readline().rstrip("\n").split("\t")
-            cols = {c: i for i, c in enumerate(header)}
+        with open(path, newline="") as fh:
+            reader = csv.DictReader(fh, delimiter="\t")
             rank = 1
-            for line in fh:
-                row = line.rstrip("\n").split("\t")
-                combo = alphabetize_combo(row[cols["consequence"]])
-                if "rank" in cols:
-                    out[combo] = int(row[cols["rank"]])
+            for row in reader:
+                combo = alphabetize_combo(row["consequence"])
+                if "rank" in (reader.fieldnames or ()):
+                    out[combo] = int(float(row["rank"]))
                 else:
                     out[combo] = rank
                     rank += 1
         return out
 
     def save(self, path: str | None = None) -> str:
-        """Versioned save (``adsp_consequence_parser.py:85-102``)."""
+        """Versioned save (``adsp_consequence_parser.py:85-102``).  Saves of
+        the shipped default seed land in the working directory, never inside
+        the package data directory (which may be read-only)."""
         if path is None:
             base = os.path.splitext(self.ranking_file or "consequence_ranking.txt")[0]
+            if self.ranking_file == DEFAULT_RANKING_FILE:
+                base = os.path.basename(base)
             path = f"{base}_{date.today().strftime('%m-%d-%Y')}.txt"
         if os.path.exists(path):
             path = os.path.splitext(path)[0] + f"_v{len(self.added)}.txt"
@@ -162,7 +206,10 @@ class ConsequenceRanker:
             members = grp.members(combos, require_subset)
             if members:
                 ordered += self._sort_group(members, grp)
-        self.rankings = {c: i + 1 for i, c in enumerate(ordered)}
+        # 0-based rank values (list_to_indexed_dict semantics); a combo in
+        # several groups keeps its LAST position (dict overwrite), matching
+        # the reference's indexed-dict conversion
+        self.rankings = {c: i for i, c in enumerate(ordered)}
         self._rebuild_canonical()
         self._match_memo.clear()
         self.version += 1

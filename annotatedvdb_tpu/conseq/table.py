@@ -10,7 +10,7 @@ current table compiles to a device snapshot:
 - lookup is a vectorized binary search over the sorted (hi, lo) mask table;
 - coding status is one mask AND against the CODING_CONSEQUENCES bits.
 
-Novel combos (mask not found) return rank 0; the host ranker learns them,
+Novel combos (mask not found) return rank -1; the host ranker learns them,
 bumps its version, and the caller rebuilds the snapshot — the
 learn-on-miss-mutable-global of the reference becomes an explicit
 host-service/device-snapshot split (SURVEY.md §5.7).
@@ -54,7 +54,7 @@ class RankTable:
     def _mask(self, terms) -> np.uint64:
         """Combo -> bitmask; any term outside the vocabulary sets the
         reserved unknown bit (63) so the mask can never alias a known
-        combo's mask — unknown combos must return rank 0, not the rank of
+        combo's mask — unknown combos must return rank -1, not the rank of
         their known subset."""
         m = np.uint64(0)
         for t in terms:
@@ -73,14 +73,14 @@ class RankTable:
         return out
 
     def lookup_host(self, masks: np.ndarray) -> np.ndarray:
-        """Host-side batch lookup (numpy searchsorted); 0 = unknown combo."""
+        """Host-side batch lookup (numpy searchsorted); -1 = unknown combo."""
         idx = np.searchsorted(self._masks, masks)
         idx = np.clip(idx, 0, len(self._masks) - 1)
         hit = self._masks[idx] == masks
-        return np.where(hit, self._ranks[idx], 0).astype(np.int32)
+        return np.where(hit, self._ranks[idx], -1).astype(np.int32)
 
     def lookup_device(self, hi, lo):
-        """Device batch lookup over (hi, lo) uint32 mask lanes; 0 = unknown.
+        """Device batch lookup over (hi, lo) uint32 mask lanes; -1 = unknown.
 
         Binary search over the sorted 64-bit masks using two-lane compares."""
         return _rank_lookup(self.d_hi, self.d_lo, self.d_ranks, hi, lo)
@@ -104,4 +104,4 @@ def _rank_lookup(table_hi, table_lo, table_ranks, hi, lo):
         r = jnp.where(active & ~less, mid, r)
     i = jnp.clip(l, 0, m - 1)
     hit = (table_hi[i] == hi) & (table_lo[i] == lo) & (l < m)
-    return jnp.where(hit, table_ranks[i], 0)
+    return jnp.where(hit, table_ranks[i], -1)

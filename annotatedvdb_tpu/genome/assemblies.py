@@ -31,6 +31,18 @@ BUILD_FILES = {
 _cache: dict[str, dict[int, int]] = {}
 
 
+def build_map_path(build: str) -> str:
+    """Path of the shipped length-map file for a build name (raises for
+    unknown builds) — the single owner of build-name resolution."""
+    key = build.lower()
+    if key not in BUILD_FILES:
+        raise ValueError(
+            f"unknown genome build {build!r}: expected one of "
+            f"{sorted(set(BUILD_FILES))} or a chr-map file path"
+        )
+    return os.path.join(_DATA_DIR, BUILD_FILES[key])
+
+
 def parse_chr_map(path: str) -> dict[int, int]:
     """``chrN<TAB>length`` TSV -> {chromosome code: length}."""
     out: dict[int, int] = {}
@@ -50,14 +62,11 @@ def chromosome_lengths(build: str = "GRCh38") -> dict[int, int]:
     key = build.lower()
     if key not in _cache:
         if key in BUILD_FILES:
-            path = os.path.join(_DATA_DIR, BUILD_FILES[key])
+            path = build_map_path(build)
         elif os.path.exists(build):
             path = build  # user-supplied map file, reference-compatible
         else:
-            raise ValueError(
-                f"unknown genome build {build!r}: expected one of "
-                f"{sorted(set(BUILD_FILES))} or a chr-map file path"
-            )
+            build_map_path(build)  # raises the unknown-build error
         lengths = parse_chr_map(path)
         if len(lengths) != 25:
             raise ValueError(f"{path}: expected 25 chromosomes, got {len(lengths)}")
@@ -67,3 +76,15 @@ def chromosome_lengths(build: str = "GRCh38") -> dict[int, int]:
 
 def genome_length(build: str = "GRCh38") -> int:
     return sum(chromosome_lengths(build).values())
+
+
+def length_table(build: str = "GRCh38"):
+    """[26] int64 chromosome-length array indexed by chromosome code
+    (index 0 = max int: pad rows never flag as out of bounds) — the
+    vectorized form for batch bounds checks."""
+    import numpy as np
+
+    table = np.full((26,), np.iinfo(np.int64).max, np.int64)
+    for code, length in chromosome_lengths(build).items():
+        table[code] = length
+    return table

@@ -26,15 +26,79 @@ CONSEQUENCE_TYPES = ["transcript", "regulatory_feature", "motif_feature", "inter
 
 _ESP_KEYS = ("aa", "ea")
 
+#: unique-combo count above which the batched rank prefetch uses the device
+#: rank table instead of the numpy one (dispatch overhead crossover)
+DEVICE_RANK_MIN = 256
+
 
 class VepResultParser:
     def __init__(self, ranker: ConsequenceRanker):
         self.ranker = ranker
         self._rank_memo: dict[str, dict] = {}
+        self._memo_version = ranker.version
+        self._table = None  # RankTable snapshot, rebuilt on ranker.version bump
+
+    # ---- batched rank prefetch -------------------------------------------
+
+    def _check_version(self) -> None:
+        """Drop memoized ranks when the ranker re-ranked (learn-on-miss):
+        every rank value shifts, so stale memo entries would mix table
+        versions within one load.  (The reference keeps its stale memo —
+        ``_matchedConseqTerms`` survives ``__update_rankings`` — which is a
+        bug we do not reproduce.)"""
+        if self._memo_version != self.ranker.version:
+            self._rank_memo.clear()
+            self._memo_version = self.ranker.version
+
+    def _rank_table(self):
+        from annotatedvdb_tpu.conseq import RankTable
+
+        if self._table is None or self._table.version != self.ranker.version:
+            self._table = RankTable(self.ranker)
+        return self._table
+
+    def prefetch_ranks(self, annotations: list) -> int:
+        """Batch-resolve every consequence combo in ``annotations`` through
+        the compiled rank-table snapshot (device binary search for large
+        batches, numpy below :data:`DEVICE_RANK_MIN`), seeding the per-combo
+        memo so the per-row ranking loop never walks the host table.  Combos
+        the snapshot doesn't know (rank -1) are left to the host ranker's
+        learn-on-miss path.  Returns the number of combos resolved."""
+        import numpy as np
+
+        self._check_version()
+        combos: set[str] = set()
+        for ann in annotations:
+            for ctype in CONSEQUENCE_TYPES:
+                for conseq in ann.get(ctype + "_consequences") or []:
+                    if isinstance(conseq, dict) and "consequence_terms" in conseq:
+                        combos.add(",".join(conseq["consequence_terms"]))
+        new = [c for c in combos if c not in self._rank_memo]
+        if not new:
+            return 0
+        table = self._rank_table()
+        masks = table.encode(new)
+        if len(new) >= DEVICE_RANK_MIN:
+            hi = (masks >> np.uint64(32)).astype(np.uint32)
+            lo = (masks & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            ranks = np.asarray(table.lookup_device(hi, lo))
+        else:
+            ranks = table.lookup_host(masks)
+        coding = table.is_coding(masks)
+        resolved = 0
+        for combo, rank, is_coding in zip(new, ranks, coding):
+            if rank >= 0:
+                self._rank_memo[combo] = {
+                    "rank": int(rank),
+                    "consequence_is_coding": bool(is_coding),
+                }
+                resolved += 1
+        return resolved
 
     # ---- consequences -----------------------------------------------------
 
     def _ranked(self, conseq: dict) -> dict:
+        self._check_version()
         terms = conseq["consequence_terms"]
         key = ",".join(terms)
         if key not in self._rank_memo:

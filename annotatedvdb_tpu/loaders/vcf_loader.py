@@ -79,6 +79,14 @@ class TpuVcfLoader:
         self.chromosome_map = chromosome_map
         self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         self.log = log
+        from annotatedvdb_tpu.genome.assemblies import BUILD_FILES, length_table
+
+        # genome bounds sanity from the shipped length tables; builds we
+        # have no table for (custom assemblies) skip the check
+        self._chrom_lengths = (
+            length_table(genome_build)
+            if genome_build.lower() in BUILD_FILES else None
+        )
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0, "update": 0,
         }
@@ -220,6 +228,21 @@ class TpuVcfLoader:
 
     def _load_chunk(self, chunk: VcfChunk, alg_id, commit, resume_line, mapping_fh):
         batch = chunk.batch
+        if self._chrom_lengths is not None:
+            oob = batch.pos.astype(np.int64) > self._chrom_lengths[
+                np.clip(batch.chrom.astype(np.int64), 0, 25)
+            ]
+            n_oob = int(oob.sum())
+            if n_oob:  # counted + logged, not dropped (the reference's
+                # SeqRepo validation would likewise only flag these)
+                self.counters["out_of_bounds"] = (
+                    self.counters.get("out_of_bounds", 0) + n_oob
+                )
+                i = int(np.argmax(oob))
+                self.log(
+                    f"{n_oob} positions beyond chromosome bounds, e.g. "
+                    f"{chunk.variant_id[i]}"
+                )
         # ---- device pipeline: annotate + bin + hash + in-batch dedup
         ann = self._annotate(batch)
         h = np.array(  # writable copy: long rows get re-hashed below

@@ -79,20 +79,33 @@ class TpuVepLoader:
             {"file": path, "datasource": self.datasource, "test": test},
             commit,
         )
-        pending: list[dict] = []
+        raw: list[dict] = []
         n_added_before = len(self.parser.ranker.added)
+
+        def flush() -> None:
+            # batched combo->rank resolution through the compiled rank-table
+            # snapshot first (device path for large batches); the per-row
+            # parse below then hits the memo, and only novel combos take the
+            # host ranker's learn-on-miss path
+            self.parser.prefetch_ranks(raw)
+            pending: list[dict] = []
+            for ann in raw:
+                pending.extend(self._parse_result(ann))
+            if pending:
+                self._apply_batch(pending, alg_id, commit)
+            raw.clear()
+
         for line in _open_text(path):
             if not line.strip():
                 continue
             self.counters["line"] += 1
-            pending.extend(self._parse_result(json.loads(line)))
-            if len(pending) >= self.batch_size:
-                self._apply_batch(pending, alg_id, commit)
-                pending = []
+            raw.append(json.loads(line))
+            if len(raw) >= self.batch_size:
+                flush()
                 if test:
                     break
-        if pending:
-            self._apply_batch(pending, alg_id, commit)
+        if raw:
+            flush()
         added = self.parser.ranker.added[n_added_before:]
         if added:
             self.log(f"added {len(added)} new consequence combos: {added}")

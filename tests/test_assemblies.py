@@ -1,0 +1,68 @@
+"""Shipped chromosome-length assets + the bounds checks they drive."""
+
+import subprocess
+import sys
+
+import numpy as np
+
+from annotatedvdb_tpu.genome.assemblies import (
+    chromosome_lengths,
+    genome_length,
+    length_table,
+)
+
+
+def test_shipped_builds_load():
+    for build in ("GRCh38", "hg19", "GRCh37", "hg38"):
+        lengths = chromosome_lengths(build)
+        assert len(lengths) == 25
+        assert lengths[25] == 16569  # chrM is build-invariant
+    # reference-parity spot checks against Load/data/hg19_chr_map.txt:1-25
+    hg19 = chromosome_lengths("hg19")
+    assert hg19[1] == 249250621 and hg19[22] == 51304566
+    assert hg19[23] == 155270560 and hg19[24] == 59373566
+    grch38 = chromosome_lengths("GRCh38")
+    assert grch38[1] == 248956422 and grch38[22] == 50818468
+    assert genome_length("GRCh38") > 3_000_000_000
+
+
+def test_length_table_pads_safe():
+    t = length_table("GRCh38")
+    assert t.shape == (26,)
+    assert t[0] == np.iinfo(np.int64).max  # pad code never out-of-bounds
+    assert t[21] == chromosome_lengths("GRCh38")[21]
+
+
+def test_loader_flags_out_of_bounds(tmp_path):
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    vcf = tmp_path / "oob.vcf"
+    vcf.write_text(
+        "##fileformat=VCFv4.2\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "21\t1000\t.\tA\tC\t.\t.\t.\n"
+        "21\t999999999\t.\tG\tT\t.\t.\t.\n"  # beyond chr21 (46.7Mb)
+    )
+    logs = []
+    store = VariantStore(width=49)
+    ledger = AlgorithmLedger(str(tmp_path / "l.jsonl"))
+    loader = TpuVcfLoader(store, ledger, log=lambda *a: logs.append(a))
+    counters = loader.load_file(str(vcf), commit=True)
+    assert counters["out_of_bounds"] == 1
+    assert counters["variant"] == 2  # flagged, not dropped
+    assert any("beyond chromosome bounds" in str(l) for l in logs)
+
+
+def test_bin_ref_cli_defaults_to_shipped_build(tmp_path):
+    out = tmp_path / "bins.tsv"
+    res = subprocess.run(
+        [sys.executable, "-m",
+         "annotatedvdb_tpu.cli.generate_bin_index_references",
+         "--genomeBuild", "hg19", "-o", str(out)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr
+    first = out.read_text().split("\n", 1)[0].split("\t")
+    assert first[0] == "chr1" and first[4] == "(0,249250621]"
+    assert "25 chromosomes" in res.stderr
