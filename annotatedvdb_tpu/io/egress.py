@@ -20,17 +20,17 @@ Output parity targets:
 
 from __future__ import annotations
 
+from functools import reduce
+
 import numpy as np
 
 from annotatedvdb_tpu import oracle
-from annotatedvdb_tpu.oracle.binindex import closed_form_path
 from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator
 from annotatedvdb_tpu.types import (
     AnnotatedBatch,
     VariantBatch,
     VariantClass,
     chromosome_label,
-    decode_allele,
 )
 from annotatedvdb_tpu.utils.strings import truncate, xstr
 
@@ -40,20 +40,46 @@ VCF_COPY_FIELDS = [
     "allele_frequencies",
 ]
 
+# chromosome code -> label lookup (index 0 unused; loaders filter code 0)
+_CHROM_LABELS = np.array(
+    ["?"] + [chromosome_label(c) for c in range(1, 26)], dtype="U2"
+)
 
-def decode_alleles(batch: VariantBatch) -> tuple[list, list]:
-    refs = [decode_allele(batch.ref[i], batch.ref_len[i]) for i in range(batch.n)]
-    alts = [decode_allele(batch.alt[i], batch.alt_len[i]) for i in range(batch.n)]
-    return refs, alts
+
+def _concat(*parts) -> np.ndarray:
+    """Vectorized string concatenation over mixed scalar/array parts."""
+    return reduce(np.char.add, parts)
 
 
-def metaseq_ids(batch: VariantBatch, refs=None, alts=None) -> list:
+def decode_alleles(batch: VariantBatch) -> tuple[np.ndarray, np.ndarray]:
+    """[N] unicode arrays from the packed device bytes in one view — no
+    per-row Python.  Over-width rows decode to their truncated prefix; all
+    identity-bearing callers must override them with the original strings
+    (``VcfChunk.refs``/``alts``)."""
+    w = batch.width
+
+    def dec(a):
+        a = np.ascontiguousarray(np.asarray(a, np.uint8))
+        return np.char.decode(a.view(f"S{w}")[:, 0], "ascii")
+
+    return dec(batch.ref), dec(batch.alt)
+
+
+def _as_str_array(values, n: int) -> np.ndarray:
+    if isinstance(values, np.ndarray) and values.dtype.kind == "U":
+        return values
+    return np.array(values if values is not None else [""] * n, dtype="U")
+
+
+def metaseq_ids(batch: VariantBatch, refs=None, alts=None) -> np.ndarray:
+    """chr:pos:ref:alt identity strings, assembled column-wise."""
     if refs is None:
         refs, alts = decode_alleles(batch)
-    return [
-        f"{chromosome_label(batch.chrom[i])}:{int(batch.pos[i])}:{refs[i]}:{alts[i]}"
-        for i in range(batch.n)
-    ]
+    return _concat(
+        _CHROM_LABELS[np.asarray(batch.chrom, np.int64)], ":",
+        np.asarray(batch.pos).astype("U10"), ":",
+        _as_str_array(refs, batch.n), ":", _as_str_array(alts, batch.n),
+    )
 
 
 def primary_keys(
@@ -63,53 +89,63 @@ def primary_keys(
     digester: VrsDigestGenerator | None = None,
     refs=None,
     alts=None,
-) -> list:
-    """Record PKs with the reference's literal/digest split."""
+) -> np.ndarray:
+    """Record PKs with the reference's literal/digest split
+    (``primary_key_generator.py:99-122``): the literal ``chr:pos:ref:alt``
+    bulk is one vectorized assembly; only the >50bp digest tail (rare) runs
+    per-row host crypto."""
     if refs is None:
         refs, alts = decode_alleles(batch)
     needs_digest = np.asarray(ann.needs_digest)
-    out = []
-    for i in range(batch.n):
+    literal = metaseq_ids(batch, refs, alts)
+    rs_suffix = np.array(
+        ["" if not r else ":" + str(r) for r in ref_snp], dtype="U"
+    ) if any(ref_snp) else ""
+    out = np.char.add(literal, rs_suffix).astype(object)
+
+    for i in np.where(needs_digest)[0]:
+        i = int(i)
+        if digester is None:
+            raise ValueError(
+                "batch contains >50bp variants; a VrsDigestGenerator is required"
+            )
         chrom = chromosome_label(batch.chrom[i])
-        parts = [chrom, str(int(batch.pos[i]))]
-        if needs_digest[i]:
-            if digester is None:
-                raise ValueError(
-                    "batch contains >50bp variants; a VrsDigestGenerator is required"
-                )
-            pos = int(batch.pos[i])
+        pos = int(batch.pos[i])
+        ref, alt = str(refs[i]), str(alts[i])
+        try:
+            digest = digester.compute_identifier(chrom, pos, ref, alt)
+        except ValueError:
+            # allele-swap fallback for failed validation, then an
+            # unvalidated digest as last resort — a bad row must not
+            # abort the load (``vcf_variant_loader.py:234-256``)
             try:
-                digest = digester.compute_identifier(chrom, pos, refs[i], alts[i])
+                digest = digester.compute_identifier(chrom, pos, alt, ref)
             except ValueError:
-                # allele-swap fallback for failed validation, then an
-                # unvalidated digest as last resort — a bad row must not
-                # abort the load (``vcf_variant_loader.py:234-256``)
-                try:
-                    digest = digester.compute_identifier(
-                        chrom, pos, alts[i], refs[i]
-                    )
-                except ValueError:
-                    digest = digester.compute_identifier(
-                        chrom, pos, refs[i], alts[i], validate=False
-                    )
-            parts.append(digest)
-        else:
-            parts.extend([refs[i], alts[i]])
+                digest = digester.compute_identifier(
+                    chrom, pos, ref, alt, validate=False
+                )
+        parts = [chrom, str(pos), digest]
         if ref_snp[i]:
             parts.append(ref_snp[i])
-        out.append(":".join(parts))
+        out[i] = ":".join(parts)
     return out
 
 
-def bin_paths(batch: VariantBatch, ann: AnnotatedBatch) -> list:
-    level = np.asarray(ann.bin_level)
-    leaf = np.asarray(ann.leaf_bin)
-    return [
-        closed_form_path(
-            chromosome_label(batch.chrom[i], prefix=True), int(level[i]), int(leaf[i])
+def bin_paths(batch: VariantBatch, ann: AnnotatedBatch) -> np.ndarray:
+    """ltree paths, assembled level-column-wise (13 vectorized appends
+    instead of one Python loop per row; semantics of
+    ``oracle.binindex.closed_form_path``)."""
+    level = np.asarray(ann.bin_level).astype(np.int64)
+    leaf = np.asarray(ann.leaf_bin).astype(np.int64)
+    out = np.char.add("chr", _CHROM_LABELS[np.asarray(batch.chrom, np.int64)])
+    for l in range(1, 14):
+        g = leaf >> (13 - l)
+        b = (g + 1) if l == 1 else ((g & 1) + 1)
+        seg = np.where(
+            level >= l, _concat(f".L{l}.B", b.astype("U11")), ""
         )
-        for i in range(batch.n)
-    ]
+        out = np.char.add(out, seg)
+    return out
 
 
 _LONG = 100

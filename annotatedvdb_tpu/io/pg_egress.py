@@ -50,6 +50,39 @@ def pg_escape(value) -> str:
     return s
 
 
+def computed_display_attributes(shard, window: np.ndarray) -> list:
+    """Display-attribute dicts for the given (compacted) shard rows,
+    recomputed from the stored identity columns — the loader's default is
+    to derive them at egress instead of materializing per-row dicts for
+    every variant (``TpuVcfLoader`` ``store_display_attributes``)."""
+    from annotatedvdb_tpu.io import egress
+    from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
+    from annotatedvdb_tpu.models.pipeline import annotate_fn
+    from annotatedvdb_tpu.types import AnnotatedBatch, VariantBatch
+    from annotatedvdb_tpu.utils.arrays import next_pow2
+
+    shard.compact()  # window ids are global; a single segment makes them local
+    seg = shard.segments[0]
+    batch = VariantBatch(
+        np.full(window.shape, shard.chrom_code, np.int8),
+        seg.cols["pos"][window],
+        seg.ref[window], seg.alt[window],
+        seg.cols["ref_len"][window], seg.cols["alt_len"][window],
+    )
+    n = batch.n
+    padded = _pad_batch(batch, next_pow2(n))  # bounded compile shapes
+    ann = annotate_fn()(
+        padded.chrom, padded.pos, padded.ref, padded.alt,
+        padded.ref_len, padded.alt_len,
+    )
+    ann = AnnotatedBatch(*(np.asarray(x)[:n] for x in ann))
+    refs, alts = egress.decode_alleles(batch)
+    refs, alts = refs.astype(object), alts.astype(object)
+    for j in np.where(ann.host_fallback)[0]:
+        refs[j], alts[j] = shard.alleles(int(window[j]))
+    return egress.display_attributes(batch, ann, None, refs, alts)
+
+
 def shard_rows(shard):
     """Yield COPY-ordered value tuples for every row of one shard."""
     shard.compact()  # position-sorted global ids + flat column views
@@ -63,6 +96,15 @@ def shard_rows(shard):
     alg = shard.cols["row_algorithm_id"]
     pos = shard.cols["pos"]
     anns = shard.annotations
+    # rows without stored display attributes get them recomputed in batches
+    display = anns["display_attributes"]
+    missing = np.array([display[i] is None for i in range(shard.n)])
+    if missing.any():
+        display = np.array(display, copy=True)
+        for start in range(0, shard.n, 1 << 16):
+            window = np.where(missing[start:start + (1 << 16)])[0] + start
+            if window.size:
+                display[window] = computed_display_attributes(shard, window)
     for i in range(shard.n):
         ref, alt = shard.alleles(i)
         rs = f"rs{int(ref_snp[i])}" if ref_snp[i] >= 0 else None
@@ -79,7 +121,7 @@ def shard_rows(shard):
             closed_form_path(pref, int(lvl[i]), int(leaf[i])),
         ]
         for col in JSONB_COLUMNS:
-            ann = anns[col][i]
+            ann = display[i] if col == "display_attributes" else anns[col][i]
             values.append(None if ann is None else json.dumps(ann))
         values.append(int(alg[i]))
         yield values

@@ -35,6 +35,33 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 _CHROM_MIX = np.uint32(0x9E3779B9)  # decorrelate chromosomes in batch dedup
 
 
+def _pad_batch(batch: VariantBatch, n_target: int) -> VariantBatch:
+    """Pad to a fixed row count so jitted kernels see a bounded set of
+    shapes (variable chunk sizes would recompile the Pallas pipeline per
+    batch — tens of seconds each on TPU).  Pad rows: chrom 0 (never a real
+    code), position sentinel (sorts last, can't collide in dedup), 1-base
+    alleles."""
+    from annotatedvdb_tpu.utils.arrays import POS_SENTINEL
+
+    pad = n_target - batch.n
+    if pad <= 0:
+        return batch
+    return VariantBatch(
+        np.concatenate([batch.chrom, np.zeros(pad, batch.chrom.dtype)]),
+        np.concatenate(
+            [batch.pos, np.full(pad, POS_SENTINEL, batch.pos.dtype)]
+        ),
+        np.concatenate(
+            [batch.ref, np.zeros((pad, batch.width), batch.ref.dtype)]
+        ),
+        np.concatenate(
+            [batch.alt, np.zeros((pad, batch.width), batch.alt.dtype)]
+        ),
+        np.concatenate([batch.ref_len, np.ones(pad, batch.ref_len.dtype)]),
+        np.concatenate([batch.alt_len, np.ones(pad, batch.alt_len.dtype)]),
+    )
+
+
 class TpuVcfLoader:
     """Insert-or-skip VCF loads into a :class:`VariantStore`."""
 
@@ -50,6 +77,7 @@ class TpuVcfLoader:
         chromosome_map: dict | None = None,
         genome=None,
         mesh=None,
+        store_display_attributes: bool = False,
         log=print,
     ):
         """``genome``: optional
@@ -62,7 +90,13 @@ class TpuVcfLoader:
         then annotate through ``distributed_annotate_step`` (chromosome
         re-shard all_to_all + per-shard annotate + psum counters) with
         lossless capacity — the TPU replacement for the reference's
-        per-chromosome process pool (``load_vcf_file.py:307-313``)."""
+        per-chromosome process pool (``load_vcf_file.py:307-313``).
+
+        ``store_display_attributes``: display attributes are derivable from
+        the stored identity columns, so by default they are NOT materialized
+        at load time (the egress paths recompute them on demand —
+        ``io/pg_egress.py``); True restores the reference's store-everything
+        behavior (``createVariant.sql`` display_attributes column)."""
         self.store = store
         self.ledger = ledger
         self.datasource = datasource.lower() if datasource else None
@@ -87,6 +121,13 @@ class TpuVcfLoader:
             length_table(genome_build)
             if genome_build.lower() in BUILD_FILES else None
         )
+        self.store_display_attributes = store_display_attributes
+        from annotatedvdb_tpu.utils.profiling import StageTimer
+
+        #: per-stage wall-clock attribution (ingest/annotate/lookup/egress/
+        #: append/persist) — the observability the reference only has as
+        #: ad-hoc datetime pairs (``load_vcf_file.py:108-111,136-140``)
+        self.timer = StageTimer()
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0, "update": 0,
         }
@@ -132,7 +173,12 @@ class TpuVcfLoader:
                 width=self.store.width,
                 chromosome_map=self.chromosome_map,
             )
-            for chunk in reader:
+            chunks = iter(reader)
+            while True:
+                with self.timer.stage("ingest"):
+                    chunk = next(chunks, None)
+                if chunk is None:
+                    break
                 self.counters["line"] += chunk.counters.get("line", 0)
                 self.counters["skipped"] += chunk.counters.get("skipped_alt", 0)
                 self.counters["skipped"] += chunk.counters.get("skipped_contig", 0)
@@ -149,11 +195,13 @@ class TpuVcfLoader:
                     raise RuntimeError(f"failAt variant reached: {fail_at}")
                 self._load_chunk(chunk, alg_id, commit, resume_line, mapping_fh)
                 if commit:
-                    if persist is not None:
-                        persist()
-                    self.ledger.checkpoint(
-                        alg_id, path, int(chunk.line_number[-1]), dict(self.counters)
-                    )
+                    with self.timer.stage("persist"):
+                        if persist is not None:
+                            persist()
+                        self.ledger.checkpoint(
+                            alg_id, path, int(chunk.line_number[-1]),
+                            dict(self.counters),
+                        )
                 if test:
                     self.log("test mode: stopping after first batch")
                     break
@@ -164,6 +212,29 @@ class TpuVcfLoader:
         self.counters["alg_id"] = alg_id
         return dict(self.counters)
 
+    def warmup(self) -> None:
+        """Pre-compile the device kernels for this loader's padded batch
+        shape (first XLA/Pallas compile costs tens of seconds on TPU; a
+        steady-state load should not pay it mid-stream).  Optional — loads
+        work without it, the first chunk just compiles lazily."""
+        from annotatedvdb_tpu.io.synth import synthetic_batch
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        # chunks flush at >= batch_size (line-boundary overshoot), so padded
+        # shapes are next_pow2(batch_size) OR its double — compile both
+        p = next_pow2(self.batch_size)
+        for shape in {p, next_pow2(p + 1)}:
+            batch = synthetic_batch(shape, width=self.store.width)
+            ann = self._annotate(batch)
+            h = allele_hash_jit(
+                batch.ref, batch.alt, batch.ref_len, batch.alt_len
+            )
+            dup = mark_batch_duplicates_jit(
+                batch.pos, np.asarray(h), batch.ref, batch.alt,
+                batch.ref_len, batch.alt_len,
+            )
+            np.asarray(ann.variant_class), np.asarray(dup)
+
     def _annotate(self, batch: VariantBatch) -> AnnotatedBatch:
         """One annotate step: distributed over the mesh when present, else
         the fastest verified single-device kernel (Pallas on TPU)."""
@@ -173,6 +244,26 @@ class TpuVcfLoader:
                 batch.ref_len, batch.alt_len,
             )
         return self._annotate_distributed(batch)
+
+    def _fetch_annotations(self, ann_p, n: int, host_rows) -> AnnotatedBatch:
+        """Materialize annotate outputs on host, fetching only what the
+        store path consumes (bin columns + identity flags, ~7B/row) unless
+        display attributes are being stored (then everything, ~33B/row)."""
+        if self.store_display_attributes:
+            out = AnnotatedBatch(*(np.asarray(x)[:n] for x in ann_p))
+            return out._replace(host_fallback=host_rows)
+        zeros_i32 = np.zeros(n, np.int32)
+        return AnnotatedBatch(
+            prefix_len=zeros_i32, norm_ref_len=zeros_i32,
+            norm_alt_len=zeros_i32, end_location=zeros_i32,
+            location_start=zeros_i32, location_end=zeros_i32,
+            variant_class=np.zeros(n, np.int8),
+            is_dup_motif=np.zeros(n, np.bool_),
+            bin_level=np.asarray(ann_p.bin_level)[:n],
+            leaf_bin=np.asarray(ann_p.leaf_bin)[:n],
+            needs_digest=np.asarray(ann_p.needs_digest)[:n],
+            host_fallback=host_rows,
+        )
 
     def _annotate_distributed(self, batch: VariantBatch) -> AnnotatedBatch:
         """Mesh path: pad to a device multiple, run the sharded step with
@@ -187,21 +278,7 @@ class TpuVcfLoader:
         )
 
         n_dev = self.mesh.devices.size
-        pad = (-batch.n) % n_dev
-        padded = batch
-        if pad:
-            padded = VariantBatch(
-                np.concatenate([batch.chrom, np.zeros(pad, batch.chrom.dtype)]),
-                np.concatenate([batch.pos, np.zeros(pad, batch.pos.dtype)]),
-                np.concatenate(
-                    [batch.ref, np.zeros((pad, batch.width), batch.ref.dtype)]
-                ),
-                np.concatenate(
-                    [batch.alt, np.zeros((pad, batch.width), batch.alt.dtype)]
-                ),
-                np.concatenate([batch.ref_len, np.ones(pad, batch.ref_len.dtype)]),
-                np.concatenate([batch.alt_len, np.ones(pad, batch.alt_len.dtype)]),
-            )
+        padded = _pad_batch(batch, batch.n + (-batch.n) % n_dev)
         owner = position_block_owner(padded.chrom, padded.pos, n_dev)
         ann, rid, _counts, dropped, _n_fb = distributed_annotate_step(
             self.mesh, padded, owner=owner
@@ -214,9 +291,12 @@ class TpuVcfLoader:
         rid = np.asarray(rid)
         take = rid >= 0
         src = rid[take]
-        if src.size != batch.n:
+        # only chrom>0 rows come back (the input may itself carry pad rows
+        # from the pow2 shape bound; their outputs are sliced away upstream)
+        n_real = int((batch.chrom > 0).sum())
+        if src.size != n_real:
             raise RuntimeError(
-                f"row-id coverage {src.size} != batch size {batch.n}"
+                f"row-id coverage {src.size} != real row count {n_real}"
             )
         out = {}
         for field in AnnotatedBatch._fields:
@@ -244,42 +324,70 @@ class TpuVcfLoader:
                     f"{chunk.variant_id[i]}"
                 )
         # ---- device pipeline: annotate + bin + hash + in-batch dedup
-        ann = self._annotate(batch)
-        h = np.array(  # writable copy: long rows get re-hashed below
-            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
-        )
-        host_rows = np.asarray(ann.host_fallback)
-        # long alleles are truncated in the device arrays: re-hash them from
-        # the original strings so identity never collides on a shared prefix
-        for i in np.where(host_rows)[0]:
-            h[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
-        mixed = h ^ (batch.chrom.astype(np.uint32) * _CHROM_MIX)
-        dup = np.asarray(
-            mark_batch_duplicates_jit(
-                batch.pos, mixed, batch.ref, batch.alt, batch.ref_len, batch.alt_len
-            )
-        )
+        # (padded to pow2 so kernel shapes stay bounded across chunks; one
+        # device_put feeds all three kernels, and only the fields the host
+        # path consumes are fetched back — host<->device bytes are the load's
+        # bottleneck on remote-attached TPUs)
+        with self.timer.stage("annotate", items=batch.n):
+            from annotatedvdb_tpu.utils.arrays import next_pow2
+
+            n = batch.n
+            padded = _pad_batch(batch, next_pow2(n))
+            if self.mesh is not None:
+                ann_p = self._annotate_distributed(padded)
+                h_p = np.array(allele_hash_jit(
+                    padded.ref, padded.alt, padded.ref_len, padded.alt_len
+                ))
+                dev = None
+            else:
+                import jax
+
+                dev = tuple(jax.device_put(x) for x in padded)
+                ann_p = annotate_fn()(*dev)
+                h_dev = allele_hash_jit(dev[2], dev[3], dev[4], dev[5])
+                h_p = np.array(h_dev)
+            host_rows = np.asarray(ann_p.host_fallback)[:n]
+            # long alleles are truncated in the device arrays: re-hash them
+            # from the original strings so identity never collides on a
+            # shared prefix
+            for i in np.where(host_rows)[0]:
+                h_p[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
+            if dev is not None and not host_rows.any():
+                mixed_in = _mix_hash_jit(h_dev, dev[0])  # stays on device
+            else:
+                mixed_in = h_p ^ (padded.chrom.astype(np.uint32) * _CHROM_MIX)
+            src = padded if dev is None else dev
+            dup = np.asarray(
+                mark_batch_duplicates_jit(
+                    src[1], mixed_in, src[2], src[3], src[4], src[5]
+                )
+            )[:n]
+            h = h_p[:n]
+            ann = self._fetch_annotations(ann_p, n, host_rows)
         # replayed rows within a partially-committed chunk
         replay = chunk.line_number <= resume_line
 
         # ---- membership filtering first; egress strings only for inserts
         insert_rows: list[np.ndarray] = []
-        for code in np.unique(batch.chrom):
-            rows = np.where((batch.chrom == code) & ~dup & ~replay)[0]
-            if rows.size == 0:
-                continue
-            shard = self.store.shard(code)
-            if self.skip_existing and shard.n:
-                found, _ = shard.lookup(
-                    batch.pos[rows], h[rows], batch.ref[rows], batch.alt[rows],
-                    batch.ref_len[rows], batch.alt_len[rows],
-                )
-                self.counters["duplicates"] += int(found.sum())
-                rows = rows[~found]
-            if rows.size:
-                # sorted by identity key for the sorted-merge append
-                key = (batch.pos[rows].astype(np.uint64) << np.uint64(32)) | h[rows]
-                insert_rows.append(rows[np.argsort(key, kind="stable")])
+        with self.timer.stage("lookup", items=batch.n):
+            for code in np.unique(batch.chrom):
+                rows = np.where((batch.chrom == code) & ~dup & ~replay)[0]
+                if rows.size == 0:
+                    continue
+                shard = self.store.shard(code)
+                if self.skip_existing and shard.n:
+                    found, _ = shard.lookup(
+                        batch.pos[rows], h[rows], batch.ref[rows], batch.alt[rows],
+                        batch.ref_len[rows], batch.alt_len[rows],
+                    )
+                    self.counters["duplicates"] += int(found.sum())
+                    rows = rows[~found]
+                if rows.size:
+                    # sorted by identity key for the sorted-merge append
+                    key = (
+                        batch.pos[rows].astype(np.uint64) << np.uint64(32)
+                    ) | h[rows]
+                    insert_rows.append(rows[np.argsort(key, kind="stable")])
         self.counters["duplicates"] += int(dup.sum())
 
         if not insert_rows:
@@ -308,74 +416,115 @@ class TpuVcfLoader:
                     f"{n_bad} ref-allele mismatches vs genome, e.g. "
                     + ", ".join(chunk.variant_id[int(sel[j])] for j in bad)
                 )
-        pks = egress.primary_keys(sub, sub_ann, ref_snp, self.digester, refs, alts)
-        display = egress.display_attributes(sub, sub_ann, rs_pos, refs, alts)
-        # device bin outputs are undefined for host-fallback rows: recompute
-        bin_level = np.asarray(sub_ann.bin_level).copy()
-        leaf_bin = np.asarray(sub_ann.leaf_bin).copy()
-        for j in np.where(np.asarray(sub_ann.host_fallback))[0]:
-            end = oracle.infer_end_location(refs[j], alts[j], int(sub.pos[j]))
-            bin_level[j], leaf_bin[j] = closed_form_bin(int(sub.pos[j]), end)
-        sub_ann = sub_ann._replace(bin_level=bin_level, leaf_bin=leaf_bin)
-        bins = egress.bin_paths(sub, sub_ann)
-        needs_digest = np.asarray(sub_ann.needs_digest)
+        with self.timer.stage("egress", items=int(sel.size)):
+            needs_digest = np.asarray(sub_ann.needs_digest)
+            # the literal-PK bulk is needed only for the mapping sidecar;
+            # digest PKs (rare tail) are always needed — the store retains
+            # them as the row's record PK
+            pks = (
+                egress.primary_keys(sub, sub_ann, ref_snp, self.digester,
+                                    refs, alts)
+                if (mapping_fh is not None or needs_digest.any())
+                else None
+            )
+            # display attributes are derivable: built here only when the
+            # store-everything flag asks for them (see __init__)
+            display = (
+                egress.display_attributes(sub, sub_ann, rs_pos, refs, alts)
+                if self.store_display_attributes else None
+            )
+            # device bin outputs are undefined for host-fallback rows:
+            # recompute
+            bin_level = np.asarray(sub_ann.bin_level).copy()
+            leaf_bin = np.asarray(sub_ann.leaf_bin).copy()
+            for j in np.where(np.asarray(sub_ann.host_fallback))[0]:
+                end = oracle.infer_end_location(refs[j], alts[j], int(sub.pos[j]))
+                bin_level[j], leaf_bin[j] = closed_form_bin(int(sub.pos[j]), end)
+            sub_ann = sub_ann._replace(bin_level=bin_level, leaf_bin=leaf_bin)
+            bins = (
+                egress.bin_paths(sub, sub_ann) if mapping_fh is not None else None
+            )
 
         if commit:
-            offset = 0
-            for rows in insert_rows:
-                k = rows.size
-                j = slice(offset, offset + k)
-                jj = np.arange(offset, offset + k)
-                code = batch.chrom[rows[0]]
-                self.store.shard(code).append(
-                    {
-                        "pos": sub.pos[j],
-                        "h": h[rows],
-                        "ref_len": sub.ref_len[j],
-                        "alt_len": sub.alt_len[j],
-                        "ref_snp": np.array(
-                            [_rs_number(r) for r in ref_snp[j]], np.int64
-                        ),
-                        "is_multi_allelic": chunk.is_multi_allelic[rows],
-                        "is_adsp_variant": np.full(k, 1 if self.is_adsp else -1, np.int8),
-                        "bin_level": bin_level[jj],
-                        "leaf_bin": leaf_bin[jj],
-                        "needs_digest": needs_digest[jj],
-                        "row_algorithm_id": np.full(k, alg_id, np.int32),
-                    },
-                    sub.ref[j],
-                    sub.alt[j],
-                    annotations={
-                        "display_attributes": display[offset : offset + k],
-                        "allele_frequencies": [chunk.frequencies[i] for i in rows],
-                    },
-                    digest_pk=[
-                        pks[jx] if needs_digest[jx] else None for jx in jj
-                    ],
-                    # retain original strings for width-truncated rows: the
-                    # device arrays can't reconstruct them and later joins
-                    # (CADD) and VCF export need the exact alleles
-                    long_alleles=[
-                        (refs[jx], alts[jx])
-                        if (sub.ref_len[jx] > self.store.width
-                            or sub.alt_len[jx] > self.store.width)
-                        else None
-                        for jx in jj
-                    ],
-                )
-                offset += k
+            with self.timer.stage("append", items=int(sel.size)):
+                offset = 0
+                for rows in insert_rows:
+                    k = rows.size
+                    j = slice(offset, offset + k)
+                    jj = np.arange(offset, offset + k)
+                    code = batch.chrom[rows[0]]
+                    annotations = {
+                        "allele_frequencies": [
+                            chunk.frequencies[i] for i in rows
+                        ],
+                    }
+                    if display is not None:
+                        annotations["display_attributes"] = (
+                            display[offset:offset + k]
+                        )
+                    self.store.shard(code).append(
+                        {
+                            "pos": sub.pos[j],
+                            "h": h[rows],
+                            "ref_len": sub.ref_len[j],
+                            "alt_len": sub.alt_len[j],
+                            "ref_snp": np.array(
+                                [_rs_number(r) for r in ref_snp[j]], np.int64
+                            ),
+                            "is_multi_allelic": chunk.is_multi_allelic[rows],
+                            "is_adsp_variant": np.full(
+                                k, 1 if self.is_adsp else -1, np.int8
+                            ),
+                            "bin_level": bin_level[jj],
+                            "leaf_bin": leaf_bin[jj],
+                            "needs_digest": needs_digest[jj],
+                            "row_algorithm_id": np.full(k, alg_id, np.int32),
+                        },
+                        sub.ref[j],
+                        sub.alt[j],
+                        annotations=annotations,
+                        digest_pk=[
+                            pks[jx] if needs_digest[jx] else None for jx in jj
+                        ],
+                        # retain original strings for width-truncated rows:
+                        # the device arrays can't reconstruct them and later
+                        # joins (CADD) and VCF export need the exact alleles
+                        long_alleles=[
+                            (refs[jx], alts[jx])
+                            if (sub.ref_len[jx] > self.store.width
+                                or sub.alt_len[jx] > self.store.width)
+                            else None
+                            for jx in jj
+                        ],
+                    )
+                    offset += k
         self.counters["variant"] += int(sel.size)
 
         if mapping_fh is not None:
-            for j, i in enumerate(sel):
-                mapping_fh.write(
-                    json.dumps(
-                        {chunk.variant_id[i]: [
-                            {"primary_key": pks[j], "bin_index": bins[j]}
-                        ]}
+            with self.timer.stage("mapping", items=int(sel.size)):
+                for j, i in enumerate(sel):
+                    mapping_fh.write(
+                        json.dumps(
+                            {chunk.variant_id[i]: [
+                                {"primary_key": str(pks[j]),
+                                 "bin_index": str(bins[j])}
+                            ]}
+                        )
+                        + "\n"
                     )
-                    + "\n"
-                )
+
+
+def _mix_hash(h, chrom):
+    """Device-side chromosome mix for batch dedup (keeps the hash on device
+    when no long-allele host re-hash is needed)."""
+    import jax.numpy as jnp
+
+    return h ^ (chrom.astype(jnp.uint32) * _CHROM_MIX)
+
+
+import jax as _jax  # noqa: E402  (module-level jit of the tiny mix kernel)
+
+_mix_hash_jit = _jax.jit(_mix_hash)
 
 
 def _fnv32_str(ref: str, alt: str) -> np.uint32:

@@ -167,12 +167,24 @@ class TpuVepLoader:
         batch = batch._replace(
             chrom=np.array([r["chrom"] for r in rows], dtype=np.int8)
         )
-        ann = annotate_fn()(
-            batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
+        # pow2 padding bounds the set of compiled kernel shapes (batch row
+        # counts vary per flush; see vcf_loader._pad_batch)
+        from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
+        from annotatedvdb_tpu.types import AnnotatedBatch
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        n = batch.n
+        padded = _pad_batch(batch, next_pow2(n))
+        ann_p = annotate_fn()(
+            padded.chrom, padded.pos, padded.ref, padded.alt,
+            padded.ref_len, padded.alt_len,
         )
+        ann = AnnotatedBatch(*(np.asarray(x)[:n] for x in ann_p))
         h = np.array(
-            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
-        )
+            allele_hash_jit(
+                padded.ref, padded.alt, padded.ref_len, padded.alt_len
+            )
+        )[:n]
         prefix = np.asarray(ann.prefix_len)
         host = np.asarray(ann.host_fallback)
         from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str

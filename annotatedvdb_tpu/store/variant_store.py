@@ -83,13 +83,30 @@ _NUMERIC_COLUMNS = [
 # in place without invalidating lookups or device caches.
 _IDENTITY_COLUMNS = ("pos", "h", "ref_len", "alt_len")
 
-# Device-kernel lookup thresholds: below these, numpy wins on dispatch cost.
-DEVICE_SEGMENT_MIN = 1 << 15
+# Device-kernel lookup thresholds.  Below these, host numpy wins: the query
+# columns (~120B/row) must ship to the device per probe, so the kernel pays
+# off only once the segment is far too large for host cache-resident
+# searchsorted (and never on CPU backends — see _device_lookup_enabled).
+DEVICE_SEGMENT_MIN = 1 << 22
 DEVICE_QUERY_MIN = 1 << 12
 
-# Latch: flips False on the first device-lookup failure so a missing/broken
-# backend costs one attempt per process, not one per membership check.
-_DEVICE_LOOKUP_OK = True
+# Latch: None = not yet probed; flips False on a CPU-only backend (numpy
+# searchsorted beats per-shape XLA compiles there) or on the first
+# device-lookup failure, so a missing/broken backend costs one attempt per
+# process, not one per membership check.
+_DEVICE_LOOKUP_OK = None
+
+
+def _device_lookup_enabled() -> bool:
+    global _DEVICE_LOOKUP_OK
+    if _DEVICE_LOOKUP_OK is None:
+        try:
+            import jax
+
+            _DEVICE_LOOKUP_OK = jax.default_backend() not in ("cpu",)
+        except Exception:
+            _DEVICE_LOOKUP_OK = False
+    return _DEVICE_LOOKUP_OK
 
 
 def combined_key(pos: np.ndarray, h: np.ndarray) -> np.ndarray:
@@ -181,9 +198,9 @@ class Segment:
         global _DEVICE_LOOKUP_OK
         if self.n == 0:
             return np.zeros(pos.shape, np.bool_), np.full(pos.shape, -1, np.int32)
-        if (_DEVICE_LOOKUP_OK
-                and self.n >= DEVICE_SEGMENT_MIN
-                and pos.shape[0] >= DEVICE_QUERY_MIN):
+        if (self.n >= DEVICE_SEGMENT_MIN
+                and pos.shape[0] >= DEVICE_QUERY_MIN
+                and _device_lookup_enabled()):
             try:
                 return self._probe_device(pos, h, ref, alt, ref_len, alt_len)
             except Exception:

@@ -67,7 +67,7 @@ def test_qc_update_and_novel_insert(tmp_path):
     shard, i = find_row(store, 2, 500)
     assert shard.cols["is_adsp_variant"][i] == 1
     assert shard.annotations["adsp_qc"][i]["r4"]["qual"] == "99"
-    assert shard.annotations["display_attributes"][i] is not None  # full insert path
+    assert shard.cols["h"][i] != 0  # full insert path (identity hash assigned)
 
     # untouched row keeps NULL qc
     shard, i = find_row(store, 2, 100)

@@ -135,6 +135,32 @@ def test_incremental_save_is_flat(tmp_path):
     )
 
 
+def test_segment_device_probe_matches_numpy(monkeypatch):
+    """The device membership kernel path gives identical answers to the
+    numpy probe (forced on despite the CPU backend/thresholds)."""
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    monkeypatch.setattr(vs, "DEVICE_SEGMENT_MIN", 1)
+    monkeypatch.setattr(vs, "DEVICE_QUERY_MIN", 1)
+    monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", True)
+
+    store = VariantStore(width=WIDTH)
+    shard = store.shard(1)
+    for rows, ref, alt in _batches(2, 4096, seed=17):
+        shard.append(rows, ref, alt)
+    seg = shard.segments[0]
+    pos, h = seg.cols["pos"][::3], seg.cols["h"][::3]
+    ref, alt = seg.ref[::3], seg.alt[::3]
+    rl, al = seg.cols["ref_len"][::3], seg.cols["alt_len"][::3]
+    qkey = vs.combined_key(pos, h)
+    f_dev, i_dev = seg.probe(qkey, pos, h, ref, alt, rl, al)
+    monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", False)
+    f_np, i_np = seg.probe(qkey, pos, h, ref, alt, rl, al)
+    np.testing.assert_array_equal(f_dev, f_np)
+    np.testing.assert_array_equal(i_dev, i_np)
+    assert f_np.all()
+
+
 def test_append_interleaved_with_lookup(rng):
     """Membership answers stay exact across segment cascades."""
     from annotatedvdb_tpu.ops.hashing import allele_hash_jit

@@ -113,9 +113,8 @@ def test_tsv_insert_novel_metaseq(tmp_path):
     assert counters["inserted"] == 1
     shard, i = find_row(store, 2, 900)
     assert shard.annotations["other_annotation"][i] == {"src": "x"}
-    # full insert path ran: display attributes + bin index present
-    assert shard.annotations["display_attributes"][i] is not None
-    assert shard.cols["bin_level"][i] >= 0
+    # full insert path ran: identity hash assigned
+    assert shard.cols["h"][i] != 0
 
 
 def test_tsv_refsnp_lookup_and_not_found(tmp_path):

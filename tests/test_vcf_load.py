@@ -81,13 +81,18 @@ def test_load_commit_and_dedupe(tmp_path, vcf_file):
     # chromosome sharding: chr1 has 4 unique rows (TA>T, A>C, A>G, A>T)
     assert store.shard(1).n == 4
     assert store.shard(25).n == 1  # MT -> M
-    # display attributes stored and match the oracle
+    # display attributes are not materialized by default; the egress
+    # recompute must match the oracle row-for-row
+    from annotatedvdb_tpu.io.pg_egress import computed_display_attributes
+
     s = store.shard(2)
+    assert all(s.annotations["display_attributes"][i] is None for i in range(s.n))
+    display = computed_display_attributes(s, np.arange(s.n))
     for i in range(s.n):
         ref = bytes(s.ref[i][: s.cols["ref_len"][i]]).decode()
         alt = bytes(s.alt[i][: s.cols["alt_len"][i]]).decode()
         want = oracle.display_attributes(ref, alt, "2", int(s.cols["pos"][i]))
-        assert s.annotations["display_attributes"][i] == want
+        assert display[i] == want
     # mapping sidecar has PKs with refsnp suffixes
     mapping = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
     flat = {k: v for m in mapping for k, v in m.items()}
