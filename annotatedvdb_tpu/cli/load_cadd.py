@@ -79,18 +79,51 @@ def main(argv=None) -> int:
                     help="stop after one chromosome / first block")
     ap.add_argument("--updateExisting", action="store_true",
                     help="re-score variants that already have cadd_scores")
+    ap.add_argument("--buildIndex", action="store_true",
+                    help="build block-offset sidecar indexes for the score "
+                         "tables (enables --fileName random-access joins) "
+                         "and exit")
+    ap.add_argument("--randomAccess", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="join subsets via indexed seeks (default: auto when "
+                         "--fileName is given and indexes exist)")
     args = ap.parse_args(argv)
+
+    if args.buildIndex:
+        from annotatedvdb_tpu.io.cadd import (
+            CADD_INDEL_FILE, CADD_SNV_FILE, CaddIndex,
+        )
+
+        for fname in (CADD_SNV_FILE, CADD_INDEL_FILE):
+            path = os.path.join(args.databaseDir, fname)
+            if os.path.exists(path):
+                index = CaddIndex.build(path)
+                print(f"{path}: {index.pos.size} seek points")
+            else:
+                print(f"{path}: absent, skipped")
+        return 0
+
+    from annotatedvdb_tpu.utils.logging import load_logger
+
+    if args.fileName:
+        log, _logger, _lp = load_logger(args.fileName, "load-cadd")
+    else:
+        log, _logger, _lp = load_logger(
+            os.path.join(args.storeDir, "store"), "load-cadd"
+        )
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
     updater = TpuCaddUpdater(
-        store, ledger, args.databaseDir, skip_existing=not args.updateExisting
+        store, ledger, args.databaseDir,
+        skip_existing=not args.updateExisting, log=log,
     )
 
     subsets = vcf_subsets(updater, args.fileName) if args.fileName else None
     counters = updater.update_all(
         parse_chromosomes(args.chromosomes),
         commit=args.commit, test=args.test, subsets=subsets,
+        random_access=args.randomAccess,
     )
 
     if args.commit:

@@ -59,6 +59,12 @@ def main(argv=None):
                              "(single device), or a count — the mesh analog "
                              "of the reference's per-chromosome process pool "
                              "(load_vcf_file.py:270)")
+    parser.add_argument("--logAfter", type=int, default=None,
+                        help="log counters every N input lines (default: "
+                             "commitAfter, the reference's cadence)")
+    parser.add_argument("--logFilePath", default=None,
+                        help="log file (default: <fileName>-load-vcf.log "
+                             "beside the input, load_vcf_file.py:29-47)")
     args = parser.parse_args(argv)
 
     os.makedirs(args.storeDir, exist_ok=True)
@@ -98,6 +104,14 @@ def main(argv=None):
             mesh = make_mesh(want)
             print(f"annotating across {want} devices", file=sys.stderr)
 
+    from annotatedvdb_tpu.utils.logging import load_logger
+
+    log, _logger, log_path = load_logger(
+        args.fileName, "load-vcf", args.logFilePath
+    )
+    log(f"load_vcf {args.fileName} -> {args.storeDir} "
+        f"(commit={args.commit}, log={log_path})")
+
     loader = TpuVcfLoader(
         store,
         ledger,
@@ -108,7 +122,10 @@ def main(argv=None):
         skip_existing=args.skipExisting,
         chromosome_map=chrom_map,
         mesh=mesh,
-        log=lambda *a: print(*a, file=sys.stderr),
+        log=log,
+        # 0 disables progress lines; unset defaults to the commit cadence
+        log_after=(args.commitAfter if args.logAfter is None
+                   else (args.logAfter or None)),
     )
     counters = loader.load_file(
         args.fileName,
@@ -123,9 +140,10 @@ def main(argv=None):
     )
     if args.commit:
         store.save(args.storeDir)
-        print(f"COMMITTED {counters}", file=sys.stderr)
+        log(f"COMMITTED {counters}")
     else:
-        print(f"ROLLING BACK (dry run) {counters}", file=sys.stderr)
+        log(f"ROLLING BACK (dry run) {counters}")
+    log(f"stage breakdown: {loader.timer.summary()}")
     print(counters["alg_id"])  # undo handle, like load_vcf_file.py:220
     return 0
 

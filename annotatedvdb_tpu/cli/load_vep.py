@@ -39,7 +39,17 @@ def main(argv=None):
     parser.add_argument("--test", action="store_true")
     parser.add_argument("--skipExisting", action="store_true",
                         help="skip variants that already have vep_output")
+    parser.add_argument("--logAfter", type=int, default=None,
+                        help="log counters every N results")
+    parser.add_argument("--logFilePath", default=None,
+                        help="log file (default: <fileName>-load-vep.log)")
     args = parser.parse_args(argv)
+
+    from annotatedvdb_tpu.utils.logging import load_logger
+
+    log, _logger, _log_path = load_logger(
+        args.fileName, "load-vep", args.logFilePath
+    )
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
@@ -52,14 +62,15 @@ def main(argv=None):
         store, ledger, ranker,
         datasource=args.datasource,
         skip_existing=args.skipExisting,
-        log=lambda *a: print(*a, file=sys.stderr),
+        log=log,
+        log_after=args.logAfter,
     )
     counters = loader.load_file(args.fileName, commit=args.commit, test=args.test)
     if args.commit:
         store.save(args.storeDir)
-        print(f"COMMITTED {counters}", file=sys.stderr)
+        log(f"COMMITTED {counters}")
     else:
-        print(f"ROLLING BACK (dry run) {counters}", file=sys.stderr)
+        log(f"ROLLING BACK (dry run) {counters}")
     print(counters["alg_id"])
     return 0
 

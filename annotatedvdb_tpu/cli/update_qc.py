@@ -33,7 +33,15 @@ def main(argv=None) -> int:
     ap.add_argument("--chromosomeMap")
     ap.add_argument("--commit", action="store_true")
     ap.add_argument("--test", action="store_true")
+    ap.add_argument("--logAfter", type=int, default=None,
+                    help="log counters every N input lines")
+    ap.add_argument("--logFilePath", default=None,
+                    help="log file (default: <fileName>-update-qc.log)")
     args = ap.parse_args(argv)
+
+    from annotatedvdb_tpu.utils.logging import load_logger
+
+    log, _logger, _log_path = load_logger(args.fileName, "update-qc", args.logFilePath)
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
@@ -44,6 +52,8 @@ def main(argv=None) -> int:
         chromosome_map=(
             read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
         ),
+        log=log,
+        log_after=args.logAfter,
     )
     counters = loader.load_file(
         args.fileName, commit=args.commit, test=args.test,

@@ -37,7 +37,15 @@ def main(argv=None) -> int:
                     help="skip known variants instead of updating them")
     ap.add_argument("--commit", action="store_true")
     ap.add_argument("--test", action="store_true")
+    ap.add_argument("--logAfter", type=int, default=None,
+                    help="log counters every N input lines")
+    ap.add_argument("--logFilePath", default=None,
+                    help="log file (default: <fileName>-update-annotation.log)")
     args = ap.parse_args(argv)
+
+    from annotatedvdb_tpu.utils.logging import load_logger
+
+    log, _logger, _log_path = load_logger(args.fileName, "update-annotation", args.logFilePath)
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
@@ -47,6 +55,8 @@ def main(argv=None) -> int:
         datasource=args.datasource,
         update_existing=not args.skipExisting,
         skip_existing=args.skipExisting,
+        log=log,
+        log_after=args.logAfter,
     )
     counters = loader.load_file(
         args.fileName, commit=args.commit, test=args.test,

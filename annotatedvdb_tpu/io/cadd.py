@@ -23,6 +23,7 @@ mitochondrial chromosome ``MT`` where the store uses ``M``
 from __future__ import annotations
 
 import gzip
+import os
 from typing import Iterator
 
 import numpy as np
@@ -33,6 +34,179 @@ from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, next_pow2
 # Canonical file names from the CADD distribution (cadd_updater.py:21-22).
 CADD_SNV_FILE = "whole_genome_SNVs.tsv.gz"
 CADD_INDEL_FILE = "gnomad.genomes.r3.0.indel.tsv.gz"
+
+INDEX_SUFFIX = ".avdx.npz"
+
+
+class _PlainRandomReader:
+    """seek/readline over an uncompressed TSV (offsets are byte offsets)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "rb")
+        self.bytes_read = 0
+
+    def seek(self, offset: int) -> None:
+        self._fh.seek(offset)
+
+    def tell(self) -> int:
+        return self._fh.tell()
+
+    def readline(self) -> bytes:
+        line = self._fh.readline()
+        self.bytes_read += len(line)
+        return line
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def open_random(path: str):
+    """Random-access reader for a score table: BGZF (the CADD distribution
+    format) or plain text.  Single-member gzip cannot be seeked — re-compress
+    with :func:`annotatedvdb_tpu.io.bgzf.compress_to_bgzf`."""
+    from annotatedvdb_tpu.io.bgzf import BgzfReader, is_bgzf
+
+    if is_bgzf(path):
+        return BgzfReader(path)
+    if path.endswith(".gz"):
+        raise ValueError(
+            f"{path}: plain gzip is not seekable; re-compress with "
+            "annotatedvdb_tpu.io.bgzf.compress_to_bgzf (the real CADD "
+            "distribution is already BGZF)"
+        )
+    return _PlainRandomReader(path)
+
+
+class CaddIndex:
+    """Block-offset sidecar enabling O(log n) position seeks into a score
+    table — the tabix-index equivalent (``cadd_updater.py:167-184`` does one
+    ``pysam`` fetch per variant; here one ``build`` pass writes
+    ``<table>.avdx.npz`` and ``fetch`` binary-searches it).
+
+    The index records (chromosome, position, virtual offset) every
+    ``stride`` data lines plus at every chromosome change; a fetch seeks to
+    the last entry at-or-before the wanted position and scans forward."""
+
+    def __init__(self, chrom: np.ndarray, pos: np.ndarray,
+                 voffset: np.ndarray, stride: int):
+        self.chrom = chrom
+        self.pos = pos
+        self.voffset = voffset
+        self.stride = stride
+        # composite sort key for the binary search
+        self._key = (chrom.astype(np.int64) << np.int64(32)) | pos.astype(
+            np.int64
+        )
+
+    @staticmethod
+    def path_for(table_path: str) -> str:
+        return table_path + INDEX_SUFFIX
+
+    @classmethod
+    def build(cls, table_path: str, stride: int = 4096) -> "CaddIndex":
+        """One sequential pass recording seek points; writes the sidecar."""
+        chroms, positions, offsets = [], [], []
+        with open_random(table_path) as reader:
+            reader.seek(0)
+            n_since, last_code = stride, None
+            while True:
+                voff = reader.tell()
+                line = reader.readline()
+                if not line:
+                    break
+                if line.startswith(b"#"):
+                    continue
+                fields = line.split(b"\t", 2)
+                if len(fields) < 3:
+                    continue
+                code = chromosome_code(fields[0].decode())
+                if code == 0:
+                    continue
+                n_since += 1
+                if code != last_code or n_since >= stride:
+                    chroms.append(code)
+                    positions.append(int(fields[1]))
+                    offsets.append(voff)
+                    n_since = 0
+                    last_code = code
+        index = cls(
+            np.array(chroms, np.int8), np.array(positions, np.int32),
+            np.array(offsets, np.int64), stride,
+        )
+        # the binary search silently requires (chrom_code, pos)-sorted input
+        # — refuse unsorted tables at build time like tabix does, instead of
+        # writing {} placeholders for every variant at update time
+        if not np.all(np.diff(index._key) >= 0):
+            i = int(np.argmin(np.diff(index._key) >= 0))
+            raise ValueError(
+                f"{table_path}: not sorted by (chromosome, position) around "
+                f"chr{index.chrom[i + 1]}:{index.pos[i + 1]} — sort the table "
+                "(chromosomes in 1..22,X,Y,M order) before indexing"
+            )
+        np.savez_compressed(
+            cls.path_for(table_path),
+            chrom=index.chrom, pos=index.pos, voffset=index.voffset,
+            stride=np.int64(stride),
+            table_size=np.int64(os.path.getsize(table_path)),
+        )
+        return index
+
+    @classmethod
+    def load(cls, table_path: str) -> "CaddIndex | None":
+        """Load the sidecar; None when absent or stale (table re-written)."""
+        sidecar = cls.path_for(table_path)
+        if not os.path.exists(sidecar):
+            return None
+        data = np.load(sidecar)
+        if int(data["table_size"]) != os.path.getsize(table_path):
+            return None  # table changed since indexing
+        return cls(
+            data["chrom"], data["pos"], data["voffset"], int(data["stride"])
+        )
+
+    def seek_point(self, chrom_code: int, pos: int) -> int:
+        """Virtual offset of the last index entry STRICTLY before
+        (chrom, pos) — an entry can land mid-run at a position, so seeking
+        to an at-position entry could skip that site's earlier rows.  Falls
+        back to the table start when nothing precedes (the forward scan's
+        early break bounds the cost)."""
+        key = (np.int64(chrom_code) << np.int64(32)) | np.int64(pos)
+        i = int(np.searchsorted(self._key, key, side="left")) - 1
+        return 0 if i < 0 else int(self.voffset[i])
+
+    def fetch(self, reader, chrom_code: int, pos: int) -> list:
+        """Score rows exactly at (chrom, pos): [(ref, alt, raw, phred), ...]
+        in file order — the reference's ``match`` fetch
+        (``cadd_updater.py:175-184``)."""
+        out: list = []
+        reader.seek(self.seek_point(chrom_code, pos))
+        while True:
+            line = reader.readline()
+            if not line:
+                break
+            if line.startswith(b"#"):
+                continue
+            fields = line.rstrip(b"\n").split(b"\t")
+            if len(fields) < 6:
+                continue
+            code = chromosome_code(fields[0].decode())
+            p = int(fields[1])
+            if code == chrom_code and p > pos:
+                break
+            if code > chrom_code:
+                break
+            if code == chrom_code and p == pos:
+                out.append(
+                    (fields[2].decode(), fields[3].decode(),
+                     float(fields[4]), float(fields[5]))
+                )
+        return out
 
 
 class CaddBlock:

@@ -89,14 +89,23 @@ class TpuCaddUpdater:
 
     def update_all(self, chromosomes=None, commit: bool = False,
                    test: bool = False,
-                   subsets: dict[int, np.ndarray] | None = None) -> dict:
+                   subsets: dict[int, np.ndarray] | None = None,
+                   random_access: bool | None = None) -> dict:
         """Update every (or the given) chromosome in one pass per table.
 
         ``subsets`` maps chromosome code -> shard row indices and restricts
         the update to those rows — the ``--fileName`` mode of the reference
         driver (``load_cadd_scores.py:180-257`` updates only a VCF's
         variants).  When both ``chromosomes`` and ``subsets`` are given, the
-        intersection applies."""
+        intersection applies.
+
+        ``random_access``: with a subset and a block-offset sidecar
+        (``io.cadd.CaddIndex``), candidate rows are joined via O(log n)
+        seeks into the score table instead of a sequential whole-table pass
+        — the tabix-fetch equivalent (``cadd_updater.py:167-184``); a
+        1k-variant update then reads KBs, not the ~80GB SNV table.  None
+        (default) auto-enables when a subset is given and every table has a
+        current index; True requires it (raising if an index is missing)."""
         if chromosomes:
             codes = [_resolve_code(c) for c in chromosomes]
             codes = [c for c in codes if c in self.store.shards]
@@ -132,6 +141,34 @@ class TpuCaddUpdater:
             )
             for code in codes
         }
+        if random_access and subsets is None:
+            # whole-store random access would do one Python fetch per variant
+            # — orders of magnitude worse than the sequential pass
+            raise ValueError(
+                "random_access requires a variant subset (--fileName); "
+                "whole-store updates use the sequential table pass"
+            )
+        if random_access or (random_access is None and subsets is not None):
+            from annotatedvdb_tpu.io.cadd import CaddIndex
+
+            indexes = {
+                path: CaddIndex.load(path)
+                for _, path, _ in self._tables() if os.path.exists(path)
+            }
+            if all(ix is not None for ix in indexes.values()) and indexes:
+                self._update_random_access(
+                    codes, candidates, indexes, commit, test
+                )
+                self.ledger.finish(alg_id, dict(self.counters))
+                self.counters["alg_id"] = alg_id
+                return dict(self.counters)
+            if random_access:
+                missing = [p for p, ix in indexes.items() if ix is None]
+                raise ValueError(
+                    "random_access requires a current block-offset index for "
+                    f"every table; missing/stale: {missing or 'all tables'} "
+                    "(build with load_cadd --buildIndex)"
+                )
         for kind, path, probe in self._tables():
             states: dict[int, _ChromState] = {}
             for code in codes:
@@ -182,6 +219,68 @@ class TpuCaddUpdater:
             (shard.cols["ref_len"][rows] > 1) | (shard.cols["alt_len"][rows] > 1)
         )
         return {"snv": rows[~is_indel], "indel": rows[is_indel]}
+
+    def _update_random_access(self, codes, candidates, indexes, commit,
+                              test: bool = False) -> None:
+        """Subset join via indexed seeks: per candidate row, fetch the score
+        rows at its position and allele-set match, first match wins
+        (``cadd_updater.py:187-221`` semantics); unmatched rows get the
+        ``{}`` placeholder.  Candidates are position-sorted, so consecutive
+        fetches hit the reader's block cache.  ``test`` samples only the
+        first 100 candidates of the first non-empty selection (the
+        sequential path's stop-after-first-block analog; unexamined rows
+        are left untouched, never placeheld)."""
+        from annotatedvdb_tpu.io.cadd import CaddIndex, open_random
+
+        bytes_read = 0
+        stop = False
+        for kind, path, _probe in self._tables():
+            if stop:
+                break
+            index = indexes.get(path)
+            if index is None:
+                continue
+            with open_random(path) as reader:
+                for code in codes:
+                    sel = candidates[code][kind]
+                    if sel.size == 0:
+                        continue
+                    if test:
+                        sel = sel[:100]
+                        stop = True
+                    shard = self.store.shard(code)
+                    matched = np.zeros(sel.shape, bool)
+                    raw = np.zeros(sel.shape, np.float64)
+                    phred = np.zeros(sel.shape, np.float64)
+                    for j, row in enumerate(sel):
+                        row = int(row)
+                        pos = int(shard.cols["pos"][row])
+                        ref, alt = shard.alleles(row)
+                        for s_ref, s_alt, s_raw, s_phred in index.fetch(
+                                reader, code, pos):
+                            # allele-set membership, first match wins
+                            if ref in (s_ref, s_alt) and alt in (s_ref, s_alt):
+                                matched[j] = True
+                                raw[j], phred[j] = s_raw, s_phred
+                                break
+                    evidence = [
+                        {"CADD_raw_score": float(raw[j]),
+                         "CADD_phred": float(phred[j])}
+                        if matched[j] else {}
+                        for j in range(sel.size)
+                    ]
+                    n_matched = int(matched.sum())
+                    self.counters[kind] += n_matched
+                    self.counters["update"] += n_matched
+                    self.counters["not_matched"] += int(sel.size) - n_matched
+                    if commit:
+                        shard.update_annotation(
+                            sel, "cadd_scores", evidence, merge=False
+                        )
+                    if stop:
+                        break
+                bytes_read += reader.bytes_read
+        self.counters["bytes_read"] = bytes_read
 
     def _join_block(self, state: _ChromState, shard, block, probe: int) -> None:
         vlo = np.searchsorted(state.pos, block.min_pos, side="left")

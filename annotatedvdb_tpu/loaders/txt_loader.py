@@ -112,6 +112,7 @@ class TpuTextLoader:
         skip_existing: bool = False,
         batch_size: int = 1 << 15,
         log=print,
+        log_after: int | None = None,
     ):
         if variant_id_type not in VARIANT_ID_TYPES:
             raise ValueError(f"variant_id_type must be one of {VARIANT_ID_TYPES}")
@@ -123,6 +124,9 @@ class TpuTextLoader:
         self.skip_existing = skip_existing
         self.batch_size = batch_size
         self.log = log
+        from annotatedvdb_tpu.utils.logging import ProgressCadence
+
+        self._cadence = ProgressCadence(log, log_after)
         self.insert_loader = TpuVcfLoader(
             store, ledger, datasource=datasource, skip_existing=False, log=log
         )
@@ -163,6 +167,7 @@ class TpuTextLoader:
                     self.counters["skipped"] += 1
                     continue
                 pending.append((line_no, row))
+                self._cadence.maybe_log(self.counters["line"], self.counters)
                 if len(pending) >= self.batch_size:
                     self._apply_batch(pending, alg_id, commit)
                     if commit:

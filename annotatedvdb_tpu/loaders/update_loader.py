@@ -61,6 +61,7 @@ class TpuUpdateLoader:
         batch_size: int = 1 << 15,
         chromosome_map: dict | None = None,
         log=print,
+        log_after: int | None = None,
         insert_loader: TpuVcfLoader | None = None,
     ):
         self.store = store
@@ -69,6 +70,9 @@ class TpuUpdateLoader:
         self.batch_size = batch_size
         self.chromosome_map = chromosome_map
         self.log = log
+        from annotatedvdb_tpu.utils.logging import ProgressCadence
+
+        self._cadence = ProgressCadence(log, log_after)
         self.insert_loader = insert_loader or TpuVcfLoader(
             store, ledger, datasource=datasource, skip_existing=False,
             log=log,
@@ -106,6 +110,7 @@ class TpuUpdateLoader:
                 self.counters["skipped"] += chunk.batch.n
                 continue
             self._apply_chunk(chunk, alg_id, commit)
+            self._cadence.maybe_log(self.counters["line"], self.counters)
             if commit:
                 if persist is not None:
                     persist()

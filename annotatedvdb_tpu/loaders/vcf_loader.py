@@ -79,6 +79,7 @@ class TpuVcfLoader:
         mesh=None,
         store_display_attributes: bool = False,
         log=print,
+        log_after: int | None = None,
     ):
         """``genome``: optional
         :class:`~annotatedvdb_tpu.genome.ReferenceGenome`; enables batched
@@ -122,7 +123,12 @@ class TpuVcfLoader:
             if genome_build.lower() in BUILD_FILES else None
         )
         self.store_display_attributes = store_display_attributes
+        # counters + stage rates every N input lines (the reference's
+        # --logAfter cadence, ``load_vcf_file.py:29-47``); None = quiet
+        from annotatedvdb_tpu.utils.logging import ProgressCadence
         from annotatedvdb_tpu.utils.profiling import StageTimer
+
+        self._cadence = ProgressCadence(self.log, log_after)
 
         #: per-stage wall-clock attribution (ingest/annotate/lookup/egress/
         #: append/persist) — the observability the reference only has as
@@ -194,6 +200,7 @@ class TpuVcfLoader:
                 if fail_at is not None and fail_at in chunk.variant_id:
                     raise RuntimeError(f"failAt variant reached: {fail_at}")
                 self._load_chunk(chunk, alg_id, commit, resume_line, mapping_fh)
+                self._log_progress()
                 if commit:
                     with self.timer.stage("persist"):
                         if persist is not None:
@@ -211,6 +218,11 @@ class TpuVcfLoader:
                 mapping_fh.close()
         self.counters["alg_id"] = alg_id
         return dict(self.counters)
+
+    def _log_progress(self) -> None:
+        self._cadence.maybe_log(
+            self.counters["line"], self.counters, self.timer.summary()
+        )
 
     def warmup(self) -> None:
         """Pre-compile the device kernels for this loader's padded batch

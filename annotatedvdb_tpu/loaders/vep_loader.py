@@ -52,6 +52,7 @@ class TpuVepLoader:
         skip_existing: bool = False,
         batch_size: int = 1 << 14,
         log=print,
+        log_after: int | None = None,
     ):
         self.store = store
         self.ledger = ledger
@@ -60,6 +61,9 @@ class TpuVepLoader:
         self.skip_existing = skip_existing
         self.batch_size = batch_size
         self.log = log
+        from annotatedvdb_tpu.utils.logging import ProgressCadence
+
+        self._cadence = ProgressCadence(log, log_after, unit="results")
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0,
             "update": 0, "not_found": 0,
@@ -94,6 +98,7 @@ class TpuVepLoader:
             if pending:
                 self._apply_batch(pending, alg_id, commit)
             raw.clear()
+            self._cadence.maybe_log(self.counters["line"], self.counters)
 
         for line in _open_text(path):
             if not line.strip():

@@ -23,16 +23,6 @@ import jax
 import jax.numpy as jnp
 
 
-def sort_by_identity(pos, h, *payload):
-    """Sort rows by (pos, hash) lexicographically; returns sorted
-    (pos, hash, *payload).  Payload arrays must be rank-1 or rank-2 [N, W]."""
-    # lax.sort requires rank-1 operands; carry row index and gather payload.
-    idx = jnp.arange(pos.shape[0], dtype=jnp.int32)
-    pos_s, h_s, idx_s = jax.lax.sort((pos, h, idx), num_keys=2)
-    out = [x[idx_s] for x in payload]
-    return (pos_s, h_s, idx_s, *out)
-
-
 def mark_batch_duplicates(pos, h, ref, alt, ref_len, alt_len):
     """Flag rows that duplicate an earlier row in the batch.
 

@@ -1,0 +1,72 @@
+"""Per-input load logs + cadence, mirroring the reference's operational
+logging (``Load/bin/load_vcf_file.py:29-47``): every load writes
+``<input>-<tag>.log`` beside its input file, messages mirror to stderr, a
+CRITICAL record kills the process (the reference's
+``ExitOnCriticalExceptionHandler``), and loaders emit counter lines every
+``--logAfter`` input lines (default = the commit batch size).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+class ProgressCadence:
+    """Counter-line emission every N input lines — the one implementation
+    of the ``--logAfter`` cadence shared by all loaders."""
+
+    def __init__(self, log, log_after: int | None, unit: str = "lines"):
+        self.log = log
+        self.log_after = log_after
+        self.unit = unit
+        self._next = log_after or 0
+
+    def maybe_log(self, n_lines: int, counters: dict, extra: str = "") -> None:
+        if self.log_after and n_lines >= self._next:
+            self.log(
+                f"PARSED {n_lines:,} {self.unit}; counters {counters}"
+                + (f" | {extra}" if extra else "")
+            )
+            self._next = n_lines + self.log_after
+
+
+class ExitOnCriticalHandler(logging.StreamHandler):
+    """Stderr mirror that terminates the process on CRITICAL — a load must
+    not keep streaming batches after an unrecoverable error
+    (``load_vcf_file.py:18,35-40``)."""
+
+    def emit(self, record):
+        super().emit(record)
+        if record.levelno >= logging.CRITICAL:
+            raise SystemExit(1)
+
+
+def load_logger(input_path: str, tag: str,
+                log_path: str | None = None) -> tuple:
+    """(log callable, logger, log file path) for one input file.
+
+    ``log`` accepts print-style positional args so it drops into the
+    loaders' existing ``log=`` parameter."""
+    if log_path is None:
+        log_path = f"{input_path}-{tag}.log"
+    name = f"avdb.{tag}.{os.path.abspath(input_path)}"
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    for h in list(logger.handlers):  # re-runs in one process: no dup handlers
+        logger.removeHandler(h)
+        h.close()
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+    fh = logging.FileHandler(log_path)
+    fh.setFormatter(fmt)
+    logger.addHandler(fh)
+    eh = ExitOnCriticalHandler(sys.stderr)
+    eh.setFormatter(fmt)
+    logger.addHandler(eh)
+
+    def log(*args) -> None:
+        logger.info(" ".join(str(a) for a in args))
+
+    return log, logger, log_path

@@ -1,0 +1,80 @@
+"""Operational-logging parity: per-input log files + --logAfter cadence
+(reference ``load_vcf_file.py:29-47``)."""
+
+import logging
+import subprocess
+import sys
+
+import pytest
+
+from annotatedvdb_tpu.utils.logging import (
+    ExitOnCriticalHandler,
+    load_logger,
+)
+
+
+def test_load_logger_writes_per_input_file(tmp_path):
+    inp = tmp_path / "x.vcf"
+    inp.write_text("")
+    log, logger, path = load_logger(str(inp), "load-vcf")
+    assert path == str(inp) + "-load-vcf.log"
+    log("hello", 42)
+    log("world")
+    content = (tmp_path / "x.vcf-load-vcf.log").read_text()
+    assert "hello 42" in content and "world" in content
+    # re-opening for the same input must not duplicate handlers
+    log2, logger2, _ = load_logger(str(inp), "load-vcf")
+    log2("once")
+    assert (tmp_path / "x.vcf-load-vcf.log").read_text().count("once") == 1
+
+
+def test_critical_exits(tmp_path, capsys):
+    _, logger, _ = load_logger(str(tmp_path / "y.vcf"), "t")
+    with pytest.raises(SystemExit):
+        logger.critical("fatal parse state")
+
+
+def test_log_after_cadence(tmp_path):
+    """The loader emits counter lines every logAfter input lines."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for i in range(100):
+        lines.append(f"1\t{1000 + i * 10}\t.\tA\tG\t.\t.\t.")
+    vcf = tmp_path / "c.vcf"
+    vcf.write_text("\n".join(lines) + "\n")
+
+    logs = []
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "l.jsonl"))
+    loader = TpuVcfLoader(
+        store, ledger, batch_size=20, log=lambda *a: logs.append(" ".join(map(str, a))),
+        log_after=20,
+    )
+    loader.load_file(str(vcf), commit=True)
+    progress = [m for m in logs if m.startswith("PARSED")]
+    # 100 lines / cadence 20 -> ~5 progress lines with counters + stage rates
+    assert 4 <= len(progress) <= 6
+    assert "counters" in progress[0] and "annotate" in progress[0]
+
+
+def test_cli_writes_log_file(tmp_path):
+    vcf = tmp_path / "in.vcf"
+    vcf.write_text(
+        "##fileformat=VCFv4.2\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "1\t100\t.\tA\tG\t.\t.\t.\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.load_vcf",
+         "--fileName", str(vcf), "--storeDir", str(tmp_path / "vdb"),
+         "--commit", "--logAfter", "1"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr
+    log_file = tmp_path / "in.vcf-load-vcf.log"
+    assert log_file.exists()
+    content = log_file.read_text()
+    assert "COMMITTED" in content and "stage breakdown" in content
