@@ -87,6 +87,8 @@ def main(argv=None) -> int:
                     default=None,
                     help="join subsets via indexed seeks (default: auto when "
                          "--fileName is given and indexes exist)")
+    ap.add_argument("--logFilePath", default=None,
+                    help="log file (default: beside --fileName or the store)")
     args = ap.parse_args(argv)
 
     if args.buildIndex:
@@ -106,10 +108,13 @@ def main(argv=None) -> int:
     from annotatedvdb_tpu.utils.logging import load_logger
 
     if args.fileName:
-        log, _logger, _lp = load_logger(args.fileName, "load-cadd")
+        log, _logger, _lp = load_logger(
+            args.fileName, "load-cadd", args.logFilePath
+        )
     else:
         log, _logger, _lp = load_logger(
-            os.path.join(args.storeDir, "store"), "load-cadd"
+            os.path.join(args.storeDir, "store"), "load-cadd",
+            args.logFilePath,
         )
 
     store = VariantStore.load(args.storeDir)

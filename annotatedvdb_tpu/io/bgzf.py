@@ -71,6 +71,7 @@ class BgzfReader:
         self._cache_order: list[int] = []
         self._cache_blocks = cache_blocks
         self._coffset = 0
+        self._next = 0  # fresh readers stream from the first block
         self._block: bytes = b""
         self._within = 0
         #: compressed bytes actually read (tests assert subset updates
@@ -182,7 +183,12 @@ class BgzfWriter:
         cdata = co.compress(data) + co.flush()
         bsize = len(cdata) + 12 + 6 + 8  # header(12) + BC extra(6) + crc/isize
         if bsize > 0x10000:
-            raise ValueError("incompressible block exceeds BGZF limit")
+            # incompressible window: deflate expanded past the 64KB member
+            # limit — halve and retry (htslib caps + retries the same way)
+            half = len(data) // 2
+            self._flush_block(data[:half])
+            self._flush_block(data[half:])
+            return
         header = _BGZF_HEADER_START + b"\x00\x00\x00\x00\x00\xff" + struct.pack(
             "<H", 6
         ) + b"BC" + struct.pack("<HH", 2, bsize - 1)

@@ -114,7 +114,7 @@ class CaddIndex:
         chroms, positions, offsets = [], [], []
         with open_random(table_path) as reader:
             reader.seek(0)
-            n_since, last_code = stride, None
+            n_since, last_code, last_key = stride, None, -1
             while True:
                 voff = reader.tell()
                 line = reader.readline()
@@ -128,10 +128,23 @@ class CaddIndex:
                 code = chromosome_code(fields[0].decode())
                 if code == 0:
                     continue
+                pos = int(fields[1])
+                # the binary search + forward scan silently require sorted
+                # input — refuse disorder at build time (every line is read
+                # here anyway), like tabix, instead of writing {}
+                # placeholders for skipped rows at update time
+                key = (code << 32) | pos
+                if key < last_key:
+                    raise ValueError(
+                        f"{table_path}: not sorted by (chromosome, position) "
+                        f"at chr{code}:{pos} — sort the table (chromosomes "
+                        "in 1..22,X,Y,M order) before indexing"
+                    )
+                last_key = key
                 n_since += 1
                 if code != last_code or n_since >= stride:
                     chroms.append(code)
-                    positions.append(int(fields[1]))
+                    positions.append(pos)
                     offsets.append(voff)
                     n_since = 0
                     last_code = code
@@ -139,16 +152,6 @@ class CaddIndex:
             np.array(chroms, np.int8), np.array(positions, np.int32),
             np.array(offsets, np.int64), stride,
         )
-        # the binary search silently requires (chrom_code, pos)-sorted input
-        # — refuse unsorted tables at build time like tabix does, instead of
-        # writing {} placeholders for every variant at update time
-        if not np.all(np.diff(index._key) >= 0):
-            i = int(np.argmin(np.diff(index._key) >= 0))
-            raise ValueError(
-                f"{table_path}: not sorted by (chromosome, position) around "
-                f"chr{index.chrom[i + 1]}:{index.pos[i + 1]} — sort the table "
-                "(chromosomes in 1..22,X,Y,M order) before indexing"
-            )
         np.savez_compressed(
             cls.path_for(table_path),
             chrom=index.chrom, pos=index.pos, voffset=index.voffset,

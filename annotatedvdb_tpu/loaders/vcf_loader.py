@@ -180,37 +180,70 @@ class TpuVcfLoader:
                 chromosome_map=self.chromosome_map,
             )
             chunks = iter(reader)
-            while True:
+            # double-buffered pipeline: chunk k+1's device work (annotate +
+            # hash + dedup, all async under jax) is dispatched before chunk
+            # k's host-side processing forces its results — the host store
+            # work overlaps device compute and transfers (the host<->device
+            # pipeline SURVEY §2.4 maps libpq batching onto).  Counter
+            # deltas travel WITH their chunk and apply at process time, so
+            # checkpoints never count a chunk that has not committed.
+            pending: tuple | None = None
+            stop = False
+            while not stop:
                 with self.timer.stage("ingest"):
                     chunk = next(chunks, None)
-                if chunk is None:
-                    break
-                self.counters["line"] += chunk.counters.get("line", 0)
-                self.counters["skipped"] += chunk.counters.get("skipped_alt", 0)
-                self.counters["skipped"] += chunk.counters.get("skipped_contig", 0)
-                self.counters["malformed"] = (
-                    self.counters.get("malformed", 0)
-                    + chunk.counters.get("malformed", 0)
-                )
-                if chunk.batch.n == 0:  # trailing counters-only chunk
-                    continue
-                if resume_line and chunk.line_number[-1] <= resume_line:
-                    self.counters["skipped"] += chunk.batch.n
-                    continue
-                if fail_at is not None and fail_at in chunk.variant_id:
-                    raise RuntimeError(f"failAt variant reached: {fail_at}")
-                self._load_chunk(chunk, alg_id, commit, resume_line, mapping_fh)
-                self._log_progress()
-                if commit:
-                    with self.timer.stage("persist"):
-                        if persist is not None:
-                            persist()
-                        self.ledger.checkpoint(
-                            alg_id, path, int(chunk.line_number[-1]),
-                            dict(self.counters),
+                entry = None
+                if chunk is not None:
+                    delta = {
+                        "line": chunk.counters.get("line", 0),
+                        "skipped": (
+                            chunk.counters.get("skipped_alt", 0)
+                            + chunk.counters.get("skipped_contig", 0)
+                        ),
+                        "malformed": chunk.counters.get("malformed", 0),
+                    }
+                    handles = None
+                    if chunk.batch.n == 0:
+                        pass  # trailing counters-only chunk
+                    elif resume_line and chunk.line_number[-1] <= resume_line:
+                        delta["skipped"] += chunk.batch.n
+                    else:
+                        with self.timer.stage("dispatch"):
+                            handles = self._dispatch_chunk(chunk)
+                    entry = (chunk, handles, delta)
+                if pending is not None:
+                    done_chunk, done_handles, done_delta = pending
+                    for key, v in done_delta.items():
+                        self.counters[key] = self.counters.get(key, 0) + v
+                    if done_handles is not None:
+                        # fault injection fires when the chunk holding the
+                        # variant is PROCESSED — earlier chunks commit
+                        # first, exactly like the reference's per-line
+                        # failAt
+                        if (fail_at is not None
+                                and fail_at in done_chunk.variant_id):
+                            raise RuntimeError(
+                                f"failAt variant reached: {fail_at}"
+                            )
+                        self._process_chunk(
+                            done_chunk, done_handles, alg_id, commit,
+                            resume_line, mapping_fh,
                         )
-                if test:
-                    self.log("test mode: stopping after first batch")
+                        self._log_progress()
+                        if commit:
+                            with self.timer.stage("persist"):
+                                if persist is not None:
+                                    persist()
+                                self.ledger.checkpoint(
+                                    alg_id, path,
+                                    int(done_chunk.line_number[-1]),
+                                    dict(self.counters),
+                                )
+                        if test:
+                            self.log("test mode: stopping after first batch")
+                            stop = True
+                pending = entry
+                if chunk is None:
                     break
             self.ledger.finish(alg_id, dict(self.counters))
         finally:
@@ -319,6 +352,49 @@ class TpuVcfLoader:
         return AnnotatedBatch(**out)
 
     def _load_chunk(self, chunk: VcfChunk, alg_id, commit, resume_line, mapping_fh):
+        """Synchronous dispatch+process of one chunk (the path callers that
+        re-chunk through the insert loader use; ``load_file`` itself
+        pipelines the two halves across chunks)."""
+        self._process_chunk(
+            chunk, self._dispatch_chunk(chunk), alg_id, commit,
+            resume_line, mapping_fh,
+        )
+
+    def _dispatch_chunk(self, chunk: VcfChunk) -> dict:
+        """Enqueue the chunk's device work without forcing any result.
+
+        Under jax's async dispatch the annotate/hash/dedup programs (and the
+        input transfer) run while the host processes the previous chunk.
+        The dedup here uses the device hash; rows flagged host_fallback are
+        re-deduped at process time with their full-string host hashes (see
+        ``_process_chunk``)."""
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        batch = chunk.batch
+        padded = _pad_batch(batch, next_pow2(batch.n))
+        if self.mesh is not None:
+            # the sharded step scatters through numpy already (synchronous);
+            # pipelining matters for the single-device transfer-bound path
+            ann_p = self._annotate_distributed(padded)
+            h_dev = allele_hash_jit(
+                padded.ref, padded.alt, padded.ref_len, padded.alt_len
+            )
+            return {"padded": padded, "dev": None, "ann_p": ann_p,
+                    "h_dev": h_dev, "dup_dev": None}
+        import jax
+
+        dev = tuple(jax.device_put(x) for x in padded)
+        ann_p = annotate_fn()(*dev)
+        h_dev = allele_hash_jit(dev[2], dev[3], dev[4], dev[5])
+        mixed = _mix_hash_jit(h_dev, dev[0])
+        dup_dev = mark_batch_duplicates_jit(
+            dev[1], mixed, dev[2], dev[3], dev[4], dev[5]
+        )
+        return {"padded": padded, "dev": dev, "ann_p": ann_p,
+                "h_dev": h_dev, "dup_dev": dup_dev}
+
+    def _process_chunk(self, chunk: VcfChunk, handles: dict, alg_id, commit,
+                       resume_line, mapping_fh):
         batch = chunk.batch
         if self._chrom_lengths is not None:
             oob = batch.pos.astype(np.int64) > self._chrom_lengths[
@@ -335,45 +411,34 @@ class TpuVcfLoader:
                     f"{n_oob} positions beyond chromosome bounds, e.g. "
                     f"{chunk.variant_id[i]}"
                 )
-        # ---- device pipeline: annotate + bin + hash + in-batch dedup
-        # (padded to pow2 so kernel shapes stay bounded across chunks; one
-        # device_put feeds all three kernels, and only the fields the host
-        # path consumes are fetched back — host<->device bytes are the load's
-        # bottleneck on remote-attached TPUs)
+        # ---- force the dispatched device results (annotate + bin + hash +
+        # in-batch dedup).  Only the fields the host path consumes are
+        # fetched back — host<->device bytes are the load's bottleneck on
+        # remote-attached TPUs.
         with self.timer.stage("annotate", items=batch.n):
-            from annotatedvdb_tpu.utils.arrays import next_pow2
-
             n = batch.n
-            padded = _pad_batch(batch, next_pow2(n))
-            if self.mesh is not None:
-                ann_p = self._annotate_distributed(padded)
-                h_p = np.array(allele_hash_jit(
-                    padded.ref, padded.alt, padded.ref_len, padded.alt_len
-                ))
-                dev = None
-            else:
-                import jax
-
-                dev = tuple(jax.device_put(x) for x in padded)
-                ann_p = annotate_fn()(*dev)
-                h_dev = allele_hash_jit(dev[2], dev[3], dev[4], dev[5])
-                h_p = np.array(h_dev)
+            padded = handles["padded"]
+            ann_p = handles["ann_p"]
+            h_p = np.array(handles["h_dev"])
             host_rows = np.asarray(ann_p.host_fallback)[:n]
             # long alleles are truncated in the device arrays: re-hash them
             # from the original strings so identity never collides on a
             # shared prefix
             for i in np.where(host_rows)[0]:
                 h_p[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
-            if dev is not None and not host_rows.any():
-                mixed_in = _mix_hash_jit(h_dev, dev[0])  # stays on device
+            if handles["dup_dev"] is not None and not host_rows.any():
+                dup = np.asarray(handles["dup_dev"])[:n]
             else:
-                mixed_in = h_p ^ (padded.chrom.astype(np.uint32) * _CHROM_MIX)
-            src = padded if dev is None else dev
-            dup = np.asarray(
-                mark_batch_duplicates_jit(
-                    src[1], mixed_in, src[2], src[3], src[4], src[5]
-                )
-            )[:n]
+                # fallback rows invalidate the speculative device dedup (it
+                # used truncated-prefix hashes): redo with host-corrected
+                # hashes.  Rare — only chunks carrying >width alleles.
+                mixed = h_p ^ (padded.chrom.astype(np.uint32) * _CHROM_MIX)
+                src = handles["dev"] or padded
+                dup = np.asarray(
+                    mark_batch_duplicates_jit(
+                        src[1], mixed, src[2], src[3], src[4], src[5]
+                    )
+                )[:n]
             h = h_p[:n]
             ann = self._fetch_annotations(ann_p, n, host_rows)
         # replayed rows within a partially-committed chunk

@@ -59,12 +59,19 @@ def load_logger(input_path: str, tag: str,
         logger.removeHandler(h)
         h.close()
     fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
-    fh = logging.FileHandler(log_path)
-    fh.setFormatter(fmt)
-    logger.addHandler(fh)
     eh = ExitOnCriticalHandler(sys.stderr)
     eh.setFormatter(fmt)
     logger.addHandler(eh)
+    try:
+        fh = logging.FileHandler(log_path)
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    except OSError as err:
+        # inputs often live on read-only mounts: degrade to stderr-only
+        # instead of refusing to load (pass an explicit log path to place
+        # the file somewhere writable)
+        log_path = None
+        logger.warning(f"cannot open log file ({err}); logging to stderr only")
 
     def log(*args) -> None:
         logger.info(" ".join(str(a) for a in args))
