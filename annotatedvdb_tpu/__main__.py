@@ -1,0 +1,55 @@
+"""Umbrella CLI: ``python -m annotatedvdb_tpu <command> [flags]``.
+
+One entry point over the twelve task drivers (the reference scatters them
+across ``Load/bin``, ``Util/bin`` and ``BinIndex/bin``); each command
+delegates to its module's ``main(argv)`` so both invocation styles work.
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMMANDS = {
+    "load-vcf": ("annotatedvdb_tpu.cli.load_vcf", "load a VCF into the store"),
+    "load-vep": ("annotatedvdb_tpu.cli.load_vep", "apply VEP JSON results"),
+    "load-cadd": ("annotatedvdb_tpu.cli.load_cadd", "join CADD scores"),
+    "update-qc": ("annotatedvdb_tpu.cli.update_qc", "apply ADSP QC pVCF"),
+    "load-snpeff-lof": ("annotatedvdb_tpu.cli.load_snpeff_lof",
+                        "apply SnpEff LOF/NMD"),
+    "update-annotation": ("annotatedvdb_tpu.cli.update_variant_annotation",
+                          "TSV-driven column updates"),
+    "undo": ("annotatedvdb_tpu.cli.undo_load", "undo a load by invocation id"),
+    "export-vcf": ("annotatedvdb_tpu.cli.export_variant2vcf",
+                   "dump the store back to VCF"),
+    "split-vcf": ("annotatedvdb_tpu.cli.split_vcf_by_chr",
+                  "demux a VCF per chromosome"),
+    "bin-references": ("annotatedvdb_tpu.cli.generate_bin_index_references",
+                       "materialize the bin-index reference table"),
+    "install-schema": ("annotatedvdb_tpu.cli.install_schema",
+                       "emit/install the Postgres-compatible schema"),
+    "index-genome": ("annotatedvdb_tpu.cli.index_genome",
+                     "pack a reference genome for device validation"),
+}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m annotatedvdb_tpu <command> [flags]\n")
+        width = max(len(c) for c in COMMANDS)
+        for cmd, (_, desc) in COMMANDS.items():
+            print(f"  {cmd:<{width}}  {desc}")
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    entry = COMMANDS.get(cmd)
+    if entry is None:
+        print(f"unknown command {cmd!r}; run with --help for the list",
+              file=sys.stderr)
+        return 2
+    import importlib
+
+    return importlib.import_module(entry[0]).main(rest) or 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,12 +31,9 @@ def main(argv=None) -> int:
     ap.add_argument("--updateExisting", action="store_true",
                     help="overwrite existing loss_of_function values")
     ap.add_argument("--chromosomeMap")
-    ap.add_argument("--commit", action="store_true")
-    ap.add_argument("--test", action="store_true")
-    ap.add_argument("--logAfter", type=int, default=None,
-                    help="log counters every N input lines")
-    ap.add_argument("--logFilePath", default=None,
-                    help="log file (default: <fileName>-load-snpeff-lof.log)")
+    from annotatedvdb_tpu.config import add_lifecycle_args, effective_log_after
+
+    add_lifecycle_args(ap)
     args = ap.parse_args(argv)
 
     from annotatedvdb_tpu.utils.logging import load_logger
@@ -51,7 +48,7 @@ def main(argv=None) -> int:
             read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
         ),
         log=log,
-        log_after=args.logAfter,
+        log_after=effective_log_after(args.logAfter, 1 << 15),
     )
     counters = loader.load_file(
         args.fileName, commit=args.commit, test=args.test,

@@ -6,6 +6,10 @@ mutation) unless ``--commit`` is passed; ``--test`` stops after one batch;
 ``--failAt`` is fault injection; the algorithm-invocation id is printed on
 exit so a wrapper can undo the load (``load_vcf_file.py:220``).
 
+Shared flags come from the typed config registry
+(``annotatedvdb_tpu.config``); also reachable as
+``python -m annotatedvdb_tpu load-vcf``.
+
 Usage:  python -m annotatedvdb_tpu.cli.load_vcf --fileName x.vcf[.gz] \
             --storeDir ./vdb [--commit] [--datasource dbSNP] ...
 """
@@ -13,68 +17,51 @@ Usage:  python -m annotatedvdb_tpu.cli.load_vcf --fileName x.vcf[.gz] \
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
+from annotatedvdb_tpu.config import (
+    StoreConfig,
+    add_load_args,
+    add_runtime_args,
+    load_from_args,
+    runtime_from_args,
+)
 from annotatedvdb_tpu.io.vcf import read_chromosome_map
 from annotatedvdb_tpu.loaders import TpuVcfLoader
-from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
-from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
 
 
 def main(argv=None):
-    from annotatedvdb_tpu.utils.runtime import pin_platform
-
-    # environment-robust platform pin (probe accelerator, CPU fallback)
-    pin_platform("auto")
-
     parser = argparse.ArgumentParser(
         description="load a VCF into the TPU-native annotated variant store"
     )
     parser.add_argument("--fileName", required=True, help="VCF file (.gz ok)")
     parser.add_argument("--storeDir", required=True, help="variant store directory")
-    parser.add_argument("--datasource", default=None, help="e.g. dbSNP / ADSP / EVA")
-    parser.add_argument("--genomeBuild", default="GRCh38")
-    parser.add_argument("--commit", action="store_true",
-                        help="persist the load (default: dry run)")
-    parser.add_argument("--test", action="store_true", help="stop after one batch")
-    parser.add_argument("--failAt", default=None, help="fail at this variant id")
-    parser.add_argument("--commitAfter", type=int, default=1 << 16,
-                        help="rows per device batch / checkpoint")
+    add_load_args(parser)
+    add_runtime_args(parser)
     parser.add_argument("--chromosomeMap", default=None,
                         help="TSV mapping seq accessions to chromosomes")
     parser.add_argument("--refGenome", default=None,
                         help="packed genome .npz (cli.index_genome); enables "
                              "ref-allele validation + canonical GA4GH digests "
                              "(the reference's --seqrepoProxyPath)")
-    parser.add_argument("--noResume", action="store_true",
-                        help="ignore previous checkpoints for this file")
     parser.add_argument("--skipExisting", action=argparse.BooleanOptionalAction,
                         default=True,
                         help="check the store for existing variants "
                              "(--no-skipExisting disables, the reference's "
                              "unchecked fast path)")
-    parser.add_argument("--maxWorkers", default="auto",
-                        help="devices to annotate across: auto (all), off "
-                             "(single device), or a count — the mesh analog "
-                             "of the reference's per-chromosome process pool "
-                             "(load_vcf_file.py:270)")
-    parser.add_argument("--logAfter", type=int, default=None,
-                        help="log counters every N input lines (default: "
-                             "commitAfter, the reference's cadence)")
-    parser.add_argument("--logFilePath", default=None,
-                        help="log file (default: <fileName>-load-vcf.log "
-                             "beside the input, load_vcf_file.py:29-47)")
     args = parser.parse_args(argv)
 
-    os.makedirs(args.storeDir, exist_ok=True)
-    manifest = os.path.join(args.storeDir, "manifest.json")
-    store = (
-        VariantStore.load(args.storeDir)
-        if os.path.exists(manifest)
-        else VariantStore(width=DEFAULT_ALLELE_WIDTH)
-    )
-    ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    runtime = runtime_from_args(args)
+    cfg = load_from_args(args)
+    try:
+        runtime.validate()  # flag VALUES only; env/runtime errors propagate
+    except ValueError as err:
+        parser.error(str(err))
+    mesh = runtime.apply()  # platform pin + multihost + annotate mesh
+    if mesh is not None:
+        print(f"annotating across {mesh.devices.size} devices", file=sys.stderr)
+
+    store, ledger = StoreConfig(args.storeDir).open()
     chrom_map = read_chromosome_map(args.chromosomeMap) if args.chromosomeMap else None
     genome = None
     if args.refGenome:
@@ -82,63 +69,39 @@ def main(argv=None):
 
         genome = ReferenceGenome.load(args.refGenome)
 
-    mesh = None
-    if args.maxWorkers != "off":
-        import jax
-
-        n_dev = len(jax.devices())
-        if args.maxWorkers == "auto":
-            want = n_dev
-        else:
-            try:
-                want = int(args.maxWorkers)
-            except ValueError:
-                parser.error(f"--maxWorkers must be auto, off, or a count, "
-                             f"not {args.maxWorkers!r}")
-            if want < 1:
-                parser.error("--maxWorkers count must be >= 1")
-            want = min(want, n_dev)
-        if want > 1:
-            from annotatedvdb_tpu.parallel import make_mesh
-
-            mesh = make_mesh(want)
-            print(f"annotating across {want} devices", file=sys.stderr)
-
     from annotatedvdb_tpu.utils.logging import load_logger
 
     log, _logger, log_path = load_logger(
         args.fileName, "load-vcf", args.logFilePath
     )
     log(f"load_vcf {args.fileName} -> {args.storeDir} "
-        f"(commit={args.commit}, log={log_path})")
+        f"(commit={cfg.commit}, log={log_path})")
 
     loader = TpuVcfLoader(
         store,
         ledger,
-        datasource=args.datasource,
-        genome_build=args.genomeBuild,
+        datasource=cfg.datasource,
+        genome_build=cfg.genome_build,
         genome=genome,
-        batch_size=args.commitAfter,
+        batch_size=cfg.commit_after,
         skip_existing=args.skipExisting,
         chromosome_map=chrom_map,
         mesh=mesh,
         log=log,
-        # 0 disables progress lines; unset defaults to the commit cadence
-        log_after=(args.commitAfter if args.logAfter is None
-                   else (args.logAfter or None)),
+        log_after=cfg.effective_log_after,
     )
     counters = loader.load_file(
         args.fileName,
-        commit=args.commit,
-        test=args.test,
-        fail_at=args.failAt,
+        commit=cfg.commit,
+        test=cfg.test,
+        fail_at=cfg.fail_at,
         mapping_path=args.fileName + ".mapping",
-        resume=not args.noResume,
+        resume=cfg.resume,
         # persist before every checkpoint so the durable store never lags
         # the resume cursor (crash between them would silently skip rows)
         persist=lambda: store.save(args.storeDir),
     )
-    if args.commit:
+    if cfg.commit:
         store.save(args.storeDir)
         log(f"COMMITTED {counters}")
     else:

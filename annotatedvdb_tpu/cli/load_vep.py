@@ -35,14 +35,11 @@ def main(argv=None):
                              "the shipped default seed)")
     parser.add_argument("--saveOnAddConsequence", action="store_true")
     parser.add_argument("--datasource", default=None)
-    parser.add_argument("--commit", action="store_true")
-    parser.add_argument("--test", action="store_true")
+    from annotatedvdb_tpu.config import add_lifecycle_args, effective_log_after
+
+    add_lifecycle_args(parser)
     parser.add_argument("--skipExisting", action="store_true",
                         help="skip variants that already have vep_output")
-    parser.add_argument("--logAfter", type=int, default=None,
-                        help="log counters every N results")
-    parser.add_argument("--logFilePath", default=None,
-                        help="log file (default: <fileName>-load-vep.log)")
     args = parser.parse_args(argv)
 
     from annotatedvdb_tpu.utils.logging import load_logger
@@ -63,7 +60,7 @@ def main(argv=None):
         datasource=args.datasource,
         skip_existing=args.skipExisting,
         log=log,
-        log_after=args.logAfter,
+        log_after=effective_log_after(args.logAfter, 1 << 14),
     )
     counters = loader.load_file(args.fileName, commit=args.commit, test=args.test)
     if args.commit:

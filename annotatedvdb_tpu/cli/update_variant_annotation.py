@@ -35,12 +35,9 @@ def main(argv=None) -> int:
     ap.add_argument("--datasource", default=None)
     ap.add_argument("--skipExisting", action="store_true",
                     help="skip known variants instead of updating them")
-    ap.add_argument("--commit", action="store_true")
-    ap.add_argument("--test", action="store_true")
-    ap.add_argument("--logAfter", type=int, default=None,
-                    help="log counters every N input lines")
-    ap.add_argument("--logFilePath", default=None,
-                    help="log file (default: <fileName>-update-annotation.log)")
+    from annotatedvdb_tpu.config import add_lifecycle_args, effective_log_after
+
+    add_lifecycle_args(ap)
     args = ap.parse_args(argv)
 
     from annotatedvdb_tpu.utils.logging import load_logger
@@ -56,7 +53,7 @@ def main(argv=None) -> int:
         update_existing=not args.skipExisting,
         skip_existing=args.skipExisting,
         log=log,
-        log_after=args.logAfter,
+        log_after=effective_log_after(args.logAfter, 1 << 15),
     )
     counters = loader.load_file(
         args.fileName, commit=args.commit, test=args.test,

@@ -1,0 +1,185 @@
+"""Unified typed configuration for every entry point.
+
+The reference repeats flag conventions per script with no shared registry
+(``load_vcf_file.py:247-286`` et al., SURVEY.md §5.6).  Here the common
+surface is three frozen dataclasses plus argparse registrars: the load and
+update drivers share the commit/test/log lifecycle flags
+(:func:`add_lifecycle_args`), ``load-vcf`` — the primary driver — layers the
+full load + runtime registries on top, and loaders receive typed objects
+instead of loose ``args`` namespaces:
+
+- :class:`RuntimeConfig` — platform pin, device fan-out, multi-host;
+- :class:`StoreConfig`  — store location/shape;
+- :class:`LoadConfig`   — the commit/test/resume/cadence contract every
+  loader shares (the reference's ``--commit``/``--commitAfter``/
+  ``--resumeAfter``-era conventions).
+
+``annotatedvdb_tpu.cli`` (``python -m annotatedvdb_tpu``) is the single
+umbrella command dispatching to the per-task entry points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Execution environment: platform + parallel fan-out."""
+
+    platform: str = "auto"        # auto (probe accelerator) | cpu
+    max_workers: str = "auto"     # auto | off | device count
+    multihost: bool = True        # join jax.distributed when env configured
+
+    def validate(self) -> None:
+        """Raise ValueError for malformed flag VALUES (callers map this to
+        a usage error; environment/runtime failures in :meth:`apply` are
+        deliberately not conflated with it)."""
+        if self.max_workers not in ("auto", "off"):
+            try:
+                if int(self.max_workers) < 1:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"maxWorkers must be auto, off, or a count >= 1, "
+                    f"not {self.max_workers!r}"
+                ) from None
+
+    def apply(self):
+        """Pin the platform, join the multi-host world (when configured),
+        and return the annotate mesh (None = single device)."""
+        from annotatedvdb_tpu.utils.runtime import pin_platform
+
+        self.validate()
+        pin_platform(self.platform)
+        if self.multihost:
+            from annotatedvdb_tpu.parallel.multihost import init_multihost
+
+            init_multihost()
+        if self.max_workers == "off":
+            return None
+        import jax
+
+        n_dev = len(jax.devices())
+        want = (
+            n_dev if self.max_workers == "auto"
+            else min(int(self.max_workers), n_dev)
+        )
+        if want <= 1:
+            return None
+        from annotatedvdb_tpu.parallel import make_mesh
+
+        return make_mesh(want)
+
+
+from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
+
+
+@dataclass(frozen=True)
+class StoreConfig:
+    store_dir: str
+    width: int = DEFAULT_ALLELE_WIDTH  # fixed per store at creation
+
+    def open(self, create: bool = True):
+        """(store, ledger) — loading the existing store when present."""
+        from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+        manifest = os.path.join(self.store_dir, "manifest.json")
+        if os.path.exists(manifest):
+            store = VariantStore.load(self.store_dir)
+        elif create:
+            os.makedirs(self.store_dir, exist_ok=True)
+            store = VariantStore(width=self.width)
+        else:
+            raise FileNotFoundError(f"no store at {self.store_dir}")
+        ledger = AlgorithmLedger(os.path.join(self.store_dir, "ledger.jsonl"))
+        return store, ledger
+
+
+@dataclass(frozen=True)
+class LoadConfig:
+    """The lifecycle contract shared by every load/update driver."""
+
+    commit: bool = False          # default dry run (reference rollback mode)
+    test: bool = False            # stop after one batch
+    fail_at: str | None = None    # fault injection
+    resume: bool = True           # honor ledger checkpoints
+    commit_after: int = 1 << 16   # rows per batch/checkpoint
+    log_after: int | None = None  # counter-line cadence; None -> commit_after
+    datasource: str | None = None
+    genome_build: str = "GRCh38"
+
+    @property
+    def effective_log_after(self) -> int | None:
+        if self.log_after is None:
+            return self.commit_after
+        return self.log_after or None  # 0 disables
+
+
+def add_lifecycle_args(parser: argparse.ArgumentParser) -> None:
+    """The commit/test/log trio every load and update driver shares."""
+    parser.add_argument("--commit", action="store_true",
+                        help="persist the load (default: dry run)")
+    parser.add_argument("--test", action="store_true",
+                        help="stop after one batch")
+    parser.add_argument("--logAfter", type=int, default=None,
+                        help="log counters every N input lines "
+                             "(default: the batch size; 0 disables)")
+    parser.add_argument("--logFilePath", default=None,
+                        help="log file (default: beside the input)")
+
+
+def effective_log_after(log_after: int | None, default: int) -> int | None:
+    """CLI cadence semantics: unset -> the batch default; 0 -> disabled."""
+    if log_after is None:
+        return default
+    return log_after or None
+
+
+def add_runtime_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--platform", default="auto",
+                        choices=("auto", "cpu"),
+                        help="backend pin: auto probes the accelerator with "
+                             "a timeout and falls back to cpu; cpu pins "
+                             "outright")
+    parser.add_argument("--maxWorkers", default="auto",
+                        help="devices to fan out across: auto/off/count")
+    parser.add_argument("--noMultihost", action="store_true",
+                        help="ignore multi-host environment settings")
+
+
+def add_load_args(parser: argparse.ArgumentParser,
+                  commit_after: int = 1 << 16) -> None:
+    add_lifecycle_args(parser)
+    parser.add_argument("--failAt", default=None,
+                        help="fail at this variant id (fault injection)")
+    parser.add_argument("--noResume", action="store_true",
+                        help="ignore previous checkpoints for this file")
+    parser.add_argument("--commitAfter", type=int, default=commit_after,
+                        help="rows per device batch / checkpoint")
+    parser.add_argument("--datasource", default=None,
+                        help="e.g. dbSNP / ADSP / EVA")
+    parser.add_argument("--genomeBuild", default="GRCh38")
+
+
+def runtime_from_args(args) -> RuntimeConfig:
+    return RuntimeConfig(
+        platform=getattr(args, "platform", "auto"),
+        max_workers=str(getattr(args, "maxWorkers", "auto")),
+        multihost=not getattr(args, "noMultihost", False),
+    )
+
+
+def load_from_args(args) -> LoadConfig:
+    return LoadConfig(
+        commit=getattr(args, "commit", False),
+        test=getattr(args, "test", False),
+        fail_at=getattr(args, "failAt", None),
+        resume=not getattr(args, "noResume", False),
+        commit_after=getattr(args, "commitAfter", 1 << 16),
+        log_after=getattr(args, "logAfter", None),
+        datasource=getattr(args, "datasource", None),
+        genome_build=getattr(args, "genomeBuild", "GRCh38"),
+    )

@@ -1,4 +1,8 @@
 from .mesh import make_mesh, SHARD_AXIS
 from .distributed import distributed_annotate_step, reshard_by_owner
+from .multihost import init_multihost, multihost_env, process_info
 
-__all__ = ["make_mesh", "SHARD_AXIS", "distributed_annotate_step", "reshard_by_owner"]
+__all__ = [
+    "make_mesh", "SHARD_AXIS", "distributed_annotate_step",
+    "reshard_by_owner", "init_multihost", "multihost_env", "process_info",
+]

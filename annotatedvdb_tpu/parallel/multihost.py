@@ -1,0 +1,108 @@
+"""Multi-host initialization: the DCN leg of the comm backend.
+
+The reference has no distributed communication beyond the Postgres TCP
+protocol — share-nothing worker processes coordinate only through DB
+transactions (SURVEY.md §5.8).  Here scale-out past one host (the
+BASELINE v5e-16 configs) rides ``jax.distributed``: every host runs the
+same program, ``jax.devices()`` spans all hosts after initialization, and
+the existing mesh/``shard_map`` load step works unchanged — collectives
+ride ICI within a slice and DCN across slices, with XLA handling the
+topology.
+
+Environment contract (standard JAX multi-process variables, also settable
+via flags):
+
+- ``AVDB_COORDINATOR``  — ``host:port`` of process 0 (or
+  ``JAX_COORDINATOR_ADDRESS``);
+- ``AVDB_NUM_PROCESSES`` / ``AVDB_PROCESS_ID`` — world size and this
+  process's rank.
+
+On Cloud TPU pods these resolve automatically from the TPU metadata and
+none of them need to be set (``jax.distributed.initialize()`` with no
+arguments).  A single-process initialization (num_processes=1) is valid
+and is how the wiring is exercised in CI.
+
+Store semantics under multi-host: every process ingests its own input
+slice (the driver splits files, exactly like the reference's
+per-chromosome fan-out of ``load_vcf_file.py:307-313``), annotates through
+the global mesh, and appends to its local shard set; per-chromosome
+ownership (``chromosome_owner_table``) keyed by the global device list
+keeps shard ownership disjoint across hosts.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def multihost_env() -> dict | None:
+    """The multi-host settings present in the environment, or None when
+    this is a plain single-host run.
+
+    The FULL triple (coordinator + world size + rank) is required: a
+    leftover coordinator variable from an unrelated workflow must not trip
+    every load into distributed initialization.  Partial settings are
+    reported and ignored."""
+    import sys
+
+    coordinator = os.environ.get(
+        "AVDB_COORDINATOR", os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    n = os.environ.get("AVDB_NUM_PROCESSES")
+    pid = os.environ.get("AVDB_PROCESS_ID")
+    present = [v for v in (coordinator, n, pid) if v]
+    if not present:
+        return None
+    if len(present) < 3:
+        print(
+            "multihost: ignoring partial settings (need AVDB_COORDINATOR + "
+            "AVDB_NUM_PROCESSES + AVDB_PROCESS_ID; "
+            f"got coordinator={coordinator!r} n={n!r} pid={pid!r})",
+            file=sys.stderr,
+        )
+        return None
+    try:
+        return {
+            "coordinator_address": coordinator,
+            "num_processes": int(n),
+            "process_id": int(pid),
+        }
+    except ValueError as err:
+        raise ValueError(
+            f"invalid multihost environment (AVDB_NUM_PROCESSES={n!r}, "
+            f"AVDB_PROCESS_ID={pid!r}): {err}"
+        ) from None
+
+
+_initialized = False
+
+
+def init_multihost(settings: dict | None = None) -> bool:
+    """Initialize ``jax.distributed`` when multi-host settings are present
+    (or given); returns True when a distributed runtime is active.
+
+    Safe to call more than once and on single-host runs (no-op).  Must run
+    before the first backend touch, like ``pin_platform``."""
+    global _initialized
+    if _initialized:
+        return True
+    if settings is None:
+        settings = multihost_env()
+    if settings is None:
+        return False
+    import jax
+
+    jax.distributed.initialize(**settings)
+    _initialized = True
+    return True
+
+
+def process_info() -> tuple[int, int]:
+    """(process_id, num_processes) of the active runtime (0, 1 when not
+    distributed)."""
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1

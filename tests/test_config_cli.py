@@ -1,0 +1,57 @@
+"""Typed config + umbrella CLI (``annotatedvdb_tpu.config`` / __main__)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from annotatedvdb_tpu.config import LoadConfig, StoreConfig
+
+
+def test_load_config_log_cadence_semantics():
+    assert LoadConfig(commit_after=500).effective_log_after == 500
+    assert LoadConfig(commit_after=500, log_after=50).effective_log_after == 50
+    assert LoadConfig(commit_after=500, log_after=0).effective_log_after is None
+
+
+def test_store_config_open_roundtrip(tmp_path):
+    cfg = StoreConfig(str(tmp_path / "vdb"), width=16)
+    store, ledger = cfg.open()
+    assert store.width == 16 and store.n == 0
+    store.save(cfg.store_dir)
+    store2, _ = cfg.open()
+    assert store2.width == 16
+    with pytest.raises(FileNotFoundError):
+        StoreConfig(str(tmp_path / "missing")).open(create=False)
+
+
+def test_umbrella_cli_lists_and_dispatches(tmp_path):
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu", "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0
+    for cmd in ("load-vcf", "load-vep", "load-cadd", "undo", "export-vcf",
+                "bin-references", "install-schema"):
+        assert cmd in res.stdout
+    # unknown command fails cleanly
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu", "frobnicate"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 2 and "unknown command" in res.stderr
+    # dispatch: a real load through the umbrella entry point
+    vcf = tmp_path / "u.vcf"
+    vcf.write_text(
+        "##fileformat=VCFv4.2\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "1\t100\t.\tA\tG\t.\t.\t.\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu", "load-vcf",
+         "--fileName", str(vcf), "--storeDir", str(tmp_path / "vdb"),
+         "--commit"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert (tmp_path / "vdb" / "manifest.json").exists()

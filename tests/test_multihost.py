@@ -1,0 +1,75 @@
+"""Multi-host wiring (``parallel/multihost.py``): env contract + a real
+single-process ``jax.distributed`` world running the sharded load step.
+
+True multi-host needs multiple machines; a num_processes=1 world exercises
+the same initialization path, and the distributed step's collectives are
+already covered on the virtual 8-device mesh (``test_distributed.py``)."""
+
+import socket
+import subprocess
+import sys
+
+from annotatedvdb_tpu.parallel.multihost import multihost_env
+
+
+def test_multihost_env_contract(monkeypatch):
+    for var in ("AVDB_COORDINATOR", "AVDB_NUM_PROCESSES", "AVDB_PROCESS_ID",
+                "JAX_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost_env() is None  # plain single-host run
+    monkeypatch.setenv("AVDB_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("AVDB_NUM_PROCESSES", "4")
+    monkeypatch.setenv("AVDB_PROCESS_ID", "2")
+    assert multihost_env() == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    # the standard JAX variable also works
+    monkeypatch.delenv("AVDB_COORDINATOR")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.9:8476")
+    env = multihost_env()
+    assert env["coordinator_address"] == "10.0.0.9:8476"
+    assert env["num_processes"] == 4 and env["process_id"] == 2
+
+
+def test_single_process_distributed_world(tmp_path):
+    """init_multihost joins a real (1-process) jax.distributed world and the
+    sharded annotate step runs over it — in a subprocess, because the
+    distributed runtime binds the process's backend for good."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    src = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["AVDB_JAX_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ["AVDB_COORDINATOR"] = "127.0.0.1:{port}"
+os.environ["AVDB_NUM_PROCESSES"] = "1"
+os.environ["AVDB_PROCESS_ID"] = "0"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from annotatedvdb_tpu.parallel import (
+    distributed_annotate_step, init_multihost, make_mesh, process_info,
+)
+assert init_multihost()
+assert process_info() == (0, 1)
+from annotatedvdb_tpu.io.synth import synthetic_batch
+import numpy as np
+mesh = make_mesh(4)
+batch = synthetic_batch(256, width=16)
+ann, rid, counts, dropped, n_fb = distributed_annotate_step(mesh, batch)
+assert int(np.asarray(dropped)) == 0
+total = int(np.asarray(counts).sum()) + int(np.asarray(n_fb))
+assert total == batch.n, (total, batch.n)
+print("DISTRIBUTED_WORLD_OK")
+"""
+    res = subprocess.run(
+        [sys.executable, "-c", src], capture_output=True, text=True,
+        timeout=600,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "DISTRIBUTED_WORLD_OK" in res.stdout
