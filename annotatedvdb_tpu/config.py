@@ -62,16 +62,20 @@ class RuntimeConfig:
             return None
         import jax
 
-        n_dev = len(jax.devices())
+        # the loader's annotate fan-out uses THIS PROCESS's devices: under
+        # multi-host each process loads its own inputs share-nothing (the
+        # reference's worker model) and numpy batches stay addressable; the
+        # global mesh is the device-resident/dryrun path, not the load path
+        devices = jax.local_devices()
         want = (
-            n_dev if self.max_workers == "auto"
-            else min(int(self.max_workers), n_dev)
+            len(devices) if self.max_workers == "auto"
+            else min(int(self.max_workers), len(devices))
         )
         if want <= 1:
             return None
         from annotatedvdb_tpu.parallel import make_mesh
 
-        return make_mesh(want)
+        return make_mesh(want, devices=devices)
 
 
 from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
@@ -113,9 +117,7 @@ class LoadConfig:
 
     @property
     def effective_log_after(self) -> int | None:
-        if self.log_after is None:
-            return self.commit_after
-        return self.log_after or None  # 0 disables
+        return effective_log_after(self.log_after, self.commit_after)
 
 
 def add_lifecycle_args(parser: argparse.ArgumentParser) -> None:

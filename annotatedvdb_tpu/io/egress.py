@@ -153,7 +153,7 @@ _SHORT = 8
 
 
 def display_attributes(
-    batch: VariantBatch, ann: AnnotatedBatch, rs_position=None, refs=None, alts=None
+    batch: VariantBatch, ann: AnnotatedBatch, refs=None, alts=None
 ) -> list:
     """Per-row display-attribute dicts from device outputs.
 

@@ -80,7 +80,7 @@ def computed_display_attributes(shard, window: np.ndarray) -> list:
     refs, alts = refs.astype(object), alts.astype(object)
     for j in np.where(ann.host_fallback)[0]:
         refs[j], alts[j] = shard.alleles(int(window[j]))
-    return egress.display_attributes(batch, ann, None, refs, alts)
+    return egress.display_attributes(batch, ann, refs, alts)
 
 
 def shard_rows(shard):

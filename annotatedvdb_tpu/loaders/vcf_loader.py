@@ -469,13 +469,23 @@ class TpuVcfLoader:
 
         if not insert_rows:
             return
-        sel = np.concatenate(insert_rows)
-        sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
-        sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
-        refs = [chunk.refs[i] for i in sel]
-        alts = [chunk.alts[i] for i in sel]
-        ref_snp = [chunk.ref_snp[i] for i in sel]
-        rs_pos = [chunk.rs_position[i] for i in sel]
+        with self.timer.stage("gather", items=int(sum(r.size for r in insert_rows))):
+            sel = np.concatenate(insert_rows)
+            sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
+            sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
+            # allele strings decode vectorized from the device arrays (one
+            # view op) — only the over-width tail needs the parser sidecar's
+            # original strings (a lazy per-row span decode, ~µs each)
+            refs, alts = egress.decode_alleles(sub)
+            refs, alts = refs.astype(object), alts.astype(object)
+            over = (
+                (sub.ref_len > self.store.width)
+                | (sub.alt_len > self.store.width)
+            )
+            for j in np.where(over)[0]:
+                refs[j] = chunk.refs[int(sel[j])]
+                alts[j] = chunk.alts[int(sel[j])]
+            ref_snp = [chunk.ref_snp[i] for i in sel]
 
         if self.genome is not None:
             # validate only the rows actually being inserted (post dedup /
@@ -507,7 +517,7 @@ class TpuVcfLoader:
             # display attributes are derivable: built here only when the
             # store-everything flag asks for them (see __init__)
             display = (
-                egress.display_attributes(sub, sub_ann, rs_pos, refs, alts)
+                egress.display_attributes(sub, sub_ann, refs, alts)
                 if self.store_display_attributes else None
             )
             # device bin outputs are undefined for host-fallback rows:
@@ -560,19 +570,21 @@ class TpuVcfLoader:
                         sub.ref[j],
                         sub.alt[j],
                         annotations=annotations,
-                        digest_pk=[
-                            pks[jx] if needs_digest[jx] else None for jx in jj
-                        ],
+                        # per-row comprehensions only when the rare tails
+                        # are present (digest PKs / width-truncated alleles)
+                        digest_pk=(
+                            [pks[jx] if needs_digest[jx] else None
+                             for jx in jj]
+                            if needs_digest[j].any() else None
+                        ),
                         # retain original strings for width-truncated rows:
                         # the device arrays can't reconstruct them and later
                         # joins (CADD) and VCF export need the exact alleles
-                        long_alleles=[
-                            (refs[jx], alts[jx])
-                            if (sub.ref_len[jx] > self.store.width
-                                or sub.alt_len[jx] > self.store.width)
-                            else None
-                            for jx in jj
-                        ],
+                        long_alleles=(
+                            [(refs[jx], alts[jx]) if over[jx] else None
+                             for jx in jj]
+                            if over[j].any() else None
+                        ),
                     )
                     offset += k
         self.counters["variant"] += int(sel.size)

@@ -19,9 +19,15 @@ from jax.sharding import Mesh
 SHARD_AXIS = "shard"
 
 
-def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
-    """1-D mesh over the first ``n_devices`` local devices (all by default)."""
-    devices = jax.devices()
+def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS,
+              devices=None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (all by default).
+
+    ``devices`` overrides the pool — pass ``jax.local_devices()`` for a
+    per-process mesh under multi-host (the loaders do; process-local numpy
+    batches are only addressable on local devices)."""
+    if devices is None:
+        devices = jax.devices()
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(

@@ -4,10 +4,20 @@ The reference has no distributed communication beyond the Postgres TCP
 protocol — share-nothing worker processes coordinate only through DB
 transactions (SURVEY.md §5.8).  Here scale-out past one host (the
 BASELINE v5e-16 configs) rides ``jax.distributed``: every host runs the
-same program, ``jax.devices()`` spans all hosts after initialization, and
-the existing mesh/``shard_map`` load step works unchanged — collectives
-ride ICI within a slice and DCN across slices, with XLA handling the
-topology.
+same program and ``jax.devices()`` spans all hosts after initialization.
+
+Two parallelism regimes sit on top:
+
+- **Loads** stay share-nothing per process (exactly the reference's worker
+  model): each host ingests its own input files and fans annotate out over
+  its LOCAL devices (``RuntimeConfig.apply`` builds the mesh from
+  ``jax.local_devices()`` — process-local numpy batches are only
+  addressable there).  No cross-host traffic; the ledger/store directories
+  are per-process.
+- **Global-mesh programs** (the chromosome-routed ``shard_map`` step, the
+  basis for device-resident stores) run over all hosts' devices with
+  collectives riding ICI within a slice and DCN across slices; inputs must
+  then be global arrays (``jax.make_array_from_process_local_data``).
 
 Environment contract (standard JAX multi-process variables, also settable
 via flags):

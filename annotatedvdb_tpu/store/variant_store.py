@@ -613,7 +613,10 @@ class VariantStore:
 
     @staticmethod
     def _write_segment(path: str, stem: str, seg: Segment) -> None:
-        np.savez_compressed(
+        # uncompressed: segments are rewritten on every cascade merge, and
+        # deflate CPU dominates the persist stage at load throughput (the
+        # reference's Postgres heap is uncompressed for the same reason)
+        np.savez(
             os.path.join(path, stem + ".npz"),
             ref=seg.ref, alt=seg.alt,
             **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
