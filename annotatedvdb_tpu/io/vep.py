@@ -18,8 +18,6 @@ dict at a time (the loader streams them in batches):
 
 from __future__ import annotations
 
-from copy import deepcopy
-
 from annotatedvdb_tpu.conseq import ConsequenceRanker, is_coding_consequence
 
 CONSEQUENCE_TYPES = ["transcript", "regulatory_feature", "motif_feature", "intergenic"]
@@ -216,8 +214,15 @@ class VepResultParser:
 
     @staticmethod
     def cleaned_result(annotation: dict) -> dict:
-        """Deep copy minus the extracted blocks (``vep_variant_loader.py:111-123``)."""
-        result = deepcopy(annotation)
+        """The result minus the extracted blocks
+        (``vep_variant_loader.py:111-123``).
+
+        A SHALLOW copy suffices: the popped keys are removed from the copy
+        only, the parsed annotation is never mutated after this point (its
+        lifetime ends with the batch), and the retained values are disjoint
+        from the extracted consequence/frequency blocks — deep-copying the
+        whole annotation per result dominated the VEP load's profile."""
+        result = dict(annotation)
         result.pop("colocated_variants", None)
         for ctype in CONSEQUENCE_TYPES:
             result.pop(ctype + "_consequences", None)

@@ -206,15 +206,19 @@ class TpuCaddUpdater:
         shard = self.store.shards.get(int(code))
         if shard is None or shard.n == 0:
             return empty
+        shard.compact()  # row ids below are flat position-sorted ids
         rows = np.arange(shard.n) if subset is None else np.sort(np.asarray(subset))
         if self.skip_existing:
-            scores_col = shard.annotations["cadd_scores"]
-            has = np.fromiter(
-                (scores_col[int(i)] is not None for i in rows),
-                bool, count=rows.size,
-            )
-            self.counters["skipped"] += int(has.sum())
-            rows = rows[~has]
+            # lazily-materialized column: None means no row is scored yet —
+            # a fresh whole-genome shard skips the per-row scan entirely
+            raw_col = shard.segments[0].obj.get("cadd_scores")
+            if raw_col is not None:
+                has = np.fromiter(
+                    (raw_col[int(i)] is not None for i in rows),
+                    bool, count=rows.size,
+                )
+                self.counters["skipped"] += int(has.sum())
+                rows = rows[~has]
         is_indel = (
             (shard.cols["ref_len"][rows] > 1) | (shard.cols["alt_len"][rows] > 1)
         )

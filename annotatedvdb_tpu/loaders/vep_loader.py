@@ -146,7 +146,9 @@ class TpuVepLoader:
         cleaned = VepResultParser.cleaned_result(annotation)
 
         rows = []
-        for alt in alt_str.split(","):
+        alts = alt_str.split(",")
+        multi = len(alts) - alts.count(".") > 1
+        for alt in alts:
             if alt == ".":
                 self.counters["skipped"] += 1
                 continue
@@ -160,6 +162,9 @@ class TpuVepLoader:
                     "annotation": annotation,
                     "freq_values": freq_values,
                     "cleaned": cleaned,
+                    # multi-alt rows share one cleaned dict and must not
+                    # alias inside the store (deep-merge mutates in place)
+                    "cleaned_shared": multi,
                 }
             )
         return rows
@@ -204,13 +209,26 @@ class TpuVepLoader:
                 batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
                 batch.ref_len[sel], batch.alt_len[sel],
             )
+            # per-row policy first; store writes buffer and apply in ONE
+            # vectorized pass per column (the reference likewise buffers
+            # jsonb_merge UPDATEs and flushes with execute_values,
+            # variant_loader.py:457-476)
+            upd_ids: list[int] = []
+            upd_freq_ids: list[int] = []
+            upd_freq: list = []
+            upd_ms: list = []
+            upd_ranked: list = []
+            upd_vep: list = []
+            seen_in_batch: set[int] = set()  # writes are buffered: the
+            # stored-value check alone can't see earlier rows of this batch
             for j, i in enumerate(sel):
                 if not found[j]:
                     self.counters["not_found"] += 1
                     continue
                 row_idx = int(idx[j])
                 r = rows[i]
-                if shard.get_ann("vep_output", row_idx) is not None:
+                if (row_idx in seen_in_batch
+                        or shard.get_ann("vep_output", row_idx) is not None):
                     if self.skip_existing:
                         self.counters["duplicates"] += 1
                         continue
@@ -228,23 +246,36 @@ class TpuVepLoader:
                 ms = VepResultParser.most_severe_consequence(r["annotation"], norm_alt)
                 ranked = VepResultParser.allele_consequences(r["annotation"], norm_alt)
                 if commit:
-                    one = np.array([row_idx])
-                    # all four columns take jsonb_merge semantics (they are
-                    # JSONB_UPDATE_FIELDS in the reference,
-                    # variant_loader.py:75-76): merging {} is a no-op, so an
-                    # empty new value never wipes stored data
+                    seen_in_batch.add(row_idx)
+                    upd_ids.append(row_idx)
                     if allele_freq is not None:
-                        shard.update_annotation(one, "allele_frequencies", [allele_freq])
-                    shard.update_annotation(
-                        one, "adsp_most_severe_consequence", [deepcopy(ms) if ms else {}]
+                        upd_freq_ids.append(row_idx)
+                        upd_freq.append(allele_freq)
+                    # {} merges as a no-op, so an empty new value never
+                    # wipes stored data (the columns are JSONB_UPDATE_FIELDS
+                    # in the reference, variant_loader.py:75-76).  Copies
+                    # only where store rows/columns would otherwise alias a
+                    # shared dict (deep-merge mutates in place): ms is
+                    # ranked's first element (two columns of one row);
+                    # cleaned is shared across a multi-alt result's rows.
+                    # ranked itself is per-(result, allele) — sole owner.
+                    upd_ms.append(deepcopy(ms) if ms else {})
+                    upd_ranked.append(ranked if ranked else {})
+                    upd_vep.append(
+                        deepcopy(r["cleaned"]) if r["cleaned_shared"]
+                        else r["cleaned"]
                     )
-                    shard.update_annotation(
-                        one, "adsp_ranked_consequences", [deepcopy(ranked) if ranked else {}]
-                    )
-                    # per-row copy: multi-allelic rows must not alias one
-                    # shared dict inside the store
-                    shard.update_annotation(one, "vep_output", [deepcopy(r["cleaned"])])
-                    shard.set_col("row_algorithm_id", one, alg_id)
-                    if self.is_adsp:
-                        shard.set_col("is_adsp_variant", one, 1)
                 self.counters["update"] += 1
+            if upd_ids:
+                ids = np.array(upd_ids, np.int64)
+                if upd_freq_ids:
+                    shard.update_annotation(
+                        np.array(upd_freq_ids, np.int64),
+                        "allele_frequencies", upd_freq,
+                    )
+                shard.update_annotation(ids, "adsp_most_severe_consequence", upd_ms)
+                shard.update_annotation(ids, "adsp_ranked_consequences", upd_ranked)
+                shard.update_annotation(ids, "vep_output", upd_vep)
+                shard.set_col("row_algorithm_id", ids, alg_id)
+                if self.is_adsp:
+                    shard.set_col("is_adsp_variant", ids, 1)
