@@ -49,6 +49,9 @@ def main(argv=None):
                         help="check the store for existing variants "
                              "(--no-skipExisting disables, the reference's "
                              "unchecked fast path)")
+    parser.add_argument("--profile", default=None, metavar="DIR",
+                        help="capture a jax.profiler (XLA) trace of the load "
+                             "into DIR (view in TensorBoard/Perfetto)")
     args = parser.parse_args(argv)
 
     runtime = runtime_from_args(args)
@@ -90,17 +93,21 @@ def main(argv=None):
         log=log,
         log_after=cfg.effective_log_after,
     )
-    counters = loader.load_file(
-        args.fileName,
-        commit=cfg.commit,
-        test=cfg.test,
-        fail_at=cfg.fail_at,
-        mapping_path=args.fileName + ".mapping",
-        resume=cfg.resume,
-        # persist before every checkpoint so the durable store never lags
-        # the resume cursor (crash between them would silently skip rows)
-        persist=lambda: store.save(args.storeDir),
-    )
+    from annotatedvdb_tpu.utils.profiling import device_trace
+
+    with device_trace(args.profile):
+        counters = loader.load_file(
+            args.fileName,
+            commit=cfg.commit,
+            test=cfg.test,
+            fail_at=cfg.fail_at,
+            mapping_path=args.fileName + ".mapping",
+            resume=cfg.resume,
+            # persist before every checkpoint so the durable store never
+            # lags the resume cursor (crash between them would silently
+            # skip rows)
+            persist=lambda: store.save(args.storeDir),
+        )
     if cfg.commit:
         store.save(args.storeDir)
         log(f"COMMITTED {counters}")

@@ -250,7 +250,11 @@ class TpuVepLoader:
                     upd_ids.append(row_idx)
                     if allele_freq is not None:
                         upd_freq_ids.append(row_idx)
-                        upd_freq.append(allele_freq)
+                        # two alts of one site can normalize to the SAME
+                        # allele (CAA->C and CAA->CA both key '-'), handing
+                        # two store rows the same bucket dict — deep-merge
+                        # mutates in place, so each row takes its own copy
+                        upd_freq.append(deepcopy(allele_freq))
                     # {} merges as a no-op, so an empty new value never
                     # wipes stored data (the columns are JSONB_UPDATE_FIELDS
                     # in the reference, variant_loader.py:75-76).  Copies

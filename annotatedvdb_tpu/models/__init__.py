@@ -1,3 +1,3 @@
-from .pipeline import AnnotationPipeline, annotate_pipeline
+from .pipeline import annotate_batch, annotate_pipeline
 
-__all__ = ["AnnotationPipeline", "annotate_pipeline"]
+__all__ = ["annotate_batch", "annotate_pipeline"]

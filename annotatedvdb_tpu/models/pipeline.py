@@ -127,14 +127,11 @@ def selected_kernel() -> str:
     return _SELECTED[1]
 
 
-class AnnotationPipeline:
-    """Convenience wrapper around the shared selected step.
-
-    ``run(batch)`` annotates a :class:`VariantBatch`; shapes are static per
-    (N, W), so batches should be padded to a fixed size by the ingest layer
-    to avoid recompiles.  All instances share one jit cache."""
-
-    def run(self, batch: VariantBatch) -> AnnotatedBatch:
-        return annotate_fn()(
-            batch.chrom, batch.pos, batch.ref, batch.alt, batch.ref_len, batch.alt_len
-        )
+def annotate_batch(batch: VariantBatch) -> AnnotatedBatch:
+    """Annotate a :class:`VariantBatch` with the selected step.  Shapes are
+    static per (N, W): pad batches to a fixed size to avoid recompiles
+    (``loaders.vcf_loader._pad_batch``)."""
+    return annotate_fn()(
+        batch.chrom, batch.pos, batch.ref, batch.alt,
+        batch.ref_len, batch.alt_len,
+    )

@@ -169,13 +169,17 @@ def bench_end_to_end():
             log=lambda *a: None,
         )
         loader.warmup()  # steady-state measurement: compile outside the clock
+        from annotatedvdb_tpu.utils.profiling import device_trace
+
         t0 = time.perf_counter()
-        counters = loader.load_file(
-            vcf, commit=True,
-            # durable per-checkpoint persistence (incremental segment saves)
-            persist=lambda: store.save(store_dir),
-        )
-        store.save(store_dir)
+        # AVDB_PROFILE=<dir> captures an XLA trace of the measured load
+        with device_trace(os.environ.get("AVDB_PROFILE")):
+            counters = loader.load_file(
+                vcf, commit=True,
+                # durable per-checkpoint persistence (incremental saves)
+                persist=lambda: store.save(store_dir),
+            )
+            store.save(store_dir)
         dt = time.perf_counter() - t0
 
         # update path: VEP results over a slice of the loaded store

@@ -37,9 +37,9 @@ def test_distributed_counts_match_single_device(rng):
     assert int(np.asarray(dropped)) == 0
     assert int(np.asarray(n_fallback)) == 0
     assert int(np.asarray(counts).sum()) == batch.n
-    from annotatedvdb_tpu.models.pipeline import AnnotationPipeline
+    from annotatedvdb_tpu.models.pipeline import annotate_batch
 
-    single = AnnotationPipeline().run(batch)
+    single = annotate_batch(batch)
     want = np.bincount(np.asarray(single.variant_class), minlength=8)
     np.testing.assert_array_equal(np.asarray(counts), want)
 

@@ -49,7 +49,23 @@ def _batches(n_batches: int, batch: int, seed: int = 11):
         yield rows, ref, alt
 
 
-def test_flush_cost_stays_flat():
+def test_flush_cost_stays_flat(monkeypatch):
+    """The scale-wall gate is DETERMINISTIC: total rows moved by cascade
+    merges must stay O(n log(n/batch)) — the old np.insert store rewrote
+    the whole shard per flush (~n^2/(2*batch) rows moved), which this bound
+    rejects by orders of magnitude.  Wall-clock is only a loose smoke check
+    (CI timers share a core with the rest of the suite)."""
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    merged_rows = [0]
+    real_merge = vs.Segment.merge.__func__
+
+    def counting_merge(cls, older, newer):
+        merged_rows[0] += older.n + newer.n
+        return real_merge(cls, older, newer)
+
+    monkeypatch.setattr(vs.Segment, "merge", classmethod(counting_merge))
+
     store = VariantStore(width=WIDTH)
     shard = store.shard(1)
     times = []
@@ -57,27 +73,22 @@ def test_flush_cost_stays_flat():
         t0 = time.perf_counter()
         shard.append(rows, ref, alt)
         times.append(time.perf_counter() - t0)
-    assert shard.n == N_BATCHES * BATCH
+    n = N_BATCHES * BATCH
+    assert shard.n == n
 
-    # cascade merges spike individual batches; medians of the two halves
-    # must stay comparable.  With the old np.insert store the second half
-    # is ~3x the first at this size (and grows without bound).  Absolute
-    # floors keep the ratio meaningful under noisy CI timers (the suite
-    # shares one core with other tests).
-    first = max(float(np.median(times[: N_BATCHES // 2])), 5e-4)
-    second = float(np.median(times[N_BATCHES // 2:]))
-    assert second < 3.0 * first + 1e-3, (
-        f"per-flush cost grew {second / first:.1f}x over the load "
-        f"({first * 1e3:.2f}ms -> {second * 1e3:.2f}ms): scale wall regressed"
+    # the deterministic amortization bound
+    assert merged_rows[0] <= n * (np.log2(N_BATCHES) + 2), (
+        f"cascade merges moved {merged_rows[0]:,} rows for a {n:,}-row load "
+        f"— amortization regressed (np.insert regime is ~{n * N_BATCHES // 2:,})"
     )
-
     # segment count stays logarithmic, so lookup cost is bounded
     assert len(shard.segments) <= 2 + int(np.log2(N_BATCHES))
-
-    # total merge work is amortized: quadratic growth drags the mean far
-    # above the median; the bound keys off the whole run's median so an
-    # unusually quiet (or noisy) first half cannot skew it
-    assert sum(times) < N_BATCHES * (float(np.median(times)) * 6 + 1e-3)
+    # loose wall-clock smoke: the second half must not blow up outright
+    first = max(float(np.median(times[: N_BATCHES // 2])), 5e-4)
+    second = float(np.median(times[N_BATCHES // 2:]))
+    assert second < 10.0 * first + 5e-3, (
+        f"per-flush cost grew {second / first:.1f}x over the load"
+    )
 
 
 @pytest.mark.skipif(
