@@ -28,6 +28,7 @@ from annotatedvdb_tpu.config import (
 )
 from annotatedvdb_tpu.io.vcf import read_chromosome_map
 from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.utils.profiling import device_trace
 
 
 def main(argv=None):
@@ -93,8 +94,6 @@ def main(argv=None):
         log=log,
         log_after=cfg.effective_log_after,
     )
-    from annotatedvdb_tpu.utils.profiling import device_trace
-
     with device_trace(args.profile):
         counters = loader.load_file(
             args.fileName,

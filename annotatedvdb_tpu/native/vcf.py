@@ -291,7 +291,17 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         ref_snp=LazyColumn(n, ref_snp_at),
         variant_id=LazyColumn(n, variant_id_at),
         is_multi_allelic=arrays.multi[:n].astype(bool),
-        frequencies=LazyColumn(n, lambda i: info_at(i)[1][int(alt_index[i])]),
+        frequencies=LazyColumn(n, lambda i: (
+            # raw-bytes pre-check: most lines carry no FREQ field, and the
+            # insert path reads this column for every row — skip the full
+            # INFO parse unless the substring is present
+            info_at(i)[1][int(alt_index[i])]
+            if info_len[i] > 0 and window.find(
+                b"FREQ=", base + int(info_off[i]),
+                base + int(info_off[i]) + int(info_len[i]),
+            ) != -1
+            else None
+        )),
         rs_position=LazyColumn(n, lambda i: info_at(i)[0].get("RSPOS")),
         info=LazyColumn(n, lambda i: info_at(i)[0]),
         line_number=line_no,
