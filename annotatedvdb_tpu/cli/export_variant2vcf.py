@@ -26,27 +26,25 @@ VARIANTS_PER_FILE = 10_000_000
 _INVALID_ALLELE = re.compile(r"^[IRDN]$")
 
 
-def shard_primary_key(shard, i: int) -> str:
-    """Row's record PK (delegates to the shared
-    :meth:`ChromosomeShard.primary_key` definition)."""
-    return shard.primary_key(i)
-
-
 def export_chromosome(store: VariantStore, code: int, out_dir: str,
                       variants_per_file: int) -> dict:
+    from annotatedvdb_tpu.io.egress import shard_strings
+
     label = chromosome_label(code)
     shard = store.shards[code]
-    shard.compact()  # position-sorted export order + flat views
+    # whole-shard string columns in one vectorized pass (per-row
+    # alleles()/primary_key() would binary-search ids row by row)
+    refs, alts, _mseq, pks = shard_strings(shard)
+    pos = shard.cols["pos"]
     counters = {"exported": 0, "invalid": 0, "files": 0}
     file_count, rows_in_file, fh = 0, 0, None
     invalid_path = os.path.join(out_dir, f"{label}_invalid.txt")
     with open(invalid_path, "w") as invalid_fh:
         try:
             for i in range(shard.n):
-                ref, alt = shard.alleles(i)
-                pk = shard_primary_key(shard, i)
+                ref, alt = refs[i], alts[i]
                 if _INVALID_ALLELE.match(ref) or _INVALID_ALLELE.match(alt):
-                    print(pk, file=invalid_fh)
+                    print(pks[i], file=invalid_fh)
                     counters["invalid"] += 1
                     continue
                 if fh is None or rows_in_file >= variants_per_file:
@@ -58,7 +56,7 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
                     )
                     print(*VCF_HEADER, sep="\t", file=fh)
                     rows_in_file = 0
-                print(label, int(shard.cols["pos"][i]), pk, ref, alt,
+                print(label, int(pos[i]), pks[i], ref, alt,
                       ".", ".", ".", sep="\t", file=fh)
                 rows_in_file += 1
                 counters["exported"] += 1

@@ -85,6 +85,8 @@ def computed_display_attributes(shard, window: np.ndarray) -> list:
 
 def shard_rows(shard):
     """Yield COPY-ordered value tuples for every row of one shard."""
+    from annotatedvdb_tpu.io.egress import shard_strings
+
     shard.compact()  # position-sorted global ids + flat column views
     label = chromosome_label(shard.chrom_code)
     pref = "chr" + label
@@ -96,6 +98,7 @@ def shard_rows(shard):
     alg = shard.cols["row_algorithm_id"]
     pos = shard.cols["pos"]
     anns = shard.annotations
+    _refs, _alts, mseq_col, pk_col = shard_strings(shard)
     # rows without stored display attributes get them recomputed in batches
     display = anns["display_attributes"]
     missing = np.array([display[i] is None for i in range(shard.n)])
@@ -106,18 +109,15 @@ def shard_rows(shard):
             if window.size:
                 display[window] = computed_display_attributes(shard, window)
     for i in range(shard.n):
-        ref, alt = shard.alleles(i)
         rs = f"rs{int(ref_snp[i])}" if ref_snp[i] >= 0 else None
-        metaseq = f"{label}:{int(pos[i])}:{ref}:{alt}"
-        pk = shard.primary_key(i)
         values = [
             pref,
-            pk,
+            pk_col[i],
             int(pos[i]),
             bool(multi[i]),
             None if adsp[i] < 0 else bool(adsp[i]),
             rs,
-            metaseq,
+            mseq_col[i],
             closed_form_path(pref, int(lvl[i]), int(leaf[i])),
         ]
         for col in JSONB_COLUMNS:

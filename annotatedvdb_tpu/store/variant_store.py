@@ -413,7 +413,9 @@ class ChromosomeShard:
     def primary_key(self, i: int) -> str:
         """Row's record PK: retained digest PK for the long-allele tail, else
         literal ``chr:pos:ref:alt[:rs]`` (``primary_key_generator.py:99-122``).
-        The single definition shared by every egress path."""
+        The scalar definition; the vectorized egress assembly
+        (``io.egress.shard_strings``) is parity-pinned against it by
+        ``tests/test_egress_vectorized.py``."""
         seg, off = self._locate([i])
         s, j = self.segments[int(seg[0])], int(off[0])
         if s.obj[_DIGEST_PK] is not None and s.obj[_DIGEST_PK][j] is not None:

@@ -9,7 +9,6 @@ import sys
 
 import pytest
 
-from annotatedvdb_tpu.cli.export_variant2vcf import shard_primary_key
 from annotatedvdb_tpu.cli.generate_bin_index_references import (
     emit_rows, read_chr_map,
 )
@@ -136,7 +135,7 @@ def test_shard_primary_key_digest(tmp_path):
     )
     TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(str(vcf), commit=True)
     shard = store.shard(1)
-    pk = shard_primary_key(shard, 0)
+    pk = shard.primary_key(0)
     assert pk.startswith("1:100:") and "ACGTACGT" not in pk  # digest form
 
 

@@ -38,6 +38,35 @@ def test_metaseq_and_bin_paths_match_scalar(rng):
         assert paths[i] == want, (i, paths[i], want)
 
 
+def test_shard_strings_matches_per_row(rng, tmp_path):
+    """The vectorized whole-shard string assembly == the scalar
+    ChromosomeShard accessors, row for row — the parity contract that lets
+    both PK definitions exist."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    pos = 1000
+    for i, (_, _, ref, alt) in enumerate(random_variants(rng, 200, max_len=8)):
+        pos += 7
+        vid = f"rs{i}" if i % 3 == 0 else "."
+        lines.append(f"7\t{pos}\t{vid}\t{ref}\t{alt}\t.\t.\t.")
+    lines.append(f"7\t{pos + 50}\t.\t{'A' * 60}\tG\t.\t.\t.")  # digest tail
+    vcf = tmp_path / "p.vcf"
+    vcf.write_text("\n".join(lines) + "\n")
+    store = VariantStore(width=49)
+    TpuVcfLoader(store, AlgorithmLedger(str(tmp_path / "l.jsonl")),
+                 log=lambda *a: None).load_file(str(vcf), commit=True)
+    shard = store.shard(7)
+    refs, alts, mseq, pks = egress.shard_strings(shard)
+    assert sum(1 for i in range(shard.n)
+               if len(refs[i]) > 49 or len(alts[i]) > 49) == 1
+    for i in range(shard.n):
+        assert (refs[i], alts[i]) == shard.alleles(i)
+        assert pks[i] == shard.primary_key(i), i
+
+
 def test_primary_keys_literal_and_rs_suffix(rng):
     variants = [("1", 100, "A", "G"), ("X", 5_000, "AT", "A"),
                 ("M", 263, "A", "G")]
