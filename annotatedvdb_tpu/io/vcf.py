@@ -35,6 +35,24 @@ from annotatedvdb_tpu.types import VariantBatch, chromosome_code
 from annotatedvdb_tpu.utils.strings import to_numeric
 
 
+def rs_number(ref_snp) -> int:
+    """'rs<digits>' -> the number, else -1.
+
+    Strict ASCII digits only (``isdigit`` would admit e.g. '¹²' and
+    ``int()`` admits '1_2'/'+12'), matching the native tokenizer's
+    ``rs_number_of`` byte scan exactly so both engines store identical
+    ref_snp columns."""
+    s = str(ref_snp) if ref_snp else ""
+    if not s.startswith("rs") or len(s) < 3:
+        return -1
+    v = 0
+    for c in s[2:]:
+        if c < "0" or c > "9":
+            return -1
+        v = v * 10 + ord(c) - 48
+    return v
+
+
 def _open_text(path: str):
     if path.endswith(".gz"):
         return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
@@ -100,6 +118,13 @@ class VcfChunk:
     filter: list = field(default_factory=list)
     format: list = field(default_factory=list)
     counters: dict = field(default_factory=dict)
+    #: int64 refsnp number per row (ID "rs<digits>" first, else INFO RS=,
+    #: else -1) — lets the insert path store rs ids without materializing
+    #: any per-row sidecar string (``loaders/vcf_loader.py`` append stage)
+    rs_number: np.ndarray | None = None
+    #: bool per row: INFO carries a FREQ entry.  The insert path skips the
+    #: frequencies column entirely for chunks with no flagged row.
+    has_freq: np.ndarray | None = None
 
 
 class VcfBatchReader:
@@ -165,10 +190,10 @@ class VcfBatchReader:
             for line_no, line in enumerate(fh, start=1):
                 if line.startswith("#") or not line.strip():
                     continue
-                counters["line"] += 1
                 fields = line.rstrip("\r\n").split("\t")
                 if (len(fields) < 5 or not fields[1].isdigit()
                         or int(fields[1]) > 0x7FFFFFFF):
+                    counters["line"] += 1
                     counters["malformed"] += 1
                     continue
                 chrom_str, pos_str, vid, ref, alt_str = fields[:5]
@@ -176,15 +201,25 @@ class VcfBatchReader:
                     chrom_str = self.chromosome_map.get(chrom_str, chrom_str)
                 code = chromosome_code(chrom_str)
                 if code == 0:
+                    counters["line"] += 1
                     counters["skipped_contig"] += 1
                     continue
+                # flush BEFORE a line that would overflow the batch: chunks
+                # stay line-aligned AND never exceed batch_size, so the
+                # loader pads every chunk to one fixed kernel shape (the
+                # native engine's fixed-capacity buffer behaves the same)
+                alts = alt_str.split(",")
+                if rows and len(rows) + len(alts) > self.batch_size:
+                    yield self._emit(rows, counters)
+                    rows = []
+                    counters = {k: 0 for k in counters}
+                counters["line"] += 1
                 info = (
                     parse_info(fields[7])
                     if len(fields) > 7 and fields[7] != "."
                     and not self.identity_only
                     else {}
                 )
-                alts = alt_str.split(",")
                 chrom_label = str(chrom_str)
                 if chrom_label.startswith("chr"):
                     chrom_label = chrom_label[3:]
@@ -227,13 +262,6 @@ class VcfBatchReader:
                             fmt,
                         )
                     )
-                # flush only at line boundaries: a checkpoint records whole
-                # lines as committed, so a multi-allelic line must never
-                # straddle two chunks
-                if len(rows) >= self.batch_size:
-                    yield self._emit(rows, counters)
-                    rows = []
-                    counters = {k: 0 for k in counters}
         if rows or any(counters.values()):
             # a trailing zero-row chunk still carries skip/malformed counters
             # so totals reconcile; loaders must tolerate batch.n == 0
@@ -248,7 +276,17 @@ class VcfBatchReader:
         batch = batch._replace(
             chrom=np.array([r[0] for r in rows], dtype=np.int8)
         )
+        rs_col = np.array(
+            [rs_number(r[4]) for r in rows], dtype=np.int64
+        ) if rows else np.zeros(0, np.int64)
+        # line-level flag (INFO carries a FREQ key), same rule as the native
+        # tokenizer's pre-scan; per-alt values may still be None
+        has_freq = np.array(
+            ["FREQ" in r[9] for r in rows], dtype=bool
+        ) if rows else np.zeros(0, bool)
         return VcfChunk(
+            rs_number=rs_col,
+            has_freq=has_freq,
             batch=batch,
             refs=[r[2] for r in rows],
             alts=[r[3] for r in rows],

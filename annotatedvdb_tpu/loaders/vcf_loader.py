@@ -24,6 +24,7 @@ import numpy as np
 from annotatedvdb_tpu import oracle
 from annotatedvdb_tpu.io import egress
 from annotatedvdb_tpu.io.vcf import VcfBatchReader, VcfChunk
+from annotatedvdb_tpu.io.vcf import rs_number as _io_rs_number
 from annotatedvdb_tpu.oracle.binindex import closed_form_bin
 from annotatedvdb_tpu.types import AnnotatedBatch, VariantBatch
 from annotatedvdb_tpu.models.pipeline import annotate_fn
@@ -265,20 +266,22 @@ class TpuVcfLoader:
         from annotatedvdb_tpu.io.synth import synthetic_batch
         from annotatedvdb_tpu.utils.arrays import next_pow2
 
-        # chunks flush at >= batch_size (line-boundary overshoot), so padded
-        # shapes are next_pow2(batch_size) OR its double — compile both
-        p = next_pow2(self.batch_size)
-        for shape in {p, next_pow2(p + 1)}:
-            batch = synthetic_batch(shape, width=self.store.width)
-            ann = self._annotate(batch)
-            h = allele_hash_jit(
-                batch.ref, batch.alt, batch.ref_len, batch.alt_len
-            )
-            dup = mark_batch_duplicates_jit(
-                batch.pos, np.asarray(h), batch.ref, batch.alt,
-                batch.ref_len, batch.alt_len,
-            )
-            np.asarray(ann.variant_class), np.asarray(dup)
+        # chunks are line-aligned at <= batch_size and ``_dispatch_chunk``
+        # min-pads to next_pow2(batch_size): ONE compiled shape per load
+        # (the only exception — a single source line wider than the whole
+        # batch — compiles lazily)
+        batch = synthetic_batch(
+            next_pow2(self.batch_size), width=self.store.width
+        )
+        ann = self._annotate(batch)
+        h = allele_hash_jit(
+            batch.ref, batch.alt, batch.ref_len, batch.alt_len
+        )
+        dup = mark_batch_duplicates_jit(
+            batch.pos, np.asarray(h), batch.ref, batch.alt,
+            batch.ref_len, batch.alt_len,
+        )
+        np.asarray(ann.variant_class), np.asarray(dup)
 
     def _annotate(self, batch: VariantBatch) -> AnnotatedBatch:
         """One annotate step: distributed over the mesh when present, else
@@ -371,7 +374,12 @@ class TpuVcfLoader:
         from annotatedvdb_tpu.utils.arrays import next_pow2
 
         batch = chunk.batch
-        padded = _pad_batch(batch, next_pow2(batch.n))
+        # tail chunks pad UP to the steady-state shape: recompiling the
+        # annotate/hash/dedup kernels for a one-off tail shape costs ~35s
+        # on TPU — far more than annotating the pad rows
+        padded = _pad_batch(
+            batch, max(next_pow2(batch.n), next_pow2(self.batch_size))
+        )
         if self.mesh is not None:
             # the sharded step scatters through numpy already (synchronous);
             # pipelining matters for the single-device transfer-bound path
@@ -485,7 +493,15 @@ class TpuVcfLoader:
             for j in np.where(over)[0]:
                 refs[j] = chunk.refs[int(sel[j])]
                 alts[j] = chunk.alts[int(sel[j])]
-            ref_snp = [chunk.ref_snp[i] for i in sel]
+            # rs numbers come pre-parsed from the reader (one int64 column);
+            # the string forms are only materialized on the PK path below
+            rs_sel = (
+                chunk.rs_number[sel]
+                if chunk.rs_number is not None
+                else np.array(
+                    [_rs_number(chunk.ref_snp[i]) for i in sel], np.int64
+                )
+            )
 
         if self.genome is not None:
             # validate only the rows actually being inserted (post dedup /
@@ -508,12 +524,13 @@ class TpuVcfLoader:
             # the literal-PK bulk is needed only for the mapping sidecar;
             # digest PKs (rare tail) are always needed — the store retains
             # them as the row's record PK
-            pks = (
-                egress.primary_keys(sub, sub_ann, ref_snp, self.digester,
-                                    refs, alts)
-                if (mapping_fh is not None or needs_digest.any())
-                else None
-            )
+            if mapping_fh is not None or needs_digest.any():
+                ref_snp = [chunk.ref_snp[i] for i in sel]
+                pks = egress.primary_keys(
+                    sub, sub_ann, ref_snp, self.digester, refs, alts
+                )
+            else:
+                pks = None
             # display attributes are derivable: built here only when the
             # store-everything flag asks for them (see __init__)
             display = (
@@ -540,11 +557,17 @@ class TpuVcfLoader:
                     j = slice(offset, offset + k)
                     jj = np.arange(offset, offset + k)
                     code = batch.chrom[rows[0]]
-                    annotations = {
-                        "allele_frequencies": [
-                            chunk.frequencies[i] for i in rows
-                        ],
-                    }
+                    # reader-flagged FREQ rows only: a FREQ-less slice (the
+                    # common case) skips the per-row lazy column entirely
+                    if (chunk.has_freq is None
+                            or bool(chunk.has_freq[rows].any())):
+                        annotations = {
+                            "allele_frequencies": [
+                                chunk.frequencies[i] for i in rows
+                            ],
+                        }
+                    else:
+                        annotations = {}
                     if display is not None:
                         annotations["display_attributes"] = (
                             display[offset:offset + k]
@@ -555,9 +578,7 @@ class TpuVcfLoader:
                             "h": h[rows],
                             "ref_len": sub.ref_len[j],
                             "alt_len": sub.alt_len[j],
-                            "ref_snp": np.array(
-                                [_rs_number(r) for r in ref_snp[j]], np.int64
-                            ),
+                            "ref_snp": rs_sel[jj],
                             "is_multi_allelic": chunk.is_multi_allelic[rows],
                             "is_adsp_variant": np.full(
                                 k, 1 if self.is_adsp else -1, np.int8
@@ -628,10 +649,6 @@ def _fnv32_str(ref: str, alt: str) -> np.uint32:
     return h
 
 
-def _rs_number(ref_snp) -> int:
-    if not ref_snp or not str(ref_snp).startswith("rs"):
-        return -1
-    try:
-        return int(str(ref_snp)[2:])
-    except ValueError:
-        return -1
+# single source of truth for the rs-parse rule (mirrored byte-for-byte by
+# the native tokenizer's rs_number_of); re-exported here for the loaders
+_rs_number = _io_rs_number

@@ -28,10 +28,15 @@ from annotatedvdb_tpu.conseq import ConsequenceRanker
 from annotatedvdb_tpu.io.vep import VepResultParser
 from annotatedvdb_tpu.models.pipeline import annotate_fn
 from annotatedvdb_tpu.ops.hashing import allele_hash_jit
-from copy import deepcopy
 
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.types import VariantBatch, chromosome_code
+
+
+def _fresh(obj):
+    """Deep, un-aliased copy of JSON-pure data via one C-level round trip
+    (~5-10x cheaper than ``copy.deepcopy`` for small nested dicts)."""
+    return json.loads(json.dumps(obj))
 
 
 def _open_text(path: str):
@@ -76,6 +81,23 @@ class TpuVepLoader:
     @property
     def is_dbsnp(self) -> bool:
         return self.datasource == "dbsnp"
+
+    def warmup(self) -> None:
+        """Pre-compile the annotate + hash kernels for this loader's padded
+        batch shape (``_apply_batch`` pads every flush to
+        ``next_pow2(batch_size)`` or its double, so two compiles cover a
+        whole load).  Optional — the first flush compiles lazily without it."""
+        from annotatedvdb_tpu.io.synth import synthetic_batch
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        p = next_pow2(self.batch_size)
+        for shape in {p, next_pow2(p + 1)}:
+            b = synthetic_batch(shape, width=self.store.width)
+            ann = annotate_fn()(
+                b.chrom, b.pos, b.ref, b.alt, b.ref_len, b.alt_len
+            )
+            h = allele_hash_jit(b.ref, b.alt, b.ref_len, b.alt_len)
+            np.asarray(ann.prefix_len), np.asarray(h)
 
     def load_file(self, path: str, commit: bool = False, test: bool = False) -> dict:
         alg_id = self.ledger.begin(
@@ -180,23 +202,27 @@ class TpuVepLoader:
         # pow2 padding bounds the set of compiled kernel shapes (batch row
         # counts vary per flush; see vcf_loader._pad_batch)
         from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
-        from annotatedvdb_tpu.types import AnnotatedBatch
         from annotatedvdb_tpu.utils.arrays import next_pow2
 
         n = batch.n
-        padded = _pad_batch(batch, next_pow2(n))
+        # tail flushes pad UP to the steady-state shape so a whole load
+        # compiles at most two kernel shapes (both covered by ``warmup``)
+        padded = _pad_batch(
+            batch, max(next_pow2(n), next_pow2(self.batch_size))
+        )
         ann_p = annotate_fn()(
             padded.chrom, padded.pos, padded.ref, padded.alt,
             padded.ref_len, padded.alt_len,
         )
-        ann = AnnotatedBatch(*(np.asarray(x)[:n] for x in ann_p))
+        # only two annotate outputs feed the update path — fetch just those
+        # (forcing all 11 fields costs one host<->device round trip each)
+        prefix = np.asarray(ann_p.prefix_len)[:n]
+        host = np.asarray(ann_p.host_fallback)[:n]
         h = np.array(
             allele_hash_jit(
                 padded.ref, padded.alt, padded.ref_len, padded.alt_len
             )
         )[:n]
-        prefix = np.asarray(ann.prefix_len)
-        host = np.asarray(ann.host_fallback)
         from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
         from annotatedvdb_tpu.oracle import normalize_alleles
 
@@ -250,28 +276,29 @@ class TpuVepLoader:
                     upd_ids.append(row_idx)
                     if allele_freq is not None:
                         upd_freq_ids.append(row_idx)
-                        # two alts of one site can normalize to the SAME
-                        # allele (CAA->C and CAA->CA both key '-'), handing
-                        # two store rows the same bucket dict — deep-merge
-                        # mutates in place, so each row takes its own copy
-                        upd_freq.append(deepcopy(allele_freq))
+                        upd_freq.append(allele_freq)
                     # {} merges as a no-op, so an empty new value never
                     # wipes stored data (the columns are JSONB_UPDATE_FIELDS
-                    # in the reference, variant_loader.py:75-76).  Copies
-                    # only where store rows/columns would otherwise alias a
-                    # shared dict (deep-merge mutates in place): ms is
-                    # ranked's first element (two columns of one row);
-                    # cleaned is shared across a multi-alt result's rows.
-                    # ranked itself is per-(result, allele) — sole owner.
-                    upd_ms.append(deepcopy(ms) if ms else {})
+                    # in the reference, variant_loader.py:75-76)
+                    upd_ms.append(ms if ms else {})
                     upd_ranked.append(ranked if ranked else {})
                     upd_vep.append(
-                        deepcopy(r["cleaned"]) if r["cleaned_shared"]
+                        _fresh(r["cleaned"]) if r["cleaned_shared"]
                         else r["cleaned"]
                     )
                 self.counters["update"] += 1
             if upd_ids:
                 ids = np.array(upd_ids, np.int64)
+                # un-alias before handing dicts to the store: ms aliases
+                # ranked's first element (two columns of one row), and two
+                # alts of one site can normalize to the SAME allele
+                # (CAA->C and CAA->CA both key '-'), handing two store rows
+                # the same frequency bucket — deep-merge mutates in place.
+                # One C-level JSON round trip over the whole column replaces
+                # ~25 deepcopy frames per dict (values are JSON-pure: they
+                # come from json.loads plus int/bool rank fields).
+                upd_ms = _fresh(upd_ms)
+                upd_freq = _fresh(upd_freq)
                 if upd_freq_ids:
                     shard.update_annotation(
                         np.array(upd_freq_ids, np.int64),

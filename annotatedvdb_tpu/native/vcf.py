@@ -51,6 +51,8 @@ class _Arrays:
         self.altcol_len = np.zeros(cap, np.int32)
         self.alt_index = np.zeros(cap, np.int32)
         self.n_alts = np.zeros(cap, np.int32)
+        self.rs_number = np.zeros(cap, np.int64)
+        self.has_freq = np.zeros(cap, np.uint8)
 
     def pointers(self):
         def p(a):
@@ -65,7 +67,8 @@ class _Arrays:
             p(self.info_off), p(self.info_len),
             p(self.format_off), p(self.format_len),
             p(self.altcol_off), p(self.altcol_len),
-            p(self.alt_index), p(self.n_alts),
+            p(self.alt_index), p(self.n_alts), p(self.rs_number),
+            p(self.has_freq),
         ]
 
 
@@ -117,6 +120,7 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool):
                     len(window) - start, width, arrays.cap,
                     line_base,
                     *arrays.pointers(),
+                    ctypes.c_int32(1 if identity_only else 0),
                     counters.ctypes.data_as(ctypes.c_void_p),
                     ctypes.byref(consumed), ctypes.byref(need_more),
                 )
@@ -238,6 +242,8 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     altcol_len = arrays.altcol_len[:n].copy()
     alt_index = arrays.alt_index[:n].copy()
     n_alts = arrays.n_alts[:n].copy()
+    rs_number = arrays.rs_number[:n].copy()
+    has_freq = arrays.has_freq[:n].astype(bool)
     line_no = arrays.line_no[:n].copy()
     mv = memoryview(window)
 
@@ -292,19 +298,15 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         variant_id=LazyColumn(n, variant_id_at),
         is_multi_allelic=arrays.multi[:n].astype(bool),
         frequencies=LazyColumn(n, lambda i: (
-            # raw-bytes pre-check: most lines carry no FREQ field, and the
-            # insert path reads this column for every row — skip the full
-            # INFO parse unless the substring is present
-            info_at(i)[1][int(alt_index[i])]
-            if info_len[i] > 0 and window.find(
-                b"FREQ=", base + int(info_off[i]),
-                base + int(info_off[i]) + int(info_len[i]),
-            ) != -1
-            else None
+            # the tokenizer pre-flags FREQ-bearing rows, so FREQ-less rows
+            # (the vast majority) skip the full INFO parse
+            info_at(i)[1][int(alt_index[i])] if has_freq[i] else None
         )),
+        has_freq=has_freq,
         rs_position=LazyColumn(n, lambda i: info_at(i)[0].get("RSPOS")),
         info=LazyColumn(n, lambda i: info_at(i)[0]),
         line_number=line_no,
+        rs_number=rs_number,
         qual=LazyColumn(n, opt(qual_off, qual_len)),
         filter=LazyColumn(n, opt(filter_off, filter_len)),
         format=LazyColumn(n, opt(format_off, format_len)),
@@ -348,4 +350,5 @@ def _empty_chunk(width: int, counters: dict):
         is_multi_allelic=np.zeros(0, bool), frequencies=[], rs_position=[],
         info=[], line_number=np.zeros(0, np.int64), qual=[], filter=[],
         format=[], counters=dict(counters),
+        rs_number=np.zeros(0, np.int64), has_freq=np.zeros(0, bool),
     )

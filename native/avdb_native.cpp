@@ -71,6 +71,45 @@ struct Span {
     int len;
 };
 
+// refsnp number for one site: ID "rs<digits>" wins, else INFO "RS=<digits>"
+// (key-anchored: start of INFO or after ';'), else -1.  Mirrors the Python
+// reader's ref_snp derivation + loaders' _rs_number parse so the insert path
+// never materializes the ID string.
+inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
+    if (id.len > 2 && id.ptr[0] == 'r' && id.ptr[1] == 's') {
+        int64_t v = 0;
+        bool ok = true;
+        for (int i = 2; i < id.len && ok; ++i) {
+            char c = id.ptr[i];
+            if (c < '0' || c > '9') ok = false;
+            else v = v * 10 + (c - '0');
+        }
+        if (ok) return v;
+    }
+    // an ID containing 'rs' anywhere IS the refsnp string (reference
+    // substring rule, vcf_parser.py:158-169) — it shadows INFO RS even when
+    // it does not parse to a number
+    for (int i = 0; i + 1 < id.len; ++i)
+        if (id.ptr[i] == 'r' && id.ptr[i + 1] == 's') return -1;
+    if (has_info) {
+        const char* s = info.ptr;
+        for (int i = 0; i + 3 <= info.len; ++i) {
+            if ((i == 0 || s[i - 1] == ';')
+                && s[i] == 'R' && s[i + 1] == 'S' && s[i + 2] == '=') {
+                int64_t v = 0;
+                int j = i + 3;
+                if (j >= info.len || s[j] < '0' || s[j] > '9') return -1;
+                for (; j < info.len && s[j] != ';'; ++j) {
+                    if (s[j] < '0' || s[j] > '9') return -1;
+                    v = v * 10 + (s[j] - '0');
+                }
+                return v;
+            }
+        }
+    }
+    return -1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -103,6 +142,13 @@ int64_t avdb_parse_vcf_chunk(
     int64_t* altcol_off, int32_t* altcol_len,
     // site index of each row within its line (alt ordinal) + alt count
     int32_t* alt_index, int32_t* n_alts_out,
+    // refsnp number (ID "rs<digits>", else INFO RS=, else -1); identity_only
+    // loads skip the INFO fallback, mirroring the readers' skipped INFO parse
+    int64_t* rs_number,
+    // 1 when INFO carries a key-anchored FREQ= entry (the insert path reads
+    // the frequencies column for every row; this flag lets it skip the lazy
+    // INFO parse wholesale on FREQ-less rows/chunks)
+    uint8_t* has_freq, int32_t identity_only,
     int64_t* counters, int64_t* consumed, int32_t* need_more) {
     int64_t rows = 0;
     int64_t offset = 0;
@@ -181,6 +227,20 @@ int64_t avdb_parse_vcf_chunk(
         bool has_info = nf > 7 && !(fields[7].len == 1 && fields[7].ptr[0] == '.');
         bool has_format = nf > 8 && !(fields[8].len == 1 && fields[8].ptr[0] == '.');
 
+        int64_t rs = rs_number_of(id_f, fields[7], has_info && !identity_only);
+        uint8_t freq_flag = 0;
+        if (has_info && !identity_only) {
+            const char* s = fields[7].ptr;
+            for (int i = 0; i + 5 <= fields[7].len; ++i) {
+                if ((i == 0 || s[i - 1] == ';')
+                    && s[i] == 'F' && s[i + 1] == 'R' && s[i + 2] == 'E'
+                    && s[i + 3] == 'Q' && s[i + 4] == '=') {
+                    freq_flag = 1;
+                    break;
+                }
+            }
+        }
+
         const char* alt_start = fields[4].ptr;
         const char* alt_end = fields[4].ptr + fields[4].len;
         int ordinal = 0;
@@ -224,6 +284,8 @@ int64_t avdb_parse_vcf_chunk(
                     altcol_len[r] = fields[4].len;
                     alt_index[r] = ordinal - 1;
                     n_alts_out[r] = n_alts;
+                    rs_number[r] = rs;
+                    has_freq[r] = freq_flag;
                 }
                 alt_start = q + 1;
             }

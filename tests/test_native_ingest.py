@@ -66,10 +66,25 @@ def assert_chunks_equal(a_chunks, b_chunks):
         pa = np.concatenate([np.asarray(getattr(c.batch, arr)) for c in a_chunks])
         na = np.concatenate([np.asarray(getattr(c.batch, arr)) for c in b_chunks])
         assert (pa == na).all(), arr
-    for attr in ("is_multi_allelic", "line_number"):
+    for attr in ("is_multi_allelic", "line_number", "rs_number"):
         pa = np.concatenate([np.asarray(getattr(c, attr)) for c in a_chunks])
         na = np.concatenate([np.asarray(getattr(c, attr)) for c in b_chunks])
         assert (pa == na).all(), attr
+    # the int rs column must agree with the loaders' parse of the string one
+    from annotatedvdb_tpu.loaders.vcf_loader import _rs_number
+
+    # strict-digit rule: engines must agree on pathological IDs too
+    assert _rs_number("rs1_2") == -1
+    assert _rs_number("rs+12") == -1
+    assert _rs_number("rs 12") == -1
+    assert _rs_number("rs0012") == 12
+
+    for chunks in (a_chunks, b_chunks):
+        for c in chunks:
+            for i in range(c.batch.n):
+                assert c.rs_number[i] == _rs_number(c.ref_snp[i]), (
+                    c.ref_snp[i]
+                )
     for key in ("line", "skipped_contig", "skipped_alt"):
         assert (
             sum(c.counters.get(key, 0) for c in a_chunks)
