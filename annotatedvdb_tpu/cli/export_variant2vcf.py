@@ -28,38 +28,45 @@ _INVALID_ALLELE = re.compile(r"^[IRDN]$")
 
 def export_chromosome(store: VariantStore, code: int, out_dir: str,
                       variants_per_file: int) -> dict:
-    from annotatedvdb_tpu.io.egress import shard_strings
+    from annotatedvdb_tpu.io.egress import EGRESS_WINDOW, shard_strings
 
     label = chromosome_label(code)
     shard = store.shards[code]
-    # whole-shard string columns in one vectorized pass (per-row
-    # alleles()/primary_key() would binary-search ids row by row)
-    refs, alts, _mseq, pks = shard_strings(shard)
     pos = shard.cols["pos"]
     counters = {"exported": 0, "invalid": 0, "files": 0}
     file_count, rows_in_file, fh = 0, 0, None
     invalid_path = os.path.join(out_dir, f"{label}_invalid.txt")
     with open(invalid_path, "w") as invalid_fh:
         try:
-            for i in range(shard.n):
-                ref, alt = refs[i], alts[i]
-                if _INVALID_ALLELE.match(ref) or _INVALID_ALLELE.match(alt):
-                    print(pks[i], file=invalid_fh)
-                    counters["invalid"] += 1
-                    continue
-                if fh is None or rows_in_file >= variants_per_file:
-                    if fh:
-                        fh.close()
-                    file_count += 1
-                    fh = open(
-                        os.path.join(out_dir, f"{label}_{file_count}.vcf"), "w"
-                    )
-                    print(*VCF_HEADER, sep="\t", file=fh)
-                    rows_in_file = 0
-                print(label, int(pos[i]), pks[i], ref, alt,
-                      ".", ".", ".", sep="\t", file=fh)
-                rows_in_file += 1
-                counters["exported"] += 1
+            # vectorized string assembly per window (per-row
+            # alleles()/primary_key() would binary-search ids row by row;
+            # whole-shard assembly would hold ~4 strings/row resident)
+            for lo in range(0, shard.n, EGRESS_WINDOW):
+                refs, alts, _mseq, pks = shard_strings(
+                    shard, lo, lo + EGRESS_WINDOW
+                )
+                for j in range(len(pks)):
+                    i = lo + j
+                    ref, alt = refs[j], alts[j]
+                    if _INVALID_ALLELE.match(ref) or _INVALID_ALLELE.match(alt):
+                        print(pks[j], file=invalid_fh)
+                        counters["invalid"] += 1
+                        continue
+                    if fh is None or rows_in_file >= variants_per_file:
+                        if fh:
+                            fh.close()
+                        file_count += 1
+                        fh = open(
+                            os.path.join(
+                                out_dir, f"{label}_{file_count}.vcf"
+                            ), "w"
+                        )
+                        print(*VCF_HEADER, sep="\t", file=fh)
+                        rows_in_file = 0
+                    print(label, int(pos[i]), pks[j], ref, alt,
+                          ".", ".", ".", sep="\t", file=fh)
+                    rows_in_file += 1
+                    counters["exported"] += 1
         finally:
             if fh:
                 fh.close()

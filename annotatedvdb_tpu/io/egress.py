@@ -148,35 +148,41 @@ def bin_paths(batch: VariantBatch, ann: AnnotatedBatch) -> np.ndarray:
     return out
 
 
-def shard_strings(shard):
-    """Whole-shard string columns for egress/export, assembled vectorized:
-    ``(refs, alts, metaseq_ids, primary_keys)`` object arrays in the
-    compacted shard's row order.
+def shard_strings(shard, lo: int = 0, hi: int | None = None):
+    """String columns for egress/export over rows ``[lo, hi)`` of the
+    compacted shard, assembled vectorized: ``(refs, alts, metaseq_ids,
+    primary_keys)`` object arrays in shard row order.
 
     Replaces per-row ``shard.alleles(i)``/``shard.primary_key(i)`` loops
     (each a binary-search id resolution) with one allele view-decode, one
     column-wise id assembly, and rare-tail patches (retained long alleles,
-    digest PKs).  Raises like :meth:`ChromosomeShard.alleles` when an
-    over-width row has no retained original strings."""
+    digest PKs).  Callers that stream rows out should iterate windows
+    (``EGRESS_WINDOW`` rows) rather than materializing ~4 Python strings per
+    row for a whole dbSNP-scale shard at once.  Raises like
+    :meth:`ChromosomeShard.alleles` when an over-width row has no retained
+    original strings."""
     from annotatedvdb_tpu.store.variant_store import _DIGEST_PK, _LONG_ALLELES
 
     shard.compact()
     seg = shard._single()
-    n = seg.n
+    hi = seg.n if hi is None else min(hi, seg.n)
+    sl = slice(lo, hi)
+    k = max(hi - lo, 0)
     batch = VariantBatch(
-        np.full((n,), shard.chrom_code, np.int8), seg.cols["pos"],
-        seg.ref, seg.alt, seg.cols["ref_len"], seg.cols["alt_len"],
+        np.full((k,), shard.chrom_code, np.int8), seg.cols["pos"][sl],
+        seg.ref[sl], seg.alt[sl], seg.cols["ref_len"][sl],
+        seg.cols["alt_len"][sl],
     )
     refs, alts = decode_alleles(batch)
     refs, alts = refs.astype(object), alts.astype(object)
-    over = (seg.cols["ref_len"] > shard.width) | (seg.cols["alt_len"] > shard.width)
+    over = (batch.ref_len > shard.width) | (batch.alt_len > shard.width)
     la = seg.obj[_LONG_ALLELES]
     for i in np.where(over)[0]:
-        retained = None if la is None else la[i]
+        retained = None if la is None else la[lo + i]
         if retained is None:
             raise ValueError(
-                f"row {i}: allele exceeds device width {shard.width} but the "
-                "original strings were not retained (store predates "
+                f"row {lo + i}: allele exceeds device width {shard.width} "
+                "but the original strings were not retained (store predates "
                 "long-allele retention; reload from source)"
             )
         refs[i], alts[i] = retained
@@ -184,16 +190,22 @@ def shard_strings(shard):
     # tests/test_egress_vectorized.py::test_shard_strings_matches_per_row
     mseq = metaseq_ids(batch, refs, alts)  # unicode array (no object cast)
 
-    rs = seg.cols["ref_snp"]
+    rs = seg.cols["ref_snp"][sl]
     suffix = np.where(
         rs >= 0, _concat(":rs", rs.clip(min=0).astype("U20")), ""
     )
     pks = np.char.add(mseq, suffix).astype(object)
     digests = seg.obj[_DIGEST_PK]
     if digests is not None:
-        for i in np.where(digests != None)[0]:  # noqa: E711 (object array)
-            pks[i] = digests[i]
+        dwin = digests[sl]
+        for i in np.where(dwin != None)[0]:  # noqa: E711 (object array)
+            pks[i] = dwin[i]
     return refs, alts, mseq, pks
+
+
+#: egress/export window size: bounds transient per-row Python string
+#: residency while keeping the vectorized assembly amortized
+EGRESS_WINDOW = 1 << 16
 
 
 _LONG = 100

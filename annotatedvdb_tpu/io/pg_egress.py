@@ -85,7 +85,7 @@ def computed_display_attributes(shard, window: np.ndarray) -> list:
 
 def shard_rows(shard):
     """Yield COPY-ordered value tuples for every row of one shard."""
-    from annotatedvdb_tpu.io.egress import shard_strings
+    from annotatedvdb_tpu.io.egress import EGRESS_WINDOW, shard_strings
 
     shard.compact()  # position-sorted global ids + flat column views
     label = chromosome_label(shard.chrom_code)
@@ -98,33 +98,39 @@ def shard_rows(shard):
     alg = shard.cols["row_algorithm_id"]
     pos = shard.cols["pos"]
     anns = shard.annotations
-    _refs, _alts, mseq_col, pk_col = shard_strings(shard)
-    # rows without stored display attributes get them recomputed in batches
-    display = anns["display_attributes"]
-    missing = np.array([display[i] is None for i in range(shard.n)])
-    if missing.any():
-        display = np.array(display, copy=True)
-        for start in range(0, shard.n, 1 << 16):
-            window = np.where(missing[start:start + (1 << 16)])[0] + start
-            if window.size:
-                display[window] = computed_display_attributes(shard, window)
-    for i in range(shard.n):
-        rs = f"rs{int(ref_snp[i])}" if ref_snp[i] >= 0 else None
-        values = [
-            pref,
-            pk_col[i],
-            int(pos[i]),
-            bool(multi[i]),
-            None if adsp[i] < 0 else bool(adsp[i]),
-            rs,
-            mseq_col[i],
-            closed_form_path(pref, int(lvl[i]), int(leaf[i])),
-        ]
-        for col in JSONB_COLUMNS:
-            ann = display[i] if col == "display_attributes" else anns[col][i]
-            values.append(None if ann is None else json.dumps(ann))
-        values.append(int(alg[i]))
-        yield values
+    display_col = anns["display_attributes"]
+    # windowed: string columns AND recomputed display attributes are
+    # assembled vectorized per EGRESS_WINDOW rows, never whole-shard
+    for lo in range(0, shard.n, EGRESS_WINDOW):
+        hi = min(lo + EGRESS_WINDOW, shard.n)
+        _refs, _alts, mseq_col, pk_col = shard_strings(shard, lo, hi)
+        display = [display_col[i] for i in range(lo, hi)]
+        missing = np.where(np.array([d is None for d in display]))[0]
+        if missing.size:
+            computed = computed_display_attributes(shard, missing + lo)
+            for j, d in zip(missing, computed):
+                display[j] = d
+        for j in range(hi - lo):
+            i = lo + j
+            rs = f"rs{int(ref_snp[i])}" if ref_snp[i] >= 0 else None
+            values = [
+                pref,
+                pk_col[j],
+                int(pos[i]),
+                bool(multi[i]),
+                None if adsp[i] < 0 else bool(adsp[i]),
+                rs,
+                mseq_col[j],
+                closed_form_path(pref, int(lvl[i]), int(leaf[i])),
+            ]
+            for col in JSONB_COLUMNS:
+                ann = (
+                    display[j] if col == "display_attributes"
+                    else anns[col][i]
+                )
+                values.append(None if ann is None else json.dumps(ann))
+            values.append(int(alg[i]))
+            yield values
 
 
 def export_store(store: VariantStore, out_dir: str,

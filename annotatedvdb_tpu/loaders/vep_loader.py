@@ -192,6 +192,18 @@ class TpuVepLoader:
         return rows
 
     def _apply_batch(self, rows: list[dict], alg_id: int, commit: bool) -> None:
+        # flushes trigger on raw RESULT count but rows are per-alt expanded:
+        # multi-allelic-heavy input can exceed the two warmed kernel shapes
+        # (p, 2p).  Split rather than compile a one-off bigger shape (~35s
+        # on TPU); sub-batches are independent (earlier writes land before
+        # later ones run, so the stored-value duplicate check still holds).
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        cap = 2 * next_pow2(self.batch_size)
+        if len(rows) > cap:
+            for lo in range(0, len(rows), cap):
+                self._apply_batch(rows[lo:lo + cap], alg_id, commit)
+            return
         batch = VariantBatch.from_tuples(
             [("1", r["pos"], r["ref"], r["alt"]) for r in rows],
             width=self.store.width,

@@ -91,23 +91,36 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
     // it does not parse to a number
     for (int i = 0; i + 1 < id.len; ++i)
         if (id.ptr[i] == 'r' && id.ptr[i + 1] == 's') return -1;
-    if (has_info) {
-        const char* s = info.ptr;
-        for (int i = 0; i + 3 <= info.len; ++i) {
-            if ((i == 0 || s[i - 1] == ';')
-                && s[i] == 'R' && s[i + 1] == 'S' && s[i + 2] == '=') {
-                int64_t v = 0;
-                int j = i + 3;
-                if (j >= info.len || s[j] < '0' || s[j] > '9') return -1;
-                for (; j < info.len && s[j] != ';'; ++j) {
-                    if (s[j] < '0' || s[j] > '9') return -1;
-                    v = v * 10 + (s[j] - '0');
+    if (!has_info) return -1;
+    // the Python chain routes the RS value through int() then re-prints it
+    // ("rs" + str(int(v))), so mirror int()'s accepted forms: optional '+'
+    // and single underscores BETWEEN digits; last RS= key wins (dict
+    // assignment order in parse_info)
+    const char* s = info.ptr;
+    int64_t result = -1;
+    for (int i = 0; i + 3 <= info.len; ++i) {
+        if ((i == 0 || s[i - 1] == ';')
+            && s[i] == 'R' && s[i + 1] == 'S' && s[i + 2] == '=') {
+            int64_t v = 0;
+            bool ok = false, prev_digit = false;
+            int j = i + 3;
+            if (j < info.len && s[j] == '+') ++j;
+            for (; j < info.len && s[j] != ';'; ++j) {
+                char c = s[j];
+                if (c >= '0' && c <= '9') {
+                    v = v * 10 + (c - '0');
+                    ok = prev_digit = true;
+                } else if (c == '_' && prev_digit) {
+                    prev_digit = false;  // int() wants digits on both sides
+                } else {
+                    ok = false;
+                    break;
                 }
-                return v;
             }
+            result = (ok && prev_digit) ? v : -1;
         }
     }
-    return -1;
+    return result;
 }
 
 }  // namespace

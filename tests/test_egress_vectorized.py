@@ -65,6 +65,16 @@ def test_shard_strings_matches_per_row(rng, tmp_path):
     for i in range(shard.n):
         assert (refs[i], alts[i]) == shard.alleles(i)
         assert pks[i] == shard.primary_key(i), i
+    # windowed assembly (the streaming-egress access pattern) must agree
+    # with the whole-shard call, including across the digest-tail row
+    w = 64
+    for lo in range(0, shard.n, w):
+        wr, wa, wm, wp = egress.shard_strings(shard, lo, lo + w)
+        hi = min(lo + w, shard.n)
+        assert list(wr) == list(refs[lo:hi])
+        assert list(wa) == list(alts[lo:hi])
+        assert list(wm) == list(mseq[lo:hi])
+        assert list(wp) == list(pks[lo:hi])
 
 
 def test_primary_keys_literal_and_rs_suffix(rng):

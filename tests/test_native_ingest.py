@@ -127,6 +127,30 @@ def test_native_over_width_fallback(tmp_path):
     assert chunk.batch.ref_len[i] == 80       # true length beyond the width
 
 
+def test_rs_info_fallback_parity(tmp_path):
+    """Pathological INFO RS= forms: the native scan must mirror the Python
+    chain (to_numeric/int() coercion then re-print), per-engine and
+    cross-engine."""
+    vcf = "\n".join([
+        "##fileformat=VCFv4.2",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "1\t100\t.\tA\tG\t.\t.\tRS=+12",       # int('+12') == 12
+        "1\t200\t.\tA\tG\t.\t.\tRS=1_2",       # int('1_2') == 12
+        "1\t300\t.\tA\tG\t.\t.\tRS=1;RS=2",    # last key wins
+        "1\t400\t.\tA\tG\t.\t.\tRS=-5",        # 'rs-5' -> -1
+        "1\t500\t.\tA\tG\t.\t.\tRS=1.5",       # float -> 'rs1.5' -> -1
+        "1\t600\t.\tA\tG\t.\t.\tRS=_1",        # int() rejects -> -1
+        "1\t700\t.\tA\tG\t.\t.\tRS=1__2",      # int() rejects -> -1
+        "1\t800\t.\tA\tG\t.\t.\tRS=",          # empty -> -1
+    ]) + "\n"
+    path = write_vcf(tmp_path, vcf)
+    py = read_all(path, engine="python", width=16)
+    nat = read_all(path, engine="native", width=16)
+    assert_chunks_equal(py, nat)
+    got = np.concatenate([c.rs_number for c in nat]).tolist()
+    assert got == [12, 12, 2, -1, -1, -1, -1, -1]
+
+
 def test_native_counters(tmp_path):
     path = write_vcf(tmp_path, TRICKY_VCF)
     (chunk,) = read_all(path, engine="native", width=16)
