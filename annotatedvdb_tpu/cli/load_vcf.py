@@ -94,6 +94,10 @@ def main(argv=None):
         log=log,
         log_after=cfg.effective_log_after,
     )
+    # compile the device kernels (and probe the packed-output transport)
+    # before streaming begins: a steady-state load should not pay the
+    # first-compile cost mid-stream
+    loader.warmup()
     with device_trace(args.profile):
         counters = loader.load_file(
             args.fileName,

@@ -63,6 +63,24 @@ def _pad_batch(batch: VariantBatch, n_target: int) -> VariantBatch:
     )
 
 
+def _slim_annotated(n: int, bin_level, leaf_bin, needs_digest,
+                    host_fallback) -> AnnotatedBatch:
+    """AnnotatedBatch carrying only the store-path columns; the display
+    fields (derivable on demand, see ``store_display_attributes``) are
+    zero-filled.  Shared by the packed and per-field fetch paths so the two
+    transports cannot drift."""
+    zeros_i32 = np.zeros(n, np.int32)
+    return AnnotatedBatch(
+        prefix_len=zeros_i32, norm_ref_len=zeros_i32,
+        norm_alt_len=zeros_i32, end_location=zeros_i32,
+        location_start=zeros_i32, location_end=zeros_i32,
+        variant_class=np.zeros(n, np.int8),
+        is_dup_motif=np.zeros(n, np.bool_),
+        bin_level=bin_level, leaf_bin=leaf_bin,
+        needs_digest=needs_digest, host_fallback=host_fallback,
+    )
+
+
 class TpuVcfLoader:
     """Insert-or-skip VCF loads into a :class:`VariantStore`."""
 
@@ -282,6 +300,32 @@ class TpuVcfLoader:
             batch.ref_len, batch.alt_len,
         )
         np.asarray(ann.variant_class), np.asarray(dup)
+        if self.mesh is None and not self.store_display_attributes:
+            # compile the output packer AND verify the packed transport
+            # bit-exactly reproduces the individual fields on this backend
+            # (bitcast byte order is hardware-defined; probe it here, not
+            # mid-load)
+            from annotatedvdb_tpu.ops.pack import (
+                pack_outputs_jit,
+                unpack_outputs,
+            )
+
+            packed = pack_outputs_jit(
+                h, dup, ann.bin_level, ann.leaf_bin,
+                ann.needs_digest, ann.host_fallback,
+            )
+            cols = unpack_outputs(np.asarray(packed))
+            for name, ref_val in (
+                ("h", h), ("dup", dup), ("bin_level", ann.bin_level),
+                ("leaf_bin", ann.leaf_bin),
+                ("needs_digest", ann.needs_digest),
+                ("host_fallback", ann.host_fallback),
+            ):
+                if not (cols[name] == np.asarray(ref_val)).all():
+                    raise RuntimeError(
+                        f"packed-output transport mismatch in {name!r}; "
+                        "refusing to load with single-fetch packing"
+                    )
 
     def _annotate(self, batch: VariantBatch) -> AnnotatedBatch:
         """One annotate step: distributed over the mesh when present, else
@@ -300,17 +344,10 @@ class TpuVcfLoader:
         if self.store_display_attributes:
             out = AnnotatedBatch(*(np.asarray(x)[:n] for x in ann_p))
             return out._replace(host_fallback=host_rows)
-        zeros_i32 = np.zeros(n, np.int32)
-        return AnnotatedBatch(
-            prefix_len=zeros_i32, norm_ref_len=zeros_i32,
-            norm_alt_len=zeros_i32, end_location=zeros_i32,
-            location_start=zeros_i32, location_end=zeros_i32,
-            variant_class=np.zeros(n, np.int8),
-            is_dup_motif=np.zeros(n, np.bool_),
-            bin_level=np.asarray(ann_p.bin_level)[:n],
-            leaf_bin=np.asarray(ann_p.leaf_bin)[:n],
-            needs_digest=np.asarray(ann_p.needs_digest)[:n],
-            host_fallback=host_rows,
+        return _slim_annotated(
+            n, np.asarray(ann_p.bin_level)[:n],
+            np.asarray(ann_p.leaf_bin)[:n],
+            np.asarray(ann_p.needs_digest)[:n], host_rows,
         )
 
     def _annotate_distributed(self, batch: VariantBatch) -> AnnotatedBatch:
@@ -398,8 +435,25 @@ class TpuVcfLoader:
         dup_dev = mark_batch_duplicates_jit(
             dev[1], mixed, dev[2], dev[3], dev[4], dev[5]
         )
-        return {"padded": padded, "dev": dev, "ann_p": ann_p,
-                "h_dev": h_dev, "dup_dev": dup_dev}
+        handles = {"padded": padded, "dev": dev, "ann_p": ann_p,
+                   "h_dev": h_dev, "dup_dev": dup_dev}
+        if not self.store_display_attributes:
+            # remote-attached TPUs pay a fixed round trip PER materialized
+            # array; pack the six per-row outputs on device so process time
+            # fetches once.  transport_verified() probes bit-exactness of
+            # the bitcast byte order once per process — backends that fail
+            # it keep the per-field fetch path.
+            from annotatedvdb_tpu.ops.pack import (
+                pack_outputs_jit,
+                transport_verified,
+            )
+
+            if transport_verified():
+                handles["packed"] = pack_outputs_jit(
+                    h_dev, dup_dev, ann_p.bin_level, ann_p.leaf_bin,
+                    ann_p.needs_digest, ann_p.host_fallback,
+                )
+        return handles
 
     def _process_chunk(self, chunk: VcfChunk, handles: dict, alg_id, commit,
                        resume_line, mapping_fh):
@@ -427,15 +481,29 @@ class TpuVcfLoader:
             n = batch.n
             padded = handles["padded"]
             ann_p = handles["ann_p"]
-            h_p = np.array(handles["h_dev"])
-            host_rows = np.asarray(ann_p.host_fallback)[:n]
+            if handles.get("packed") is not None:
+                # single-fetch path: one [n_padded, 10] uint8 transfer
+                # carries hash + dup + bin + flags (ops/pack.py)
+                from annotatedvdb_tpu.ops.pack import unpack_outputs
+
+                cols = unpack_outputs(np.asarray(handles["packed"]))
+                h_p = cols["h"].copy()
+                host_rows = cols["host_fallback"][:n]
+                dup_src = cols["dup"]  # already on host
+            else:
+                h_p = np.array(handles["h_dev"])
+                host_rows = np.asarray(ann_p.host_fallback)[:n]
+                cols = None
+                # device handle, materialized lazily below — fetching it
+                # when host_rows invalidates it would waste a round trip
+                dup_src = handles["dup_dev"]
             # long alleles are truncated in the device arrays: re-hash them
             # from the original strings so identity never collides on a
             # shared prefix
             for i in np.where(host_rows)[0]:
                 h_p[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
-            if handles["dup_dev"] is not None and not host_rows.any():
-                dup = np.asarray(handles["dup_dev"])[:n]
+            if dup_src is not None and not host_rows.any():
+                dup = np.asarray(dup_src)[:n]
             else:
                 # fallback rows invalidate the speculative device dedup (it
                 # used truncated-prefix hashes): redo with host-corrected
@@ -448,7 +516,13 @@ class TpuVcfLoader:
                     )
                 )[:n]
             h = h_p[:n]
-            ann = self._fetch_annotations(ann_p, n, host_rows)
+            if cols is not None:
+                ann = _slim_annotated(
+                    n, cols["bin_level"][:n], cols["leaf_bin"][:n],
+                    cols["needs_digest"][:n], host_rows,
+                )
+            else:
+                ann = self._fetch_annotations(ann_p, n, host_rows)
         # replayed rows within a partially-committed chunk
         replay = chunk.line_number <= resume_line
 

@@ -71,6 +71,11 @@ struct Span {
     int len;
 };
 
+inline bool is_space(char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r'
+        || c == '\v' || c == '\f';
+}
+
 // refsnp number for one site: ID "rs<digits>" wins, else INFO "RS=<digits>"
 // (key-anchored: start of INFO or after ';'), else -1.  Mirrors the Python
 // reader's ref_snp derivation + loaders' _rs_number parse so the insert path
@@ -104,6 +109,8 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
             int64_t v = 0;
             bool ok = false, prev_digit = false;
             int j = i + 3;
+            // int() strips surrounding ASCII whitespace
+            while (j < info.len && is_space(s[j])) ++j;
             if (j < info.len && s[j] == '+') ++j;
             for (; j < info.len && s[j] != ';'; ++j) {
                 char c = s[j];
@@ -112,6 +119,12 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
                     ok = prev_digit = true;
                 } else if (c == '_' && prev_digit) {
                     prev_digit = false;  // int() wants digits on both sides
+                } else if (is_space(c) && ok && prev_digit) {
+                    // trailing whitespace only: anything after must be
+                    // whitespace until ';' or end
+                    for (; j < info.len && s[j] != ';'; ++j)
+                        if (!is_space(s[j])) { ok = false; break; }
+                    break;
                 } else {
                     ok = false;
                     break;

@@ -142,13 +142,14 @@ def test_rs_info_fallback_parity(tmp_path):
         "1\t600\t.\tA\tG\t.\t.\tRS=_1",        # int() rejects -> -1
         "1\t700\t.\tA\tG\t.\t.\tRS=1__2",      # int() rejects -> -1
         "1\t800\t.\tA\tG\t.\t.\tRS=",          # empty -> -1
+        "1\t900\t.\tA\tG\t.\t.\tRS= 12",       # int() strips whitespace
     ]) + "\n"
     path = write_vcf(tmp_path, vcf)
     py = read_all(path, engine="python", width=16)
     nat = read_all(path, engine="native", width=16)
     assert_chunks_equal(py, nat)
     got = np.concatenate([c.rs_number for c in nat]).tolist()
-    assert got == [12, 12, 2, -1, -1, -1, -1, -1]
+    assert got == [12, 12, 2, -1, -1, -1, -1, -1, 12]
 
 
 def test_native_counters(tmp_path):

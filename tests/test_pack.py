@@ -1,0 +1,41 @@
+"""Packed single-fetch output transport (ops/pack.py): bit-exact parity."""
+
+import numpy as np
+
+from annotatedvdb_tpu.ops.pack import WIDTH, pack_outputs_jit, unpack_outputs
+
+
+def test_pack_roundtrip_random():
+    rng = np.random.default_rng(7)
+    n = 4096
+    h = rng.integers(0, 2**32, n, dtype=np.uint32)
+    dup = rng.random(n) < 0.3
+    level = rng.integers(0, 15, n).astype(np.int32)
+    leaf = rng.integers(-1, 20000, n).astype(np.int32)
+    nd = rng.random(n) < 0.01
+    hf = rng.random(n) < 0.01
+    packed = np.asarray(pack_outputs_jit(h, dup, level, leaf, nd, hf))
+    assert packed.shape == (n, WIDTH) and packed.dtype == np.uint8
+    cols = unpack_outputs(packed)
+    assert (cols["h"] == h).all()
+    assert (cols["dup"] == dup).all()
+    assert (cols["bin_level"] == level).all()
+    assert (cols["leaf_bin"] == leaf).all()          # negatives survive
+    assert (cols["needs_digest"] == nd).all()
+    assert (cols["host_fallback"] == hf).all()
+
+
+def test_pack_extreme_values():
+    h = np.array([0, 1, 0xFFFFFFFF, 0xDEADBEEF], np.uint32)
+    leaf = np.array([-(2**31), 2**31 - 1, 0, -1], np.int32)
+    level = np.array([0, 255, 13, 1], np.int32)
+    t = np.array([True, False, True, False])
+    cols = unpack_outputs(
+        np.asarray(pack_outputs_jit(h, t, level, leaf, ~t, t))
+    )
+    assert (cols["h"] == h).all()
+    assert (cols["leaf_bin"] == leaf).all()
+    assert (cols["bin_level"] == (level & 0xFF)).all()
+    assert (cols["dup"] == t).all()
+    assert (cols["needs_digest"] == ~t).all()
+    assert (cols["host_fallback"] == t).all()
