@@ -555,18 +555,33 @@ class TpuVcfLoader:
             sel = np.concatenate(insert_rows)
             sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
             sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
-            # allele strings decode vectorized from the device arrays (one
-            # view op) — only the over-width tail needs the parser sidecar's
-            # original strings (a lazy per-row span decode, ~µs each)
-            refs, alts = egress.decode_alleles(sub)
-            refs, alts = refs.astype(object), alts.astype(object)
             over = (
                 (sub.ref_len > self.store.width)
                 | (sub.alt_len > self.store.width)
             )
-            for j in np.where(over)[0]:
-                refs[j] = chunk.refs[int(sel[j])]
-                alts[j] = chunk.alts[int(sel[j])]
+            # allele-string object arrays cost a PyObject per row: build
+            # them only for the paths that read them (PKs for the mapping
+            # sidecar / digest rows, genome validation, display attributes,
+            # retained long alleles).  The common insert path stores the
+            # fixed-width byte matrices directly and never needs strings.
+            need_strings = (
+                mapping_fh is not None
+                or self.genome is not None
+                or self.store_display_attributes
+                or bool(over.any())
+                or bool(np.asarray(sub_ann.needs_digest).any())
+            )
+            if need_strings:
+                # vectorized view-decode; only the over-width tail needs
+                # the parser sidecar's original strings (a lazy per-row
+                # span decode, ~µs each)
+                refs, alts = egress.decode_alleles(sub)
+                refs, alts = refs.astype(object), alts.astype(object)
+                for j in np.where(over)[0]:
+                    refs[j] = chunk.refs[int(sel[j])]
+                    alts[j] = chunk.alts[int(sel[j])]
+            else:
+                refs = alts = None
             # rs numbers come pre-parsed from the reader (one int64 column);
             # the string forms are only materialized on the PK path below
             rs_sel = (

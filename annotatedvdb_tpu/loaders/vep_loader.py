@@ -90,6 +90,11 @@ class TpuVepLoader:
         from annotatedvdb_tpu.io.synth import synthetic_batch
         from annotatedvdb_tpu.utils.arrays import next_pow2
 
+        from annotatedvdb_tpu.ops.pack import (
+            pack_vep_outputs_jit,
+            transport_verified,
+        )
+
         p = next_pow2(self.batch_size)
         for shape in {p, next_pow2(p + 1)}:
             b = synthetic_batch(shape, width=self.store.width)
@@ -97,7 +102,12 @@ class TpuVepLoader:
                 b.chrom, b.pos, b.ref, b.alt, b.ref_len, b.alt_len
             )
             h = allele_hash_jit(b.ref, b.alt, b.ref_len, b.alt_len)
-            np.asarray(ann.prefix_len), np.asarray(h)
+            if transport_verified() and self.store.width <= 255:
+                np.asarray(
+                    pack_vep_outputs_jit(h, ann.prefix_len, ann.host_fallback)
+                )
+            else:
+                np.asarray(ann.prefix_len), np.asarray(h)
 
     def load_file(self, path: str, commit: bool = False, test: bool = False) -> dict:
         alg_id = self.ledger.begin(
@@ -226,15 +236,34 @@ class TpuVepLoader:
             padded.chrom, padded.pos, padded.ref, padded.alt,
             padded.ref_len, padded.alt_len,
         )
-        # only two annotate outputs feed the update path — fetch just those
-        # (forcing all 11 fields costs one host<->device round trip each)
-        prefix = np.asarray(ann_p.prefix_len)[:n]
-        host = np.asarray(ann_p.host_fallback)[:n]
-        h = np.array(
-            allele_hash_jit(
-                padded.ref, padded.alt, padded.ref_len, padded.alt_len
+        h_dev = allele_hash_jit(
+            padded.ref, padded.alt, padded.ref_len, padded.alt_len
+        )
+        # only hash + prefix + fallback-flag feed the update path; pack them
+        # into ONE fetched buffer — each materialization pays a fixed round
+        # trip on remote-attached TPUs (see ops/pack.py)
+        from annotatedvdb_tpu.ops.pack import (
+            pack_vep_outputs_jit,
+            transport_verified,
+            unpack_vep_outputs,
+        )
+
+        # width bound: prefix_len rides a uint8 lane (pack truncates >255)
+        if transport_verified() and self.store.width <= 255:
+            cols = unpack_vep_outputs(
+                np.asarray(
+                    pack_vep_outputs_jit(
+                        h_dev, ann_p.prefix_len, ann_p.host_fallback
+                    )
+                )
             )
-        )[:n]
+            prefix = cols["prefix_len"][:n]
+            host = cols["host_fallback"][:n]
+            h = cols["h"][:n]
+        else:
+            prefix = np.asarray(ann_p.prefix_len)[:n]
+            host = np.asarray(ann_p.host_fallback)[:n]
+            h = np.array(h_dev)[:n]
         from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
         from annotatedvdb_tpu.oracle import normalize_alleles
 

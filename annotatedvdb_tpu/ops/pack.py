@@ -48,6 +48,38 @@ def pack_outputs(h, dup, bin_level, leaf_bin, needs_digest, host_fallback):
 pack_outputs_jit = jax.jit(pack_outputs)
 
 
+#: update-path row layout: uint32 hash, uint8 prefix_len, uint8 flags(bit0
+#: host_fallback).  prefix_len <= allele width; callers must gate this pack
+#: on width <= 255 (the uint8 lane truncates beyond that).
+VEP_WIDTH = 6
+
+
+def pack_vep_outputs(h, prefix_len, host_fallback):
+    """[n] update-path device outputs -> [n, 6] uint8 (one fetch)."""
+    n = h.shape[0]
+    h_b = lax.bitcast_convert_type(h.astype(jnp.uint32), jnp.uint8)
+    return jnp.concatenate(
+        [
+            h_b,
+            prefix_len.astype(jnp.uint8).reshape(n, 1),
+            host_fallback.astype(jnp.uint8).reshape(n, 1),
+        ],
+        axis=1,
+    )
+
+
+pack_vep_outputs_jit = jax.jit(pack_vep_outputs)
+
+
+def unpack_vep_outputs(packed: np.ndarray):
+    packed = np.asarray(packed)
+    return {
+        "h": np.ascontiguousarray(packed[:, :4]).view(np.uint32).reshape(-1),
+        "prefix_len": packed[:, 4].astype(np.int32),
+        "host_fallback": packed[:, 5].astype(bool),
+    }
+
+
 _TRANSPORT_OK: bool | None = None
 
 

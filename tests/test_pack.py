@@ -25,6 +25,40 @@ def test_pack_roundtrip_random():
     assert (cols["host_fallback"] == hf).all()
 
 
+def test_pack_vep_roundtrip():
+    from annotatedvdb_tpu.ops.pack import (
+        VEP_WIDTH,
+        pack_vep_outputs_jit,
+        unpack_vep_outputs,
+    )
+
+    rng = np.random.default_rng(3)
+    n = 2048
+    h = rng.integers(0, 2**32, n, dtype=np.uint32)
+    prefix = rng.integers(0, 50, n).astype(np.int32)
+    host = rng.random(n) < 0.02
+    packed = np.asarray(pack_vep_outputs_jit(h, prefix, host))
+    assert packed.shape == (n, VEP_WIDTH)
+    cols = unpack_vep_outputs(packed)
+    assert (cols["h"] == h).all()
+    assert (cols["prefix_len"] == prefix).all()
+    assert (cols["host_fallback"] == host).all()
+
+
+def test_transport_probe():
+    import sys
+
+    from annotatedvdb_tpu.ops.pack import transport_verified
+
+    ok = transport_verified()
+    assert isinstance(ok, bool)
+    if sys.byteorder == "little":
+        # on a little-endian host with the (little-endian) CPU/TPU backends
+        # the packed transport must verify; elsewhere False is the designed
+        # degradation, not a failure
+        assert ok is True
+
+
 def test_pack_extreme_values():
     h = np.array([0, 1, 0xFFFFFFFF, 0xDEADBEEF], np.uint32)
     leaf = np.array([-(2**31), 2**31 - 1, 0, -1], np.int32)
