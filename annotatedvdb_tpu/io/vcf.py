@@ -125,6 +125,15 @@ class VcfChunk:
     #: bool per row: INFO carries a FREQ entry.  The insert path skips the
     #: frequencies column entirely for chunks with no flagged row.
     has_freq: np.ndarray | None = None
+    #: nibble-packed [n, ceil(width/2)] allele matrices (ops/pack.py codes),
+    #: present only when every row packs — the loader uploads these instead
+    #: of the raw byte matrices and inflates on device
+    ref_packed: np.ndarray | None = None
+    alt_packed: np.ndarray | None = None
+    #: tri-state: True = packed arrays present, False = the reader scanned
+    #: and found out-of-alphabet bytes (don't re-try on the host), None =
+    #: packing was never attempted (Python engine / synthetic chunks)
+    alleles_packable: bool | None = None
 
 
 class VcfBatchReader:
@@ -142,12 +151,16 @@ class VcfBatchReader:
 
     def __init__(self, path: str, batch_size: int = 1 << 16, width: int = 49,
                  chromosome_map: dict | None = None, identity_only: bool = False,
-                 engine: str = "auto"):
+                 engine: str = "auto", pack_alleles: bool = True):
         self.path = path
         self.batch_size = batch_size
         self.width = width
         self.chromosome_map = chromosome_map
         self.identity_only = identity_only
+        #: pre-pack alleles for device upload during the native scan;
+        #: consumers that never upload (mesh-path loads, export scans)
+        #: turn this off to skip the per-byte pack work
+        self.pack_alleles = pack_alleles
         if engine not in ("auto", "python", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         self.engine = engine
@@ -177,7 +190,8 @@ class VcfBatchReader:
             from annotatedvdb_tpu.native.vcf import iter_native_chunks
 
             yield from iter_native_chunks(
-                self.path, self.batch_size, self.width, self.identity_only
+                self.path, self.batch_size, self.width, self.identity_only,
+                self.pack_alleles
             )
             return
         yield from self._iter_python()

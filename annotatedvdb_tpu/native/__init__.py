@@ -67,7 +67,9 @@ def load():
             c.c_void_p, c.c_void_p,                            # format
             c.c_void_p, c.c_void_p,                            # altcol
             c.c_void_p, c.c_void_p,                            # alt_index, n_alts
-            c.c_void_p, c.c_void_p, c.c_int32,                 # rs_number, has_freq, identity_only
+            c.c_void_p, c.c_void_p,                            # rs_number, has_freq
+            c.c_void_p, c.c_void_p, c.c_void_p,               # ref_packed, alt_packed, pack_ok
+            c.c_int32, c.c_int32,                              # identity_only, want_packed
             c.c_void_p, c.c_void_p, c.c_void_p,               # counters, consumed, need_more
         ]
         lib.avdb_parse_rs.restype = c.c_int32

@@ -53,6 +53,9 @@ class _Arrays:
         self.n_alts = np.zeros(cap, np.int32)
         self.rs_number = np.zeros(cap, np.int64)
         self.has_freq = np.zeros(cap, np.uint8)
+        self.ref_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
+        self.alt_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
+        self.pack_ok = np.zeros(cap, np.uint8)
 
     def pointers(self):
         def p(a):
@@ -69,10 +72,12 @@ class _Arrays:
             p(self.altcol_off), p(self.altcol_len),
             p(self.alt_index), p(self.n_alts), p(self.rs_number),
             p(self.has_freq),
+            p(self.ref_packed), p(self.alt_packed), p(self.pack_ok),
         ]
 
 
-def scan_native(path: str, batch_size: int, width: int, identity_only: bool):
+def scan_native(path: str, batch_size: int, width: int, identity_only: bool,
+                pack_alleles: bool = True):
     """Yield (arrays, n_rows, window_bytes, counters_dict) per batch.
 
     ``window_bytes`` is the bytes object the span columns index into; it must
@@ -121,6 +126,7 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool):
                     line_base,
                     *arrays.pointers(),
                     ctypes.c_int32(1 if identity_only else 0),
+                    ctypes.c_int32(1 if pack_alleles else 0),
                     counters.ctypes.data_as(ctypes.c_void_p),
                     ctypes.byref(consumed), ctypes.byref(need_more),
                 )
@@ -244,6 +250,14 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     n_alts = arrays.n_alts[:n].copy()
     rs_number = arrays.rs_number[:n].copy()
     has_freq = arrays.has_freq[:n].astype(bool)
+    # pre-packed alleles travel with the chunk only when EVERY row packs
+    # (the loader uploads whole chunks either packed or raw)
+    packable = bool(arrays.pack_ok[:n].all())
+    if packable:
+        ref_packed = arrays.ref_packed[:n].copy()
+        alt_packed = arrays.alt_packed[:n].copy()
+    else:
+        ref_packed = alt_packed = None
     line_no = arrays.line_no[:n].copy()
     mv = memoryview(window)
 
@@ -307,6 +321,9 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         info=LazyColumn(n, lambda i: info_at(i)[0]),
         line_number=line_no,
         rs_number=rs_number,
+        ref_packed=ref_packed,
+        alt_packed=alt_packed,
+        alleles_packable=packable,
         qual=LazyColumn(n, opt(qual_off, qual_len)),
         filter=LazyColumn(n, opt(filter_off, filter_len)),
         format=LazyColumn(n, opt(format_off, format_len)),
@@ -315,12 +332,12 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
 
 
 def iter_native_chunks(path: str, batch_size: int, width: int,
-                       identity_only: bool):
+                       identity_only: bool, pack_alleles: bool = True):
     """VcfChunk iterator over the native scanner (engine='native')."""
     pending_counters = {"line": 0, "skipped_contig": 0, "skipped_alt": 0,
                         "malformed": 0}
     for arrays, n, window, base, counters in scan_native(
-            path, batch_size, width, identity_only):
+            path, batch_size, width, identity_only, pack_alleles):
         for k, v in counters.items():
             pending_counters[k] = pending_counters.get(k, 0) + v
         if n == 0:

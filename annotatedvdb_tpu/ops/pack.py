@@ -48,6 +48,91 @@ def pack_outputs(h, dup, bin_level, leaf_bin, needs_digest, host_fallback):
 pack_outputs_jit = jax.jit(pack_outputs)
 
 
+# ---- nibble-packed allele uploads ------------------------------------
+#
+# Upload bandwidth is the insert path's floor on remote-attached TPUs: the
+# [n, width] ref/alt byte matrices are ~90% of the bytes.  Alleles are
+# (almost) always drawn from a tiny alphabet, so the host packs two bases
+# per byte and a jitted preamble inflates them back to the exact ASCII
+# matrices on device — the annotate/hash/dedup kernels are unchanged.
+# Chunks containing any out-of-alphabet byte (symbolic alleles, breakends)
+# upload unpacked; correctness never depends on packing.
+
+#: code 0 is the zero pad byte; 15 codes remain for the allele alphabet
+_ALPHABET = b"ACGTNacgtn*.-"
+_ENC = np.full(256, 255, np.uint8)
+_ENC[0] = 0
+for _i, _c in enumerate(_ALPHABET, start=1):
+    _ENC[_c] = _i
+_DEC = np.zeros(16, np.uint8)
+for _i, _c in enumerate(_ALPHABET, start=1):
+    _DEC[_i] = _c
+_DEC_DEV = jnp.asarray(_DEC)
+
+
+def encode_alleles_nibble(ref: np.ndarray, alt: np.ndarray):
+    """Host-side 4-bit pack of two [n, w] allele byte matrices.
+
+    Returns ``(ref_packed, alt_packed)`` of shape [n, ceil(w/2)] — or None
+    when any byte falls outside the packable alphabet (caller uploads the
+    raw matrices instead)."""
+    w = ref.shape[1]
+    cols = (w + 1) // 2
+    codes_r = _ENC[ref]
+    codes_a = _ENC[alt]
+    if (codes_r == 255).any() or (codes_a == 255).any():
+        return None
+    if w % 2:
+        pad = ((0, 0), (0, 1))
+        codes_r = np.pad(codes_r, pad)
+        codes_a = np.pad(codes_a, pad)
+    rp = codes_r[:, 0::2] | (codes_r[:, 1::2] << 4)
+    ap = codes_a[:, 0::2] | (codes_a[:, 1::2] << 4)
+    assert rp.shape[1] == cols
+    return rp, ap
+
+
+def _inflate_one(packed, width: int):
+    n, cols = packed.shape
+    lo = packed & jnp.uint8(0xF)
+    hi = packed >> jnp.uint8(4)
+    codes = jnp.stack([lo, hi], axis=2).reshape(n, 2 * cols)
+    return jnp.take(_DEC_DEV, codes, axis=0)[:, :width]
+
+
+def inflate_alleles(ref_packed, alt_packed, width: int):
+    """Device-side inverse of :func:`encode_alleles_nibble`."""
+    return _inflate_one(ref_packed, width), _inflate_one(alt_packed, width)
+
+
+inflate_alleles_jit = jax.jit(inflate_alleles, static_argnums=2)
+
+_NIBBLE_OK: bool | None = None
+
+
+def nibble_verified() -> bool:
+    """One-time probe that encode->upload->inflate reproduces the exact
+    byte matrices on this backend (same contract as
+    :func:`transport_verified`; callers upload raw matrices when False)."""
+    global _NIBBLE_OK
+    if _NIBBLE_OK is None:
+        probe = np.zeros((4, 7), np.uint8)  # odd width exercises the pad
+        probe[0, :5] = np.frombuffer(b"ACGTN", np.uint8)
+        probe[1, :3] = np.frombuffer(b"acg", np.uint8)
+        probe[2, :7] = np.frombuffer(b"*.-TGCA", np.uint8)
+        probe[3, :1] = np.frombuffer(b"G", np.uint8)
+        enc = encode_alleles_nibble(probe, probe[::-1].copy())
+        if enc is None:
+            _NIBBLE_OK = False
+        else:
+            r, a = inflate_alleles_jit(enc[0], enc[1], 7)
+            _NIBBLE_OK = bool(
+                (np.asarray(r) == probe).all()
+                and (np.asarray(a) == probe[::-1]).all()
+            )
+    return _NIBBLE_OK
+
+
 #: update-path row layout: uint32 hash, uint8 prefix_len, uint8 flags(bit0
 #: host_fallback).  prefix_len <= allele width; callers must gate this pack
 #: on width <= 255 (the uint8 lane truncates beyond that).

@@ -76,6 +76,34 @@ inline bool is_space(char c) {
         || c == '\v' || c == '\f';
 }
 
+// 4-bit allele codes for nibble-packed device uploads; 0 = pad byte,
+// 255 = unpackable.  MUST match _ALPHABET in annotatedvdb_tpu/ops/pack.py.
+struct NibbleLut {
+    uint8_t enc[256];
+    NibbleLut() {
+        memset(enc, 255, sizeof(enc));
+        enc[0] = 0;
+        const char* alphabet = "ACGTNacgtn*.-";
+        for (int i = 0; alphabet[i]; ++i)
+            enc[static_cast<uint8_t>(alphabet[i])] =
+                static_cast<uint8_t>(i + 1);
+    }
+};
+const NibbleLut kNibble;
+
+// pack one width-w byte row into ceil(w/2) nibble pairs; returns false on
+// any out-of-alphabet byte (row left undefined, caller uploads raw bytes)
+inline bool pack_row(const uint8_t* src, int width, uint8_t* dst) {
+    int cols = (width + 1) / 2;
+    for (int k = 0; k < cols; ++k) {
+        uint8_t lo = kNibble.enc[src[2 * k]];
+        uint8_t hi = (2 * k + 1 < width) ? kNibble.enc[src[2 * k + 1]] : 0;
+        if (lo == 255 || hi == 255) return false;
+        dst[k] = static_cast<uint8_t>(lo | (hi << 4));
+    }
+    return true;
+}
+
 // refsnp number for one site: ID "rs<digits>" wins, else INFO "RS=<digits>"
 // (key-anchored: start of INFO or after ';'), else -1.  Mirrors the Python
 // reader's ref_snp derivation + loaders' _rs_number parse so the insert path
@@ -174,7 +202,13 @@ int64_t avdb_parse_vcf_chunk(
     // 1 when INFO carries a key-anchored FREQ= entry (the insert path reads
     // the frequencies column for every row; this flag lets it skip the lazy
     // INFO parse wholesale on FREQ-less rows/chunks)
-    uint8_t* has_freq, int32_t identity_only,
+    uint8_t* has_freq,
+    // nibble-packed allele uploads: [cap, ceil(width/2)] each + per-row
+    // packable flag (0 when the row holds out-of-alphabet bytes).
+    // want_packed=0 skips the pack work entirely (consumers that never
+    // upload, e.g. mesh-path loads and export scans)
+    uint8_t* ref_packed, uint8_t* alt_packed, uint8_t* pack_ok,
+    int32_t identity_only, int32_t want_packed,
     int64_t* counters, int64_t* consumed, int32_t* need_more) {
     int64_t rows = 0;
     int64_t offset = 0;
@@ -312,6 +346,16 @@ int64_t avdb_parse_vcf_chunk(
                     n_alts_out[r] = n_alts;
                     rs_number[r] = rs;
                     has_freq[r] = freq_flag;
+                    if (want_packed) {
+                        int cols = (width + 1) / 2;
+                        bool ok = pack_row(ref + r * width, width,
+                                           ref_packed + r * cols)
+                               && pack_row(alt + r * width, width,
+                                           alt_packed + r * cols);
+                        pack_ok[r] = ok ? 1 : 0;
+                    } else {
+                        pack_ok[r] = 0;
+                    }
                 }
                 alt_start = q + 1;
             }

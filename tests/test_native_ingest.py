@@ -152,6 +152,34 @@ def test_rs_info_fallback_parity(tmp_path):
     assert got == [12, 12, 2, -1, -1, -1, -1, -1, 12]
 
 
+def test_native_prepacked_alleles_match_host_encoder(tmp_path):
+    """The tokenizer's inline nibble pack == ops.pack.encode_alleles_nibble
+    over the same byte matrices; chunks with symbolic alleles ship none."""
+    from annotatedvdb_tpu.ops.pack import encode_alleles_nibble
+
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    for chunk in read_all(path, engine="native", width=16):
+        enc = encode_alleles_nibble(
+            np.asarray(chunk.batch.ref), np.asarray(chunk.batch.alt)
+        )
+        # both directions: the C++ and Python alphabets must agree on
+        # WHETHER the chunk packs, not just on the packed bytes
+        assert (chunk.ref_packed is None) == (enc is None)
+        if enc is not None:
+            assert (chunk.ref_packed == enc[0]).all()
+            assert (chunk.alt_packed == enc[1]).all()
+
+    sym = (
+        "##fileformat=VCFv4.2\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "1\t100\t.\tA\t<DEL>\t.\t.\t.\n"
+    )
+    (tmp_path / "s").mkdir()
+    p2 = write_vcf(tmp_path / "s", sym)
+    (chunk,) = read_all(p2, engine="native", width=16)
+    assert chunk.ref_packed is None  # symbolic allele blocks chunk packing
+    assert chunk.alleles_packable is False
+
+
 def test_native_counters(tmp_path):
     path = write_vcf(tmp_path, TRICKY_VCF)
     (chunk,) = read_all(path, engine="native", width=16)

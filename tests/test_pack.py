@@ -59,6 +59,39 @@ def test_transport_probe():
         assert ok is True
 
 
+def test_nibble_alleles_roundtrip():
+    from annotatedvdb_tpu.ops.pack import (
+        encode_alleles_nibble,
+        inflate_alleles_jit,
+    )
+
+    rng = np.random.default_rng(11)
+    for width in (16, 49):  # even and odd widths
+        alphabet = np.frombuffer(b"ACGTNacgtn*.-", np.uint8)
+        lens = rng.integers(1, width + 1, 512)
+        ref = np.zeros((512, width), np.uint8)
+        alt = np.zeros((512, width), np.uint8)
+        for i, L in enumerate(lens):
+            ref[i, :L] = rng.choice(alphabet, L)
+            alt[i, :L] = rng.choice(alphabet, L)
+        enc = encode_alleles_nibble(ref, alt)
+        assert enc is not None
+        assert enc[0].shape == (512, (width + 1) // 2)
+        r, a = inflate_alleles_jit(enc[0], enc[1], width)
+        assert (np.asarray(r) == ref).all()
+        assert (np.asarray(a) == alt).all()
+
+
+def test_nibble_alleles_rejects_exotic_bytes():
+    from annotatedvdb_tpu.ops.pack import encode_alleles_nibble
+
+    ref = np.zeros((4, 8), np.uint8)
+    alt = np.zeros((4, 8), np.uint8)
+    ref[0, :3] = np.frombuffer(b"ACG", np.uint8)
+    alt[2, :5] = np.frombuffer(b"<DEL>", np.uint8)  # symbolic allele
+    assert encode_alleles_nibble(ref, alt) is None
+
+
 def test_pack_extreme_values():
     h = np.array([0, 1, 0xFFFFFFFF, 0xDEADBEEF], np.uint32)
     leaf = np.array([-(2**31), 2**31 - 1, 0, -1], np.int32)
