@@ -53,7 +53,9 @@ def vcf_subsets(updater: TpuCaddUpdater, path: str) -> dict[int, np.ndarray]:
 
     updater.store.compact()
     hits: dict[int, list] = {}
-    for chunk in VcfBatchReader(path, width=updater.store.width):
+    # membership scan only — packed allele uploads are never used here
+    for chunk in VcfBatchReader(path, width=updater.store.width,
+                                pack_alleles=False):
         for code, shard, sel, found, idx in chunk_lookup(updater.store, chunk):
             if shard is None:
                 continue

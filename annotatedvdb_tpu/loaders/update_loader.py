@@ -94,6 +94,7 @@ class TpuUpdateLoader:
         reader = VcfBatchReader(
             path, batch_size=self.batch_size, width=self.store.width,
             chromosome_map=self.chromosome_map,
+            pack_alleles=False,  # update path never uploads allele matrices
         )
         for chunk in reader:
             self.counters["line"] += chunk.counters.get("line", 0)

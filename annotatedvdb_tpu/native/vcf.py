@@ -216,7 +216,8 @@ class LazyColumn:
 
 
 def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
-                      counters: dict, width: int, identity_only: bool):
+                      counters: dict, width: int, identity_only: bool,
+                      pack_alleles: bool = True):
     """Assemble a :class:`~annotatedvdb_tpu.io.vcf.VcfChunk` from one native
     batch.  Device arrays are copied out (the buffers are reused by the next
     fill); sidecar columns are lazy views over the window bytes."""
@@ -251,8 +252,10 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     rs_number = arrays.rs_number[:n].copy()
     has_freq = arrays.has_freq[:n].astype(bool)
     # pre-packed alleles travel with the chunk only when EVERY row packs
-    # (the loader uploads whole chunks either packed or raw)
-    packable = bool(arrays.pack_ok[:n].all())
+    # (the loader uploads whole chunks either packed or raw).  When packing
+    # was never attempted (pack_alleles=False), packable stays None — the
+    # tri-state contract lets downstream host-encode if it wants to.
+    packable = bool(arrays.pack_ok[:n].all()) if pack_alleles else None
     if packable:
         ref_packed = arrays.ref_packed[:n].copy()
         alt_packed = arrays.alt_packed[:n].copy()
@@ -343,7 +346,8 @@ def iter_native_chunks(path: str, batch_size: int, width: int,
         if n == 0:
             continue
         chunk = chunk_from_native(
-            arrays, n, window, base, pending_counters, width, identity_only
+            arrays, n, window, base, pending_counters, width, identity_only,
+            pack_alleles,
         )
         pending_counters = {k: 0 for k in pending_counters}
         yield chunk

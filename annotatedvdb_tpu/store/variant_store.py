@@ -90,6 +90,27 @@ _IDENTITY_COLUMNS = ("pos", "h", "ref_len", "alt_len")
 DEVICE_SEGMENT_MIN = 1 << 22
 DEVICE_QUERY_MIN = 1 << 12
 
+# The device probe must first UPLOAD the segment's identity columns
+# (~110B/row); on remote-attached accelerators that transfer dwarfs a numpy
+# searchsorted unless it amortizes.  The upload is taken only when the HBM
+# cache already exists (built by ``ChromosomeShard.pin_device_lookup`` for
+# read-mostly workloads), or one query batch is within this factor of the
+# segment size (AVDB_DEVICE_LOOKUP=always|auto|off overrides).
+DEVICE_UPLOAD_AMORTIZE = 4
+
+# Cascade merges stop once the older segment exceeds this row count:
+# beyond it, re-merging (and re-persisting) the biggest segment every few
+# flushes costs more than probing a handful of extra segments.  Big
+# segments become effectively immutable — written to disk once — and
+# read paths that need a single flat view call compact() explicitly.
+MERGE_SEGMENT_CAP = 1 << 20
+
+
+def _device_lookup_mode() -> str:
+    import os
+
+    return os.environ.get("AVDB_DEVICE_LOOKUP", "auto")
+
 # Latch: None = not yet probed; flips False on a CPU-only backend (numpy
 # searchsorted beats per-shape XLA compiles there) or on the first
 # device-lookup failure, so a missing/broken backend costs one attempt per
@@ -99,6 +120,8 @@ _DEVICE_LOOKUP_OK = None
 
 def _device_lookup_enabled() -> bool:
     global _DEVICE_LOOKUP_OK
+    if _device_lookup_mode() == "off":
+        return False
     if _DEVICE_LOOKUP_OK is None:
         try:
             import jax
@@ -198,9 +221,15 @@ class Segment:
         global _DEVICE_LOOKUP_OK
         if self.n == 0:
             return np.zeros(pos.shape, np.bool_), np.full(pos.shape, -1, np.int32)
-        if (self.n >= DEVICE_SEGMENT_MIN
-                and pos.shape[0] >= DEVICE_QUERY_MIN
-                and _device_lookup_enabled()):
+        nq = pos.shape[0]
+        # a pinned HBM cache is sunk cost — use it at any size; otherwise
+        # the upload must amortize within this one query batch
+        if (_device_lookup_enabled()
+                and (self._device is not None
+                     or (self.n >= DEVICE_SEGMENT_MIN
+                         and nq >= DEVICE_QUERY_MIN
+                         and (nq * DEVICE_UPLOAD_AMORTIZE >= self.n
+                              or _device_lookup_mode() == "always")))):
             try:
                 return self._probe_device(pos, h, ref, alt, ref_len, alt_len)
             except Exception:
@@ -208,23 +237,51 @@ class Segment:
                 # correct; latch so the hot path doesn't retry per lookup
                 _DEVICE_LOOKUP_OK = False
         lo = np.searchsorted(self.key, qkey, side="left")
-        found = np.zeros(pos.shape, np.bool_)
-        index = np.full(pos.shape, -1, np.int32)
-        # equal-(pos,hash) runs are length 1 barring 2^-32 collisions; probe 4
+        found = np.zeros(nq, np.bool_)
+        index = np.full(nq, -1, np.int32)
+        # equal-(pos,hash) runs are length 1 barring 2^-32 collisions; probe
+        # up to 4 — but gather/compare the wide allele rows ONLY where the
+        # key matches (typical chunks match almost nowhere, and runs are
+        # contiguous so a no-match round ends the scan)
         for k in range(4):
             i = np.clip(lo + k, 0, self.n - 1)
+            keyeq = (lo + k < self.n) & (self.key[i] == qkey)
+            if not keyeq.any():
+                break
+            rows_q = np.where(keyeq & ~found)[0]
+            if rows_q.size == 0:
+                continue
+            ii = i[rows_q]
             cand = (
-                (lo + k < self.n)
-                & (self.key[i] == qkey)
-                & (self.cols["ref_len"][i] == ref_len)
-                & (self.cols["alt_len"][i] == alt_len)
-                & (self.ref[i] == ref).all(axis=1)
-                & (self.alt[i] == alt).all(axis=1)
+                (self.cols["ref_len"][ii] == ref_len[rows_q])
+                & (self.cols["alt_len"][ii] == alt_len[rows_q])
+                & (self.ref[ii] == ref[rows_q]).all(axis=1)
+                & (self.alt[ii] == alt[rows_q]).all(axis=1)
             )
-            take = cand & ~found
-            index = np.where(take, i, index)
-            found |= cand
+            sel = rows_q[cand]
+            index[sel] = ii[cand]
+            found[sel] = True
         return found, index
+
+    def _ensure_device_cache(self) -> None:
+        """Upload this segment's identity columns to HBM (once; pow2-padded
+        so compile count stays O(log n) — the sentinel position sorts last
+        and can't match a real query)."""
+        if self._device is not None:
+            return
+        import jax
+
+        from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
+
+        self._device = tuple(
+            jax.device_put(x) for x in (
+                pad_pow2(self.cols["pos"], POS_SENTINEL),
+                pad_pow2(self.cols["h"], 0),
+                pad_pow2(self.ref, 0), pad_pow2(self.alt, 0),
+                pad_pow2(self.cols["ref_len"], 0),
+                pad_pow2(self.cols["alt_len"], 0),
+            )
+        )
 
     def _probe_device(self, pos, h, ref, alt, ref_len, alt_len):
         """Large-batch membership on device (``ops/dedup.lookup_in_sorted``),
@@ -234,20 +291,7 @@ class Segment:
         from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_jit
         from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
 
-        if self._device is None:
-            import jax
-
-            # store side padded to pow2 as well (sentinel sorts last, can't
-            # match a real position) so compile count is O(log n * log q)
-            self._device = tuple(
-                jax.device_put(x) for x in (
-                    pad_pow2(self.cols["pos"], POS_SENTINEL),
-                    pad_pow2(self.cols["h"], 0),
-                    pad_pow2(self.ref, 0), pad_pow2(self.alt, 0),
-                    pad_pow2(self.cols["ref_len"], 0),
-                    pad_pow2(self.cols["alt_len"], 0),
-                )
-            )
+        self._ensure_device_cache()
         nq = pos.shape[0]
         found, index = lookup_in_sorted_jit(
             *self._device,
@@ -454,6 +498,29 @@ class ChromosomeShard:
 
     # -- membership ---------------------------------------------------------
 
+    def pin_device_lookup(self) -> int:
+        """Build the HBM membership cache for every current segment.
+
+        For read-mostly workloads (update loads over a static store) the
+        one-time identity-column upload amortizes across many query
+        batches; inserts invalidate the cache (merges replace segments), so
+        the insert path never calls this.  Returns the number of segments
+        pinned; a failed/unavailable backend pins none (lookups keep the
+        numpy path)."""
+        if not _device_lookup_enabled():
+            return 0
+        pinned = 0
+        for seg in self.segments:
+            if seg.n and seg.n >= DEVICE_QUERY_MIN:
+                try:
+                    seg._ensure_device_cache()
+                    pinned += 1
+                except Exception:
+                    global _DEVICE_LOOKUP_OK
+                    _DEVICE_LOOKUP_OK = False
+                    return pinned
+        return pinned
+
     def lookup(self, pos, h, ref, alt, ref_len, alt_len):
         """Vectorized membership: (found [N] bool, global id [N] int64).
 
@@ -493,9 +560,14 @@ class ChromosomeShard:
             Segment.build(rows, ref, alt, annotations, digest_pk, long_alleles)
         )
         # size-tiered cascade: keep strictly geometric segment sizes so the
-        # segment count stays O(log n) and total merge work O(n log n)
+        # segment count stays O(log n) and total merge work O(n log n).
+        # Segments past MERGE_SEGMENT_CAP freeze (written to disk once,
+        # never re-merged mid-load): re-merging the biggest segment costs
+        # O(n) memcpy + O(n) re-persist per flush at whole-genome scale,
+        # while probing the extra frozen segments is a few searchsorteds.
         while (len(self.segments) >= 2
-               and self.segments[-2].n <= 2 * self.segments[-1].n):
+               and self.segments[-2].n <= 2 * self.segments[-1].n
+               and self.segments[-2].n <= MERGE_SEGMENT_CAP):
             newer = self.segments.pop()
             self.segments[-1] = Segment.merge(self.segments[-1], newer)
         self._starts_cache = None

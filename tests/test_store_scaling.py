@@ -149,6 +149,46 @@ def test_incremental_save_is_flat(tmp_path):
     )
 
 
+def test_frozen_segments_not_remerged_or_rewritten(tmp_path, monkeypatch):
+    """Segments past MERGE_SEGMENT_CAP freeze: later flushes never re-merge
+    them (bounding merge traffic at whole-genome scale) and later saves
+    never rewrite their files (bounding persist IO)."""
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    monkeypatch.setattr(vs, "MERGE_SEGMENT_CAP", 3 * BATCH)
+    store = VariantStore(width=WIDTH)
+    shard = store.shard(1)
+    out = str(tmp_path / "vdb")
+    frozen_mtime = {}
+    for bi, (rows, ref, alt) in enumerate(_batches(16, BATCH, seed=31)):
+        shard.append(rows, ref, alt)
+        store.save(out)
+        for seg in shard.segments:
+            if seg.n > 3 * BATCH and seg.seg_id is not None:
+                f = [x for x in os.listdir(out)
+                     if x.endswith(".npz") and f"{seg.seg_id:06d}" in x]
+                assert f, "frozen segment must be on disk"
+                mt = os.path.getmtime(os.path.join(out, f[0]))
+                if seg.seg_id in frozen_mtime:
+                    assert mt == frozen_mtime[seg.seg_id], (
+                        "frozen segment rewritten by a later save"
+                    )
+                frozen_mtime[seg.seg_id] = mt
+    assert frozen_mtime, "load never produced a frozen segment"
+    assert len(shard.segments) > 1  # cap actually prevented full compaction
+    # membership still correct across frozen + live segments
+    rows, ref, alt = next(iter(_batches(1, BATCH, seed=31)))
+    found, idx = shard.lookup(
+        rows["pos"], rows["h"], ref, alt, rows["ref_len"], rows["alt_len"]
+    )
+    assert found.all()
+    # and lookups against absent rows stay absent
+    found2, _ = shard.lookup(
+        rows["pos"] + 1, rows["h"], ref, alt, rows["ref_len"], rows["alt_len"]
+    )
+    assert not found2.any()
+
+
 def test_segment_device_probe_matches_numpy(monkeypatch):
     """The device membership kernel path gives identical answers to the
     numpy probe (forced on despite the CPU backend/thresholds)."""
@@ -166,6 +206,37 @@ def test_segment_device_probe_matches_numpy(monkeypatch):
     pos, h = seg.cols["pos"][::3], seg.cols["h"][::3]
     ref, alt = seg.ref[::3], seg.alt[::3]
     rl, al = seg.cols["ref_len"][::3], seg.cols["alt_len"][::3]
+    qkey = vs.combined_key(pos, h)
+    f_dev, i_dev = seg.probe(qkey, pos, h, ref, alt, rl, al)
+    monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", False)
+    f_np, i_np = seg.probe(qkey, pos, h, ref, alt, rl, al)
+    np.testing.assert_array_equal(f_dev, f_np)
+    np.testing.assert_array_equal(i_dev, i_np)
+    assert f_np.all()
+
+
+def test_pin_device_lookup_builds_reachable_cache(monkeypatch):
+    """pin_device_lookup uploads segment caches that subsequent small-query
+    probes actually use (the sunk-cost disjunct in Segment.probe)."""
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", True)
+    monkeypatch.setattr(vs, "DEVICE_QUERY_MIN", 1)
+
+    store = VariantStore(width=WIDTH)
+    shard = store.shard(1)
+    for rows, ref, alt in _batches(2, 4096, seed=23):
+        shard.append(rows, ref, alt)
+    assert shard.pin_device_lookup() == len(
+        [s for s in shard.segments if s.n]
+    )
+    assert all(s._device is not None for s in shard.segments if s.n)
+    seg = shard.segments[0]
+    # a query far too small to amortize an upload still rides the cache;
+    # answers match the numpy path exactly
+    pos, h = seg.cols["pos"][:16], seg.cols["h"][:16]
+    ref, alt = seg.ref[:16], seg.alt[:16]
+    rl, al = seg.cols["ref_len"][:16], seg.cols["alt_len"][:16]
     qkey = vs.combined_key(pos, h)
     f_dev, i_dev = seg.probe(qkey, pos, h, ref, alt, rl, al)
     monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", False)
