@@ -213,10 +213,8 @@ class TpuCaddUpdater:
             # a fresh whole-genome shard skips the per-row scan entirely
             raw_col = shard.segments[0].obj.get("cadd_scores")
             if raw_col is not None:
-                has = np.fromiter(
-                    (raw_col[int(i)] is not None for i in rows),
-                    bool, count=rows.size,
-                )
+                # vectorized is-not-None over the object column slice
+                has = np.not_equal(raw_col[rows], None)
                 self.counters["skipped"] += int(has.sum())
                 rows = rows[~has]
         is_indel = (
@@ -351,12 +349,15 @@ class TpuCaddUpdater:
                 continue
             sel = state.sel[:hi]
             matched = state.matched[:hi]
+            # C-level scalar conversion first (tolist), then one pass of
+            # small-dict construction — the only per-row Python left here
             evidence = [
-                {"CADD_raw_score": float(state.raw[i]),
-                 "CADD_phred": float(state.phred[i])}
-                if matched[i]
+                {"CADD_raw_score": r, "CADD_phred": p} if m
                 else {}  # unmatched placeholder (cadd_updater.py:216-221)
-                for i in range(hi)
+                for r, p, m in zip(
+                    state.raw[:hi].tolist(), state.phred[:hi].tolist(),
+                    matched.tolist(),
+                )
             ]
             n_matched = int(matched.sum())
             self.counters[kind] += n_matched

@@ -87,15 +87,20 @@ _IDENTITY_COLUMNS = ("pos", "h", "ref_len", "alt_len")
 # columns (~120B/row) must ship to the device per probe, so the kernel pays
 # off only once the segment is far too large for host cache-resident
 # searchsorted (and never on CPU backends — see _device_lookup_enabled).
-DEVICE_SEGMENT_MIN = 1 << 22
+DEVICE_SEGMENT_MIN = 1 << 18
 DEVICE_QUERY_MIN = 1 << 12
 
 # The device probe must first UPLOAD the segment's identity columns
 # (~110B/row); on remote-attached accelerators that transfer dwarfs a numpy
-# searchsorted unless it amortizes.  The upload is taken only when the HBM
-# cache already exists (built by ``ChromosomeShard.pin_device_lookup`` for
-# read-mostly workloads), or one query batch is within this factor of the
-# segment size (AVDB_DEVICE_LOOKUP=always|auto|off overrides).
+# searchsorted unless it amortizes.  Ski-rental rule: each segment counts
+# the query volume its numpy probes have served, and uploads once
+# cumulative volume reaches 1/AMORTIZE of the segment size — by then the
+# forgone device work would have paid for the transfer, so total cost is
+# within a constant factor of either pure strategy.  Mid-load segments are
+# replaced by merges before reaching the threshold (write-heavy loads stay
+# numpy); static stores probed repeatedly (update loads) cross it and ride
+# HBM.  ``ChromosomeShard.pin_device_lookup`` forces the upload up front;
+# AVDB_DEVICE_LOOKUP=always|auto|off overrides the rule entirely.
 DEVICE_UPLOAD_AMORTIZE = 4
 
 # Cascade merges stop once the older segment exceeds this row count:
@@ -106,10 +111,46 @@ DEVICE_UPLOAD_AMORTIZE = 4
 MERGE_SEGMENT_CAP = 1 << 20
 
 
-def _device_lookup_mode() -> str:
-    import os
+_DEVICE_LOOKUP_MODE: str | None = None
 
-    return os.environ.get("AVDB_DEVICE_LOOKUP", "auto")
+
+def _device_lookup_mode() -> str:
+    global _DEVICE_LOOKUP_MODE
+    if _DEVICE_LOOKUP_MODE is None:
+        _DEVICE_LOOKUP_MODE = os.environ.get("AVDB_DEVICE_LOOKUP", "auto")
+    return _DEVICE_LOOKUP_MODE
+
+
+# Minimum measured host->device bandwidth for 'auto' device lookups: every
+# probe call must also UPLOAD its query identity columns (~110B/row), so on
+# slow links (remote-attached/tunneled devices, ~tens of MB/s) the query
+# transfer alone dwarfs a numpy searchsorted no matter how the segment
+# cache amortizes.  Locally-attached accelerators (~10GB/s PCIe/ICI) clear
+# this easily.
+DEVICE_MIN_BANDWIDTH = 1e9  # bytes/sec
+_TRANSFER_FAST: bool | None = None
+
+
+def _transfer_fast() -> bool:
+    """One-time 1MB upload timing; latched per process."""
+    global _TRANSFER_FAST
+    if _TRANSFER_FAST is None:
+        try:
+            import time
+
+            import jax
+
+            buf = np.zeros(1 << 20, np.uint8)
+            dev = jax.device_put(buf)          # warm the path once
+            dev.block_until_ready()
+            t0 = time.perf_counter()
+            dev = jax.device_put(buf)
+            dev.block_until_ready()
+            dt = max(time.perf_counter() - t0, 1e-9)
+            _TRANSFER_FAST = (len(buf) / dt) >= DEVICE_MIN_BANDWIDTH
+        except Exception:
+            _TRANSFER_FAST = False
+    return _TRANSFER_FAST
 
 # Latch: None = not yet probed; flips False on a CPU-only backend (numpy
 # searchsorted beats per-shape XLA compiles there) or on the first
@@ -144,7 +185,7 @@ class Segment:
     is preserved (first-wins duplicate semantics)."""
 
     __slots__ = ("n", "cols", "ref", "alt", "obj", "seg_id", "dirty",
-                 "_key", "_device")
+                 "_key", "_device", "_numpy_query_volume")
 
     def __init__(self, cols, ref, alt, obj, seg_id=None):
         self.n = int(ref.shape[0])
@@ -156,6 +197,7 @@ class Segment:
         self.dirty = True
         self._key = None
         self._device = None
+        self._numpy_query_volume = 0  # ski-rental accumulator (see probe)
 
     @property
     def key(self) -> np.ndarray:
@@ -222,20 +264,24 @@ class Segment:
         if self.n == 0:
             return np.zeros(pos.shape, np.bool_), np.full(pos.shape, -1, np.int32)
         nq = pos.shape[0]
-        # a pinned HBM cache is sunk cost — use it at any size; otherwise
-        # the upload must amortize within this one query batch
+        # an existing HBM cache is sunk cost — use it at any size; otherwise
+        # upload once the ski-rental accumulator says the transfer has paid
+        # for itself in forgone device work (see DEVICE_UPLOAD_AMORTIZE)
         if (_device_lookup_enabled()
-                and (self._device is not None
-                     or (self.n >= DEVICE_SEGMENT_MIN
-                         and nq >= DEVICE_QUERY_MIN
-                         and (nq * DEVICE_UPLOAD_AMORTIZE >= self.n
-                              or _device_lookup_mode() == "always")))):
+                and (_device_lookup_mode() == "always"
+                     or (_transfer_fast()
+                         and (self._device is not None
+                              or (self.n >= DEVICE_SEGMENT_MIN
+                                  and nq >= DEVICE_QUERY_MIN
+                                  and (self._numpy_query_volume + nq)
+                                  * DEVICE_UPLOAD_AMORTIZE >= self.n))))):
             try:
                 return self._probe_device(pos, h, ref, alt, ref_len, alt_len)
             except Exception:
                 # device unusable (no backend / OOM): numpy is always
                 # correct; latch so the hot path doesn't retry per lookup
                 _DEVICE_LOOKUP_OK = False
+        self._numpy_query_volume += nq
         lo = np.searchsorted(self.key, qkey, side="left")
         found = np.zeros(nq, np.bool_)
         index = np.full(nq, -1, np.int32)
@@ -509,17 +555,22 @@ class ChromosomeShard:
         numpy path)."""
         if not _device_lookup_enabled():
             return 0
-        pinned = 0
+        pinned = []
         for seg in self.segments:
             if seg.n and seg.n >= DEVICE_QUERY_MIN:
                 try:
                     seg._ensure_device_cache()
-                    pinned += 1
+                    pinned.append(seg)
                 except Exception:
+                    # all-or-nothing: a disabled latch means probe() would
+                    # never consult the already-built caches, so release
+                    # them instead of holding dead HBM for the process life
+                    for p in pinned:
+                        p._device = None
                     global _DEVICE_LOOKUP_OK
                     _DEVICE_LOOKUP_OK = False
-                    return pinned
-        return pinned
+                    return 0
+        return len(pinned)
 
     def lookup(self, pos, h, ref, alt, ref_len, alt_len):
         """Vectorized membership: (found [N] bool, global id [N] int64).
