@@ -96,14 +96,71 @@ def primary_keys(
     per-row host crypto."""
     if refs is None:
         refs, alts = decode_alleles(batch)
-    needs_digest = np.asarray(ann.needs_digest)
     literal = metaseq_ids(batch, refs, alts)
     rs_suffix = np.array(
         ["" if not r else ":" + str(r) for r in ref_snp], dtype="U"
     ) if any(ref_snp) else ""
     out = np.char.add(literal, rs_suffix).astype(object)
+    return _digest_tail(
+        out, batch, ann, refs, alts, digester,
+        lambda i: ref_snp[i] if ref_snp[i] else None,
+    )
 
-    for i in np.where(needs_digest)[0]:
+
+def primary_keys_from_ints(
+    batch: VariantBatch,
+    ann: AnnotatedBatch,
+    rs_numbers: np.ndarray,
+    digester: VrsDigestGenerator | None = None,
+    refs=None,
+    alts=None,
+    rs_weird: np.ndarray | None = None,
+    ref_snp_at=None,
+    literal: np.ndarray | None = None,
+) -> np.ndarray:
+    """Record PKs assembled from the reader's pre-parsed rs-number column —
+    no per-row refsnp string materialization.
+
+    ``rs_numbers`` [N] int64 (-1 = none); rows flagged in ``rs_weird``
+    (refsnp strings that don't round-trip through the int: unparsable ids,
+    zero-padded ids) fall back to ``ref_snp_at(row) -> str`` per row
+    (rare).  ``literal`` (a precomputed :func:`metaseq_ids` array) avoids
+    rebuilding the id strings when the caller also needs them.  Digest-tail
+    and allele-swap semantics identical to :func:`primary_keys`."""
+    if refs is None:
+        refs, alts = decode_alleles(batch)
+    if literal is None:
+        literal = metaseq_ids(batch, refs, alts)
+    rs_numbers = np.asarray(rs_numbers, np.int64)
+    if (rs_numbers >= 0).any():
+        suffix = np.where(
+            rs_numbers >= 0,
+            _concat(":rs", np.char.mod("%d", rs_numbers.clip(min=0))),
+            "",
+        )
+        out = np.char.add(literal, suffix).astype(object)
+    else:
+        out = literal.astype(object)
+    weird_rows = (
+        np.where(rs_weird)[0] if rs_weird is not None else np.empty(0, int)
+    )
+    for j in weird_rows:
+        r = ref_snp_at(int(j)) if ref_snp_at is not None else None
+        out[j] = literal[j] + (":" + str(r) if r else "")
+
+    def rs_str(i):
+        if rs_weird is not None and rs_weird[i]:
+            r = ref_snp_at(int(i)) if ref_snp_at is not None else None
+            return str(r) if r else None
+        return f"rs{int(rs_numbers[i])}" if rs_numbers[i] >= 0 else None
+
+    return _digest_tail(out, batch, ann, refs, alts, digester, rs_str)
+
+
+def _digest_tail(out, batch, ann, refs, alts, digester, rs_str) -> np.ndarray:
+    """Replace >50bp rows' literal PKs with VRS digests (rare tail);
+    ``rs_str(i)`` supplies the optional refsnp suffix."""
+    for i in np.where(np.asarray(ann.needs_digest))[0]:
         i = int(i)
         if digester is None:
             raise ValueError(
@@ -125,27 +182,54 @@ def primary_keys(
                     chrom, pos, ref, alt, validate=False
                 )
         parts = [chrom, str(pos), digest]
-        if ref_snp[i]:
-            parts.append(ref_snp[i])
+        rs = rs_str(i)
+        if rs:
+            parts.append(rs)
         out[i] = ":".join(parts)
     return out
 
 
 def bin_paths(batch: VariantBatch, ann: AnnotatedBatch) -> np.ndarray:
-    """ltree paths, assembled level-column-wise (13 vectorized appends
-    instead of one Python loop per row; semantics of
-    ``oracle.binindex.closed_form_path``)."""
+    """ltree paths (semantics of ``oracle.binindex.closed_form_path``).
+
+    Position-sorted chunks touch few distinct bins (a 131k-row chunk spans
+    ~dozens of 15.6kb leaves), so paths are assembled once per unique
+    (chrom, level, leaf) and scattered back — the reference exploits the
+    same locality with its current-bin cache (``bin_index.py:20-22``)."""
     level = np.asarray(ann.bin_level).astype(np.int64)
     leaf = np.asarray(ann.leaf_bin).astype(np.int64)
-    out = np.char.add("chr", _CHROM_LABELS[np.asarray(batch.chrom, np.int64)])
-    for l in range(1, 14):
-        g = leaf >> (13 - l)
-        b = (g + 1) if l == 1 else ((g & 1) + 1)
-        seg = np.where(
-            level >= l, _concat(f".L{l}.B", b.astype("U11")), ""
-        )
-        out = np.char.add(out, seg)
-    return out
+    chrom = np.asarray(batch.chrom, np.int64)
+    key = (
+        (chrom << np.int64(40)) | (level << np.int64(32))
+        | (leaf & np.int64(0xFFFFFFFF))
+    )
+    uniq, inverse = np.unique(key, return_inverse=True)
+    if uniq.size >= level.shape[0] // 4:
+        # low locality: the column-wise assembly is cheaper than dedup
+        out = np.char.add("chr", _CHROM_LABELS[chrom])
+        for l in range(1, 14):
+            g = leaf >> (13 - l)
+            b = (g + 1) if l == 1 else ((g & 1) + 1)
+            seg = np.where(
+                level >= l, _concat(f".L{l}.B", b.astype("U11")), ""
+            )
+            out = np.char.add(out, seg)
+        return out
+    from annotatedvdb_tpu.oracle.binindex import closed_form_path
+
+    paths = np.array(
+        [
+            closed_form_path(
+                # table lookup, not chromosome_label(): code 0 must emit
+                # 'chr?' exactly like the column-wise branch
+                "chr" + str(_CHROM_LABELS[int(k >> 40)]),
+                int((k >> 32) & 0xFF), int(k & 0xFFFFFFFF),
+            )
+            for k in uniq.tolist()
+        ],
+        dtype="U",
+    )
+    return paths[inverse]
 
 
 def shard_strings(shard, lo: int = 0, hi: int | None = None):

@@ -53,6 +53,18 @@ def rs_number(ref_snp) -> int:
     return v
 
 
+def rs_is_weird(ref_snp, rs_num: int) -> bool:
+    """True when a refsnp STRING exists but does not round-trip through its
+    parsed number — unparsable ids and zero-padded ids ('rs0042' prints
+    back as 'rs42').  Primary keys for such rows must use the string.
+    Shared by the Python reader and the loaders' chunk fallback; mirrored
+    byte-for-byte by the native tokenizer's rs_number_of."""
+    if ref_snp is None:
+        return False
+    s = str(ref_snp)
+    return rs_num < 0 or (s.startswith("rs0") and len(s) > 3)
+
+
 def _open_text(path: str):
     if path.endswith(".gz"):
         return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
@@ -122,6 +134,13 @@ class VcfChunk:
     #: else -1) — lets the insert path store rs ids without materializing
     #: any per-row sidecar string (``loaders/vcf_loader.py`` append stage)
     rs_number: np.ndarray | None = None
+    #: bool per row: a refsnp STRING exists but does not parse to a number
+    #: ('weird' ids like 'chr_rs_x'); primary keys for these rows fall back
+    #: to the materialized ``ref_snp`` string (rare)
+    rs_weird: np.ndarray | None = None
+    #: bool per row: the ID column is a verbatim variant id (not '.' / not
+    #: an rs accession) — mapping ids for other rows assemble vectorized
+    id_verbatim: np.ndarray | None = None
     #: bool per row: INFO carries a FREQ entry.  The insert path skips the
     #: frequencies column entirely for chunks with no flagged row.
     has_freq: np.ndarray | None = None
@@ -274,6 +293,7 @@ class VcfBatchReader:
                             qual,
                             filt,
                             fmt,
+                            not (vid == "." or vid.startswith("rs")),
                         )
                     )
         if rows or any(counters.values()):
@@ -293,13 +313,22 @@ class VcfBatchReader:
         rs_col = np.array(
             [rs_number(r[4]) for r in rows], dtype=np.int64
         ) if rows else np.zeros(0, np.int64)
+        rs_weird = np.array(
+            [rs_is_weird(r[4], n) for r, n in zip(rows, rs_col)],
+            dtype=bool,
+        ) if rows else np.zeros(0, bool)
         # line-level flag (INFO carries a FREQ key), same rule as the native
         # tokenizer's pre-scan; per-alt values may still be None
         has_freq = np.array(
             ["FREQ" in r[9] for r in rows], dtype=bool
         ) if rows else np.zeros(0, bool)
+        id_verbatim = np.array(
+            [r[14] for r in rows], dtype=bool
+        ) if rows else np.zeros(0, bool)
         return VcfChunk(
             rs_number=rs_col,
+            rs_weird=rs_weird,
+            id_verbatim=id_verbatim,
             has_freq=has_freq,
             batch=batch,
             refs=[r[2] for r in rows],

@@ -653,13 +653,21 @@ class TpuVcfLoader:
                 refs = alts = None
             # rs numbers come pre-parsed from the reader (one int64 column);
             # the string forms are only materialized on the PK path below
-            rs_sel = (
-                chunk.rs_number[sel]
-                if chunk.rs_number is not None
-                else np.array(
-                    [_rs_number(chunk.ref_snp[i]) for i in sel], np.int64
+            if chunk.rs_number is not None:
+                rs_sel = chunk.rs_number[sel]
+                rs_weird_sel = (
+                    chunk.rs_weird[sel] if chunk.rs_weird is not None
+                    else None
                 )
-            )
+            else:  # chunks from non-reader builders: derive both per row
+                from annotatedvdb_tpu.io.vcf import rs_is_weird
+
+                strs = [chunk.ref_snp[i] for i in sel]
+                rs_sel = np.array([_rs_number(r) for r in strs], np.int64)
+                rs_weird_sel = np.array(
+                    [rs_is_weird(r, n) for r, n in zip(strs, rs_sel)],
+                    dtype=bool,
+                )
 
         if self.genome is not None:
             # validate only the rows actually being inserted (post dedup /
@@ -683,12 +691,19 @@ class TpuVcfLoader:
             # digest PKs (rare tail) are always needed — the store retains
             # them as the row's record PK
             if mapping_fh is not None or needs_digest.any():
-                ref_snp = [chunk.ref_snp[i] for i in sel]
-                pks = egress.primary_keys(
-                    sub, sub_ann, ref_snp, self.digester, refs, alts
+                # assembled from the reader's pre-parsed rs column; only
+                # 'weird' refsnp rows materialize their sidecar string.
+                # The literal id strings are shared with the mapping
+                # stage's vectorized vid assembly below.
+                literal = egress.metaseq_ids(sub, refs, alts)
+                pks = egress.primary_keys_from_ints(
+                    sub, sub_ann, rs_sel, self.digester, refs, alts,
+                    rs_weird=rs_weird_sel,
+                    ref_snp_at=lambda j: chunk.ref_snp[int(sel[j])],
+                    literal=literal,
                 )
             else:
-                pks = None
+                pks = literal = None
             # display attributes are derivable: built here only when the
             # store-everything flag asks for them (see __init__)
             display = (
@@ -770,16 +785,44 @@ class TpuVcfLoader:
 
         if mapping_fh is not None:
             with self.timer.stage("mapping", items=int(sel.size)):
-                for j, i in enumerate(sel):
-                    mapping_fh.write(
-                        json.dumps(
-                            {chunk.variant_id[i]: [
-                                {"primary_key": str(pks[j]),
-                                 "bin_index": str(bins[j])}
-                            ]}
-                        )
-                        + "\n"
+                # mapping ids: rows whose ID is '.' or an rs accession use
+                # the assembled chr:pos:ref:altcol form — for single-alt
+                # rows that IS the metaseq id already built vectorized;
+                # only verbatim-ID and multi-allelic rows (rare in dbSNP
+                # loads) materialize their sidecar string
+                if chunk.id_verbatim is not None:
+                    slow = (
+                        chunk.id_verbatim[sel]
+                        | chunk.is_multi_allelic[sel]
                     )
+                    vids = literal.astype(object)
+                    for j in np.where(slow)[0]:
+                        vids[j] = chunk.variant_id[int(sel[j])]
+                    vids = vids.tolist()
+                else:
+                    vids = [chunk.variant_id[i] for i in sel]
+                # one write per chunk; per-line JSON with a single
+                # no-escaping-needed check across all three fields
+                # (json.dumps only for the exceptions)
+                lines = []
+                bins_l = bins.tolist()
+                for j, vid in enumerate(vids):
+                    pk = str(pks[j])
+                    b = bins_l[j]
+                    probe = vid + pk
+                    if (probe.isascii() and probe.isprintable()
+                            and '"' not in probe and "\\" not in probe):
+                        lines.append(
+                            f'{{"{vid}": [{{"primary_key": "{pk}", '
+                            f'"bin_index": "{b}"}}]}}'
+                        )
+                    else:
+                        lines.append(
+                            f'{{{json.dumps(vid)}: '
+                            f'[{{"primary_key": {json.dumps(pk)}, '
+                            f'"bin_index": {json.dumps(b)}}}]}}'
+                        )
+                mapping_fh.write("\n".join(lines) + "\n")
 
 
 def _mix_hash(h, chrom):

@@ -52,6 +52,8 @@ class _Arrays:
         self.alt_index = np.zeros(cap, np.int32)
         self.n_alts = np.zeros(cap, np.int32)
         self.rs_number = np.zeros(cap, np.int64)
+        self.rs_weird = np.zeros(cap, np.uint8)
+        self.id_verbatim = np.zeros(cap, np.uint8)
         self.has_freq = np.zeros(cap, np.uint8)
         self.ref_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
         self.alt_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
@@ -70,7 +72,8 @@ class _Arrays:
             p(self.info_off), p(self.info_len),
             p(self.format_off), p(self.format_len),
             p(self.altcol_off), p(self.altcol_len),
-            p(self.alt_index), p(self.n_alts), p(self.rs_number),
+            p(self.alt_index), p(self.n_alts),
+            p(self.rs_number), p(self.rs_weird), p(self.id_verbatim),
             p(self.has_freq),
             p(self.ref_packed), p(self.alt_packed), p(self.pack_ok),
         ]
@@ -250,6 +253,8 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     alt_index = arrays.alt_index[:n].copy()
     n_alts = arrays.n_alts[:n].copy()
     rs_number = arrays.rs_number[:n].copy()
+    rs_weird = arrays.rs_weird[:n].astype(bool)
+    id_verbatim = arrays.id_verbatim[:n].astype(bool)
     has_freq = arrays.has_freq[:n].astype(bool)
     # pre-packed alleles travel with the chunk only when EVERY row packs
     # (the loader uploads whole chunks either packed or raw).  When packing
@@ -324,6 +329,8 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         info=LazyColumn(n, lambda i: info_at(i)[0]),
         line_number=line_no,
         rs_number=rs_number,
+        rs_weird=rs_weird,
+        id_verbatim=id_verbatim,
         ref_packed=ref_packed,
         alt_packed=alt_packed,
         alleles_packable=packable,

@@ -269,12 +269,14 @@ class Segment:
         # for itself in forgone device work (see DEVICE_UPLOAD_AMORTIZE)
         if (_device_lookup_enabled()
                 and (_device_lookup_mode() == "always"
+                     # an existing cache (auto-built or pinned) is sunk
+                     # cost — honor it regardless of link speed
+                     or self._device is not None
                      or (_transfer_fast()
-                         and (self._device is not None
-                              or (self.n >= DEVICE_SEGMENT_MIN
-                                  and nq >= DEVICE_QUERY_MIN
-                                  and (self._numpy_query_volume + nq)
-                                  * DEVICE_UPLOAD_AMORTIZE >= self.n))))):
+                         and self.n >= DEVICE_SEGMENT_MIN
+                         and nq >= DEVICE_QUERY_MIN
+                         and (self._numpy_query_volume + nq)
+                         * DEVICE_UPLOAD_AMORTIZE >= self.n))):
             try:
                 return self._probe_device(pos, h, ref, alt, ref_len, alt_len)
             except Exception:

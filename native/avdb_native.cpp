@@ -107,8 +107,13 @@ inline bool pack_row(const uint8_t* src, int width, uint8_t* dst) {
 // refsnp number for one site: ID "rs<digits>" wins, else INFO "RS=<digits>"
 // (key-anchored: start of INFO or after ';'), else -1.  Mirrors the Python
 // reader's ref_snp derivation + loaders' _rs_number parse so the insert path
-// never materializes the ID string.
-inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
+// never materializes the ID string.  *weird is set when the row HAS a
+// refsnp string (ID containing 'rs', or an INFO RS entry) that does not
+// parse to a number — the rare rows whose primary keys must fall back to
+// the materialized string.
+inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info,
+                            uint8_t* weird) {
+    *weird = 0;
     if (id.len > 2 && id.ptr[0] == 'r' && id.ptr[1] == 's') {
         int64_t v = 0;
         bool ok = true;
@@ -117,13 +122,21 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
             if (c < '0' || c > '9') ok = false;
             else v = v * 10 + (c - '0');
         }
-        if (ok) return v;
+        if (ok) {
+            // zero-padded ids ("rs0012") round-trip through the int as
+            // "rs12": flag them so PKs use the verbatim string
+            if (id.len > 3 && id.ptr[2] == '0') *weird = 1;
+            return v;
+        }
     }
     // an ID containing 'rs' anywhere IS the refsnp string (reference
     // substring rule, vcf_parser.py:158-169) — it shadows INFO RS even when
     // it does not parse to a number
     for (int i = 0; i + 1 < id.len; ++i)
-        if (id.ptr[i] == 'r' && id.ptr[i + 1] == 's') return -1;
+        if (id.ptr[i] == 'r' && id.ptr[i + 1] == 's') {
+            *weird = 1;
+            return -1;
+        }
     if (!has_info) return -1;
     // the Python chain routes the RS value through int() then re-prints it
     // ("rs" + str(int(v))), so mirror int()'s accepted forms: optional '+'
@@ -159,6 +172,10 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info) {
                 }
             }
             result = (ok && prev_digit) ? v : -1;
+            // an RS entry that fails int() still yields a "rs<value>"
+            // string in the Python chain — flag it (cleared by a later
+            // parsable RS key, matching last-key-wins)
+            *weird = result < 0 ? 1 : 0;
         }
     }
     return result;
@@ -196,9 +213,15 @@ int64_t avdb_parse_vcf_chunk(
     int64_t* altcol_off, int32_t* altcol_len,
     // site index of each row within its line (alt ordinal) + alt count
     int32_t* alt_index, int32_t* n_alts_out,
-    // refsnp number (ID "rs<digits>", else INFO RS=, else -1); identity_only
-    // loads skip the INFO fallback, mirroring the readers' skipped INFO parse
-    int64_t* rs_number,
+    // refsnp number (ID "rs<digits>", else INFO RS=, else -1) + per-row
+    // flag for rows whose refsnp STRING exists but does not parse (their
+    // primary keys need the materialized string); identity_only loads skip
+    // the INFO fallback, mirroring the readers' skipped INFO parse
+    int64_t* rs_number, uint8_t* rs_weird,
+    // 1 when the ID column is a verbatim variant id (not '.' and not an
+    // rs accession) — those rows' mapping ids must use the ID string;
+    // all others use the assembled chr:pos:ref:altcol form
+    uint8_t* id_verbatim,
     // 1 when INFO carries a key-anchored FREQ= entry (the insert path reads
     // the frequencies column for every row; this flag lets it skip the lazy
     // INFO parse wholesale on FREQ-less rows/chunks)
@@ -287,7 +310,13 @@ int64_t avdb_parse_vcf_chunk(
         bool has_info = nf > 7 && !(fields[7].len == 1 && fields[7].ptr[0] == '.');
         bool has_format = nf > 8 && !(fields[8].len == 1 && fields[8].ptr[0] == '.');
 
-        int64_t rs = rs_number_of(id_f, fields[7], has_info && !identity_only);
+        uint8_t rs_w = 0;
+        int64_t rs = rs_number_of(
+            id_f, fields[7], has_info && !identity_only, &rs_w);
+        uint8_t id_verb =
+            !(id_f.len == 1 && id_f.ptr[0] == '.')
+            && !(id_f.len >= 2 && id_f.ptr[0] == 'r' && id_f.ptr[1] == 's')
+            ? 1 : 0;
         uint8_t freq_flag = 0;
         if (has_info && !identity_only) {
             const char* s = fields[7].ptr;
@@ -345,6 +374,8 @@ int64_t avdb_parse_vcf_chunk(
                     alt_index[r] = ordinal - 1;
                     n_alts_out[r] = n_alts;
                     rs_number[r] = rs;
+                    rs_weird[r] = rs_w;
+                    id_verbatim[r] = id_verb;
                     has_freq[r] = freq_flag;
                     if (want_packed) {
                         int cols = (width + 1) / 2;

@@ -64,6 +64,57 @@ def test_reader_row_expansion(vcf_file):
     assert c.is_multi_allelic[i_g] and c.is_multi_allelic[i_t]
 
 
+def test_mapping_ids_and_pks_tricky_shapes(tmp_path):
+    """Mapping sidecar fidelity across the id/rs shapes the vectorized
+    assembly special-cases: verbatim ids, multi-allelic sites, weird and
+    zero-padded rs ids — identical for both ingest engines."""
+    vcf = tmp_path / "t.vcf"
+    vcf.write_text(
+        "##fileformat=VCFv4.2\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "1\t100\trs7\tA\tG\t.\t.\t.\n"          # rs id -> assembled vid
+        "1\t200\tcustom_id\tC\tT\t.\t.\t.\n"    # verbatim id
+        "1\t300\tweird_rs_x\tG\tA\t.\t.\t.\n"   # weird rs string in PK
+        "1\t400\trs0042\tT\tC\t.\t.\t.\n"       # zero-padded rs
+        "1\t500\t.\tA\tT,TA\t.\t.\tRS=9\n"      # multi-alt + INFO rs
+        '1\t600\tq"uote\tA\tC\t.\t.\t.\n'       # id needing JSON escape
+    )
+    expected = {
+        "1:100:A:G": "1:100:A:G:rs7",
+        "custom_id": "1:200:C:T",
+        "weird_rs_x": "1:300:G:A:weird_rs_x",
+        "1:400:T:C": "1:400:T:C:rs0042",
+        "1:500:A:T,TA": None,  # two rows share the site id
+        'q"uote': "1:600:A:C",
+    }
+    for engine in ("python", "native"):
+        store = VariantStore(width=16)
+        ledger = AlgorithmLedger(str(tmp_path / f"l{engine}.jsonl"))
+        loader = TpuVcfLoader(store, ledger, log=lambda *a: None)
+        import annotatedvdb_tpu.io.vcf as iov
+
+        mp = tmp_path / f"m.{engine}.jsonl"
+        # force the engine through the reader the loader constructs
+        orig = iov.VcfBatchReader._use_native
+        iov.VcfBatchReader._use_native = lambda self: engine == "native"
+        try:
+            loader.load_file(str(vcf), commit=True, mapping_path=str(mp))
+        finally:
+            iov.VcfBatchReader._use_native = orig
+        mapping = [json.loads(l) for l in open(mp)]
+        flat: dict = {}
+        for m in mapping:
+            for k, v in m.items():
+                flat.setdefault(k, []).extend(v)
+        for vid, pk in expected.items():
+            assert vid in flat, (engine, vid)
+            if pk is not None:
+                assert flat[vid][0]["primary_key"] == pk, (engine, vid)
+        assert {e["primary_key"] for e in flat["1:500:A:T,TA"]} == {
+            "1:500:A:T:rs9", "1:500:A:TA:rs9"
+        }, engine
+
+
 def test_info_escape_scrubbing():
     info = parse_info(r"NOTE=a\x2cb\x59c#d;FLAG")
     assert info["NOTE"] == "a,b/c:d"
