@@ -111,6 +111,7 @@ def main(argv=None):
             # skip rows)
             persist=lambda: store.save(args.storeDir),
         )
+    loader.close()
     if cfg.commit:
         store.save(args.storeDir)
         log(f"COMMITTED {counters}")

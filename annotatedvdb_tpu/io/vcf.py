@@ -50,6 +50,8 @@ def rs_number(ref_snp) -> int:
         if c < "0" or c > "9":
             return -1
         v = v * 10 + ord(c) - 48
+        if v > 0x7FFFFFFFFFFFFFFF:  # int64 column bound: wider ids are
+            return -1               # 'weird' (PK keeps the verbatim string)
     return v
 
 

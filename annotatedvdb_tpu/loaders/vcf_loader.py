@@ -153,6 +153,7 @@ class TpuVcfLoader:
         #: append/persist) — the observability the reference only has as
         #: ad-hoc datetime pairs (``load_vcf_file.py:108-111,136-140``)
         self.timer = StageTimer()
+        self._prefetch_pool = None  # lazily spawned by the packed path
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0, "update": 0,
         }
@@ -518,11 +519,36 @@ class TpuVcfLoader:
             )
 
             if transport_verified():
-                handles["packed"] = pack_outputs_jit(
+                packed = pack_outputs_jit(
                     h_dev, dup_dev, ann_p.bin_level, ann_p.leaf_bin,
                     ann_p.needs_digest, ann_p.host_fallback,
                 )
+                # the device->host copy releases the GIL: prefetch it on a
+                # worker thread so the transfer overlaps the next chunk's
+                # ingest/dispatch instead of blocking process time
+                handles["packed"] = self._prefetch().submit(
+                    np.asarray, packed
+                )
         return handles
+
+    def _prefetch(self):
+        """Single-worker transfer thread (lazy: configurations that never
+        take the packed path spawn no thread).  Ordering is preserved —
+        one outstanding prefetch per pipelined chunk."""
+        if self._prefetch_pool is None:
+            import concurrent.futures
+
+            self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="avdb-fetch"
+            )
+        return self._prefetch_pool
+
+    def close(self) -> None:
+        """Release the prefetch worker (idempotent; loaders are reusable
+        until closed)."""
+        if self._prefetch_pool is not None:
+            self._prefetch_pool.shutdown(wait=False)
+            self._prefetch_pool = None
 
     def _process_chunk(self, chunk: VcfChunk, handles: dict, alg_id, commit,
                        resume_line, mapping_fh):
@@ -552,10 +578,11 @@ class TpuVcfLoader:
             ann_p = handles["ann_p"]
             if handles.get("packed") is not None:
                 # single-fetch path: one [n_padded, 10] uint8 transfer
-                # carries hash + dup + bin + flags (ops/pack.py)
+                # carries hash + dup + bin + flags (ops/pack.py),
+                # prefetched on the worker thread at dispatch time
                 from annotatedvdb_tpu.ops.pack import unpack_outputs
 
-                cols = unpack_outputs(np.asarray(handles["packed"]))
+                cols = unpack_outputs(handles["packed"].result())
                 h_p = cols["h"].copy()
                 host_rows = cols["host_fallback"][:n]
                 dup_src = cols["dup"]  # already on host

@@ -120,6 +120,7 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info,
         for (int i = 2; i < id.len && ok; ++i) {
             char c = id.ptr[i];
             if (c < '0' || c > '9') ok = false;
+            else if (v > (INT64_MAX - 9) / 10) ok = false;  // int64 bound
             else v = v * 10 + (c - '0');
         }
         if (ok) {
@@ -156,6 +157,10 @@ inline int64_t rs_number_of(const Span& id, const Span& info, bool has_info,
             for (; j < info.len && s[j] != ';'; ++j) {
                 char c = s[j];
                 if (c >= '0' && c <= '9') {
+                    if (v > (INT64_MAX - 9) / 10) {  // int64 bound
+                        ok = false;
+                        break;
+                    }
                     v = v * 10 + (c - '0');
                     ok = prev_digit = true;
                 } else if (c == '_' && prev_digit) {

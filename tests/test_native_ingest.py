@@ -78,6 +78,8 @@ def assert_chunks_equal(a_chunks, b_chunks):
     assert _rs_number("rs+12") == -1
     assert _rs_number("rs 12") == -1
     assert _rs_number("rs0012") == 12
+    # wider than int64: 'weird' (-1), never an overflow crash or wrap
+    assert _rs_number("rs99999999999999999999") == -1
 
     for chunks in (a_chunks, b_chunks):
         for c in chunks:
@@ -143,13 +145,15 @@ def test_rs_info_fallback_parity(tmp_path):
         "1\t700\t.\tA\tG\t.\t.\tRS=1__2",      # int() rejects -> -1
         "1\t800\t.\tA\tG\t.\t.\tRS=",          # empty -> -1
         "1\t900\t.\tA\tG\t.\t.\tRS= 12",       # int() strips whitespace
+        "1\t950\trs99999999999999999999\tA\tG\t.\t.\t.\n"
+        "1\t960\t.\tA\tG\t.\t.\tRS=99999999999999999999",  # > int64
     ]) + "\n"
     path = write_vcf(tmp_path, vcf)
     py = read_all(path, engine="python", width=16)
     nat = read_all(path, engine="native", width=16)
     assert_chunks_equal(py, nat)
     got = np.concatenate([c.rs_number for c in nat]).tolist()
-    assert got == [12, 12, 2, -1, -1, -1, -1, -1, 12]
+    assert got == [12, 12, 2, -1, -1, -1, -1, -1, 12, -1, -1]
 
 
 def test_native_prepacked_alleles_match_host_encoder(tmp_path):
