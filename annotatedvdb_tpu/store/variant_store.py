@@ -233,17 +233,25 @@ class Segment:
 
     @classmethod
     def merge(cls, older: "Segment", newer: "Segment") -> "Segment":
-        """Stable two-way merge (older rows first on equal keys)."""
-        ka, kb = older.key, newer.key
-        pos_a = np.searchsorted(kb, ka, side="left") + np.arange(older.n)
-        pos_b = np.searchsorted(ka, kb, side="right") + np.arange(newer.n)
-        n = older.n + newer.n
+        """Stable two-way merge (older rows first on equal keys).
 
-        def merge_col(a, b):
-            out = np.empty((n,) + a.shape[1:], a.dtype)
-            out[pos_a] = a
-            out[pos_b] = b
-            return out
+        Position-sorted loads append monotonically, so the newer segment's
+        keys usually all sort after the older's — that case is a pure
+        concatenation (sequential memcpy, no gather)."""
+        ka, kb = older.key, newer.key
+        n = older.n + newer.n
+        if older.n == 0 or newer.n == 0 or kb[0] > ka[-1]:
+            def merge_col(a, b):
+                return np.concatenate([a, b])
+        else:
+            pos_a = np.searchsorted(kb, ka, side="left") + np.arange(older.n)
+            pos_b = np.searchsorted(ka, kb, side="right") + np.arange(newer.n)
+
+            def merge_col(a, b):
+                out = np.empty((n,) + a.shape[1:], a.dtype)
+                out[pos_a] = a
+                out[pos_b] = b
+                return out
 
         cols = {name: merge_col(older.cols[name], newer.cols[name])
                 for name, _ in _NUMERIC_COLUMNS}
