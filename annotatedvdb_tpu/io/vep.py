@@ -24,6 +24,12 @@ CONSEQUENCE_TYPES = ["transcript", "regulatory_feature", "motif_feature", "inter
 
 _ESP_KEYS = ("aa", "ea")
 
+#: blocks cleaned_result strips from the stored vep_output
+#: (``vep_variant_loader.py:111-123``)
+_EXTRACTED_KEYS = frozenset(
+    ["colocated_variants"] + [t + "_consequences" for t in CONSEQUENCE_TYPES]
+)
+
 #: unique-combo count above which the batched rank prefetch uses the device
 #: rank table instead of the numpy one (dispatch overhead crossover)
 DEVICE_RANK_MIN = 256
@@ -193,12 +199,16 @@ class VepResultParser:
             return None
         result: dict = {}
         for allele, values in frequencies.items():
-            gnomad = {k: v for k, v in values.items() if "gnomad" in k}
-            esp = {k: v for k, v in values.items() if k in _ESP_KEYS}
-            genomes = {
-                k: v for k, v in values.items()
-                if "gnomad" not in k and k not in _ESP_KEYS
-            }
+            gnomad: dict = {}
+            esp: dict = {}
+            genomes: dict = {}
+            for k, v in values.items():  # one pass, not three scans
+                if "gnomad" in k:
+                    gnomad[k] = v
+                elif k in _ESP_KEYS:
+                    esp[k] = v
+                else:
+                    genomes[k] = v
             buckets = {}
             if gnomad:
                 buckets["GnomAD"] = gnomad
@@ -217,13 +227,11 @@ class VepResultParser:
         """The result minus the extracted blocks
         (``vep_variant_loader.py:111-123``).
 
-        A SHALLOW copy suffices: the popped keys are removed from the copy
+        A SHALLOW copy suffices: the dropped keys are excluded from the copy
         only, the parsed annotation is never mutated after this point (its
         lifetime ends with the batch), and the retained values are disjoint
         from the extracted consequence/frequency blocks — deep-copying the
         whole annotation per result dominated the VEP load's profile."""
-        result = dict(annotation)
-        result.pop("colocated_variants", None)
-        for ctype in CONSEQUENCE_TYPES:
-            result.pop(ctype + "_consequences", None)
-        return result
+        return {
+            k: v for k, v in annotation.items() if k not in _EXTRACTED_KEYS
+        }

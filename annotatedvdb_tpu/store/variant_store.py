@@ -261,8 +261,13 @@ class Segment:
             obj[c] = None if a is None and b is None else merge_col(
                 _dense(a, older.n), _dense(b, newer.n)
             )
-        return cls(cols, merge_col(older.ref, newer.ref),
-                   merge_col(older.alt, newer.alt), obj)
+        seg = cls(cols, merge_col(older.ref, newer.ref),
+                  merge_col(older.alt, newer.alt), obj)
+        # both inputs' keys are already materialized for the guard/scatter:
+        # hand the merged key to the new segment so its next probe skips
+        # the O(n) recompute
+        seg._key = merge_col(ka, kb)
+        return seg
 
     # -- membership ---------------------------------------------------------
 
