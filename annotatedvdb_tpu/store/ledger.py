@@ -22,12 +22,39 @@ class AlgorithmLedger:
     def __init__(self, path: str):
         self.path = path
         self._entries: list[dict] = []
+        self._heal_before_append = False
         if os.path.exists(path):
             with open(path) as f:
-                self._entries = [json.loads(line) for line in f if line.strip()]
+                lines = [line for line in f if line.strip()]
+            for k, line in enumerate(lines):
+                try:
+                    self._entries.append(json.loads(line))
+                except json.JSONDecodeError:
+                    if k == len(lines) - 1:
+                        # torn FINAL line: the writer died mid-append, so
+                        # that checkpoint never became durable — resume
+                        # proceeds from the previous one (the store may run
+                        # ahead of the cursor; replay is idempotent).
+                        # Heal lazily at our first append — NOT here:
+                        # rewriting on open would let a concurrent
+                        # read-only opener clobber a line the live writer
+                        # is completing.
+                        self._heal_before_append = True
+                        break
+                    raise
 
     def _append(self, entry: dict) -> None:
         self._entries.append(entry)
+        if getattr(self, "_heal_before_append", False):
+            # drop the torn tail detected at open, atomically, now that
+            # this process IS the writer
+            tmp = self.path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as out:
+                for e in self._entries:
+                    out.write(json.dumps(e) + "\n")
+            os.replace(tmp, self.path)
+            self._heal_before_append = False
+            return
         with open(self.path, "a") as f:
             f.write(json.dumps(entry) + "\n")
 

@@ -732,7 +732,12 @@ class VariantStore:
             label = chromosome_label(code)
             seg_ids = []
             for seg in shard.segments:
-                if seg.seg_id is None:
+                if seg.dirty or seg.seg_id is None:
+                    # EVERY (re-)write takes a fresh seg id, so a
+                    # manifested segment's files are never touched in
+                    # place — the manifest swap below is the single
+                    # commit point (a crash between the two per-segment
+                    # renames can otherwise tear an npz/jsonl pair)
                     seg.seg_id = self._next_seg_id
                     self._next_seg_id = max(self._next_seg_id + 1, seg.seg_id + 1)
                 stem = f"chr{label}.{seg.seg_id:06d}"
@@ -744,24 +749,39 @@ class VariantStore:
                 live_files.update({stem + ".npz", stem + ".ann.jsonl"})
             manifest["shards"][label] = seg_ids
         manifest["next_seg_id"] = self._next_seg_id
-        with open(os.path.join(path, "manifest.json"), "w") as f:
+        # atomic swap: a crash mid-save must leave the PREVIOUS manifest
+        # intact (segments are also written via tmp+rename, so the old
+        # manifest's files are never mutated in place) — the store is
+        # always loadable, possibly one checkpoint behind
+        mtmp = os.path.join(path, f".manifest.tmp{os.getpid()}")
+        with open(mtmp, "w") as f:
             json.dump(manifest, f)
+        os.replace(mtmp, os.path.join(path, "manifest.json"))
         for fname in os.listdir(path):
             if fname not in live_files and (
-                    fname.endswith(".npz") or fname.endswith(".ann.jsonl")):
+                    fname.endswith(".npz") or fname.endswith(".ann.jsonl")
+                    # orphaned tmp files from crashed saves (any pid)
+                    or (fname.startswith(".") and ".tmp" in fname)):
                 os.remove(os.path.join(path, fname))
 
     @staticmethod
     def _write_segment(path: str, stem: str, seg: Segment) -> None:
         # uncompressed: segments are rewritten on every cascade merge, and
         # deflate CPU dominates the persist stage at load throughput (the
-        # reference's Postgres heap is uncompressed for the same reason)
-        np.savez(
-            os.path.join(path, stem + ".npz"),
-            ref=seg.ref, alt=seg.alt,
-            **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
-        )
-        with open(os.path.join(path, stem + ".ann.jsonl"), "w") as f:
+        # reference's Postgres heap is uncompressed for the same reason).
+        # tmp+rename: a re-persisted dirty segment (e.g. updated
+        # annotations) must never corrupt the file the current manifest
+        # references if the process dies mid-write
+        tmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.npz")
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                ref=seg.ref, alt=seg.alt,
+                **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
+            )
+        os.replace(tmp, os.path.join(path, stem + ".npz"))
+        atmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.ann.jsonl")
+        with open(atmp, "w") as f:
             present = [(c, seg.obj[c]) for c in OBJECT_COLUMNS
                        if seg.obj[c] is not None]
             for i in range(seg.n) if present else ():
@@ -773,6 +793,7 @@ class VariantStore:
                 if row:
                     row["i"] = i
                     f.write(json.dumps(row) + "\n")
+        os.replace(atmp, os.path.join(path, stem + ".ann.jsonl"))
 
     @classmethod
     def load(cls, path: str) -> "VariantStore":

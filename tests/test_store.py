@@ -262,3 +262,77 @@ def test_ledger_undone_superseder_revives_older_cursor(tmp_path):
     ledger.undo(a2, removed=900)
     # a2's coverage is gone; a1's crashed cursor is live again
     assert ledger.last_checkpoint("f.vcf") == 500
+
+
+def test_ledger_tolerates_torn_final_line(tmp_path):
+    """A SIGKILL mid-append leaves a truncated trailing JSONL line; reopen
+    must drop it (that checkpoint never became durable), heal the file, and
+    keep accepting appends."""
+    path = str(tmp_path / "ledger.jsonl")
+    ledger = AlgorithmLedger(path)
+    a1 = ledger.begin("load", {"file": "f.vcf"}, commit=True)
+    ledger.checkpoint(a1, "f.vcf", 1000, {})
+    with open(path, "a") as f:
+        f.write('{"type": "checkpoint", "alg_id": 1, "file": "f.v')  # torn
+    reopened = AlgorithmLedger(path)
+    assert reopened.last_checkpoint("f.vcf") == 1000  # torn line ignored
+    a2 = reopened.begin("load", {"file": "f.vcf"}, commit=True)
+    reopened.checkpoint(a2, "f.vcf", 2000, {})
+    # healed: every line in the file parses again
+    again = AlgorithmLedger(path)
+    assert again.last_checkpoint("f.vcf") == 2000
+    # a torn line in the MIDDLE is real corruption and must still raise
+    lines = open(path).read().splitlines()
+    lines.insert(1, '{"type": "checkpoi')
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        AlgorithmLedger(path)
+
+
+def test_save_is_atomic_against_kill(tmp_path, monkeypatch):
+    """A crash mid-save must leave the previous on-disk state loadable:
+    manifest and segment files swap in via tmp+rename, never truncate in
+    place."""
+    import os
+
+    import annotatedvdb_tpu.store.variant_store as vs
+
+    store = VariantStore(width=8)
+    shard = store.shard(1)
+    rows = {
+        "pos": np.arange(100, 200, dtype=np.int32),
+        "h": np.arange(100, dtype=np.uint32),
+        "ref_len": np.ones(100, np.int32),
+        "alt_len": np.ones(100, np.int32),
+    }
+    ref = np.zeros((100, 8), np.uint8); ref[:, 0] = 65
+    alt = np.zeros((100, 8), np.uint8); alt[:, 0] = 71
+    shard.append(dict(rows), ref.copy(), alt.copy())
+    out = str(tmp_path / "vdb")
+    store.save(out)
+    before = VariantStore.load(out).n
+
+    # second save dies midway: the segment write completes but the process
+    # "dies" before the manifest swap
+    rows2 = dict(rows); rows2["pos"] = rows["pos"] + 1000
+    shard.append(rows2, ref.copy(), alt.copy())
+
+    real_replace = os.replace
+    def dying_replace(src, dst):
+        if dst.endswith("manifest.json"):
+            raise KeyboardInterrupt("simulated kill before manifest swap")
+        return real_replace(src, dst)
+    monkeypatch.setattr(vs.os, "replace", dying_replace)
+    try:
+        store.save(out)
+    except KeyboardInterrupt:
+        pass
+    monkeypatch.setattr(vs.os, "replace", real_replace)
+    # the previous state must still load intact
+    assert VariantStore.load(out).n == before
+    # and a clean retry completes the save
+    store.save(out)
+    assert VariantStore.load(out).n == 200

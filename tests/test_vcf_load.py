@@ -115,6 +115,19 @@ def test_mapping_ids_and_pks_tricky_shapes(tmp_path):
         }, engine
 
 
+def test_loader_close_is_idempotent(tmp_path, vcf_file):
+    """close() releases the prefetch worker and a closed loader can load
+    again (the pool respawns lazily)."""
+    store, loader = make_loader(tmp_path)
+    loader.load_file(vcf_file, commit=True)
+    loader.close()
+    loader.close()  # idempotent
+    n = store.n
+    loader.load_file(vcf_file, commit=True, resume=False)
+    assert store.n == n  # all duplicates on the second pass
+    loader.close()
+
+
 def test_info_escape_scrubbing():
     info = parse_info(r"NOTE=a\x2cb\x59c#d;FLAG")
     assert info["NOTE"] == "a,b/c:d"
