@@ -40,19 +40,24 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
         try:
             # vectorized string assembly per window (per-row
             # alleles()/primary_key() would binary-search ids row by row;
-            # whole-shard assembly would hold ~4 strings/row resident)
+            # whole-shard assembly would hold ~4 strings/row resident);
+            # lines buffer per window and flush in one write
+            pending: list = []
             for lo in range(0, shard.n, EGRESS_WINDOW):
                 refs, alts, _mseq, pks = shard_strings(
                     shard, lo, lo + EGRESS_WINDOW
                 )
+                pos_l = pos[lo:lo + EGRESS_WINDOW].tolist()
                 for j in range(len(pks)):
-                    i = lo + j
                     ref, alt = refs[j], alts[j]
                     if _INVALID_ALLELE.match(ref) or _INVALID_ALLELE.match(alt):
                         print(pks[j], file=invalid_fh)
                         counters["invalid"] += 1
                         continue
                     if fh is None or rows_in_file >= variants_per_file:
+                        if pending and fh:
+                            fh.write("\n".join(pending) + "\n")
+                            pending = []
                         if fh:
                             fh.close()
                         file_count += 1
@@ -63,12 +68,20 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
                         )
                         print(*VCF_HEADER, sep="\t", file=fh)
                         rows_in_file = 0
-                    print(label, int(pos[i]), pks[j], ref, alt,
-                          ".", ".", ".", sep="\t", file=fh)
+                    pending.append(
+                        f"{label}\t{pos_l[j]}\t{pks[j]}\t{ref}\t{alt}\t.\t.\t."
+                    )
                     rows_in_file += 1
                     counters["exported"] += 1
+                if pending and fh:
+                    fh.write("\n".join(pending) + "\n")
+                    pending = []
         finally:
             if fh:
+                # an exception mid-window must not drop buffered rows the
+                # counters already counted
+                if pending:
+                    fh.write("\n".join(pending) + "\n")
                 fh.close()
     counters["files"] = file_count
     return counters

@@ -45,13 +45,17 @@ class AlgorithmLedger:
 
     def _append(self, entry: dict) -> None:
         self._entries.append(entry)
-        if getattr(self, "_heal_before_append", False):
+        if self._heal_before_append:
             # drop the torn tail detected at open, atomically, now that
-            # this process IS the writer
-            tmp = self.path + f".tmp{os.getpid()}"
+            # this process IS the writer.  Dot-prefixed tmp name so
+            # VariantStore.save's orphan cleanup reaps it after a crash.
+            d, base = os.path.split(self.path)
+            tmp = os.path.join(d, f".{base}.tmp{os.getpid()}")
             with open(tmp, "w") as out:
                 for e in self._entries:
                     out.write(json.dumps(e) + "\n")
+                out.flush()
+                os.fsync(out.fileno())
             os.replace(tmp, self.path)
             self._heal_before_append = False
             return

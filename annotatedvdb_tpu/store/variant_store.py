@@ -739,7 +739,7 @@ class VariantStore:
                     # commit point (a crash between the two per-segment
                     # renames can otherwise tear an npz/jsonl pair)
                     seg.seg_id = self._next_seg_id
-                    self._next_seg_id = max(self._next_seg_id + 1, seg.seg_id + 1)
+                    self._next_seg_id += 1
                 stem = f"chr{label}.{seg.seg_id:06d}"
                 if seg.dirty or not os.path.exists(
                         os.path.join(path, stem + ".npz")):
@@ -749,13 +749,21 @@ class VariantStore:
                 live_files.update({stem + ".npz", stem + ".ann.jsonl"})
             manifest["shards"][label] = seg_ids
         manifest["next_seg_id"] = self._next_seg_id
-        # atomic swap: a crash mid-save must leave the PREVIOUS manifest
-        # intact (segments are also written via tmp+rename, so the old
-        # manifest's files are never mutated in place) — the store is
-        # always loadable, possibly one checkpoint behind
+        # atomic swap: a PROCESS crash mid-save must leave the previous
+        # manifest intact (segments are also written via tmp+rename, so the
+        # old manifest's files are never mutated in place) — the store is
+        # always loadable, possibly one checkpoint behind.  The small
+        # manifest is always fsynced; segment DATA fsync is opt-in
+        # (AVDB_FSYNC=1) because per-checkpoint writeback of 100MB+
+        # segments costs real throughput, and the survivable fault model
+        # matches the reference's own bulk loads (UNLOGGED tables are
+        # truncated by Postgres crash recovery, createVariant.sql:4) —
+        # process death is covered, power loss needs the opt-in.
         mtmp = os.path.join(path, f".manifest.tmp{os.getpid()}")
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(mtmp, os.path.join(path, "manifest.json"))
         for fname in os.listdir(path):
             if fname not in live_files and (
@@ -772,6 +780,7 @@ class VariantStore:
         # tmp+rename: a re-persisted dirty segment (e.g. updated
         # annotations) must never corrupt the file the current manifest
         # references if the process dies mid-write
+        fsync_data = bool(os.environ.get("AVDB_FSYNC"))
         tmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.npz")
         with open(tmp, "wb") as f:
             np.savez(
@@ -779,6 +788,9 @@ class VariantStore:
                 ref=seg.ref, alt=seg.alt,
                 **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
             )
+            if fsync_data:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, os.path.join(path, stem + ".npz"))
         atmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.ann.jsonl")
         with open(atmp, "w") as f:
@@ -793,6 +805,9 @@ class VariantStore:
                 if row:
                     row["i"] = i
                     f.write(json.dumps(row) + "\n")
+            if fsync_data:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(atmp, os.path.join(path, stem + ".ann.jsonl"))
 
     @classmethod
