@@ -43,6 +43,12 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
             # whole-shard assembly would hold ~4 strings/row resident);
             # lines buffer per window and flush in one write
             pending: list = []
+
+            def flush_pending():
+                if pending and fh:
+                    fh.write("\n".join(pending) + "\n")
+                    pending.clear()
+
             for lo in range(0, shard.n, EGRESS_WINDOW):
                 refs, alts, _mseq, pks = shard_strings(
                     shard, lo, lo + EGRESS_WINDOW
@@ -55,9 +61,7 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
                         counters["invalid"] += 1
                         continue
                     if fh is None or rows_in_file >= variants_per_file:
-                        if pending and fh:
-                            fh.write("\n".join(pending) + "\n")
-                            pending = []
+                        flush_pending()
                         if fh:
                             fh.close()
                         file_count += 1
@@ -73,15 +77,12 @@ def export_chromosome(store: VariantStore, code: int, out_dir: str,
                     )
                     rows_in_file += 1
                     counters["exported"] += 1
-                if pending and fh:
-                    fh.write("\n".join(pending) + "\n")
-                    pending = []
+                flush_pending()
         finally:
+            # an exception mid-window must not drop buffered rows the
+            # counters already counted
+            flush_pending()
             if fh:
-                # an exception mid-window must not drop buffered rows the
-                # counters already counted
-                if pending:
-                    fh.write("\n".join(pending) + "\n")
                 fh.close()
     counters["files"] = file_count
     return counters

@@ -61,6 +61,15 @@ class AlgorithmLedger:
             return
         with open(self.path, "a") as f:
             f.write(json.dumps(entry) + "\n")
+            from annotatedvdb_tpu.store.variant_store import _fsync_wanted
+
+            if _fsync_wanted():
+                # power-loss opt-in: make the cursor promptly durable.
+                # (Safety never depends on this — the store's fsync'd
+                # renames complete BEFORE this append is written, so the
+                # cursor can lag the store but never lead it.)
+                f.flush()
+                os.fsync(f.fileno())
 
     def begin(self, script: str, params: dict, commit: bool) -> int:
         """Register a load; returns the new algorithm-invocation id (serial)."""

@@ -114,6 +114,12 @@ MERGE_SEGMENT_CAP = 1 << 20
 _DEVICE_LOOKUP_MODE: str | None = None
 
 
+def _fsync_wanted() -> bool:
+    """AVDB_FSYNC opt-in: full power-loss durability for segment data and
+    rename metadata (see ``VariantStore.save``).  '0'/'false' disable."""
+    return os.environ.get("AVDB_FSYNC", "").lower() not in ("", "0", "false")
+
+
 def _device_lookup_mode() -> str:
     global _DEVICE_LOOKUP_MODE
     if _DEVICE_LOOKUP_MODE is None:
@@ -765,6 +771,15 @@ class VariantStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(mtmp, os.path.join(path, "manifest.json"))
+        if _fsync_wanted():
+            # commit the rename METADATA too (every segment rename above
+            # shares this directory, so one directory fsync after the
+            # manifest swap covers them all)
+            dfd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         for fname in os.listdir(path):
             if fname not in live_files and (
                     fname.endswith(".npz") or fname.endswith(".ann.jsonl")
@@ -780,7 +795,7 @@ class VariantStore:
         # tmp+rename: a re-persisted dirty segment (e.g. updated
         # annotations) must never corrupt the file the current manifest
         # references if the process dies mid-write
-        fsync_data = bool(os.environ.get("AVDB_FSYNC"))
+        fsync_data = _fsync_wanted()
         tmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.npz")
         with open(tmp, "wb") as f:
             np.savez(
