@@ -91,6 +91,11 @@ class TpuUpdateLoader:
         resume_line = self.ledger.last_checkpoint(path) if resume else 0
         if resume_line:
             self.log(f"resuming {path} after committed line {resume_line}")
+        if not self.strategy.insert_novel:
+            # pure-update strategies probe a static store per chunk: pin
+            # membership caches where the link makes that a win (no-op on
+            # slow links / CPU backends)
+            self.store.pin_for_updates()
         reader = VcfBatchReader(
             path, batch_size=self.batch_size, width=self.store.width,
             chromosome_map=self.chromosome_map,

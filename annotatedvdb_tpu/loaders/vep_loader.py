@@ -115,6 +115,9 @@ class TpuVepLoader:
             {"file": path, "datasource": self.datasource, "test": test},
             commit,
         )
+        # update loads probe a static store per flush: pin membership
+        # caches in HBM where the link makes that a win (no-op otherwise)
+        self.store.pin_for_updates()
         raw: list[dict] = []
         n_added_before = len(self.parser.ranker.added)
 

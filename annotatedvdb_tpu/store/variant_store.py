@@ -576,22 +576,22 @@ class ChromosomeShard:
         numpy path)."""
         if not _device_lookup_enabled():
             return 0
-        pinned = []
+        pinned = 0
         for seg in self.segments:
-            if seg.n and seg.n >= DEVICE_QUERY_MIN:
+            # only segments past the numpy break-even — pinning smaller
+            # ones routes probes through kernel dispatch where a
+            # cache-resident searchsorted wins
+            if seg.n >= DEVICE_SEGMENT_MIN:
                 try:
                     seg._ensure_device_cache()
-                    pinned.append(seg)
+                    pinned += 1
                 except Exception:
-                    # all-or-nothing: a disabled latch means probe() would
-                    # never consult the already-built caches, so release
-                    # them instead of holding dead HBM for the process life
-                    for p in pinned:
-                        p._device = None
-                    global _DEVICE_LOOKUP_OK
-                    _DEVICE_LOOKUP_OK = False
-                    return 0
-        return len(pinned)
+                    # likely HBM pressure: stop pinning MORE (already
+                    # pinned caches stay useful) but leave the global
+                    # lookup latch alone — the lazy ski-rental path in
+                    # probe() keeps working within whatever fits
+                    break
+        return pinned
 
     def lookup(self, pos, h, ref, alt, ref_len, alt_len):
         """Vectorized membership: (found [N] bool, global id [N] int64).
@@ -706,6 +706,17 @@ class VariantStore:
         if code not in self.shards:
             self.shards[code] = ChromosomeShard(code, self.width)
         return self.shards[code]
+
+    def pin_for_updates(self) -> int:
+        """Upload every shard's membership cache to HBM when that pays:
+        update loads (VEP/CADD/QC) probe a STATIC store many times, so on
+        fast locally-attached links the one-time identity-column upload
+        amortizes across the whole file.  No-op on slow links (probing a
+        remote tunnel costs more in query transfers than numpy saves) and
+        on CPU backends.  Returns segments pinned."""
+        if not (_device_lookup_enabled() and _transfer_fast()):
+            return 0
+        return sum(s.pin_device_lookup() for s in self.shards.values())
 
     @property
     def n(self) -> int:

@@ -221,7 +221,7 @@ def test_pin_device_lookup_builds_reachable_cache(monkeypatch):
     from annotatedvdb_tpu.store import variant_store as vs
 
     monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", True)
-    monkeypatch.setattr(vs, "DEVICE_QUERY_MIN", 1)
+    monkeypatch.setattr(vs, "DEVICE_SEGMENT_MIN", 1)
 
     store = VariantStore(width=WIDTH)
     shard = store.shard(1)
@@ -244,6 +244,24 @@ def test_pin_device_lookup_builds_reachable_cache(monkeypatch):
     np.testing.assert_array_equal(f_dev, f_np)
     np.testing.assert_array_equal(i_dev, i_np)
     assert f_np.all()
+
+
+def test_pin_for_updates_respects_link_speed(monkeypatch):
+    """store.pin_for_updates pins every eligible segment when the backend
+    and link qualify, and is a no-op on slow links."""
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    monkeypatch.setattr(vs, "_DEVICE_LOOKUP_OK", True)
+    monkeypatch.setattr(vs, "DEVICE_SEGMENT_MIN", 1)
+    store = VariantStore(width=WIDTH)
+    shard = store.shard(1)
+    for rows, ref, alt in _batches(1, 4096, seed=41):
+        shard.append(rows, ref, alt)
+    monkeypatch.setattr(vs, "_TRANSFER_FAST", False)
+    assert store.pin_for_updates() == 0  # slow link: no-op
+    monkeypatch.setattr(vs, "_TRANSFER_FAST", True)
+    assert store.pin_for_updates() == 1
+    assert shard.segments[0]._device is not None
 
 
 def test_append_interleaved_with_lookup(rng):
