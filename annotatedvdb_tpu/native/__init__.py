@@ -73,8 +73,6 @@ def load():
             c.c_int32, c.c_int32,                              # identity_only, want_packed
             c.c_void_p, c.c_void_p, c.c_void_p,               # counters, consumed, need_more
         ]
-        lib.avdb_parse_rs.restype = c.c_int32
-        lib.avdb_parse_rs.argtypes = [c.c_char_p, c.c_int32, c.c_void_p]
         _lib = lib
         return _lib
 

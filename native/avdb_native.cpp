@@ -405,18 +405,4 @@ int64_t avdb_parse_vcf_chunk(
     return rows;
 }
 
-// Fast scan for the ID column's refsnp: returns 1 and writes the rs number
-// when the span looks like "rs<digits>", else 0.  (INFO RS= extraction stays
-// in Python — it needs the full INFO parse anyway.)
-int32_t avdb_parse_rs(const char* s, int32_t len, int64_t* out) {
-    if (len < 3 || s[0] != 'r' || s[1] != 's') return 0;
-    int64_t v = 0;
-    for (int32_t i = 2; i < len; ++i) {
-        if (s[i] < '0' || s[i] > '9') return 0;
-        v = v * 10 + (s[i] - '0');
-    }
-    *out = v;
-    return 1;
-}
-
 }  // extern "C"

@@ -83,6 +83,12 @@ def test_primary_keys_literal_and_rs_suffix(rng):
     batch = VariantBatch.from_tuples(variants, width=24)
     ann = _annotated(batch)
     pks = egress.primary_keys(batch, ann, ["rs1", None, "rs3"])
+    # the int-column assembly the loaders use must agree with the
+    # string-input variant byte-for-byte
+    pks_ints = egress.primary_keys_from_ints(
+        batch, ann, np.array([1, -1, 3], np.int64)
+    )
+    assert list(pks_ints) == list(pks)
     assert pks[0] == "1:100:A:G:rs1"
     assert pks[1] == "X:5000:AT:A"
     assert pks[2] == "M:263:A:G:rs3"
