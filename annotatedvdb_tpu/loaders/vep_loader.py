@@ -94,7 +94,10 @@ class TpuVepLoader:
             pack_vep_outputs_jit,
             transport_verified,
         )
+        from annotatedvdb_tpu.store.variant_store import _transfer_fast
 
+        if not _transfer_fast():
+            return  # slow link: _apply_batch computes on host, no kernels
         p = next_pow2(self.batch_size)
         for shape in {p, next_pow2(p + 1)}:
             b = synthetic_batch(shape, width=self.store.width)
@@ -227,46 +230,63 @@ class TpuVepLoader:
         # pow2 padding bounds the set of compiled kernel shapes (batch row
         # counts vary per flush; see vcf_loader._pad_batch)
         from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
-        from annotatedvdb_tpu.utils.arrays import next_pow2
 
         n = batch.n
-        # tail flushes pad UP to the steady-state shape so a whole load
-        # compiles at most two kernel shapes (both covered by ``warmup``)
-        padded = _pad_batch(
-            batch, max(next_pow2(n), next_pow2(self.batch_size))
-        )
-        ann_p = annotate_fn()(
-            padded.chrom, padded.pos, padded.ref, padded.alt,
-            padded.ref_len, padded.alt_len,
-        )
-        h_dev = allele_hash_jit(
-            padded.ref, padded.alt, padded.ref_len, padded.alt_len
-        )
-        # only hash + prefix + fallback-flag feed the update path; pack them
-        # into ONE fetched buffer — each materialization pays a fixed round
-        # trip on remote-attached TPUs (see ops/pack.py)
-        from annotatedvdb_tpu.ops.pack import (
-            pack_vep_outputs_jit,
-            transport_verified,
-            unpack_vep_outputs,
-        )
+        from annotatedvdb_tpu.store.variant_store import _transfer_fast
 
-        # width bound: prefix_len rides a uint8 lane (pack truncates >255)
-        if transport_verified() and self.store.width <= 255:
-            cols = unpack_vep_outputs(
-                np.asarray(
-                    pack_vep_outputs_jit(
-                        h_dev, ann_p.prefix_len, ann_p.host_fallback
+        if not _transfer_fast():
+            # slow remote-attached link: the update path only needs hash +
+            # prefix + fallback flag, and the device round trip (query
+            # upload + fetch latency) costs more than computing them on
+            # host — bit-exact numpy twins of the kernels (see
+            # ops/hashing.allele_hash_np, ops/annotate.vep_identity_np)
+            from annotatedvdb_tpu.ops.annotate import vep_identity_np
+            from annotatedvdb_tpu.ops.hashing import allele_hash_np
+
+            prefix, host = vep_identity_np(
+                batch.ref, batch.alt, batch.ref_len, batch.alt_len
+            )
+            h = allele_hash_np(
+                batch.ref, batch.alt, batch.ref_len, batch.alt_len
+            )
+        else:
+            # tail flushes pad UP to the steady-state shape so a whole load
+            # compiles at most two kernel shapes (both covered by ``warmup``)
+            padded = _pad_batch(
+                batch, max(next_pow2(n), next_pow2(self.batch_size))
+            )
+            ann_p = annotate_fn()(
+                padded.chrom, padded.pos, padded.ref, padded.alt,
+                padded.ref_len, padded.alt_len,
+            )
+            h_dev = allele_hash_jit(
+                padded.ref, padded.alt, padded.ref_len, padded.alt_len
+            )
+            # only hash + prefix + fallback-flag feed the update path; pack
+            # them into ONE fetched buffer — each materialization pays a
+            # fixed round trip (see ops/pack.py)
+            from annotatedvdb_tpu.ops.pack import (
+                pack_vep_outputs_jit,
+                transport_verified,
+                unpack_vep_outputs,
+            )
+
+            # width bound: prefix_len rides a uint8 lane (>255 truncates)
+            if transport_verified() and self.store.width <= 255:
+                cols = unpack_vep_outputs(
+                    np.asarray(
+                        pack_vep_outputs_jit(
+                            h_dev, ann_p.prefix_len, ann_p.host_fallback
+                        )
                     )
                 )
-            )
-            prefix = cols["prefix_len"][:n]
-            host = cols["host_fallback"][:n]
-            h = cols["h"][:n]
-        else:
-            prefix = np.asarray(ann_p.prefix_len)[:n]
-            host = np.asarray(ann_p.host_fallback)[:n]
-            h = np.array(h_dev)[:n]
+                prefix = cols["prefix_len"][:n]
+                host = cols["host_fallback"][:n]
+                h = cols["h"][:n]
+            else:
+                prefix = np.asarray(ann_p.prefix_len)[:n]
+                host = np.asarray(ann_p.host_fallback)[:n]
+                h = np.array(h_dev)[:n]
         from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
         from annotatedvdb_tpu.oracle import normalize_alleles
 

@@ -136,3 +136,27 @@ def annotate_kernel(pos, ref, alt, ref_len, alt_len):
 
 
 annotate_kernel_jit = jax.jit(annotate_kernel)
+
+
+def vep_identity_np(ref, alt, ref_len, alt_len):
+    """Host-side twin of the two annotate outputs the VEP update path
+    consumes: ``(prefix_len, host_fallback)``, bit-exact with
+    :func:`annotate_kernel` (parity pinned by ``tests/test_pack.py``).
+    The path's third input, the allele hash, comes from
+    ``ops.hashing.allele_hash_np``.
+
+    On slow remote-attached links the device round trip costs more than
+    this numpy scan; see ``loaders/vep_loader.py``."""
+    import numpy as _np
+
+    ref = _np.asarray(ref, _np.uint8)
+    alt = _np.asarray(alt, _np.uint8)
+    rlen = _np.asarray(ref_len, _np.int32)
+    alen = _np.asarray(alt_len, _np.int32)
+    w = ref.shape[1]
+    col = _np.arange(w, dtype=_np.int32)[None, :]
+    match = (ref == alt) & (col < rlen[:, None]) & (col < alen[:, None])
+    prefix = (_np.cumsum(~match, axis=1) == 0).sum(axis=1).astype(_np.int32)
+    prefix = _np.where((rlen == 1) & (alen == 1), 0, prefix)
+    host_fallback = (rlen > w) | (alen > w)
+    return prefix, host_fallback

@@ -43,3 +43,28 @@ def allele_hash(ref, alt, ref_len, alt_len):
 
 
 allele_hash_jit = jax.jit(allele_hash)
+
+
+def allele_hash_np(ref, alt, ref_len, alt_len) -> np.ndarray:
+    """Bit-exact numpy twin of :func:`allele_hash`.
+
+    On slow remote-attached links (see ``store.variant_store._transfer_fast``)
+    the update loaders hash on host: the device round trip costs more than
+    the FNV loop saves.  Parity with the jitted kernel is pinned by
+    ``tests/test_pack.py`` — store membership compares these hashes against
+    device-computed ones, so they must never diverge."""
+    ref = np.asarray(ref, np.uint8)
+    alt = np.asarray(alt, np.uint8)
+    h = np.full(ref.shape[0], FNV_OFFSET, np.uint32)
+    prime = FNV_PRIME
+
+    def step(h, byte):
+        return (h ^ byte.astype(np.uint32)) * prime
+
+    h = step(h, np.asarray(ref_len).astype(np.uint32) & 0xFF)
+    h = step(h, np.asarray(alt_len).astype(np.uint32) & 0xFF)
+    for i in range(ref.shape[1]):
+        h = step(h, ref[:, i])
+    for i in range(alt.shape[1]):
+        h = step(h, alt[:, i])
+    return h

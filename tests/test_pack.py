@@ -92,6 +92,28 @@ def test_nibble_alleles_rejects_exotic_bytes():
     assert encode_alleles_nibble(ref, alt) is None
 
 
+def test_host_identity_twins_match_kernels():
+    """allele_hash_np / vep_identity_np must be BIT-EXACT with the jitted
+    kernels: store membership compares host hashes against device-computed
+    ones, so divergence silently breaks dedup on slow links."""
+    from annotatedvdb_tpu.io.synth import synthetic_batch
+    from annotatedvdb_tpu.models.pipeline import annotate_fn
+    from annotatedvdb_tpu.ops.annotate import vep_identity_np
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit, allele_hash_np
+
+    for width in (16, 49):
+        b = synthetic_batch(2048, width=width)
+        h_dev = np.asarray(
+            allele_hash_jit(b.ref, b.alt, b.ref_len, b.alt_len)
+        )
+        h_np = allele_hash_np(b.ref, b.alt, b.ref_len, b.alt_len)
+        assert (h_dev == h_np).all()
+        ann = annotate_fn()(b.chrom, b.pos, b.ref, b.alt, b.ref_len, b.alt_len)
+        prefix, host = vep_identity_np(b.ref, b.alt, b.ref_len, b.alt_len)
+        assert (np.asarray(ann.prefix_len) == prefix).all()
+        assert (np.asarray(ann.host_fallback) == host).all()
+
+
 def test_pack_extreme_values():
     h = np.array([0, 1, 0xFFFFFFFF, 0xDEADBEEF], np.uint32)
     leaf = np.array([-(2**31), 2**31 - 1, 0, -1], np.int32)

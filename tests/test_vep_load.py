@@ -104,8 +104,14 @@ def test_parser_cosmic_filtered_and_refsnp_disambiguation():
     assert out["values"] == {"C": {"1000Genomes": {"af": 0.2}}}
 
 
-def test_vep_load_updates_store(tmp_path, loaded_store):
+@pytest.mark.parametrize("link_fast", [True, False])
+def test_vep_load_updates_store(tmp_path, loaded_store, link_fast, monkeypatch):
     store, ledger = loaded_store
+    # link_fast False forces the slow-link host path (numpy hash/prefix
+    # twins) — results must be identical either way
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    monkeypatch.setattr(vs, "_TRANSFER_FAST", link_fast)
     results = [
         vep_result("1", 10039, "rs978760828", "A", "C", "C",
                    ["missense_variant", "splice_region_variant"],
