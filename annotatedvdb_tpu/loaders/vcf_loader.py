@@ -28,13 +28,10 @@ from annotatedvdb_tpu.io.vcf import rs_number as _io_rs_number
 from annotatedvdb_tpu.oracle.binindex import closed_form_bin
 from annotatedvdb_tpu.types import AnnotatedBatch, VariantBatch
 from annotatedvdb_tpu.models.pipeline import annotate_fn
-from annotatedvdb_tpu.ops.dedup import mark_batch_duplicates_jit
 from annotatedvdb_tpu.ops.hashing import allele_hash_jit
 from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
-
-_CHROM_MIX = np.uint32(0x9E3779B9)  # decorrelate chromosomes in batch dedup
-
+from annotatedvdb_tpu.store.variant_store import Segment
 
 def _pad_batch(batch: VariantBatch, n_target: int) -> VariantBatch:
     """Pad to a fixed row count so jitted kernels see a bounded set of
@@ -148,6 +145,16 @@ class TpuVcfLoader:
         from annotatedvdb_tpu.utils.profiling import StageTimer
 
         self._cadence = ProgressCadence(self.log, log_after)
+        # async store pipeline: built segments queue to a single writer
+        # thread (append -> persist -> checkpoint -> cascade merge) while
+        # the main thread runs the next chunk's device work.  Entries are
+        # (future, payload); payload segments double as the pending
+        # membership set (see _membership_segments).  AVDB_ASYNC_STORE=0
+        # forces the synchronous path.
+        import collections
+
+        self._inflight: "collections.deque" = collections.deque()
+        self._writer_pool = None
 
         #: per-stage wall-clock attribution (ingest/annotate/lookup/egress/
         #: append/persist) — the observability the reference only has as
@@ -192,15 +199,27 @@ class TpuVcfLoader:
         if resume_line:
             self.log(f"resuming {path} after committed line {resume_line}")
         mapping_fh = open(mapping_path, "w") if mapping_path else None
+        import os as _os
+
+        # async store pipeline (append/persist/checkpoint on the writer
+        # thread) — the store side of the r3 bench was 61% of e2e
+        # wall-clock, all of it overlappable with the next chunk's device
+        # work.  Opt-out for debugging via AVDB_ASYNC_STORE=0.
+        async_store = commit and _os.environ.get(
+            "AVDB_ASYNC_STORE", "1"
+        ) != "0"
         try:
+            from annotatedvdb_tpu.ops.pack import transport_wanted
+
             reader = VcfBatchReader(
                 path,
                 batch_size=self.batch_size,
                 width=self.store.width,
                 chromosome_map=self.chromosome_map,
-                # the mesh path never uploads packed alleles; skip the
-                # tokenizer's pack work there
-                pack_alleles=self.mesh is None,
+                # the mesh path never uploads packed alleles, and on CPU
+                # backends packing saves no transfer; skip the tokenizer's
+                # pack work in both cases
+                pack_alleles=self.mesh is None and transport_wanted(),
             )
             chunks = iter(reader)
             # double-buffered pipeline: chunk k+1's device work (annotate +
@@ -248,12 +267,22 @@ class TpuVcfLoader:
                             raise RuntimeError(
                                 f"failAt variant reached: {fail_at}"
                             )
-                        self._process_chunk(
+                        self._prune_inflight()
+                        payload = self._process_chunk(
                             done_chunk, done_handles, alg_id, commit,
                             resume_line, mapping_fh,
+                            defer_commit=async_store,
                         )
                         self._log_progress()
-                        if commit:
+                        if commit and async_store:
+                            # checkpoint even for insert-less chunks (an
+                            # all-duplicate chunk must still advance the
+                            # resume cursor)
+                            self._enqueue_commit(
+                                payload, persist, alg_id, path,
+                                int(done_chunk.line_number[-1]),
+                            )
+                        elif commit:
                             with self.timer.stage("persist"):
                                 if persist is not None:
                                     persist()
@@ -268,10 +297,17 @@ class TpuVcfLoader:
                 pending = entry
                 if chunk is None:
                     break
+            self._drain_inflight()
             self.ledger.finish(alg_id, dict(self.counters))
         finally:
-            if mapping_fh:
-                mapping_fh.close()
+            try:
+                # earlier chunks' queued commits land even when a later
+                # chunk raised (failAt semantics: everything before the
+                # fault commits, the fault's own chunk does not)
+                self._drain_inflight()
+            finally:
+                if mapping_fh:
+                    mapping_fh.close()
         self.counters["alg_id"] = alg_id
         return dict(self.counters)
 
@@ -303,9 +339,10 @@ class TpuVcfLoader:
                 encode_alleles_nibble,
                 inflate_alleles_jit,
                 nibble_verified,
+                transport_wanted,
             )
 
-            if nibble_verified():
+            if transport_wanted() and nibble_verified():
                 enc = encode_alleles_nibble(batch.ref, batch.alt)
                 if enc is not None:
                     r, a = inflate_alleles_jit(
@@ -313,17 +350,12 @@ class TpuVcfLoader:
                     )
                     np.asarray(r), np.asarray(a)
         ann = self._annotate(batch)
-        # mirror _dispatch_chunk's exact op chain (hash -> chrom-mix ->
-        # dedup) so no kernel is left to compile mid-load
+        # mirror _dispatch_chunk's exact op chain (annotate + hash; in-batch
+        # dedup is host-side) so no kernel is left to compile mid-load
         h = allele_hash_jit(
             batch.ref, batch.alt, batch.ref_len, batch.alt_len
         )
-        mixed = _mix_hash_jit(h, batch.chrom)
-        dup = mark_batch_duplicates_jit(
-            batch.pos, mixed, batch.ref, batch.alt,
-            batch.ref_len, batch.alt_len,
-        )
-        np.asarray(ann.variant_class), np.asarray(dup)
+        np.asarray(ann.variant_class), np.asarray(h)
         if self.mesh is None and not self.store_display_attributes:
             # compile the output packer AND verify the packed transport
             # bit-exactly reproduces the individual fields on this backend
@@ -332,6 +364,7 @@ class TpuVcfLoader:
             from annotatedvdb_tpu.ops.pack import (
                 pack_outputs_jit,
                 transport_verified,
+                transport_wanted,
                 unpack_outputs,
             )
 
@@ -339,14 +372,17 @@ class TpuVcfLoader:
             # verdict never land inside the first measured chunk; when it
             # fails, _dispatch_chunk falls back to per-field fetches — no
             # packing to warm
-            if transport_verified():
+            if transport_wanted() and transport_verified():
+                import jax.numpy as jnp
+
+                dup = jnp.zeros(h.shape, jnp.bool_)  # unused lane (host dedup)
                 packed = pack_outputs_jit(
                     h, dup, ann.bin_level, ann.leaf_bin,
                     ann.needs_digest, ann.host_fallback,
                 )
                 cols = unpack_outputs(np.asarray(packed))
                 for name, ref_val in (
-                    ("h", h), ("dup", dup), ("bin_level", ann.bin_level),
+                    ("h", h), ("bin_level", ann.bin_level),
                     ("leaf_bin", ann.leaf_bin),
                     ("needs_digest", ann.needs_digest),
                     ("host_fallback", ann.host_fallback),
@@ -455,13 +491,14 @@ class TpuVcfLoader:
                 padded.ref, padded.alt, padded.ref_len, padded.alt_len
             )
             return {"padded": padded, "dev": None, "ann_p": ann_p,
-                    "h_dev": h_dev, "dup_dev": None}
+                    "h_dev": h_dev}
         import jax
 
         from annotatedvdb_tpu.ops.pack import (
             encode_alleles_nibble,
             inflate_alleles_jit,
             nibble_verified,
+            transport_wanted,
         )
 
         # the allele matrices are ~90% of the upload bytes; send them
@@ -470,7 +507,8 @@ class TpuVcfLoader:
         # The native tokenizer pre-packs during its scan; chunks without
         # pre-packed arrays encode here UNLESS the reader already tried and
         # failed (alleles_packable False) or the backend probe failed.
-        if not nibble_verified():
+        # CPU backends skip packing entirely (no transfer to save).
+        if not (transport_wanted() and nibble_verified()):
             enc = None
         elif chunk.ref_packed is not None:
             n_pad = padded.chrom.shape[0]
@@ -501,12 +539,8 @@ class TpuVcfLoader:
             dev = tuple(jax.device_put(x) for x in padded)
         ann_p = annotate_fn()(*dev)
         h_dev = allele_hash_jit(dev[2], dev[3], dev[4], dev[5])
-        mixed = _mix_hash_jit(h_dev, dev[0])
-        dup_dev = mark_batch_duplicates_jit(
-            dev[1], mixed, dev[2], dev[3], dev[4], dev[5]
-        )
         handles = {"padded": padded, "dev": dev, "ann_p": ann_p,
-                   "h_dev": h_dev, "dup_dev": dup_dev}
+                   "h_dev": h_dev}
         if not self.store_display_attributes:
             # remote-attached TPUs pay a fixed round trip PER materialized
             # array; pack the six per-row outputs on device so process time
@@ -518,9 +552,15 @@ class TpuVcfLoader:
                 transport_verified,
             )
 
-            if transport_verified():
+            if transport_wanted() and transport_verified():
+                import jax.numpy as jnp
+
+                # the dup lane of the packed layout is unused since in-batch
+                # dedup moved into the host identity sort; zeros keep the
+                # 10-byte row format (and its bit-exactness probe) stable
                 packed = pack_outputs_jit(
-                    h_dev, dup_dev, ann_p.bin_level, ann_p.leaf_bin,
+                    h_dev, jnp.zeros(h_dev.shape, jnp.bool_),
+                    ann_p.bin_level, ann_p.leaf_bin,
                     ann_p.needs_digest, ann_p.host_fallback,
                 )
                 # the device->host copy releases the GIL: prefetch it on a
@@ -530,6 +570,76 @@ class TpuVcfLoader:
                     np.asarray, packed
                 )
         return handles
+
+    # -- async store writer --------------------------------------------------
+
+    MAX_INFLIGHT_COMMITS = 2  # bounds pending-segment memory + probe work
+
+    def _writer(self):
+        if self._writer_pool is None:
+            import concurrent.futures
+
+            self._writer_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="avdb-store"
+            )
+        return self._writer_pool
+
+    def _membership_segments(self, code: int) -> list:
+        """Segments to probe for membership of chromosome ``code``: pending
+        (enqueued, possibly not yet appended) first, then a snapshot of the
+        shard's list.  Only the writer thread mutates the shard's list, so
+        the snapshot is consistent; pending-then-snapshot ordering plus the
+        writer's append-before-completion means no segment can be missed."""
+        segs = [
+            seg
+            for _fut, payload in self._inflight
+            for c, seg in payload
+            if c == code
+        ]
+        shard = self.store.shards.get(int(code))
+        if shard is not None:
+            segs.extend(list(shard.segments))
+        return segs
+
+    def _commit_job(self, payload, persist, alg_id, path, line, counters):
+        """Writer-thread store commit for one chunk: append its segments,
+        persist + checkpoint, THEN cascade-merge — merging after the persist
+        keeps disk writes append-only (clean+clean merges reference their
+        constituents' files instead of rewriting, Segment.merge)."""
+        n_rows = sum(seg.n for _c, seg in payload)
+        with self.timer.stage("append", items=n_rows):
+            for code, seg in payload:
+                self.store.shard(code).append_segment(seg)
+        with self.timer.stage("persist"):
+            if persist is not None:
+                persist()
+            self.ledger.checkpoint(alg_id, path, line, counters)
+        with self.timer.stage("maintain"):
+            for code in {c for c, _seg in payload}:
+                self.store.shard(code).maintain()
+
+    def _enqueue_commit(self, payload, persist, alg_id, path, line) -> None:
+        """Queue one chunk's store commit; bounded in-flight depth applies
+        backpressure by blocking on the oldest job."""
+        fut = self._writer().submit(
+            self._commit_job, payload or [], persist, alg_id, path, line,
+            dict(self.counters),
+        )
+        self._inflight.append((fut, payload or []))
+        while len(self._inflight) > self.MAX_INFLIGHT_COMMITS:
+            self._inflight[0][0].result()
+            self._inflight.popleft()
+
+    def _prune_inflight(self) -> None:
+        """Drop completed commits (surfacing writer exceptions promptly)."""
+        while self._inflight and self._inflight[0][0].done():
+            fut, _ = self._inflight.popleft()
+            fut.result()
+
+    def _drain_inflight(self) -> None:
+        while self._inflight:
+            fut, _ = self._inflight.popleft()
+            fut.result()
 
     def _prefetch(self):
         """Single-worker transfer thread (lazy: configurations that never
@@ -544,14 +654,21 @@ class TpuVcfLoader:
         return self._prefetch_pool
 
     def close(self) -> None:
-        """Release the prefetch worker (idempotent; loaders are reusable
-        until closed)."""
+        """Release the prefetch + store-writer workers (idempotent; loaders
+        are reusable until closed)."""
         if self._prefetch_pool is not None:
             self._prefetch_pool.shutdown(wait=False)
             self._prefetch_pool = None
+        if self._writer_pool is not None:
+            self._writer_pool.shutdown(wait=True)
+            self._writer_pool = None
 
     def _process_chunk(self, chunk: VcfChunk, handles: dict, alg_id, commit,
-                       resume_line, mapping_fh):
+                       resume_line, mapping_fh, defer_commit: bool = False):
+        """Force the chunk's device results, filter to inserts, build the
+        sorted segments.  With ``defer_commit`` the built segments are
+        RETURNED (for the async store writer) instead of appended inline;
+        the caller owns appending + persisting them in order."""
         batch = chunk.batch
         if self._chrom_lengths is not None:
             oob = batch.pos.astype(np.int64) > self._chrom_lengths[
@@ -578,39 +695,24 @@ class TpuVcfLoader:
             ann_p = handles["ann_p"]
             if handles.get("packed") is not None:
                 # single-fetch path: one [n_padded, 10] uint8 transfer
-                # carries hash + dup + bin + flags (ops/pack.py),
-                # prefetched on the worker thread at dispatch time
+                # carries hash + bin + flags (ops/pack.py), prefetched on
+                # the worker thread at dispatch time
                 from annotatedvdb_tpu.ops.pack import unpack_outputs
 
                 cols = unpack_outputs(handles["packed"].result())
                 h_p = cols["h"].copy()
                 host_rows = cols["host_fallback"][:n]
-                dup_src = cols["dup"]  # already on host
             else:
                 h_p = np.array(handles["h_dev"])
                 host_rows = np.asarray(ann_p.host_fallback)[:n]
                 cols = None
-                # device handle, materialized lazily below — fetching it
-                # when host_rows invalidates it would waste a round trip
-                dup_src = handles["dup_dev"]
             # long alleles are truncated in the device arrays: re-hash them
             # from the original strings so identity never collides on a
-            # shared prefix
+            # shared prefix.  (In-batch dedup happens on host, inside the
+            # per-chromosome identity sort below, so the corrected hashes
+            # are always the ones deduped on.)
             for i in np.where(host_rows)[0]:
                 h_p[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
-            if dup_src is not None and not host_rows.any():
-                dup = np.asarray(dup_src)[:n]
-            else:
-                # fallback rows invalidate the speculative device dedup (it
-                # used truncated-prefix hashes): redo with host-corrected
-                # hashes.  Rare — only chunks carrying >width alleles.
-                mixed = h_p ^ (padded.chrom.astype(np.uint32) * _CHROM_MIX)
-                src = handles["dev"] or padded
-                dup = np.asarray(
-                    mark_batch_duplicates_jit(
-                        src[1], mixed, src[2], src[3], src[4], src[5]
-                    )
-                )[:n]
             h = h_p[:n]
             if cols is not None:
                 ann = _slim_annotated(
@@ -622,31 +724,63 @@ class TpuVcfLoader:
         # replayed rows within a partially-committed chunk
         replay = chunk.line_number <= resume_line
 
-        # ---- membership filtering first; egress strings only for inserts
+        # ---- in-batch dedup + membership filtering; egress strings only
+        # for inserts.  Both ride ONE stable host sort per chromosome by
+        # identity key: in-batch duplicates are adjacent-equal rows after
+        # the sort (byte-confirmed; same first-wins semantics as the
+        # ops.dedup device kernel, which the single-device path no longer
+        # needs), and the surviving rows are already in sorted-merge append
+        # order.  Membership is probed against in-flight (built but not yet
+        # appended) segments FIRST, then a snapshot of the shard's segment
+        # list — in that order, so a segment the async writer moves from
+        # pending into the store mid-probe is seen at least once
+        # (double-probing is idempotent; a gap would drop the
+        # read-your-writes guarantee the reference gets from DB
+        # transactions, database/variant.py:287-309).
         insert_rows: list[np.ndarray] = []
         with self.timer.stage("lookup", items=batch.n):
+            from annotatedvdb_tpu.store.variant_store import combined_key
+
             for code in np.unique(batch.chrom):
-                rows = np.where((batch.chrom == code) & ~dup & ~replay)[0]
+                rows = np.where((batch.chrom == code) & ~replay)[0]
                 if rows.size == 0:
                     continue
-                shard = self.store.shard(code)
-                if self.skip_existing and shard.n:
-                    found, _ = shard.lookup(
-                        batch.pos[rows], h[rows], batch.ref[rows], batch.alt[rows],
-                        batch.ref_len[rows], batch.alt_len[rows],
-                    )
+                key = combined_key(batch.pos[rows], h[rows])
+                order = np.argsort(key, kind="stable")
+                rows, key = rows[order], key[order]
+                if rows.size > 1:
+                    cand = np.where(key[1:] == key[:-1])[0]
+                    if cand.size:
+                        a, b = rows[cand], rows[cand + 1]
+                        same = (
+                            (batch.ref_len[b] == batch.ref_len[a])
+                            & (batch.alt_len[b] == batch.alt_len[a])
+                            & (batch.ref[b] == batch.ref[a]).all(axis=1)
+                            & (batch.alt[b] == batch.alt[a]).all(axis=1)
+                        )
+                        if same.any():
+                            keep = np.ones(rows.size, np.bool_)
+                            keep[cand[same] + 1] = False
+                            self.counters["duplicates"] += int((~keep).sum())
+                            rows, key = rows[keep], key[keep]
+                segs = self._membership_segments(int(code))
+                if self.skip_existing and segs:
+                    qpos, qh = batch.pos[rows], h[rows]
+                    qref, qalt = batch.ref[rows], batch.alt[rows]
+                    qrl, qal = batch.ref_len[rows], batch.alt_len[rows]
+                    found = np.zeros(rows.size, np.bool_)
+                    for seg in segs:
+                        if found.all():
+                            break
+                        f, _ = seg.probe(key, qpos, qh, qref, qalt, qrl, qal)
+                        found |= f
                     self.counters["duplicates"] += int(found.sum())
                     rows = rows[~found]
                 if rows.size:
-                    # sorted by identity key for the sorted-merge append
-                    key = (
-                        batch.pos[rows].astype(np.uint64) << np.uint64(32)
-                    ) | h[rows]
-                    insert_rows.append(rows[np.argsort(key, kind="stable")])
-        self.counters["duplicates"] += int(dup.sum())
+                    insert_rows.append(rows)
 
         if not insert_rows:
-            return
+            return None
         with self.timer.stage("gather", items=int(sum(r.size for r in insert_rows))):
             sel = np.concatenate(insert_rows)
             sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
@@ -749,14 +883,22 @@ class TpuVcfLoader:
                 egress.bin_paths(sub, sub_ann) if mapping_fh is not None else None
             )
 
+        payload: list[tuple[int, Segment]] | None = None
         if commit:
-            with self.timer.stage("append", items=int(sel.size)):
+            # build the sorted segments HERE (cheap: insert rows are already
+            # key-sorted per chromosome, so Segment.build skips its argsort
+            # and gathers) — appending/merging/persisting them is the store
+            # side of the pipeline, which runs on the async writer thread
+            # when defer_commit is set (overlapping the next chunk's device
+            # work) or inline otherwise.
+            with self.timer.stage("build", items=int(sel.size)):
+                payload = []
                 offset = 0
                 for rows in insert_rows:
                     k = rows.size
                     j = slice(offset, offset + k)
                     jj = np.arange(offset, offset + k)
-                    code = batch.chrom[rows[0]]
+                    code = int(batch.chrom[rows[0]])
                     # reader-flagged FREQ rows only: a FREQ-less slice (the
                     # common case) skips the per-row lazy column entirely
                     if (chunk.has_freq is None
@@ -772,7 +914,7 @@ class TpuVcfLoader:
                         annotations["display_attributes"] = (
                             display[offset:offset + k]
                         )
-                    self.store.shard(code).append(
+                    seg = Segment.build(
                         {
                             "pos": sub.pos[j],
                             "h": h[rows],
@@ -807,7 +949,15 @@ class TpuVcfLoader:
                             if over[j].any() else None
                         ),
                     )
+                    payload.append((code, seg))
                     offset += k
+            if not defer_commit:
+                with self.timer.stage("append", items=int(sel.size)):
+                    for code, seg in payload:
+                        sh = self.store.shard(code)
+                        sh.append_segment(seg)
+                        sh.maintain()
+                payload = None
         self.counters["variant"] += int(sel.size)
 
         if mapping_fh is not None:
@@ -850,19 +1000,7 @@ class TpuVcfLoader:
                             f'"bin_index": {json.dumps(b)}}}]}}'
                         )
                 mapping_fh.write("\n".join(lines) + "\n")
-
-
-def _mix_hash(h, chrom):
-    """Device-side chromosome mix for batch dedup (keeps the hash on device
-    when no long-allele host re-hash is needed)."""
-    import jax.numpy as jnp
-
-    return h ^ (chrom.astype(jnp.uint32) * _CHROM_MIX)
-
-
-import jax as _jax  # noqa: E402  (module-level jit of the tiny mix kernel)
-
-_mix_hash_jit = _jax.jit(_mix_hash)
+        return payload
 
 
 def _fnv32_str(ref: str, alt: str) -> np.uint32:

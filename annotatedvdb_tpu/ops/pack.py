@@ -107,6 +107,36 @@ def inflate_alleles(ref_packed, alt_packed, width: int):
 
 inflate_alleles_jit = jax.jit(inflate_alleles, static_argnums=2)
 
+_TRANSPORT_WANTED: bool | None = None
+
+
+def transport_wanted() -> bool:
+    """Whether output packing / nibble uploads pay on this backend.
+
+    The whole transport layer exists to batch host<->device round trips
+    over a real interconnect; on the CPU backend ``device_put`` is a
+    zero-copy no-op and per-field fetches are free, so the extra
+    pack/inflate kernel passes are pure overhead (measurable at ~15% of
+    end-to-end on a single-core host).  ``AVDB_PACK_TRANSPORT=always``
+    forces packing on any backend (tests use it to exercise the packed
+    path on CPU); ``=never`` disables it everywhere."""
+    global _TRANSPORT_WANTED
+    if _TRANSPORT_WANTED is None:
+        import os
+
+        mode = os.environ.get("AVDB_PACK_TRANSPORT", "auto")
+        if mode == "always":
+            _TRANSPORT_WANTED = True
+        elif mode == "never":
+            _TRANSPORT_WANTED = False
+        else:
+            try:
+                _TRANSPORT_WANTED = jax.default_backend() not in ("cpu",)
+            except Exception:
+                _TRANSPORT_WANTED = False
+    return _TRANSPORT_WANTED
+
+
 _NIBBLE_OK: bool | None = None
 
 
@@ -116,20 +146,26 @@ def nibble_verified() -> bool:
     :func:`transport_verified`; callers upload raw matrices when False)."""
     global _NIBBLE_OK
     if _NIBBLE_OK is None:
-        probe = np.zeros((4, 7), np.uint8)  # odd width exercises the pad
-        probe[0, :5] = np.frombuffer(b"ACGTN", np.uint8)
-        probe[1, :3] = np.frombuffer(b"acg", np.uint8)
-        probe[2, :7] = np.frombuffer(b"*.-TGCA", np.uint8)
-        probe[3, :1] = np.frombuffer(b"G", np.uint8)
-        enc = encode_alleles_nibble(probe, probe[::-1].copy())
-        if enc is None:
+        try:
+            probe = np.zeros((4, 7), np.uint8)  # odd width exercises the pad
+            probe[0, :5] = np.frombuffer(b"ACGTN", np.uint8)
+            probe[1, :3] = np.frombuffer(b"acg", np.uint8)
+            probe[2, :7] = np.frombuffer(b"*.-TGCA", np.uint8)
+            probe[3, :1] = np.frombuffer(b"G", np.uint8)
+            enc = encode_alleles_nibble(probe, probe[::-1].copy())
+            if enc is None:
+                _NIBBLE_OK = False
+            else:
+                r, a = inflate_alleles_jit(enc[0], enc[1], 7)
+                _NIBBLE_OK = bool(
+                    (np.asarray(r) == probe).all()
+                    and (np.asarray(a) == probe[::-1]).all()
+                )
+        except Exception:
+            # a backend that imports but cannot compile/run the tiny
+            # kernel must degrade to raw uploads, not crash the loader —
+            # same latch discipline as _device_lookup_enabled
             _NIBBLE_OK = False
-        else:
-            r, a = inflate_alleles_jit(enc[0], enc[1], 7)
-            _NIBBLE_OK = bool(
-                (np.asarray(r) == probe).all()
-                and (np.asarray(a) == probe[::-1]).all()
-            )
     return _NIBBLE_OK
 
 
@@ -175,21 +211,26 @@ def transport_verified() -> bool:
     Callers must fall back to per-field fetches when this returns False."""
     global _TRANSPORT_OK
     if _TRANSPORT_OK is None:
-        h = np.array([0x01020304, 0xFFFFFFFF, 0, 0xDEADBEEF], np.uint32)
-        leaf = np.array([-1, 2**31 - 1, -(2**31), 1234], np.int32)
-        level = np.array([0, 13, 255, 7], np.int32)
-        t = np.array([True, False, True, False])
-        cols = unpack_outputs(
-            np.asarray(pack_outputs_jit(h, t, level, leaf, ~t, t))
-        )
-        _TRANSPORT_OK = bool(
-            (cols["h"] == h).all()
-            and (cols["leaf_bin"] == leaf).all()
-            and (cols["bin_level"] == (level & 0xFF)).all()
-            and (cols["dup"] == t).all()
-            and (cols["needs_digest"] == ~t).all()
-            and (cols["host_fallback"] == t).all()
-        )
+        try:
+            h = np.array([0x01020304, 0xFFFFFFFF, 0, 0xDEADBEEF], np.uint32)
+            leaf = np.array([-1, 2**31 - 1, -(2**31), 1234], np.int32)
+            level = np.array([0, 13, 255, 7], np.int32)
+            t = np.array([True, False, True, False])
+            cols = unpack_outputs(
+                np.asarray(pack_outputs_jit(h, t, level, leaf, ~t, t))
+            )
+            _TRANSPORT_OK = bool(
+                (cols["h"] == h).all()
+                and (cols["leaf_bin"] == leaf).all()
+                and (cols["bin_level"] == (level & 0xFF)).all()
+                and (cols["dup"] == t).all()
+                and (cols["needs_digest"] == ~t).all()
+                and (cols["host_fallback"] == t).all()
+            )
+        except Exception:
+            # same degrade-don't-crash latch as nibble_verified: fall back
+            # to per-field fetches on a backend that can't run the probe
+            _TRANSPORT_OK = False
     return _TRANSPORT_OK
 
 

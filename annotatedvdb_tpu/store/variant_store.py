@@ -188,18 +188,25 @@ class Segment:
     """One sorted run of rows: numeric columns + packed alleles + object cols.
 
     Rows are sorted by (pos, hash); within equal keys, original append order
-    is preserved (first-wins duplicate semantics)."""
+    is preserved (first-wins duplicate semantics).
 
-    __slots__ = ("n", "cols", "ref", "alt", "obj", "seg_id", "dirty",
+    ``backing`` is the on-disk identity: the ordered list of saved segment
+    ids whose files, merged left-to-right, reproduce this segment exactly.
+    A fresh/mutated segment has ``backing=None`` (nothing on disk matches);
+    a clean merge of clean segments CONCATENATES their backings — which is
+    what makes persistence append-only (``VariantStore.save`` never rewrites
+    a merged segment's rows, it just references the constituent files)."""
+
+    __slots__ = ("n", "cols", "ref", "alt", "obj", "backing", "dirty",
                  "_key", "_device", "_numpy_query_volume")
 
-    def __init__(self, cols, ref, alt, obj, seg_id=None):
+    def __init__(self, cols, ref, alt, obj, backing=None):
         self.n = int(ref.shape[0])
         self.cols = cols
         self.ref = ref
         self.alt = alt
         self.obj = obj
-        self.seg_id = seg_id       # persistence id; None = never saved
+        self.backing: list[int] | None = backing  # None = never saved
         self.dirty = True
         self._key = None
         self._device = None
@@ -216,7 +223,11 @@ class Segment:
     @classmethod
     def build(cls, rows: dict, ref, alt, annotations=None, digest_pk=None,
               long_alleles=None) -> "Segment":
-        """Create a sorted segment from one flush's rows (any input order)."""
+        """Create a sorted segment from one flush's rows (any input order).
+
+        Already-sorted input (the insert loader pre-sorts each flush by
+        identity key) skips the argsort AND the per-column gather — the
+        arrays are owned as-is, so build is O(n) dtype checks."""
         k = rows["pos"].shape[0]
         cols = {}
         for name, dtype in _NUMERIC_COLUMNS:
@@ -226,16 +237,30 @@ class Segment:
                 cols[name] = np.full((k,), -1, dtype)
             else:
                 cols[name] = np.zeros((k,), dtype)
-        order = np.argsort(combined_key(cols["pos"], cols["h"]), kind="stable")
-        cols = {name: col[order] for name, col in cols.items()}
+        key = combined_key(cols["pos"], cols["h"])
+        if k <= 1 or bool((key[1:] >= key[:-1]).all()):
+            order = None
+        else:
+            order = np.argsort(key, kind="stable")
+            key = key[order]
+            cols = {name: col[order] for name, col in cols.items()}
 
         obj = {}
         for c in JSONB_COLUMNS:
             src = annotations.get(c) if annotations else None
-            obj[c] = _obj_array(src, order)
-        obj[_DIGEST_PK] = _obj_array(digest_pk, order)
-        obj[_LONG_ALLELES] = _obj_array(long_alleles, order)
-        return cls(cols, np.asarray(ref)[order], np.asarray(alt)[order], obj)
+            obj[c] = _obj_array(src, order, k)
+        obj[_DIGEST_PK] = _obj_array(digest_pk, order, k)
+        obj[_LONG_ALLELES] = _obj_array(long_alleles, order, k)
+        ref = np.asarray(ref)
+        alt = np.asarray(alt)
+        seg = cls(
+            cols,
+            ref if order is None else ref[order],
+            alt if order is None else alt[order],
+            obj,
+        )
+        seg._key = key
+        return seg
 
     @classmethod
     def merge(cls, older: "Segment", newer: "Segment") -> "Segment":
@@ -273,6 +298,13 @@ class Segment:
         # hand the merged key to the new segment so its next probe skips
         # the O(n) recompute
         seg._key = merge_col(ka, kb)
+        # two CLEAN segments merge into a clean segment whose on-disk
+        # identity is the concatenation of their files (stable merge is
+        # associative, so loading [a..., b...] left-to-right reproduces
+        # this exact row order) — the append-only persistence invariant
+        if not older.dirty and not newer.dirty and older.backing and newer.backing:
+            seg.backing = older.backing + newer.backing
+            seg.dirty = False
         return seg
 
     # -- membership ---------------------------------------------------------
@@ -385,14 +417,18 @@ class Segment:
         return self.obj[name]
 
 
-def _obj_array(values, order: np.ndarray) -> np.ndarray | None:
+def _obj_array(values, order: np.ndarray | None, n: int) -> np.ndarray | None:
     """Object column from per-row values; None when the column is all-None
-    (lazily-materialized columns keep annotation-free segments free)."""
+    (lazily-materialized columns keep annotation-free segments free).
+    ``order=None`` means the rows are already in sorted order."""
     if values is None or all(v is None for v in values):
         return None
-    out = np.empty((len(order),), object)
-    for j, i in enumerate(order):
-        out[j] = values[i]
+    out = np.empty((n,), object)
+    if order is None:
+        out[:] = list(values) if not isinstance(values, np.ndarray) else values
+    else:
+        for j, i in enumerate(order):
+            out[j] = values[i]
     return out
 
 
@@ -476,8 +512,9 @@ class ChromosomeShard:
     def compact(self) -> None:
         """Merge all segments into one (position-sorted global ids)."""
         while len(self.segments) > 1:
-            newer = self.segments.pop()
-            self.segments[-1] = Segment.merge(self.segments[-1], newer)
+            # same atomic-splice discipline as maintain()
+            merged = Segment.merge(self.segments[-2], self.segments[-1])
+            self.segments[-2:] = [merged]
         self._starts_cache = None
 
     # -- whole-column views (any segment count, global-id order) ------------
@@ -628,20 +665,40 @@ class ChromosomeShard:
         columns filled with NULL defaults)."""
         if rows["pos"].shape[0] == 0:
             return
-        self.segments.append(
+        self.append_segment(
             Segment.build(rows, ref, alt, annotations, digest_pk, long_alleles)
         )
-        # size-tiered cascade: keep strictly geometric segment sizes so the
-        # segment count stays O(log n) and total merge work O(n log n).
-        # Segments past MERGE_SEGMENT_CAP freeze (written to disk once,
-        # never re-merged mid-load): re-merging the biggest segment costs
-        # O(n) memcpy + O(n) re-persist per flush at whole-genome scale,
-        # while probing the extra frozen segments is a few searchsorteds.
+        self.maintain()
+
+    def append_segment(self, seg: Segment) -> None:
+        """O(1) append of a prebuilt sorted segment, no cascade merge.
+
+        The async insert pipeline appends here, persists, and runs
+        :meth:`maintain` afterwards — merging clean (persisted) segments
+        keeps their backing files referenced instead of rewriting them, so
+        per-checkpoint disk writes stay O(new rows)."""
+        if seg.n == 0:
+            return
+        self.segments.append(seg)
+        self._starts_cache = None
+
+    def maintain(self) -> None:
+        """Size-tiered cascade merge: keep strictly geometric segment sizes
+        so the segment count stays O(log n) and total merge work O(n log n).
+        Segments past MERGE_SEGMENT_CAP freeze (written to disk once,
+        never re-merged mid-load): re-merging the biggest segment costs
+        O(n) memcpy per flush at whole-genome scale, while probing the
+        extra frozen segments is a few searchsorteds."""
         while (len(self.segments) >= 2
                and self.segments[-2].n <= 2 * self.segments[-1].n
                and self.segments[-2].n <= MERGE_SEGMENT_CAP):
-            newer = self.segments.pop()
-            self.segments[-1] = Segment.merge(self.segments[-1], newer)
+            merged = Segment.merge(self.segments[-2], self.segments[-1])
+            # single splice AFTER the merge completes: a concurrent reader
+            # snapshotting the list (the loader's membership probe) must
+            # never observe a window where the older rows are in neither
+            # the list nor the in-flight set — pop-then-merge would open
+            # one for the whole O(n) merge
+            self.segments[-2:] = [merged]
         self._starts_cache = None
 
     def update_annotation(self, index: np.ndarray, column: str,
@@ -734,37 +791,49 @@ class VariantStore:
 
     # -- persistence --------------------------------------------------------
     #
-    # Layout v2: manifest.json lists each shard's segment ids in order;
-    # every segment is one npz (numeric cols + alleles) plus one sparse
-    # JSONL (object columns, only rows that have any).  ``save`` writes
-    # only segments that are new or dirty and prunes orphaned files, so a
-    # per-checkpoint persist is O(new rows) — the reference's analog is the
-    # WAL-less UNLOGGED-table commit, not a full table rewrite.
+    # Layout v3: manifest.json lists each shard's segments in order, each as
+    # a GROUP of saved segment ids — an in-memory segment merged from
+    # already-persisted segments is manifested as the list of its
+    # constituents' ids (merged left-to-right on load), so merges never
+    # rewrite rows on disk.  Every segment file is one npz (numeric cols +
+    # alleles) plus one sparse JSONL (object columns, only rows that have
+    # any).  ``save`` writes only segments that are new or mutated and
+    # prunes orphaned files: a per-checkpoint persist is O(rows appended or
+    # updated since the last save) — the reference's analog is the WAL-less
+    # UNLOGGED-table commit, not a full table rewrite.
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         live_files = {"manifest.json"}
-        manifest = {"format": 2, "width": self.width, "shards": {}}
+        manifest = {"format": 3, "width": self.width, "shards": {}}
         for code, shard in sorted(self.shards.items()):
             label = chromosome_label(code)
-            seg_ids = []
+            groups = []
             for seg in shard.segments:
-                if seg.dirty or seg.seg_id is None:
+                stems = (
+                    [f"chr{label}.{sid:06d}" for sid in seg.backing]
+                    if seg.backing else []
+                )
+                if (seg.dirty or not stems
+                        # a clean segment saved to a DIFFERENT directory
+                        # earlier: its files aren't here, rewrite fresh
+                        or not all(os.path.exists(os.path.join(path, s + ".npz"))
+                                   for s in stems)):
                     # EVERY (re-)write takes a fresh seg id, so a
                     # manifested segment's files are never touched in
                     # place — the manifest swap below is the single
                     # commit point (a crash between the two per-segment
                     # renames can otherwise tear an npz/jsonl pair)
-                    seg.seg_id = self._next_seg_id
+                    sid = self._next_seg_id
                     self._next_seg_id += 1
-                stem = f"chr{label}.{seg.seg_id:06d}"
-                if seg.dirty or not os.path.exists(
-                        os.path.join(path, stem + ".npz")):
-                    self._write_segment(path, stem, seg)
+                    stems = [f"chr{label}.{sid:06d}"]
+                    self._write_segment(path, stems[0], seg)
+                    seg.backing = [sid]
                     seg.dirty = False
-                seg_ids.append(seg.seg_id)
-                live_files.update({stem + ".npz", stem + ".ann.jsonl"})
-            manifest["shards"][label] = seg_ids
+                for stem in stems:
+                    live_files.update({stem + ".npz", stem + ".ann.jsonl"})
+                groups.append(list(seg.backing))
+            manifest["shards"][label] = groups
         manifest["next_seg_id"] = self._next_seg_id
         # atomic swap: a PROCESS crash mid-save must leave the previous
         # manifest intact (segments are also written via tmp+rename, so the
@@ -840,7 +909,8 @@ class VariantStore:
     def load(cls, path: str) -> "VariantStore":
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
-        if manifest.get("format") != 2:
+        fmt = manifest.get("format")
+        if fmt not in (2, 3):
             raise ValueError(
                 "unsupported store format (pre-segment layout); reload from "
                 "source VCFs"
@@ -849,24 +919,39 @@ class VariantStore:
         store._next_seg_id = manifest.get("next_seg_id", 1)
         from annotatedvdb_tpu.types import chromosome_code
 
-        for label, seg_ids in manifest["shards"].items():
+        for label, groups in manifest["shards"].items():
+            if fmt == 2:  # v2: flat id list, one file per segment
+                groups = [[sid] for sid in groups]
             shard = store.shard(chromosome_code(label))
-            for seg_id in seg_ids:
-                stem = f"chr{label}.{seg_id:06d}"
-                data = np.load(os.path.join(path, stem + ".npz"))
-                cols = {name: data[name] for name, _ in _NUMERIC_COLUMNS}
-                n = data["ref"].shape[0]
-                obj: dict = {c: None for c in OBJECT_COLUMNS}
-                with open(os.path.join(path, stem + ".ann.jsonl")) as f:
-                    for line in f:
-                        row = json.loads(line)
-                        i = row.pop("i")
-                        for c, v in row.items():
-                            if obj[c] is None:
-                                obj[c] = np.full((n,), None, object)
-                            obj[c][i] = tuple(v) if c == _LONG_ALLELES else v
-                seg = Segment(cols, data["ref"], data["alt"], obj, seg_id=seg_id)
-                seg.dirty = False
+            for group in groups:
+                parts = [
+                    cls._read_segment(path, label, sid) for sid in group
+                ]
+                seg = parts[0]
+                for part in parts[1:]:
+                    seg = Segment.merge(seg, part)
+                # merge() already propagated backing == group for clean
+                # inputs; assert the invariant rather than trusting it
+                assert seg.backing == list(group) and not seg.dirty
                 shard.segments.append(seg)
             shard._starts_cache = None
         return store
+
+    @staticmethod
+    def _read_segment(path: str, label: str, seg_id: int) -> Segment:
+        stem = f"chr{label}.{seg_id:06d}"
+        data = np.load(os.path.join(path, stem + ".npz"))
+        cols = {name: data[name] for name, _ in _NUMERIC_COLUMNS}
+        n = data["ref"].shape[0]
+        obj: dict = {c: None for c in OBJECT_COLUMNS}
+        with open(os.path.join(path, stem + ".ann.jsonl")) as f:
+            for line in f:
+                row = json.loads(line)
+                i = row.pop("i")
+                for c, v in row.items():
+                    if obj[c] is None:
+                        obj[c] = np.full((n,), None, object)
+                    obj[c][i] = tuple(v) if c == _LONG_ALLELES else v
+        seg = Segment(cols, data["ref"], data["alt"], obj, backing=[seg_id])
+        seg.dirty = False
+        return seg

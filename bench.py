@@ -218,12 +218,17 @@ def bench_end_to_end():
 
 def main():
     # Pin the platform BEFORE any backend touch: round 1's bench died with
-    # rc=1 because the TPU tunnel errored during jax.default_backend().
-    # pin_platform probes the accelerator in a subprocess (hard timeout) and
-    # falls back to CPU, so a number is always recorded.
-    from annotatedvdb_tpu.utils.runtime import pin_platform
+    # rc=1 because the TPU tunnel errored during jax.default_backend(), and
+    # round 3's official record was a silent CPU fallback (one failed 90 s
+    # probe + a cached AVDB_JAX_PLATFORM=cpu pinned the whole round).  The
+    # bench therefore probes with retries, ignores a *cached* CPU fallback
+    # (a user's explicit pin is still honored), and records the probe
+    # attempts/errors in the JSON so a fallback is never unexplained.
+    from annotatedvdb_tpu.utils import runtime
 
-    platform = pin_platform("auto")
+    platform = runtime.pin_platform(
+        "auto", attempts=3, ignore_cached_fallback=True
+    )
 
     import jax
 
@@ -244,6 +249,11 @@ def main():
                 "kernel": kernel_kind,
                 "backend": jax.default_backend(),
                 "platform_pin": platform,
+                "probe": (
+                    runtime.LAST_PROBE.as_dict()
+                    if runtime.LAST_PROBE is not None
+                    else {"skipped": "explicit platform pin"}
+                ),
                 "end_to_end": e2e,
             }
         )

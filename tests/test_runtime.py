@@ -1,0 +1,95 @@
+"""Platform-pin robustness: probe retries, cached-fallback override, and
+probe-detail recording (VERDICT r3 #1 — the official bench record must show
+a TPU backend or say exactly why not, inside the JSON).
+
+All probes are mocked: nothing here touches a real accelerator tunnel."""
+
+import pytest
+
+from annotatedvdb_tpu.utils import runtime
+
+
+@pytest.fixture
+def clean_pin(monkeypatch):
+    """Isolate the pin cache env vars (conftest pins AVDB_JAX_PLATFORM=cpu
+    for every other test — these tests manage it explicitly)."""
+    monkeypatch.delenv("AVDB_JAX_PLATFORM", raising=False)
+    monkeypatch.delenv("AVDB_JAX_PLATFORM_SOURCE", raising=False)
+    yield monkeypatch
+
+
+def _sequence_probe(monkeypatch, outcomes):
+    """Replace the subprocess probe with a canned outcome sequence."""
+    calls = []
+
+    def fake(timeout):
+        calls.append(timeout)
+        return outcomes[min(len(calls), len(outcomes)) - 1]
+
+    monkeypatch.setattr(runtime, "_probe_once", fake)
+    return calls
+
+
+def test_probe_retries_until_success(monkeypatch):
+    calls = _sequence_probe(
+        monkeypatch,
+        [(None, "probe hung past 1s"), (None, "probe rc=1: boom"), ("tpu", None)],
+    )
+    platform = runtime.probe_accelerator(timeout=1, attempts=3, backoff=0)
+    assert platform == "tpu"
+    assert len(calls) == 3
+    rec = runtime.LAST_PROBE.as_dict()
+    assert rec["platform"] == "tpu"
+    assert rec["attempts"] == 3
+    assert len(rec["errors"]) == 2
+    assert "hung" in rec["errors"][0]
+
+
+def test_probe_records_every_failure(monkeypatch):
+    _sequence_probe(monkeypatch, [(None, "probe hung past 1s")])
+    assert runtime.probe_accelerator(timeout=1, attempts=3, backoff=0) is None
+    rec = runtime.LAST_PROBE.as_dict()
+    assert rec["platform"] is None
+    assert rec["attempts"] == 3
+    assert len(rec["errors"]) == 3
+
+
+def test_pin_reprobes_cached_fallback(clean_pin, monkeypatch):
+    # a prior pin_platform probe failed and cached cpu ...
+    monkeypatch.setenv("AVDB_JAX_PLATFORM", "cpu")
+    monkeypatch.setenv("AVDB_JAX_PLATFORM_SOURCE", "probe")
+    calls = _sequence_probe(monkeypatch, [("axon", None)])
+    # ... the bench ignores that cache and probes fresh
+    choice = runtime.pin_platform(
+        "auto", timeout=1, attempts=3, ignore_cached_fallback=True
+    )
+    assert choice == "axon"
+    assert len(calls) == 1
+    import os
+
+    assert os.environ["AVDB_JAX_PLATFORM"] == "axon"
+    assert os.environ["AVDB_JAX_PLATFORM_SOURCE"] == "probe"
+
+
+def test_pin_honors_user_explicit_cpu(clean_pin, monkeypatch):
+    # the user exported AVDB_JAX_PLATFORM=cpu themselves (no SOURCE marker):
+    # never re-probed, even with ignore_cached_fallback
+    monkeypatch.setenv("AVDB_JAX_PLATFORM", "cpu")
+    calls = _sequence_probe(monkeypatch, [("axon", None)])
+    choice = runtime.pin_platform(
+        "auto", timeout=1, attempts=3, ignore_cached_fallback=True
+    )
+    assert choice == "cpu"
+    assert calls == []
+
+
+def test_pin_falls_back_to_cpu_and_marks_source(clean_pin, monkeypatch):
+    _sequence_probe(monkeypatch, [(None, "probe rc=1: tunnel down")])
+    choice = runtime.pin_platform("auto", timeout=1, attempts=2)
+    assert choice == "cpu"
+    import os
+
+    assert os.environ["AVDB_JAX_PLATFORM"] == "cpu"
+    # marked as probe-derived so a later bench may re-probe it
+    assert os.environ["AVDB_JAX_PLATFORM_SOURCE"] == "probe"
+    assert runtime.LAST_PROBE.attempts == 2

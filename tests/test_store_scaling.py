@@ -164,16 +164,17 @@ def test_frozen_segments_not_remerged_or_rewritten(tmp_path, monkeypatch):
         shard.append(rows, ref, alt)
         store.save(out)
         for seg in shard.segments:
-            if seg.n > 3 * BATCH and seg.seg_id is not None:
-                f = [x for x in os.listdir(out)
-                     if x.endswith(".npz") and f"{seg.seg_id:06d}" in x]
-                assert f, "frozen segment must be on disk"
-                mt = os.path.getmtime(os.path.join(out, f[0]))
-                if seg.seg_id in frozen_mtime:
-                    assert mt == frozen_mtime[seg.seg_id], (
-                        "frozen segment rewritten by a later save"
-                    )
-                frozen_mtime[seg.seg_id] = mt
+            if seg.n > 3 * BATCH and seg.backing:
+                for sid in seg.backing:
+                    f = [x for x in os.listdir(out)
+                         if x.endswith(".npz") and f"{sid:06d}" in x]
+                    assert f, "frozen segment must be on disk"
+                    mt = os.path.getmtime(os.path.join(out, f[0]))
+                    if sid in frozen_mtime:
+                        assert mt == frozen_mtime[sid], (
+                            "frozen segment rewritten by a later save"
+                        )
+                    frozen_mtime[sid] = mt
     assert frozen_mtime, "load never produced a frozen segment"
     assert len(shard.segments) > 1  # cap actually prevented full compaction
     # membership still correct across frozen + live segments

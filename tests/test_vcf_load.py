@@ -277,3 +277,63 @@ def test_cli_roundtrip(tmp_path, vcf_file):
     )
     assert out.returncode == 0, out.stderr
     assert VariantStore.load(str(tmp_path / "vdb")).n == 0
+
+
+def test_packed_transport_forced_on_cpu(tmp_path, vcf_file, monkeypatch):
+    """The packed-output/nibble-upload transport is gated OFF on CPU
+    backends (transport_wanted); force it on and pin that a load through
+    the packed path produces the identical store — keeping the TPU-side
+    transport logic covered by the CPU suite."""
+    from annotatedvdb_tpu.ops import pack
+
+    monkeypatch.setattr(pack, "_TRANSPORT_WANTED", True)
+    store_p, loader_p = make_loader(tmp_path)
+    c_p = loader_p.load_file(vcf_file, commit=True)
+
+    monkeypatch.setattr(pack, "_TRANSPORT_WANTED", False)
+    store_u, loader_u = make_loader(tmp_path / "u")
+    (tmp_path / "u").mkdir(exist_ok=True)
+    c_u = loader_u.load_file(vcf_file, commit=True)
+
+    assert c_p["variant"] == c_u["variant"] == 8
+    assert c_p["duplicates"] == c_u["duplicates"] == 1
+    for code in store_u.shards:
+        a, b = store_p.shard(code), store_u.shard(code)
+        a.compact(), b.compact()
+        np.testing.assert_array_equal(a.cols["pos"], b.cols["pos"])
+        np.testing.assert_array_equal(a.cols["h"], b.cols["h"])
+        np.testing.assert_array_equal(a.cols["bin_level"], b.cols["bin_level"])
+        np.testing.assert_array_equal(a.cols["leaf_bin"], b.cols["leaf_bin"])
+        np.testing.assert_array_equal(a.ref, b.ref)
+        np.testing.assert_array_equal(a.alt, b.alt)
+
+
+def test_async_and_sync_store_paths_match(tmp_path, vcf_file, monkeypatch):
+    """AVDB_ASYNC_STORE=0 (inline append+persist) and the default async
+    writer produce identical stores, counters, and resumable checkpoints."""
+    import os
+
+    monkeypatch.setenv("AVDB_ASYNC_STORE", "0")
+    store_s, loader_s = make_loader(tmp_path / "s")
+    os.makedirs(tmp_path / "s", exist_ok=True)
+    c_s = loader_s.load_file(vcf_file, commit=True,
+                             persist=lambda: store_s.save(str(tmp_path / "s/vdb")))
+
+    monkeypatch.setenv("AVDB_ASYNC_STORE", "1")
+    store_a, loader_a = make_loader(tmp_path / "a")
+    os.makedirs(tmp_path / "a", exist_ok=True)
+    c_a = loader_a.load_file(vcf_file, commit=True,
+                             persist=lambda: store_a.save(str(tmp_path / "a/vdb")))
+
+    assert {k: c_s[k] for k in ("variant", "duplicates", "line")} == \
+           {k: c_a[k] for k in ("variant", "duplicates", "line")}
+    assert store_s.n == store_a.n
+    # both persisted stores reload to the same content
+    rs = VariantStore.load(str(tmp_path / "s/vdb"))
+    ra = VariantStore.load(str(tmp_path / "a/vdb"))
+    for code in rs.shards:
+        a, b = rs.shard(code), ra.shard(code)
+        a.compact(), b.compact()
+        np.testing.assert_array_equal(a.cols["pos"], b.cols["pos"])
+        np.testing.assert_array_equal(a.cols["h"], b.cols["h"])
+        np.testing.assert_array_equal(a.ref, b.ref)
