@@ -104,33 +104,81 @@ class ConsequenceRanker:
         self.save_on_add = False
         self.added = []
         self._match_memo = {}
+        self._extra = {}
         self.version = 0
         self.rankings = {t: i + 1 for i, t in enumerate(ConseqGroup.all_terms())}
         self._rerank()
         return self
 
+    #: metadata columns of the shipped 6-column schema, preserved verbatim
+    #: through re-ranks and written back by :meth:`save`
+    EXTRA_COLUMNS = (
+        "adsp_impact", "ensembl_ranking", "ensembl_impact",
+        "genomicsdb_consequence",
+    )
+
     @staticmethod
-    def _parse_file(path: str) -> dict:
+    def _to_numeric(value: str):
+        """``to_numeric`` semantics: int when integral, float otherwise —
+        the seed's legacy fractional ranks (2.5, 2.6) keep their order."""
+        f = float(value)
+        i = int(f)
+        return i if i == f else f
+
+    def _parse_file(self, path: str) -> dict:
         """csv.DictReader parse (combos are quoted comma-strings in the
         shipped table, ``adsp_consequence_parser.py:105-126`` semantics):
-        an explicit ``rank`` column wins; otherwise load order is rank."""
+        an explicit rank column (``rank`` or the 6-column schema's
+        ``adsp_ranking``) wins; otherwise load order is rank.  The schema's
+        metadata columns (impact classes, Ensembl ranks) are retained per
+        combo so a save round-trips the full table."""
         out = {}
+        self._extra: dict[str, dict] = {}
         with open(path, newline="") as fh:
             reader = csv.DictReader(fh, delimiter="\t")
+            fields = reader.fieldnames or ()
+            rank_col = (
+                "rank" if "rank" in fields
+                else "adsp_ranking" if "adsp_ranking" in fields
+                else None
+            )
             rank = 1
             for row in reader:
                 combo = alphabetize_combo(row["consequence"])
-                if "rank" in (reader.fieldnames or ()):
-                    out[combo] = int(float(row["rank"]))
+                if rank_col is not None:
+                    cell = (row[rank_col] or "").strip()
+                    if not cell:
+                        # fail fast: silently assigning the load-order
+                        # counter here would tie this combo with a genuine
+                        # low-rank combo and ship scrambled severities
+                        raise ValueError(
+                            f"{path}: blank {rank_col} for combo "
+                            f"{row['consequence']!r}"
+                        )
+                    out[combo] = self._to_numeric(cell)
                 else:
                     out[combo] = rank
                     rank += 1
+                extra = {
+                    c: row[c] for c in self.EXTRA_COLUMNS
+                    if c in fields and (row[c] or "") != ""
+                }
+                if extra:
+                    self._extra[combo] = extra
         return out
 
     def save(self, path: str | None = None) -> str:
-        """Versioned save (``adsp_consequence_parser.py:85-102``).  Saves of
-        the shipped default seed land in the working directory, never inside
-        the package data directory (which may be read-only)."""
+        """Versioned save in the seed's 6-column schema (header
+        ``consequence adsp_ranking adsp_impact ensembl_ranking
+        ensembl_impact genomicsdb_consequence`` —
+        ``Load/data/custom_consequence_ranking.txt``), so a saved table can
+        be diffed against the seed and re-consumed by tooling that expects
+        the shipped format.  Metadata columns are preserved from the loaded
+        file; novel (learned) combos leave them blank.  Rows are written in
+        rank order, so readers that derive rank from load order (the
+        reference's no-rank-column path) agree with ``adsp_ranking``.
+        Saves of the shipped default seed land in the working directory,
+        never inside the package data directory (which may be read-only)."""
         if path is None:
             base = os.path.splitext(self.ranking_file or "consequence_ranking.txt")[0]
             if self.ranking_file == DEFAULT_RANKING_FILE:
@@ -138,10 +186,19 @@ class ConsequenceRanker:
             path = f"{base}_{date.today().strftime('%m-%d-%Y')}.txt"
         if os.path.exists(path):
             path = os.path.splitext(path)[0] + f"_v{len(self.added)}.txt"
-        with open(path, "w") as fh:
-            fh.write("consequence\trank\n")
+        extra = getattr(self, "_extra", {})
+        with open(path, "w", newline="") as fh:
+            writer = csv.writer(
+                fh, delimiter="\t", quoting=csv.QUOTE_MINIMAL,
+                lineterminator="\n",
+            )
+            writer.writerow(("consequence",) + ("adsp_ranking",) + self.EXTRA_COLUMNS)
             for combo, rank in self.rankings.items():
-                fh.write(f"{combo}\t{rank}\n")
+                meta = extra.get(alphabetize_combo(combo), {})
+                writer.writerow(
+                    [combo, rank]
+                    + [meta.get(c, "") for c in self.EXTRA_COLUMNS]
+                )
         return path
 
     # ---- matching ---------------------------------------------------------

@@ -39,17 +39,23 @@ class RankTable:
         masks = np.array(
             [self._mask(c.split(",")) for c in ranker.rankings], dtype=np.uint64
         )
-        ranks = np.array(list(ranker.rankings.values()), dtype=np.int32)
+        # exact (possibly fractional — legacy seed ranks like 2.5 loaded
+        # with rank_on_load=False) rank values; the device table is int32,
+        # so fractional tables gate lookups onto the host path — otherwise
+        # the prefetch memo and the host ranker would disagree on the same
+        # combo depending on batch size
+        ranks = np.array(list(ranker.rankings.values()), dtype=np.float64)
+        self.integral = bool((ranks == np.round(ranks)).all())
         order = np.argsort(masks, kind="stable")
         self._masks = masks[order]
         self._ranks = ranks[order]
         self.coding_mask = self._mask(
             [t for t in CODING_CONSEQUENCES if t in self.vocab]
         )
-        # device copies (uint32 lanes)
+        # device copies (uint32 lanes); rank lane only valid when integral
         self.d_hi = jnp.asarray((self._masks >> np.uint64(32)).astype(np.uint32))
         self.d_lo = jnp.asarray((self._masks & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-        self.d_ranks = jnp.asarray(self._ranks)
+        self.d_ranks = jnp.asarray(self._ranks.astype(np.int32))
 
     def _mask(self, terms) -> np.uint64:
         """Combo -> bitmask; any term outside the vocabulary sets the
@@ -73,16 +79,24 @@ class RankTable:
         return out
 
     def lookup_host(self, masks: np.ndarray) -> np.ndarray:
-        """Host-side batch lookup (numpy searchsorted); -1 = unknown combo."""
+        """Host-side batch lookup (numpy searchsorted); -1 = unknown combo.
+        Returns float64 so fractional legacy ranks survive exactly."""
         idx = np.searchsorted(self._masks, masks)
         idx = np.clip(idx, 0, len(self._masks) - 1)
         hit = self._masks[idx] == masks
-        return np.where(hit, self._ranks[idx], -1).astype(np.int32)
+        return np.where(hit, self._ranks[idx], -1.0)
 
     def lookup_device(self, hi, lo):
         """Device batch lookup over (hi, lo) uint32 mask lanes; -1 = unknown.
 
-        Binary search over the sorted 64-bit masks using two-lane compares."""
+        Binary search over the sorted 64-bit masks using two-lane compares.
+        Only valid on integral tables (``self.integral``); callers must
+        route fractional tables through :meth:`lookup_host`."""
+        if not self.integral:
+            raise ValueError(
+                "device rank table is int32; this table has fractional "
+                "ranks — use lookup_host"
+            )
         return _rank_lookup(self.d_hi, self.d_lo, self.d_ranks, hi, lo)
 
     def is_coding(self, masks: np.ndarray) -> np.ndarray:

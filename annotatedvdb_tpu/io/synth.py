@@ -40,3 +40,33 @@ def synthetic_batch(
     ref = np.where(cols < ref_len[:, None], fill_ref, 0).astype(np.uint8)
     alt = np.where(cols < alt_len[:, None], fill_alt, 0).astype(np.uint8)
     return VariantBatch(chrom, pos, ref, alt, ref_len, alt_len)
+
+
+def batch_chunk(batch: VariantBatch, line_start: int = 1):
+    """Wrap a :class:`VariantBatch` as a minimal :class:`~annotatedvdb_tpu.io.vcf.VcfChunk`
+    (tests/dryruns drive loader internals with synthetic batches)."""
+    from annotatedvdb_tpu.io.vcf import VcfChunk
+    from annotatedvdb_tpu.types import decode_allele
+
+    n = batch.n
+    refs = [decode_allele(batch.ref[i], int(batch.ref_len[i])) for i in range(n)]
+    alts = [decode_allele(batch.alt[i], int(batch.alt_len[i])) for i in range(n)]
+    return VcfChunk(
+        batch=batch,
+        refs=refs,
+        alts=alts,
+        ref_snp=[None] * n,
+        variant_id=[
+            f"{int(batch.chrom[i])}:{int(batch.pos[i])}:{refs[i]}:{alts[i]}"
+            for i in range(n)
+        ],
+        is_multi_allelic=np.zeros(n, np.bool_),
+        frequencies=[None] * n,
+        rs_position=[None] * n,
+        info=[None] * n,
+        line_number=np.arange(line_start, line_start + n, dtype=np.int64),
+        counters={"line": n},
+        rs_number=np.full(n, -1, np.int64),
+        rs_weird=np.zeros(n, np.bool_),
+        has_freq=np.zeros(n, np.bool_),
+    )

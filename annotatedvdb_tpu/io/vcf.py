@@ -49,9 +49,12 @@ def rs_number(ref_snp) -> int:
     for c in s[2:]:
         if c < "0" or c > "9":
             return -1
+        # pre-multiply int64 bound, the same test the C++ twin applies
+        # ((INT64_MAX - 9) / 10): ids within 8 of INT64_MAX are rejected by
+        # BOTH engines rather than accepted here and rejected there
+        if v > 922337203685477579:  # 'weird' (PK keeps the verbatim string)
+            return -1
         v = v * 10 + ord(c) - 48
-        if v > 0x7FFFFFFFFFFFFFFF:  # int64 column bound: wider ids are
-            return -1               # 'weird' (PK keeps the verbatim string)
     return v
 
 

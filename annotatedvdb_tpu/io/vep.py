@@ -34,6 +34,12 @@ _EXTRACTED_KEYS = frozenset(
 #: rank table instead of the numpy one (dispatch overhead crossover)
 DEVICE_RANK_MIN = 256
 
+_CONSEQ_KEYS = tuple(t + "_consequences" for t in CONSEQUENCE_TYPES)
+
+
+def _conseq_sort_key(c):
+    return (c["rank"], c["vep_consequence_order_num"])
+
 
 class VepResultParser:
     def __init__(self, ranker: ConsequenceRanker):
@@ -82,7 +88,10 @@ class VepResultParser:
             return 0
         table = self._rank_table()
         masks = table.encode(new)
-        if len(new) >= DEVICE_RANK_MIN:
+        # fractional tables (legacy seed ranks loaded without re-rank)
+        # stay on the host path: the int32 device lane would truncate and
+        # disagree with the host ranker on the same combo
+        if len(new) >= DEVICE_RANK_MIN and table.integral:
             hi = (masks >> np.uint64(32)).astype(np.uint32)
             lo = (masks & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             ranks = np.asarray(table.lookup_device(hi, lo))
@@ -92,8 +101,12 @@ class VepResultParser:
         resolved = 0
         for combo, rank, is_coding in zip(new, ranks, coding):
             if rank >= 0:
+                r = float(rank)
                 self._rank_memo[combo] = {
-                    "rank": int(rank),
+                    # same int-when-integral coercion as the host ranker's
+                    # to_numeric, so memo-seeded and memo-missed rows store
+                    # identical rank values
+                    "rank": int(r) if r.is_integer() else r,
                     "consequence_is_coding": bool(is_coding),
                 }
                 resolved += 1
@@ -115,22 +128,38 @@ class VepResultParser:
 
     def rank_and_sort(self, annotation: dict) -> dict:
         """Mutates ``annotation``: each '<ctype>_consequences' list becomes a
-        per-allele dict of rank-sorted consequence dicts."""
-        for ctype in CONSEQUENCE_TYPES:
-            key = ctype + "_consequences"
+        per-allele dict of rank-sorted consequence dicts.
+
+        This is the per-result hot loop of the VEP load (called once per
+        JSON line); memo/ranker lookups are inlined rather than routed
+        through :meth:`_ranked` and version checking is hoisted out."""
+        self._check_version()
+        memo = self._rank_memo
+        ranker = self.ranker
+        for key in _CONSEQ_KEYS:
             conseqs = annotation.get(key)
             if conseqs is None:
                 continue
             by_allele: dict[str, list] = {}
             for index, conseq in enumerate(conseqs):
                 conseq["vep_consequence_order_num"] = index
-                by_allele.setdefault(conseq["variant_allele"], []).append(
-                    self._ranked(conseq)
-                )
-            for allele in by_allele:
-                by_allele[allele].sort(
-                    key=lambda c: (c["rank"], c["vep_consequence_order_num"])
-                )
+                terms = conseq["consequence_terms"]
+                mkey = ",".join(terms)
+                entry = memo.get(mkey)
+                if entry is None:
+                    entry = memo[mkey] = {
+                        "rank": ranker.find_matching_consequence(terms),
+                        "consequence_is_coding": is_coding_consequence(terms),
+                    }
+                conseq.update(entry)
+                lst = by_allele.get(conseq["variant_allele"])
+                if lst is None:
+                    by_allele[conseq["variant_allele"]] = [conseq]
+                else:
+                    lst.append(conseq)
+            for lst in by_allele.values():
+                if len(lst) > 1:
+                    lst.sort(key=_conseq_sort_key)
             annotation[key] = by_allele
         return annotation
 

@@ -33,10 +33,26 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.types import VariantBatch, chromosome_code
 
 
+# pending-row tuple layout (see _parse_result)
+R_CODE, R_POS, R_REF, R_ALT, R_ANN, R_FREQ, R_CLEANED, R_SHARED = range(8)
+
+
+def _np_scalar(obj):
+    """json.dumps ``default`` hook: numpy scalars (a future rank field that
+    skips prefetch_ranks' int()/bool() coercion) degrade to their Python
+    value instead of crashing the load mid-file with a TypeError."""
+    item = getattr(obj, "item", None)
+    if item is not None:
+        return item()
+    raise TypeError(
+        f"non-JSON value of type {type(obj).__name__} in a store update"
+    )
+
+
 def _fresh(obj):
     """Deep, un-aliased copy of JSON-pure data via one C-level round trip
     (~5-10x cheaper than ``copy.deepcopy`` for small nested dicts)."""
-    return json.loads(json.dumps(obj))
+    return json.loads(json.dumps(obj, default=_np_scalar))
 
 
 def _open_text(path: str):
@@ -121,33 +137,39 @@ class TpuVepLoader:
         # update loads probe a static store per flush: pin membership
         # caches in HBM where the link makes that a win (no-op otherwise)
         self.store.pin_for_updates()
-        raw: list[dict] = []
+        lines: list[str] = []
         n_added_before = len(self.parser.ranker.added)
 
         def flush() -> None:
+            # ONE json.loads over the whole flush (lines joined into a JSON
+            # array) — the C decoder amortizes per-call setup and allocator
+            # churn across the batch, ~2x a per-line loads loop
+            raw = json.loads(f'[{",".join(lines)}]')
             # batched combo->rank resolution through the compiled rank-table
             # snapshot first (device path for large batches); the per-row
             # parse below then hits the memo, and only novel combos take the
             # host ranker's learn-on-miss path
             self.parser.prefetch_ranks(raw)
-            pending: list[dict] = []
+            pending: list[tuple] = []
+            extend = pending.extend
+            parse = self._parse_result
             for ann in raw:
-                pending.extend(self._parse_result(ann))
+                extend(parse(ann))
             if pending:
                 self._apply_batch(pending, alg_id, commit)
-            raw.clear()
+            lines.clear()
             self._cadence.maybe_log(self.counters["line"], self.counters)
 
         for line in _open_text(path):
             if not line.strip():
                 continue
             self.counters["line"] += 1
-            raw.append(json.loads(line))
-            if len(raw) >= self.batch_size:
+            lines.append(line)
+            if len(lines) >= self.batch_size:
                 flush()
                 if test:
                     break
-        if raw:
+        if lines:
             flush()
         added = self.parser.ranker.added[n_added_before:]
         if added:
@@ -158,8 +180,10 @@ class TpuVepLoader:
 
     # ------------------------------------------------------------------
 
-    def _parse_result(self, annotation: dict) -> list[dict]:
-        """One VEP result -> per-alt pending update rows."""
+    def _parse_result(self, annotation: dict) -> list[tuple]:
+        """One VEP result -> per-alt pending update rows, as tuples
+        ``(code, pos, ref, alt, annotation, freq_values, cleaned, shared)``
+        (a dict per row measurably drags the 100k-results/sec path)."""
         self.parser.rank_and_sort(annotation)
         entry = annotation["input"]
         if isinstance(entry, str):
@@ -169,8 +193,9 @@ class TpuVepLoader:
         chrom_str, pos_str, vid, ref, alt_str = [str(f) for f in fields[:5]]
         # structured replacement for the raw input string
         # (vep_variant_loader.py:279-281)
+        pos = int(pos_str)
         annotation["input"] = {
-            "chrom": chrom_str, "pos": int(pos_str), "id": vid,
+            "chrom": chrom_str, "pos": pos, "id": vid,
             "ref": ref, "alt": alt_str,
         }
         code = chromosome_code(chrom_str)
@@ -191,41 +216,49 @@ class TpuVepLoader:
                 self.counters["skipped"] += 1
                 continue
             self.counters["variant"] += 1
+            # multi-alt rows share one cleaned dict and must not alias
+            # inside the store (deep-merge mutates in place) — flagged here,
+            # un-aliased at apply time
             rows.append(
-                {
-                    "chrom": code,
-                    "pos": int(pos_str),
-                    "ref": ref,
-                    "alt": alt,
-                    "annotation": annotation,
-                    "freq_values": freq_values,
-                    "cleaned": cleaned,
-                    # multi-alt rows share one cleaned dict and must not
-                    # alias inside the store (deep-merge mutates in place)
-                    "cleaned_shared": multi,
-                }
+                (code, pos, ref, alt, annotation, freq_values, cleaned, multi)
             )
         return rows
 
-    def _apply_batch(self, rows: list[dict], alg_id: int, commit: bool) -> None:
+    def _apply_batch(self, rows: list[tuple], alg_id: int, commit: bool,
+                     seen_freq: set | None = None) -> None:
         # flushes trigger on raw RESULT count but rows are per-alt expanded:
         # multi-allelic-heavy input can exceed the two warmed kernel shapes
         # (p, 2p).  Split rather than compile a one-off bigger shape (~35s
         # on TPU); sub-batches are independent (earlier writes land before
         # later ones run, so the stored-value duplicate check still holds).
         from annotatedvdb_tpu.utils.arrays import next_pow2
+        from annotatedvdb_tpu.types import encode_allele_array
 
+        if seen_freq is None:
+            # aliased-frequency tracking must span sub-batch splits AND
+            # chromosome groups: two alts of one site sharing a frequency
+            # bucket can land in different sub-batches (see the copy logic
+            # at the buffer stage below)
+            seen_freq = set()
         cap = 2 * next_pow2(self.batch_size)
         if len(rows) > cap:
             for lo in range(0, len(rows), cap):
-                self._apply_batch(rows[lo:lo + cap], alg_id, commit)
+                self._apply_batch(rows[lo:lo + cap], alg_id, commit,
+                                  seen_freq=seen_freq)
             return
-        batch = VariantBatch.from_tuples(
-            [("1", r["pos"], r["ref"], r["alt"]) for r in rows],
-            width=self.store.width,
+        n_rows = len(rows)
+        ref_arr, ref_len = encode_allele_array(
+            [r[R_REF] for r in rows], self.store.width
         )
-        batch = batch._replace(
-            chrom=np.array([r["chrom"] for r in rows], dtype=np.int8)
+        alt_arr, alt_len = encode_allele_array(
+            [r[R_ALT] for r in rows], self.store.width
+        )
+        batch = VariantBatch(
+            chrom=np.fromiter(
+                (r[R_CODE] for r in rows), np.int8, count=n_rows
+            ),
+            pos=np.fromiter((r[R_POS] for r in rows), np.int32, count=n_rows),
+            ref=ref_arr, alt=alt_arr, ref_len=ref_len, alt_len=alt_len,
         )
         # pow2 padding bounds the set of compiled kernel shapes (batch row
         # counts vary per flush; see vcf_loader._pad_batch)
@@ -290,10 +323,16 @@ class TpuVepLoader:
         from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
         from annotatedvdb_tpu.oracle import normalize_alleles
 
+        check_existing = self.skip_existing  # stored-value probe is ONLY a
+        # policy input; without the flag it would be a pure waste of a
+        # per-row segment locate (measurable at ~7% of the whole load)
+        msc = VepResultParser.most_severe_consequence
+        conseqs_of = VepResultParser.allele_consequences
+        counters = self.counters
         for code in np.unique(batch.chrom):
             sel = np.where(batch.chrom == code)[0]
             for i in sel[host[sel]]:
-                h[i] = _fnv32_str(rows[i]["ref"], rows[i]["alt"])
+                h[i] = _fnv32_str(rows[i][R_REF], rows[i][R_ALT])
             shard = self.store.shard(code)
             found, idx = shard.lookup(
                 batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
@@ -313,32 +352,42 @@ class TpuVepLoader:
             # stored-value check alone can't see earlier rows of this batch
             for j, i in enumerate(sel):
                 if not found[j]:
-                    self.counters["not_found"] += 1
+                    counters["not_found"] += 1
                     continue
                 row_idx = int(idx[j])
                 r = rows[i]
-                if (row_idx in seen_in_batch
+                if check_existing and (
+                        row_idx in seen_in_batch
                         or shard.get_ann("vep_output", row_idx) is not None):
-                    if self.skip_existing:
-                        self.counters["duplicates"] += 1
-                        continue
+                    counters["duplicates"] += 1
+                    continue
                 # normalized alleles key the VEP frequency/consequence maps
                 if host[i]:
-                    norm_ref, norm_alt = normalize_alleles(
-                        r["ref"], r["alt"], snv_div_minus=True
+                    _norm_ref, norm_alt = normalize_alleles(
+                        r[R_REF], r[R_ALT], snv_div_minus=True
                     )
                 else:
                     p = int(prefix[i])
-                    norm_alt = r["alt"][p:] or "-"
+                    norm_alt = r[R_ALT][p:] or "-"
+                freq_values = r[R_FREQ]
                 allele_freq = None
-                if r["freq_values"] and norm_alt in r["freq_values"]:
-                    allele_freq = r["freq_values"][norm_alt]
-                ms = VepResultParser.most_severe_consequence(r["annotation"], norm_alt)
-                ranked = VepResultParser.allele_consequences(r["annotation"], norm_alt)
+                if freq_values and norm_alt in freq_values:
+                    allele_freq = freq_values[norm_alt]
+                ann = r[R_ANN]
+                ms = msc(ann, norm_alt)
+                ranked = conseqs_of(ann, norm_alt)
                 if commit:
                     seen_in_batch.add(row_idx)
                     upd_ids.append(row_idx)
                     if allele_freq is not None:
+                        # two alts of one site can normalize to the SAME
+                        # allele (CAA->C and CAA->CA both key '-'), handing
+                        # two store rows one frequency bucket — deep-merge
+                        # mutates in place, so copy exactly the aliased ones
+                        fkey = (id(freq_values), norm_alt)
+                        if fkey in seen_freq:
+                            allele_freq = _fresh(allele_freq)
+                        seen_freq.add(fkey)
                         upd_freq_ids.append(row_idx)
                         upd_freq.append(allele_freq)
                     # {} merges as a no-op, so an empty new value never
@@ -347,22 +396,17 @@ class TpuVepLoader:
                     upd_ms.append(ms if ms else {})
                     upd_ranked.append(ranked if ranked else {})
                     upd_vep.append(
-                        _fresh(r["cleaned"]) if r["cleaned_shared"]
-                        else r["cleaned"]
+                        _fresh(r[R_CLEANED]) if r[R_SHARED] else r[R_CLEANED]
                     )
-                self.counters["update"] += 1
+                counters["update"] += 1
             if upd_ids:
                 ids = np.array(upd_ids, np.int64)
-                # un-alias before handing dicts to the store: ms aliases
-                # ranked's first element (two columns of one row), and two
-                # alts of one site can normalize to the SAME allele
-                # (CAA->C and CAA->CA both key '-'), handing two store rows
-                # the same frequency bucket — deep-merge mutates in place.
-                # One C-level JSON round trip over the whole column replaces
-                # ~25 deepcopy frames per dict (values are JSON-pure: they
-                # come from json.loads plus int/bool rank fields).
+                # un-alias the most-severe column: ms IS ranked's first
+                # element (two columns of one row) and deep-merge mutates in
+                # place.  One C-level JSON round trip over the whole column
+                # replaces ~25 deepcopy frames per dict (values are
+                # JSON-pure: json.loads output plus int/bool rank fields).
                 upd_ms = _fresh(upd_ms)
-                upd_freq = _fresh(upd_freq)
                 if upd_freq_ids:
                     shard.update_annotation(
                         np.array(upd_freq_ids, np.int64),

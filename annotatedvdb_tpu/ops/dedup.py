@@ -98,5 +98,85 @@ def lookup_in_sorted(
     return found, index
 
 
+def mark_batch_duplicates_multi(chrom, pos, h, ref, alt, ref_len, alt_len):
+    """Chromosome-aware :func:`mark_batch_duplicates` for mesh shards that
+    own SEVERAL chromosomes (``parallel.distributed.chromosome_owner`` packs
+    ~3 per shard on an 8-way mesh): the identity sort carries the chromosome
+    as the leading key, so equal (pos, hash) rows of different chromosomes
+    never compare as duplicates."""
+    n = pos.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    chrom_s, pos_s, h_s, idx_s = jax.lax.sort(
+        (chrom.astype(jnp.int32), pos, h, idx), num_keys=4
+    )
+    ref_s, alt_s = ref[idx_s], alt[idx_s]
+    rlen_s, alen_s = ref_len[idx_s], alt_len[idx_s]
+    same_key = (
+        (chrom_s[1:] == chrom_s[:-1])
+        & (pos_s[1:] == pos_s[:-1])
+        & (h_s[1:] == h_s[:-1])
+    )
+    same_len = (rlen_s[1:] == rlen_s[:-1]) & (alen_s[1:] == alen_s[:-1])
+    same_bytes = jnp.all(ref_s[1:] == ref_s[:-1], axis=1) & jnp.all(
+        alt_s[1:] == alt_s[:-1], axis=1
+    )
+    dup_next = same_key & same_len & same_bytes
+    dup_sorted = jnp.concatenate([jnp.zeros((1,), jnp.bool_), dup_next])
+    return jnp.zeros((n,), jnp.bool_).at[idx_s].set(dup_sorted)
+
+
+#: golden-ratio odd constant decorrelating chromosomes in the mixed hash
+#: (the per-shard membership slices hold several chromosomes in ONE
+#: (pos, mixed-hash)-sorted run — see ``parallel.device_store``)
+CHROM_MIX = 0x9E3779B9
+
+
+def mix_chrom_hash(h, chrom):
+    """Chromosome-salted identity hash for multi-chromosome sorted runs."""
+    return h ^ (chrom.astype(jnp.uint32) * jnp.uint32(CHROM_MIX))
+
+
+def lookup_in_sorted_multi(
+    store_chrom, store_pos, store_hm, store_ref, store_alt,
+    store_rlen, store_alen,
+    chrom, pos, hm, ref, alt, ref_len, alt_len,
+):
+    """Membership in a multi-chromosome shard slice sorted by
+    (pos, chrom-mixed hash).  Same two-level search as
+    :func:`lookup_in_sorted`; byte confirmation additionally compares the
+    chromosome, so a cross-chromosome (pos, mixed-hash) collision cannot
+    produce a false hit."""
+    m = store_pos.shape[0]
+    lo = jnp.searchsorted(store_pos, pos, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(store_pos, pos, side="right").astype(jnp.int32)
+    l, r = lo, hi
+    for _ in range(32):
+        active = l < r
+        mid = (l + r) >> 1
+        less = store_hm[jnp.clip(mid, 0, m - 1)] < hm
+        l = jnp.where(active & less, mid + 1, l)
+        r = jnp.where(active & ~less, mid, r)
+    found = jnp.zeros(pos.shape, jnp.bool_)
+    index = jnp.full(pos.shape, -1, jnp.int32)
+    for k in range(4):
+        i = jnp.clip(l + k, 0, m - 1)
+        cand = (
+            (l + k < hi)
+            & (store_pos[i] == pos)
+            & (store_hm[i] == hm)
+            & (store_chrom[i] == chrom)
+            & (store_rlen[i] == ref_len)
+            & (store_alen[i] == alt_len)
+            & jnp.all(store_ref[i] == ref, axis=1)
+            & jnp.all(store_alt[i] == alt, axis=1)
+        )
+        take = cand & ~found
+        found = found | cand
+        index = jnp.where(take, i, index)
+    return found, index
+
+
 mark_batch_duplicates_jit = jax.jit(mark_batch_duplicates)
+mark_batch_duplicates_multi_jit = jax.jit(mark_batch_duplicates_multi)
 lookup_in_sorted_jit = jax.jit(lookup_in_sorted)
+lookup_in_sorted_multi_jit = jax.jit(lookup_in_sorted_multi)

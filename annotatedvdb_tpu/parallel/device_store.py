@@ -1,0 +1,115 @@
+"""Per-shard device-resident membership snapshot of a :class:`VariantStore`.
+
+The multi-chip insert step (``parallel.distributed.distributed_insert_step``)
+re-shards each batch to its chromosome owners and needs the store's identity
+columns ON DEVICE, sharded the same way, to run membership probes without the
+host fan-in the reference's DB round-trips imply
+(``Util/lib/python/database/variant.py:287-309``; SURVEY.md §5.8 prescribes
+the sorted-merge cross-shard duplicate detection this implements).
+
+Layout: one stacked array per identity column, ``[n_shards, M, ...]`` with
+every shard's slice sorted by ``(pos, chrom-mixed hash)`` and padded to the
+common power-of-two row count ``M`` with sentinel positions (which sort last
+and can never match a probe).  A shard's slice holds ALL chromosomes that
+``chromosome_owner_table`` assigns to it, disambiguated inside one sorted run
+by the chromosome-salted hash (``ops.dedup.mix_chrom_hash``) plus exact
+chromosome confirmation in the probe kernel.
+
+The snapshot is FROZEN at build time: rows appended to the host store
+afterwards are not visible (callers keep probing those few segments
+host-side — same discipline as the single-device loader's pending-segment
+probe).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from annotatedvdb_tpu.ops.dedup import CHROM_MIX
+from annotatedvdb_tpu.parallel.distributed import chromosome_owner_table
+from annotatedvdb_tpu.store import VariantStore
+from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, next_pow2
+
+
+class DeviceShardStore(NamedTuple):
+    """Stacked per-shard identity columns (host numpy; callers shard them
+    onto the mesh with a ``NamedSharding`` over the shard axis or pass them
+    straight into ``shard_map``)."""
+
+    chrom: np.ndarray     # [S, M] int8
+    pos: np.ndarray       # [S, M] int32, POS_SENTINEL pad
+    hm: np.ndarray        # [S, M] uint32 chromosome-mixed identity hash
+    ref: np.ndarray       # [S, M, W] uint8
+    alt: np.ndarray       # [S, M, W] uint8
+    ref_len: np.ndarray   # [S, M] int32
+    alt_len: np.ndarray   # [S, M] int32
+    n_rows: np.ndarray    # [S] int64 real rows per shard
+
+    @property
+    def n_shards(self) -> int:
+        return self.chrom.shape[0]
+
+
+def build_device_shard_store(
+    store: VariantStore, n_shards: int, build: str = "GRCh38"
+) -> DeviceShardStore:
+    """Snapshot ``store``'s identity columns into the stacked per-shard
+    layout.  O(store rows): one concat + one sort per shard."""
+    owner = chromosome_owner_table(n_shards, build)
+    per_shard: list[list] = [[] for _ in range(n_shards)]
+    width = store.width
+    for code, shard in store.shards.items():
+        s = owner[min(code, len(owner) - 1)]
+        for seg in list(shard.segments):
+            per_shard[s].append(
+                (
+                    np.full(seg.n, code, np.int8),
+                    seg.cols["pos"],
+                    seg.cols["h"],
+                    seg.ref,
+                    seg.alt,
+                    seg.cols["ref_len"],
+                    seg.cols["alt_len"],
+                )
+            )
+    m = max(
+        (sum(parts[0].shape[0] for parts in bucket) for bucket in per_shard
+         if bucket),
+        default=0,
+    )
+    m = max(next_pow2(max(m, 1)), 1)
+    out = {
+        "chrom": np.zeros((n_shards, m), np.int8),
+        "pos": np.full((n_shards, m), POS_SENTINEL, np.int32),
+        "hm": np.zeros((n_shards, m), np.uint32),
+        "ref": np.zeros((n_shards, m, width), np.uint8),
+        "alt": np.zeros((n_shards, m, width), np.uint8),
+        "ref_len": np.zeros((n_shards, m), np.int32),
+        "alt_len": np.zeros((n_shards, m), np.int32),
+    }
+    n_rows = np.zeros((n_shards,), np.int64)
+    for s, bucket in enumerate(per_shard):
+        if not bucket:
+            continue
+        chrom = np.concatenate([b[0] for b in bucket])
+        pos = np.concatenate([b[1] for b in bucket])
+        h = np.concatenate([b[2] for b in bucket])
+        ref = np.concatenate([b[3] for b in bucket])
+        alt = np.concatenate([b[4] for b in bucket])
+        rl = np.concatenate([b[5] for b in bucket])
+        al = np.concatenate([b[6] for b in bucket])
+        hm = h ^ (chrom.astype(np.uint32) * np.uint32(CHROM_MIX))
+        key = (pos.astype(np.uint64) << np.uint64(32)) | hm
+        order = np.argsort(key, kind="stable")
+        k = order.shape[0]
+        n_rows[s] = k
+        out["chrom"][s, :k] = chrom[order]
+        out["pos"][s, :k] = pos[order]
+        out["hm"][s, :k] = hm[order]
+        out["ref"][s, :k] = ref[order]
+        out["alt"][s, :k] = alt[order]
+        out["ref_len"][s, :k] = rl[order]
+        out["alt_len"][s, :k] = al[order]
+    return DeviceShardStore(n_rows=n_rows, **out)

@@ -245,3 +245,132 @@ def _annotated_specs():
     from annotatedvdb_tpu.types import AnnotatedBatch
 
     return AnnotatedBatch(*([0] * len(AnnotatedBatch._fields)))
+
+
+def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
+                            capacity: int | None = None, row_id=None):
+    """Full sharded INSERT step: chromosome re-shard + annotate + in-batch
+    dedup + store membership, all inside one mesh program (VERDICT r3 #4 —
+    previously only annotate ran on the mesh; duplicate detection and store
+    probes serialized on the host after device fan-in).
+
+    Rows route to their chromosome's owning shard (``chromosome_owner``), so
+    each shard sees every row of the chromosomes it owns — the partition
+    invariant that makes per-shard dedup GLOBALLY correct (the reference
+    gets the same guarantee from per-chromosome worker processes sharing a
+    DB, ``database/variant.py:287-309``).
+
+    ``dev_store``: optional
+    :class:`~annotatedvdb_tpu.parallel.device_store.DeviceShardStore`
+    snapshot; when present each shard probes its resident slice with the
+    sorted two-level search (``ops.dedup.lookup_in_sorted_multi``) and
+    duplicate counts ride one psum.  Returns
+    ``(ann, rid_out, flags, counters)``:
+
+    - ``ann``: annotated arrays in post-exchange order;
+    - ``rid_out``: input row id per slot (-1 = empty/pad/dropped);
+    - ``flags``: dict of per-slot bool arrays ``dup_batch`` (duplicates an
+      earlier row of this batch) and ``in_store`` (identity already present
+      in the snapshot) — scatter back with ``rid_out`` exactly like the
+      annotate outputs;
+    - ``counters``: dict of psum'd globals (``class_counts``, ``n_dropped``,
+      ``n_fallback``, ``n_batch_dup``, ``n_store_dup``).
+
+    Host-fallback rows (alleles wider than the device arrays) are excluded
+    from both verdicts — their truncated-prefix identity could collide, so
+    the host re-checks them exactly as the single-device path does."""
+    from annotatedvdb_tpu.ops.dedup import (
+        lookup_in_sorted_multi,
+        mark_batch_duplicates_multi,
+        mix_chrom_hash,
+    )
+    from annotatedvdb_tpu.ops.hashing import allele_hash
+
+    n_shards = mesh.devices.size
+    if batch.n % n_shards:
+        raise ValueError(
+            f"batch size {batch.n} not divisible by {n_shards} shards — pad "
+            "with chrom-0 rows first"
+        )
+    n_local = batch.n // n_shards
+    if capacity is None:
+        host_owner = np.asarray(chromosome_owner_table(n_shards))[
+            np.clip(np.asarray(batch.chrom, np.int32), 0, NUM_CHROMOSOMES)
+        ]
+        capacity = min(exact_capacity(host_owner, n_shards), n_local)
+    if row_id is None:
+        row_id = np.arange(batch.n, dtype=np.int32)
+    has_store = dev_store is not None
+    store_arrays = tuple(dev_store[:7]) if has_store else ()
+
+    spec = P(SHARD_AXIS)
+    store_specs = (spec,) * len(store_arrays)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * 7 + store_specs,
+        out_specs=(
+            jax.tree.map(lambda _: spec, _annotated_specs()),
+            spec,
+            {"dup_batch": spec, "in_store": spec},
+            {"class_counts": P(), "n_dropped": P(), "n_fallback": P(),
+             "n_batch_dup": P(), "n_store_dup": P()},
+        ),
+        check_vma=False,
+    )
+    def step(chrom, pos, ref, alt, ref_len, alt_len, rid, *store_cols):
+        owner = chromosome_owner(chrom, n_shards)
+        arrays = (chrom, pos, ref, alt, ref_len, alt_len, rid)
+        (chrom, pos, ref, alt, ref_len, alt_len, rid), valid, dropped = (
+            reshard_by_owner(owner, arrays, n_shards, capacity)
+        )
+        ann = annotate_pipeline(chrom, pos, ref, alt, ref_len, alt_len)
+        real = valid & (chrom > 0)
+        usable = real & ~ann.host_fallback
+        h = allele_hash(ref, alt, ref_len, alt_len)
+        # pad/empty slots carry chrom 0 + zero alleles and would dedup
+        # against each other: salt them out of every identity comparison
+        # by replacing their position with a unique negative sentinel
+        slot = jnp.arange(pos.shape[0], dtype=jnp.int32)
+        pos_k = jnp.where(usable, pos, -1 - slot)
+        dup_batch = mark_batch_duplicates_multi(
+            chrom, pos_k, h, ref, alt, ref_len, alt_len
+        ) & usable
+        if store_cols:
+            (s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al) = store_cols
+            # shard_map passes the [1, M, ...] local block; drop the axis
+            s_chrom, s_pos, s_hm = s_chrom[0], s_pos[0], s_hm[0]
+            s_ref, s_alt, s_rl, s_al = s_ref[0], s_alt[0], s_rl[0], s_al[0]
+            hm = mix_chrom_hash(h, chrom)
+            in_store, _ = lookup_in_sorted_multi(
+                s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al,
+                chrom, pos_k, hm, ref, alt, ref_len, alt_len,
+            )
+            in_store = in_store & usable
+        else:
+            in_store = jnp.zeros(pos.shape, jnp.bool_)
+        counted = usable & ~dup_batch & ~in_store
+        counts = jnp.zeros((8,), jnp.int32).at[ann.variant_class].add(
+            counted.astype(jnp.int32), mode="drop"
+        )
+        counters = {
+            "class_counts": jax.lax.psum(counts, SHARD_AXIS),
+            "n_dropped": dropped,
+            "n_fallback": jax.lax.psum(
+                jnp.sum(real & ann.host_fallback, dtype=jnp.int32), SHARD_AXIS
+            ),
+            "n_batch_dup": jax.lax.psum(
+                jnp.sum(dup_batch, dtype=jnp.int32), SHARD_AXIS
+            ),
+            "n_store_dup": jax.lax.psum(
+                jnp.sum(in_store, dtype=jnp.int32), SHARD_AXIS
+            ),
+        }
+        rid_out = jnp.where(real, rid, -1)
+        return ann, rid_out, {"dup_batch": dup_batch, "in_store": in_store}, counters
+
+    return step(
+        batch.chrom, batch.pos, batch.ref, batch.alt,
+        batch.ref_len, batch.alt_len, row_id, *store_arrays,
+    )

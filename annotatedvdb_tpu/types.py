@@ -114,13 +114,16 @@ def encode_allele_array(alleles: Sequence[str], width: int) -> tuple[np.ndarray,
     fallback — their length column still records the true length so the
     pipeline can flag them)."""
     n = len(alleles)
-    out = np.zeros((n, width), dtype=np.uint8)
-    lens = np.zeros((n,), dtype=np.int32)
-    for i, a in enumerate(alleles):
-        b = a.encode("ascii", errors="replace")
-        lens[i] = len(b)
-        w = min(len(b), width)
-        out[i, :w] = np.frombuffer(b[:w], dtype=np.uint8)
+    lens = np.fromiter(map(len, alleles), np.int32, count=n)
+    # one C-level join/encode instead of a per-row frombuffer loop:
+    # 'replace' maps every non-ASCII CHARACTER to one '?' byte, so the
+    # char-padded rows stay exactly ``width`` bytes each
+    joined = "".join(a[:width].ljust(width, "\0") for a in alleles)
+    out = (
+        np.frombuffer(joined.encode("ascii", errors="replace"), np.uint8)
+        .reshape(n, width)
+        .copy()
+    )
     return out, lens
 
 

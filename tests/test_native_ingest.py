@@ -147,13 +147,22 @@ def test_rs_info_fallback_parity(tmp_path):
         "1\t900\t.\tA\tG\t.\t.\tRS= 12",       # int() strips whitespace
         "1\t950\trs99999999999999999999\tA\tG\t.\t.\t.\n"
         "1\t960\t.\tA\tG\t.\t.\tRS=99999999999999999999",  # > int64
+        # int64 boundary: both engines share the pre-multiply bound
+        # ((2^63-10)//10), so the largest accepted id is ...799 and ids
+        # within 8 of INT64_MAX are rejected by BOTH (they diverged here
+        # once: Python post-add accepted ...800-807, C++ rejected)
+        "1\t970\trs9223372036854775799\tA\tG\t.\t.\t.",   # max accepted
+        "1\t980\trs9223372036854775807\tA\tG\t.\t.\t.",   # INT64_MAX -> -1
+        "1\t990\t.\tA\tG\t.\t.\tRS=9223372036854775799",  # max accepted
+        "1\t995\t.\tA\tG\t.\t.\tRS=9223372036854775800",  # in-window -> -1
     ]) + "\n"
     path = write_vcf(tmp_path, vcf)
     py = read_all(path, engine="python", width=16)
     nat = read_all(path, engine="native", width=16)
     assert_chunks_equal(py, nat)
     got = np.concatenate([c.rs_number for c in nat]).tolist()
-    assert got == [12, 12, 2, -1, -1, -1, -1, -1, 12, -1, -1]
+    assert got == [12, 12, 2, -1, -1, -1, -1, -1, 12, -1, -1,
+                   9223372036854775799, -1, 9223372036854775799, -1]
 
 
 def test_native_prepacked_alleles_match_host_encoder(tmp_path):

@@ -167,3 +167,25 @@ def test_vep_load_updates_store(tmp_path, loaded_store, link_fast, monkeypatch):
     counters2 = loader2.load_file(str(path), commit=True)
     assert counters2["duplicates"] == 4
     assert counters2["update"] == 0
+
+
+def test_fresh_copy_tolerates_numpy_scalars():
+    """_fresh (the store-update un-aliasing copy) must not crash on
+    numpy-typed values — a rank field that skips prefetch_ranks' coercion
+    would otherwise turn a working load into a mid-load TypeError."""
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders.vep_loader import _fresh
+
+    src = {
+        "rank": np.int32(7),
+        "af": np.float64(0.25),
+        "is_coding": np.bool_(True),
+        "nested": {"vals": [np.int64(1), 2, "x"]},
+    }
+    out = _fresh(src)
+    assert out == {"rank": 7, "af": 0.25, "is_coding": True,
+                   "nested": {"vals": [1, 2, "x"]}}
+    assert type(out["rank"]) is int and type(out["is_coding"]) is bool
+    out["nested"]["vals"].append(3)
+    assert len(src["nested"]["vals"]) == 3  # un-aliased
