@@ -147,8 +147,16 @@ class VepResultParser:
                 mkey = ",".join(terms)
                 entry = memo.get(mkey)
                 if entry is None:
+                    rank = ranker.find_matching_consequence(terms)
+                    # a learn-on-miss re-rank renumbers the whole table:
+                    # drop every memo entry of the old version BEFORE
+                    # caching this one (the table version only ever changes
+                    # inside the miss path, so checking here is equivalent
+                    # to the per-consequence check this loop inlined —
+                    # memo is cleared in place, the local alias sees it)
+                    self._check_version()
                     entry = memo[mkey] = {
-                        "rank": ranker.find_matching_consequence(terms),
+                        "rank": rank,
                         "consequence_is_coding": is_coding_consequence(terms),
                     }
                 conseq.update(entry)

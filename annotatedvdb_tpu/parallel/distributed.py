@@ -195,8 +195,19 @@ def distributed_annotate_step(
         np.asarray(owner, np.int32) if owner is not None
         else np.full(batch.n, -1, np.int32)  # -1: chromosome routing in-trace
     )
-    use_chrom_owner = owner is None
+    step = _annotate_step_program(mesh, n_shards, capacity, owner is None)
+    return step(
+        batch.chrom, batch.pos, batch.ref, batch.alt,
+        batch.ref_len, batch.alt_len, row_id, owner_in,
+    )
 
+
+@lru_cache(maxsize=64)
+def _annotate_step_program(mesh, n_shards: int, capacity: int,
+                           use_chrom_owner: bool):
+    """The shard_map program for :func:`distributed_annotate_step`, cached
+    by (mesh, shape parameters) — rebuilding the closure per call would
+    re-trace AND re-compile every step (~40s each on a virtual CPU mesh)."""
     spec = P(SHARD_AXIS)
 
     @partial(
@@ -235,10 +246,9 @@ def distributed_annotate_step(
         rid_out = jnp.where(real, rid, -1)
         return ann, rid_out, counts, dropped, n_fallback
 
-    return step(
-        batch.chrom, batch.pos, batch.ref, batch.alt,
-        batch.ref_len, batch.alt_len, row_id, owner_in,
-    )
+    # one jitted program: shard_map OUTSIDE jit executes eagerly, paying a
+    # per-primitive dispatch (measured ~1000x slower on a CPU mesh)
+    return jax.jit(step)
 
 
 def _annotated_specs():
@@ -279,13 +289,6 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
     Host-fallback rows (alleles wider than the device arrays) are excluded
     from both verdicts — their truncated-prefix identity could collide, so
     the host re-checks them exactly as the single-device path does."""
-    from annotatedvdb_tpu.ops.dedup import (
-        lookup_in_sorted_multi,
-        mark_batch_duplicates_multi,
-        mix_chrom_hash,
-    )
-    from annotatedvdb_tpu.ops.hashing import allele_hash
-
     n_shards = mesh.devices.size
     if batch.n % n_shards:
         raise ValueError(
@@ -302,9 +305,27 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
         row_id = np.arange(batch.n, dtype=np.int32)
     has_store = dev_store is not None
     store_arrays = tuple(dev_store[:7]) if has_store else ()
+    step = _insert_step_program(mesh, n_shards, capacity, has_store)
+    return step(
+        batch.chrom, batch.pos, batch.ref, batch.alt,
+        batch.ref_len, batch.alt_len, row_id, *store_arrays,
+    )
+
+
+@lru_cache(maxsize=64)
+def _insert_step_program(mesh, n_shards: int, capacity: int, has_store: bool):
+    """The shard_map program for :func:`distributed_insert_step`, cached by
+    (mesh, shape parameters) — same re-compile trap as
+    :func:`_annotate_step_program`."""
+    from annotatedvdb_tpu.ops.dedup import (
+        lookup_in_sorted_multi,
+        mark_batch_duplicates_multi,
+        mix_chrom_hash,
+    )
+    from annotatedvdb_tpu.ops.hashing import allele_hash
 
     spec = P(SHARD_AXIS)
-    store_specs = (spec,) * len(store_arrays)
+    store_specs = (spec,) * (7 if has_store else 0)
 
     @partial(
         shard_map,
@@ -347,7 +368,12 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
                 s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al,
                 chrom, pos_k, hm, ref, alt, ref_len, alt_len,
             )
-            in_store = in_store & usable
+            # disjoint verdicts: a row that duplicates an earlier batch row
+            # AND exists in the store counts once, as an in-batch dup —
+            # matching the host loader's order (dedup filters first, then
+            # membership probes survivors) and keeping the conservation
+            # identity n_new + n_batch_dup + n_store_dup + n_fallback == n
+            in_store = in_store & usable & ~dup_batch
         else:
             in_store = jnp.zeros(pos.shape, jnp.bool_)
         counted = usable & ~dup_batch & ~in_store
@@ -370,7 +396,5 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
         rid_out = jnp.where(real, rid, -1)
         return ann, rid_out, {"dup_batch": dup_batch, "in_store": in_store}, counters
 
-    return step(
-        batch.chrom, batch.pos, batch.ref, batch.alt,
-        batch.ref_len, batch.alt_len, row_id, *store_arrays,
-    )
+    # see _annotate_step_program: un-jitted shard_map executes eagerly
+    return jax.jit(step)

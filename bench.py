@@ -216,6 +216,76 @@ def bench_end_to_end():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_multichip_virtual(n_devices: int = 8):
+    """Mesh insert-step timing on a VIRTUAL n-device CPU mesh — a labeled
+    scaling datapoint (reshard + annotate + dedup + membership as one mesh
+    program), NOT a hardware throughput claim: all virtual devices share
+    this host's cores, so the number is an upper bound on per-step cost and
+    a lower bound on what real chips with ICI would do.  Requires
+    ``--xla_force_host_platform_device_count`` set before backend init
+    (main() does this)."""
+    import jax
+
+    try:
+        cpu_devices = jax.devices("cpu")
+    except RuntimeError:
+        return {"skipped": "no CPU backend available"}
+    if len(cpu_devices) < n_devices:
+        return {
+            "skipped": f"only {len(cpu_devices)} CPU devices (flag not set "
+                       "before backend init)"
+        }
+    from jax.sharding import Mesh
+
+    from annotatedvdb_tpu.io.synth import synthetic_batch
+    from annotatedvdb_tpu.parallel.device_store import build_device_shard_store
+    from annotatedvdb_tpu.parallel.distributed import distributed_insert_step
+    from annotatedvdb_tpu.parallel.mesh import SHARD_AXIS
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+
+    mesh = Mesh(np.array(cpu_devices[:n_devices]), (SHARD_AXIS,))
+    batch_rows = 1 << 19  # 512k rows/step: a realistic per-step load
+    store_rows = 1 << 20  # 1M-row resident membership snapshot
+    batch = synthetic_batch(batch_rows, width=16, seed=23)
+    resident = synthetic_batch(store_rows, width=16, seed=29)
+    store = VariantStore(width=16)
+    h = np.asarray(allele_hash_jit(
+        resident.ref, resident.alt, resident.ref_len, resident.alt_len
+    ))
+    for code in np.unique(resident.chrom):
+        rows = np.where(resident.chrom == code)[0]
+        store.shard(int(code)).append(
+            {"pos": resident.pos[rows], "h": h[rows],
+             "ref_len": resident.ref_len[rows],
+             "alt_len": resident.alt_len[rows]},
+            resident.ref[rows], resident.alt[rows],
+        )
+    dev_store = build_device_shard_store(store, n_devices)
+
+    def step():
+        return distributed_insert_step(mesh, batch, dev_store=dev_store)
+
+    out = step()  # compile
+    jax.block_until_ready(out[3]["class_counts"])
+    t0 = time.perf_counter()
+    out = step()
+    jax.block_until_ready(out[3]["class_counts"])
+    dt = time.perf_counter() - t0
+    return {
+        "label": "virtual-cpu-mesh (shared host cores; NOT chip throughput)",
+        "devices": n_devices,
+        "batch_rows": batch_rows,
+        "resident_store_rows": store_rows,
+        "step_seconds": round(dt, 3),
+        "rows_per_sec_virtual": round(batch_rows / dt, 1),
+        "counters": {
+            k: np.asarray(v).tolist()
+            for k, v in out[3].items()
+        },
+    }
+
+
 def main():
     # Pin the platform BEFORE any backend touch: round 1's bench died with
     # rc=1 because the TPU tunnel errored during jax.default_backend(), and
@@ -226,6 +296,15 @@ def main():
     # attempts/errors in the JSON so a fallback is never unexplained.
     from annotatedvdb_tpu.utils import runtime
 
+    # virtual CPU devices for the multi-chip projection leg (harmless when
+    # the accelerator backend is selected: the CPU platform coexists);
+    # must precede backend init, like the platform pin itself
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
     platform = runtime.pin_platform(
         "auto", attempts=3, ignore_cached_fallback=True
     )
@@ -234,6 +313,7 @@ def main():
 
     kernel_vps, kernel_kind = bench_kernel()
     e2e = bench_end_to_end()
+    multichip = bench_multichip_virtual()
 
     print(
         json.dumps(
@@ -255,6 +335,7 @@ def main():
                     else {"skipped": "explicit platform pin"}
                 ),
                 "end_to_end": e2e,
+                "multichip_virtual": multichip,
             }
         )
     )

@@ -203,3 +203,45 @@ def test_insert_step_without_store_snapshot():
     assert int(np.asarray(counters["n_batch_dup"])) == 4
     assert int(np.asarray(counters["n_store_dup"])) == 0
     assert not np.asarray(flags["in_store"]).any()
+
+
+def test_insert_step_overlapping_verdicts_stay_disjoint(tmp_path):
+    """A row that is BOTH an in-batch duplicate and present in the store
+    counts once (as the in-batch dup, matching host-loader order), so the
+    conservation identity holds on overlapping data."""
+    from annotatedvdb_tpu.io.synth import synthetic_batch
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+    from annotatedvdb_tpu.parallel import make_mesh
+    from annotatedvdb_tpu.parallel.device_store import build_device_shard_store
+    from annotatedvdb_tpu.parallel.distributed import distributed_insert_step
+    from annotatedvdb_tpu.store import VariantStore
+
+    n = 128
+    batch = synthetic_batch(n, width=16, seed=17)
+    # rows [0:4) duplicated at [4:8); rows [0:8) ALSO preloaded in store
+    for f in batch._fields:
+        getattr(batch, f)[4:8] = getattr(batch, f)[0:4]
+    store = VariantStore(width=16)
+    h = np.asarray(allele_hash_jit(
+        batch.ref[:8], batch.alt[:8], batch.ref_len[:8], batch.alt_len[:8]
+    ))
+    for code in np.unique(batch.chrom[:8]):
+        rows = np.where(batch.chrom[:8] == code)[0]
+        store.shard(int(code)).append(
+            {"pos": batch.pos[rows], "h": h[rows],
+             "ref_len": batch.ref_len[rows], "alt_len": batch.alt_len[rows]},
+            batch.ref[rows], batch.alt[rows],
+        )
+    mesh = make_mesh(8)
+    _ann, _rid, flags, c = distributed_insert_step(
+        mesh, batch, dev_store=build_device_shard_store(store, 8)
+    )
+    n_batch_dup = int(np.asarray(c["n_batch_dup"]))
+    n_store_dup = int(np.asarray(c["n_store_dup"]))
+    n_new = int(np.asarray(c["class_counts"]).sum())
+    # the 4 later copies are in-batch dups (even though they are ALSO in
+    # the store — counted once); the 4 first copies are store dups
+    assert n_batch_dup == 4
+    assert n_store_dup == 4
+    assert n_new + n_batch_dup + n_store_dup == n
+    assert not (np.asarray(flags["dup_batch"]) & np.asarray(flags["in_store"])).any()
