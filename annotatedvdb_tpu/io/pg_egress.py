@@ -20,7 +20,7 @@ import numpy as np
 from annotatedvdb_tpu.oracle.binindex import closed_form_path
 from annotatedvdb_tpu.sql.schema import SCHEMA, full_schema
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
-from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
+from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS, jsonb_dumps
 from annotatedvdb_tpu.types import chromosome_label
 
 #: Variant column order for COPY (matches create_variant_table_sql)
@@ -128,7 +128,8 @@ def shard_rows(shard):
                     display[j] if col == "display_attributes"
                     else anns[col][i]
                 )
-                values.append(None if ann is None else json.dumps(ann))
+                # raw-text values splice verbatim (jsonb_dumps)
+                values.append(None if ann is None else jsonb_dumps(ann))
             values.append(int(alg[i]))
             yield values
 

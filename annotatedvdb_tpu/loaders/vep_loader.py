@@ -24,12 +24,16 @@ import json
 
 import numpy as np
 
+import os
+
 from annotatedvdb_tpu.conseq import ConsequenceRanker
 from annotatedvdb_tpu.io.vep import VepResultParser
 from annotatedvdb_tpu.models.pipeline import annotate_fn
+from annotatedvdb_tpu.native import vep as native_vep
 from annotatedvdb_tpu.ops.hashing import allele_hash_jit
 
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.store.variant_store import RawJson
 from annotatedvdb_tpu.types import VariantBatch, chromosome_code
 
 
@@ -85,10 +89,21 @@ class TpuVepLoader:
         from annotatedvdb_tpu.utils.logging import ProgressCadence
 
         self._cadence = ProgressCadence(log, log_after, unit="results")
+        self._blob: bytes | None = None      # native rank-table serialization
+        self._blob_version = -1
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0,
             "update": 0, "not_found": 0,
         }
+
+    def _ranking_blob(self) -> bytes:
+        """Serialized rank table for the native transformer, refreshed when
+        a learn-on-miss re-rank bumps the ranker version."""
+        v = self.parser.ranker.version
+        if self._blob is None or self._blob_version != v:
+            self._blob = native_vep.ranking_blob(self.parser.ranker)
+            self._blob_version = v
+        return self._blob
 
     @property
     def is_adsp(self) -> bool:
@@ -139,12 +154,16 @@ class TpuVepLoader:
         self.store.pin_for_updates()
         lines: list[str] = []
         n_added_before = len(self.parser.ranker.added)
+        use_native = (
+            os.environ.get("AVDB_NATIVE_VEP", "1") != "0"
+            and native_vep.available()
+        )
 
-        def flush() -> None:
+        def flush_python(batch_lines: list[str]) -> None:
             # ONE json.loads over the whole flush (lines joined into a JSON
             # array) — the C decoder amortizes per-call setup and allocator
             # churn across the batch, ~2x a per-line loads loop
-            raw = json.loads(f'[{",".join(lines)}]')
+            raw = json.loads(f'[{",".join(batch_lines)}]')
             # batched combo->rank resolution through the compiled rank-table
             # snapshot first (device path for large batches); the per-row
             # parse below then hits the memo, and only novel combos take the
@@ -157,6 +176,37 @@ class TpuVepLoader:
                 extend(parse(ann))
             if pending:
                 self._apply_batch(pending, alg_id, commit)
+
+        def flush() -> None:
+            if use_native:
+                res = native_vep.transform(
+                    lines, self._ranking_blob(), self.is_dbsnp,
+                    self.store.width,
+                )
+            else:
+                res = None
+            if res is None:
+                flush_python(lines)
+            else:
+                self.counters["skipped"] += int(
+                    (res.doc_fallback == 2).sum()
+                ) + res.skipped_alts
+                self.counters["variant"] += res.n_rows
+                # docs the native parser could not transform faithfully
+                # (novel combos, escapes, malformed inputs) re-run through
+                # the pure-Python path, INTERLEAVED in document order so
+                # same-row update/merge ordering matches the all-Python
+                # path exactly
+                fb_docs = np.where(res.doc_fallback == 1)[0]
+                lo = 0
+                for f in fb_docs.tolist():
+                    hi = int(np.searchsorted(res.doc_of_row, f))
+                    if hi > lo:
+                        self._apply_native(res, alg_id, commit, lo, hi)
+                    flush_python([lines[f]])
+                    lo = int(np.searchsorted(res.doc_of_row, f, side="right"))
+                if res.n_rows > lo:
+                    self._apply_native(res, alg_id, commit, lo, res.n_rows)
             lines.clear()
             self._cadence.maybe_log(self.counters["line"], self.counters)
 
@@ -179,6 +229,194 @@ class TpuVepLoader:
         return dict(self.counters)
 
     # ------------------------------------------------------------------
+
+    def _batch_identity(self, batch: VariantBatch):
+        """(hash, prefix_len, host_fallback) for one per-alt batch — the
+        three identity outputs the update path consumes.  Device kernels on
+        fast links (packed single-fetch transport), bit-exact numpy twins on
+        slow remote-attached links (see ops/hashing.allele_hash_np,
+        ops/annotate.vep_identity_np)."""
+        from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
+        from annotatedvdb_tpu.store.variant_store import _transfer_fast
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        n = batch.n
+        if not _transfer_fast():
+            from annotatedvdb_tpu.ops.annotate import vep_identity_np
+            from annotatedvdb_tpu.ops.hashing import allele_hash_np
+
+            prefix, host = vep_identity_np(
+                batch.ref, batch.alt, batch.ref_len, batch.alt_len
+            )
+            h = allele_hash_np(
+                batch.ref, batch.alt, batch.ref_len, batch.alt_len
+            )
+            return h, prefix, host
+        # tail flushes pad UP to the steady-state shape so a whole load
+        # compiles at most two kernel shapes (both covered by ``warmup``)
+        padded = _pad_batch(
+            batch, max(next_pow2(n), next_pow2(self.batch_size))
+        )
+        ann_p = annotate_fn()(
+            padded.chrom, padded.pos, padded.ref, padded.alt,
+            padded.ref_len, padded.alt_len,
+        )
+        h_dev = allele_hash_jit(
+            padded.ref, padded.alt, padded.ref_len, padded.alt_len
+        )
+        # only hash + prefix + fallback-flag feed the update path; pack
+        # them into ONE fetched buffer — each materialization pays a
+        # fixed round trip (see ops/pack.py)
+        from annotatedvdb_tpu.ops.pack import (
+            pack_vep_outputs_jit,
+            transport_verified,
+            unpack_vep_outputs,
+        )
+
+        # width bound: prefix_len rides a uint8 lane (>255 truncates)
+        if transport_verified() and self.store.width <= 255:
+            cols = unpack_vep_outputs(
+                np.asarray(
+                    pack_vep_outputs_jit(
+                        h_dev, ann_p.prefix_len, ann_p.host_fallback
+                    )
+                )
+            )
+            return cols["h"][:n].copy(), cols["prefix_len"][:n], cols["host_fallback"][:n]
+        return (
+            np.array(h_dev)[:n],
+            np.asarray(ann_p.prefix_len)[:n],
+            np.asarray(ann_p.host_fallback)[:n],
+        )
+
+    def _apply_native(self, res, alg_id: int, commit: bool,
+                      lo: int = 0, hi: int | None = None) -> None:
+        """Apply rows [lo, hi) of a native-transformed flush: identity
+        lookup + RawJson store writes.  No per-row Python dicts are built —
+        the four JSONB values ride as raw text
+        (``store.variant_store.RawJson``), and sharing one RawJson across a
+        doc's alts is safe because raw values are immutable (the store
+        materializes fresh objects per row on any merge/read)."""
+        from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        if hi is None:
+            hi = res.n_rows
+        # same shape discipline as _apply_batch: per-alt expansion can
+        # exceed the two warmed kernel shapes (p, 2p); split rather than
+        # compile a one-off bigger shape (~35s on TPU)
+        cap = 2 * next_pow2(self.batch_size)
+        if hi - lo > cap:
+            for s0 in range(lo, hi, cap):
+                self._apply_native(res, alg_id, commit, s0, min(s0 + cap, hi))
+            return
+        sl = slice(lo, hi)
+        batch = VariantBatch(
+            res.chrom[sl], res.pos[sl], res.ref[sl], res.alt[sl],
+            res.ref_len[sl], res.alt_len[sl],
+        )
+        # local views: all row indexing below is relative to the slice
+        ref_off, ref_slen = res.ref_off[sl], res.ref_slen[sl]
+        alt_off, alt_slen = res.alt_off[sl], res.alt_slen[sl]
+        ms_off, ms_len = res.ms_off[sl], res.ms_len[sl]
+        rk_off, rk_len = res.rk_off[sl], res.rk_len[sl]
+        fq_off, fq_len = res.fq_off[sl], res.fq_len[sl]
+        vo_off, vo_len = res.vo_off[sl], res.vo_len[sl]
+        h, _prefix, host = self._batch_identity(batch)
+        arena = res.arena
+        # ASCII arenas (the normal case) decode once; byte offsets then
+        # equal str offsets so per-value slicing stays on the str
+        arena_s = arena.decode("ascii") if arena.isascii() else None
+        check_existing = self.skip_existing
+        counters = self.counters
+        raw_cache: dict[tuple, RawJson] = {}  # (off, len) -> shared instance
+        cache_get = raw_cache.get
+
+        def raw(off: int, length: int):
+            if length == 0:
+                return {}
+            key = (off, length)
+            v = cache_get(key)
+            if v is None:
+                v = raw_cache[key] = RawJson(
+                    arena_s[off:off + length] if arena_s is not None
+                    else arena[off:off + length].decode()
+                )
+            return v
+
+        for code in np.unique(batch.chrom):
+            sel = np.where(batch.chrom == code)[0]
+            for i in sel[host[sel]]:
+                # over-width alleles: identity from the original strings
+                ref_s = res.text[
+                    ref_off[i]:ref_off[i] + ref_slen[i]
+                ].decode()
+                alt_s = res.text[
+                    alt_off[i]:alt_off[i] + alt_slen[i]
+                ].decode()
+                h[i] = _fnv32_str(ref_s, alt_s)
+            shard = self.store.shard(int(code))
+            found, idx = shard.lookup(
+                batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
+                batch.ref_len[sel], batch.alt_len[sel],
+            )
+            counters["not_found"] += int((~found).sum())
+            rows_i = sel[found]
+            ids = idx[found]
+            if check_existing and rows_i.size:
+                # policy path (rare): first occurrence per store row wins,
+                # stored vep_output marks a duplicate
+                keep = np.ones(rows_i.size, np.bool_)
+                seen_in_batch: set[int] = set()
+                for j, row_idx in enumerate(ids.tolist()):
+                    if (row_idx in seen_in_batch
+                            or shard.get_ann("vep_output", row_idx)
+                            is not None):
+                        keep[j] = False
+                    elif commit:
+                        # dry runs buffer nothing: only the stored-value
+                        # check applies, matching _apply_batch's gating
+                        seen_in_batch.add(row_idx)
+                counters["duplicates"] += int((~keep).sum())
+                rows_i, ids = rows_i[keep], ids[keep]
+            counters["update"] += int(rows_i.size)
+            if not commit or rows_i.size == 0:
+                continue
+            # bulk assembly (C-level zips; the per-row Python loop was the
+            # path's bottleneck once parsing went native)
+            fmask = fq_len[rows_i] > 0
+            fq_rows = rows_i[fmask]
+            upd_freq = [
+                raw(o, l)
+                for o, l in zip(fq_off[fq_rows].tolist(),
+                                fq_len[fq_rows].tolist())
+            ]
+            upd_ms = [
+                raw(o, l)
+                for o, l in zip(ms_off[rows_i].tolist(),
+                                ms_len[rows_i].tolist())
+            ]
+            upd_ranked = [
+                raw(o, l)
+                for o, l in zip(rk_off[rows_i].tolist(),
+                                rk_len[rows_i].tolist())
+            ]
+            upd_vep = [
+                raw(o, l)
+                for o, l in zip(vo_off[rows_i].tolist(),
+                                vo_len[rows_i].tolist())
+            ]
+            ids = np.asarray(ids, np.int64)
+            if fq_rows.size:
+                shard.update_annotation(
+                    ids[fmask], "allele_frequencies", upd_freq,
+                )
+            shard.update_annotation(ids, "adsp_most_severe_consequence", upd_ms)
+            shard.update_annotation(ids, "adsp_ranked_consequences", upd_ranked)
+            shard.update_annotation(ids, "vep_output", upd_vep)
+            shard.set_col("row_algorithm_id", ids, alg_id)
+            if self.is_adsp:
+                shard.set_col("is_adsp_variant", ids, 1)
 
     def _parse_result(self, annotation: dict) -> list[tuple]:
         """One VEP result -> per-alt pending update rows, as tuples
@@ -260,66 +498,7 @@ class TpuVepLoader:
             pos=np.fromiter((r[R_POS] for r in rows), np.int32, count=n_rows),
             ref=ref_arr, alt=alt_arr, ref_len=ref_len, alt_len=alt_len,
         )
-        # pow2 padding bounds the set of compiled kernel shapes (batch row
-        # counts vary per flush; see vcf_loader._pad_batch)
-        from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
-
-        n = batch.n
-        from annotatedvdb_tpu.store.variant_store import _transfer_fast
-
-        if not _transfer_fast():
-            # slow remote-attached link: the update path only needs hash +
-            # prefix + fallback flag, and the device round trip (query
-            # upload + fetch latency) costs more than computing them on
-            # host — bit-exact numpy twins of the kernels (see
-            # ops/hashing.allele_hash_np, ops/annotate.vep_identity_np)
-            from annotatedvdb_tpu.ops.annotate import vep_identity_np
-            from annotatedvdb_tpu.ops.hashing import allele_hash_np
-
-            prefix, host = vep_identity_np(
-                batch.ref, batch.alt, batch.ref_len, batch.alt_len
-            )
-            h = allele_hash_np(
-                batch.ref, batch.alt, batch.ref_len, batch.alt_len
-            )
-        else:
-            # tail flushes pad UP to the steady-state shape so a whole load
-            # compiles at most two kernel shapes (both covered by ``warmup``)
-            padded = _pad_batch(
-                batch, max(next_pow2(n), next_pow2(self.batch_size))
-            )
-            ann_p = annotate_fn()(
-                padded.chrom, padded.pos, padded.ref, padded.alt,
-                padded.ref_len, padded.alt_len,
-            )
-            h_dev = allele_hash_jit(
-                padded.ref, padded.alt, padded.ref_len, padded.alt_len
-            )
-            # only hash + prefix + fallback-flag feed the update path; pack
-            # them into ONE fetched buffer — each materialization pays a
-            # fixed round trip (see ops/pack.py)
-            from annotatedvdb_tpu.ops.pack import (
-                pack_vep_outputs_jit,
-                transport_verified,
-                unpack_vep_outputs,
-            )
-
-            # width bound: prefix_len rides a uint8 lane (>255 truncates)
-            if transport_verified() and self.store.width <= 255:
-                cols = unpack_vep_outputs(
-                    np.asarray(
-                        pack_vep_outputs_jit(
-                            h_dev, ann_p.prefix_len, ann_p.host_fallback
-                        )
-                    )
-                )
-                prefix = cols["prefix_len"][:n]
-                host = cols["host_fallback"][:n]
-                h = cols["h"][:n]
-            else:
-                prefix = np.asarray(ann_p.prefix_len)[:n]
-                host = np.asarray(ann_p.host_fallback)[:n]
-                h = np.array(h_dev)[:n]
+        h, prefix, host = self._batch_identity(batch)
         from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
         from annotatedvdb_tpu.oracle import normalize_alleles
 

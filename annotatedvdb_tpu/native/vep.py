@@ -1,0 +1,208 @@
+"""ctypes binding for the native VEP-result transformer
+(``native/avdb_vep.cpp``).
+
+``transform`` hands a flush's raw JSON lines to C++ and receives per-alt
+row columns (identity arrays, plus byte spans of ready-made JSON text for
+the four store-bound values) — no per-row Python dicts on the fast path.
+Docs the native parser cannot handle faithfully (novel consequence combos,
+escaped compared strings, malformed inputs) come back flagged; the caller
+re-runs exactly those through the pure-Python path, so behavior is identical
+by construction (parity pinned by ``tests/test_vep_native.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import json
+import os
+import subprocess
+import threading
+from typing import NamedTuple
+
+import numpy as np
+
+_SOURCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "avdb_vep.cpp",
+)
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+
+_lock = threading.Lock()
+_lib = None
+_lib_error: str | None = None
+
+
+def _build() -> str:
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_CACHE_DIR, f"avdb_vep-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    tmp = so_path + f".tmp{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SOURCE],
+        check=True, capture_output=True,
+    )
+    os.replace(tmp, so_path)
+    return so_path
+
+
+def load():
+    """The loaded CDLL, building if needed; None when unavailable."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as err:
+            _lib_error = str(err)
+            return None
+        c = ctypes
+        lib.avdb_vep_transform.restype = c.c_int64
+        lib.avdb_vep_transform.argtypes = (
+            [c.c_char_p, c.c_int64, c.c_char_p, c.c_int64, c.c_int32, c.c_int32,
+             c.c_int64]
+            + [c.c_void_p] * 3           # doc_of_row, chrom, pos
+            + [c.c_void_p] * 4           # ref_mat, alt_mat, ref_len, alt_len
+            + [c.c_void_p] * 4           # ref_off/slen, alt_off/slen
+            + [c.c_void_p]               # is_multi
+            + [c.c_void_p] * 8           # ms/rk/fq/vo off+len
+            + [c.c_int64, c.c_void_p]    # docs_cap, doc_fallback
+            + [c.c_void_p, c.c_int64]    # arena, arena_cap
+            + [c.c_void_p] * 4           # out_rows, out_docs, arena_used, skipped
+        )
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def ranking_blob(ranker) -> bytes:
+    """Serialize the ranker's current table for the C++ side: one line per
+    canonical combo — ``canon \\x1F rank-json \\x1F sort-key \\x1F coding``.
+    The rank JSON text is spliced verbatim into emitted consequences, so the
+    native output's rank formatting is byte-identical to the host ranker's
+    values."""
+    from annotatedvdb_tpu.conseq import is_coding_consequence
+
+    lines = []
+    for canon, key in ranker._canonical.items():
+        rank = ranker.rankings[key]
+        coding = is_coding_consequence(canon.split(","))
+        lines.append(
+            f"{canon}\x1f{json.dumps(rank)}\x1f{float(rank)!r}\x1f"
+            f"{1 if coding else 0}"
+        )
+    return ("\n".join(lines) + "\n").encode()
+
+
+class VepTransform(NamedTuple):
+    n_rows: int
+    doc_of_row: np.ndarray
+    chrom: np.ndarray
+    pos: np.ndarray
+    ref: np.ndarray
+    alt: np.ndarray
+    ref_len: np.ndarray
+    alt_len: np.ndarray
+    ref_off: np.ndarray
+    ref_slen: np.ndarray
+    alt_off: np.ndarray
+    alt_slen: np.ndarray
+    is_multi: np.ndarray
+    ms_off: np.ndarray
+    ms_len: np.ndarray
+    rk_off: np.ndarray
+    rk_len: np.ndarray
+    fq_off: np.ndarray
+    fq_len: np.ndarray
+    vo_off: np.ndarray
+    vo_len: np.ndarray
+    doc_fallback: np.ndarray   # 0 ok, 1 python-path, 2 skipped contig
+    arena: bytes
+    text: bytes                # the joined input lines (spans reference it)
+    skipped_alts: int
+
+
+def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
+              width: int) -> VepTransform | None:
+    """Run the native transformer over one flush; None when the library is
+    unavailable (callers use the pure-Python path)."""
+    lib = load()
+    if lib is None:
+        return None
+    text = "\n".join(lines).encode()
+    n_docs = len(lines)
+    rows_cap = max(2 * n_docs + 64, 256)
+    arena_cap = 4 * len(text) + (1 << 20)
+    c = ctypes
+    while True:
+        a = {
+            "doc_of_row": np.zeros(rows_cap, np.int32),
+            "chrom": np.zeros(rows_cap, np.int8),
+            "pos": np.zeros(rows_cap, np.int32),
+            "ref": np.zeros((rows_cap, width), np.uint8),
+            "alt": np.zeros((rows_cap, width), np.uint8),
+            "ref_len": np.zeros(rows_cap, np.int32),
+            "alt_len": np.zeros(rows_cap, np.int32),
+            "ref_off": np.zeros(rows_cap, np.int64),
+            "ref_slen": np.zeros(rows_cap, np.int32),
+            "alt_off": np.zeros(rows_cap, np.int64),
+            "alt_slen": np.zeros(rows_cap, np.int32),
+            "is_multi": np.zeros(rows_cap, np.uint8),
+            "ms_off": np.zeros(rows_cap, np.int64),
+            "ms_len": np.zeros(rows_cap, np.int32),
+            "rk_off": np.zeros(rows_cap, np.int64),
+            "rk_len": np.zeros(rows_cap, np.int32),
+            "fq_off": np.zeros(rows_cap, np.int64),
+            "fq_len": np.zeros(rows_cap, np.int32),
+            "vo_off": np.zeros(rows_cap, np.int64),
+            "vo_len": np.zeros(rows_cap, np.int32),
+        }
+        doc_fallback = np.zeros(n_docs + 1, np.uint8)
+        arena = ctypes.create_string_buffer(arena_cap)
+        out_rows = c.c_int64(0)
+        out_docs = c.c_int64(0)
+        arena_used = c.c_int64(0)
+        skipped = c.c_int64(0)
+        rc = lib.avdb_vep_transform(
+            text, len(text), blob, len(blob),
+            1 if is_dbsnp else 0, width, rows_cap,
+            *(x.ctypes.data_as(c.c_void_p) for x in (
+                a["doc_of_row"], a["chrom"], a["pos"],
+                a["ref"], a["alt"], a["ref_len"], a["alt_len"],
+                a["ref_off"], a["ref_slen"], a["alt_off"], a["alt_slen"],
+                a["is_multi"],
+                a["ms_off"], a["ms_len"], a["rk_off"], a["rk_len"],
+                a["fq_off"], a["fq_len"], a["vo_off"], a["vo_len"],
+            )),
+            n_docs + 1,
+            doc_fallback.ctypes.data_as(c.c_void_p),
+            arena, arena_cap,
+            c.byref(out_rows), c.byref(out_docs), c.byref(arena_used),
+            c.byref(skipped),
+        )
+        if rc == 1:
+            rows_cap *= 2
+            continue
+        if rc == 2:
+            arena_cap *= 2
+            continue
+        if rc != 0:
+            return None
+        n = out_rows.value
+        return VepTransform(
+            n_rows=n,
+            **{k: v[:n] for k, v in a.items()},
+            doc_fallback=doc_fallback[: out_docs.value],
+            arena=arena.raw[: arena_used.value],
+            text=text,
+            skipped_alts=skipped.value,
+        )

@@ -184,6 +184,80 @@ def combined_key(pos: np.ndarray, h: np.ndarray) -> np.ndarray:
     return (pos.astype(np.uint64) << np.uint64(32)) | h.astype(np.uint64)
 
 
+class RawJson:
+    """A JSONB column value held as raw JSON TEXT instead of parsed dicts.
+
+    The native VEP transformer emits store-bound values as ready JSON; at
+    100k+ results/sec, building their dict trees on ingest is the dominant
+    cost and almost always wasted (the common consumer is the persistence
+    writer, which wants text anyway).  A RawJson is immutable — sharing one
+    instance across rows is safe, unlike dicts under deep-merge — and
+    behaves as a read-only mapping for consumers that index into it (the
+    parse is cached).  Store-side mutation sites (deep-merge targets,
+    ``get_ann`` write-back) materialize a FRESH object per row via
+    :meth:`fresh` so no parsed tree is ever shared between rows."""
+
+    __slots__ = ("text", "_obj")
+
+    def __init__(self, text: str):
+        self.text = text
+        self._obj = None
+
+    def fresh(self):
+        """A newly parsed (never shared) Python object of this value."""
+        return json.loads(self.text)
+
+    def _cached(self):
+        if self._obj is None:
+            self._obj = json.loads(self.text)
+        return self._obj
+
+    # -- read-only mapping/sequence protocol (cached parse) -----------------
+
+    def __getitem__(self, k):
+        return self._cached()[k]
+
+    def get(self, k, default=None):
+        obj = self._cached()
+        return obj.get(k, default) if isinstance(obj, dict) else default
+
+    def __contains__(self, k):
+        return k in self._cached()
+
+    def __iter__(self):
+        return iter(self._cached())
+
+    def __len__(self):
+        return len(self._cached())
+
+    def keys(self):
+        return self._cached().keys()
+
+    def values(self):
+        return self._cached().values()
+
+    def items(self):
+        return self._cached().items()
+
+    def __eq__(self, other):
+        if isinstance(other, RawJson):
+            other = other._cached()
+        return self._cached() == other
+
+    def __bool__(self):
+        return bool(self._cached())
+
+    def __repr__(self):
+        return f"RawJson({self.text!r})"
+
+
+def jsonb_dumps(value) -> str:
+    """Serialize a stored JSONB value — raw text splices straight through."""
+    if isinstance(value, RawJson):
+        return value.text
+    return json.dumps(value)
+
+
 class Segment:
     """One sorted run of rows: numeric columns + packed alleles + object cols.
 
@@ -556,7 +630,14 @@ class ChromosomeShard:
     def get_ann(self, column: str, i):
         seg, off = self._locate([i])
         col = self.segments[int(seg[0])].obj[column]
-        return None if col is None else col[int(off[0])]
+        if col is None:
+            return None
+        v = col[int(off[0])]
+        if isinstance(v, RawJson):
+            # materialize ON THE ROW (fresh parse, never the shared cached
+            # object — the same RawJson instance may back several rows)
+            v = col[int(off[0])] = v.fresh()
+        return v
 
     def primary_key(self, i: int) -> str:
         """Row's record PK: retained digest PK for the long-allele tail, else
@@ -716,8 +797,15 @@ class ChromosomeShard:
             s = self.segments[int(si)]
             col = s.obj_dense(column)
             j = int(j)
-            if merge and isinstance(col[j], dict) and isinstance(v, dict):
-                deep_update(col[j], v)
+            cur = col[j]
+            if merge and cur is not None and (
+                    isinstance(cur, (dict, RawJson))
+                    and isinstance(v, (dict, RawJson))):
+                # deep-merge: materialize raw values per row (fresh — a
+                # RawJson may back several rows) before mutating
+                if isinstance(cur, RawJson):
+                    cur = col[j] = cur.fresh()
+                deep_update(cur, v.fresh() if isinstance(v, RawJson) else v)
             else:
                 col[j] = v
             s.dirty = True
@@ -892,14 +980,22 @@ class VariantStore:
             present = [(c, seg.obj[c]) for c in OBJECT_COLUMNS
                        if seg.obj[c] is not None]
             for i in range(seg.n) if present else ():
-                row = {}
+                # rows are assembled by splicing so RawJson values write
+                # their text verbatim (no parse/re-serialize round trip)
+                parts = []
                 for c, col in present:
-                    if col[i] is not None:
-                        row[c] = (list(col[i]) if c == _LONG_ALLELES
-                                  else col[i])
-                if row:
-                    row["i"] = i
-                    f.write(json.dumps(row) + "\n")
+                    v = col[i]
+                    if v is None:
+                        continue
+                    if isinstance(v, RawJson):
+                        parts.append(f'"{c}":{v.text}')
+                    elif c == _LONG_ALLELES:
+                        parts.append(f'"{c}":{json.dumps(list(v))}')
+                    else:
+                        parts.append(f'"{c}":{json.dumps(v)}')
+                if parts:
+                    parts.append(f'"i":{i}')
+                    f.write("{" + ",".join(parts) + "}\n")
             if fsync_data:
                 f.flush()
                 os.fsync(f.fileno())

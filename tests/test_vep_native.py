@@ -1,0 +1,179 @@
+"""Native VEP transformer parity: the C++ fast path must produce the exact
+store the pure-Python path produces — values compared after materializing
+RawJson text back to Python objects."""
+
+import json
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.conseq import ConsequenceRanker
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.loaders.vep_loader import TpuVepLoader
+from annotatedvdb_tpu.native import vep as native_vep
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS, RawJson
+
+pytestmark = pytest.mark.skipif(
+    not native_vep.available(), reason="no C++ toolchain for the native lib"
+)
+
+VCF = """##fileformat=VCFv4.2
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t1000\trs1\tA\tG\t.\t.\tRS=1
+1\t2000\trs2\tCA\tC\t.\t.\tRS=2
+1\t3000\trs3\tT\tTA,TG\t.\t.\tRS=3
+2\t4000\trs4\tG\tC\t.\t.\tRS=4
+2\t5000\trs5\tGT\tG,GTT\t.\t.\tRS=5
+X\t6000\trs6\tA\tT\t.\t.\tRS=6
+"""
+
+DOCS = [
+    # plain SNV: consequences + multi-source frequencies
+    {"input": "1\t1000\trs1\tA\tG", "most_severe_consequence": "missense_variant",
+     "assembly_name": "GRCh38", "strand": 1,
+     "transcript_consequences": [
+         {"consequence_terms": ["missense_variant"], "variant_allele": "G",
+          "impact": "MODERATE", "cadd_phred": 22.5,
+          "domains": [{"db": "Pfam", "name": "PF0001"}]},
+         {"consequence_terms": ["intron_variant"], "variant_allele": "G"},
+         {"consequence_terms": ["missense_variant", "splice_region_variant"],
+          "variant_allele": "G"}],
+     "colocated_variants": [
+         {"id": "rs1", "allele_string": "A/G",
+          "frequencies": {"G": {"gnomad": 0.01, "af": 0.5, "aa": 0.125,
+                                "gnomad_afr": 0.25, "ea": 0.0625}}}]},
+    # deletion: '-'-keyed consequence + frequency
+    {"input": "1\t2000\trs2\tCA\tC", "most_severe_consequence": "intron_variant",
+     "transcript_consequences": [
+         {"consequence_terms": ["intron_variant"], "variant_allele": "-"}],
+     "regulatory_feature_consequences": [
+         {"consequence_terms": ["regulatory_region_variant"],
+          "variant_allele": "-"}],
+     "colocated_variants": [
+         {"id": "rs2", "allele_string": "CA/C",
+          "frequencies": {"-": {"af": 0.25}}}]},
+    # multi-allelic site: per-alt consequence split
+    {"input": "1\t3000\trs3\tT\tTA,TG", "most_severe_consequence": "intron_variant",
+     "transcript_consequences": [
+         {"consequence_terms": ["intron_variant"], "variant_allele": "A"},
+         {"consequence_terms": ["downstream_gene_variant"],
+          "variant_allele": "G"}]},
+    # COSMIC filter + id disambiguation across colocated variants
+    {"input": "2\t4000\trs4\tG\tC", "most_severe_consequence": "intron_variant",
+     "transcript_consequences": [
+         {"consequence_terms": ["intron_variant"], "variant_allele": "C"}],
+     "colocated_variants": [
+         {"id": "COSV1", "allele_string": "COSMIC_MUTATION",
+          "frequencies": {"C": {"af": 0.9}}},
+         {"id": "rsOTHER", "allele_string": "G/C",
+          "frequencies": {"C": {"af": 0.1}}},
+         {"id": "rs4", "allele_string": "G/C",
+          "frequencies": {"C": {"af": 0.2, "gnomad": 0.3}}}]},
+    # multi-alt indels; one alt '.'-skipped in VEP output form
+    {"input": "2\t5000\trs5\tGT\tG,GTT", "most_severe_consequence": "intron_variant",
+     "transcript_consequences": [
+         {"consequence_terms": ["intron_variant"], "variant_allele": "-"},
+         {"consequence_terms": ["downstream_gene_variant"],
+          "variant_allele": "T"}]},
+    # doc with NO consequences for its allele and no frequencies
+    {"input": "X\t6000\trs6\tA\tT", "most_severe_consequence": "intergenic_variant",
+     "intergenic_consequences": [
+         {"consequence_terms": ["intergenic_variant"], "variant_allele": "T"}]},
+    # novel combo -> native fallback -> host learn-on-miss (both paths)
+    {"input": "1\t1000\trs1\tA\tG",
+     "most_severe_consequence": "splice_region_variant",
+     "motif_feature_consequences": [
+         {"consequence_terms": ["splice_region_variant",
+                                "non_coding_transcript_variant"],
+          "variant_allele": "G"}]},
+]
+
+
+def _load(tmp_path, tag, native: bool, monkeypatch):
+    monkeypatch.setenv("AVDB_NATIVE_VEP", "1" if native else "0")
+    work = tmp_path / tag
+    work.mkdir()
+    vcf = work / "t.vcf"
+    vcf.write_text(VCF)
+    vep = work / "t.vep.json"
+    vep.write_text("".join(json.dumps(d) + "\n" for d in DOCS))
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(work / "l.jsonl"))
+    TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(
+        str(vcf), commit=True
+    )
+    loader = TpuVepLoader(
+        store, ledger, ConsequenceRanker(), datasource="dbSNP",
+        log=lambda *a: None,
+    )
+    counters = loader.load_file(str(vep), commit=True)
+    return store, counters
+
+
+def _materialize(v):
+    if isinstance(v, RawJson):
+        return v.fresh()
+    return v
+
+
+def test_native_python_store_parity(tmp_path, monkeypatch):
+    s_py, c_py = _load(tmp_path, "py", native=False, monkeypatch=monkeypatch)
+    s_nat, c_nat = _load(tmp_path, "nat", native=True, monkeypatch=monkeypatch)
+    for k in ("variant", "skipped", "update", "not_found", "line"):
+        assert c_py[k] == c_nat[k], (k, c_py[k], c_nat[k])
+    assert set(s_py.shards) == set(s_nat.shards)
+    for code in s_py.shards:
+        a, b = s_py.shard(code), s_nat.shard(code)
+        a.compact(), b.compact()
+        np.testing.assert_array_equal(a.cols["pos"], b.cols["pos"])
+        for col in JSONB_COLUMNS:
+            av, bv = a.annotations[col], b.annotations[col]
+            for i in range(a.n):
+                assert _materialize(av[i]) == _materialize(bv[i]), (
+                    code, col, i, av[i], bv[i]
+                )
+
+
+def test_native_store_persists_and_reloads(tmp_path, monkeypatch):
+    """RawJson values round-trip through save/load as plain dicts."""
+    store, _ = _load(tmp_path, "persist", native=True, monkeypatch=monkeypatch)
+    out = str(tmp_path / "persist" / "vdb")
+    store.save(out)
+    reloaded = VariantStore.load(out)
+    for code in store.shards:
+        a, b = store.shard(code), reloaded.shard(code)
+        a.compact(), b.compact()
+        for col in JSONB_COLUMNS:
+            av, bv = a.annotations[col], b.annotations[col]
+            for i in range(a.n):
+                assert _materialize(av[i]) == bv[i], (code, col, i)
+
+
+def test_pg_egress_splices_rawjson(tmp_path, monkeypatch):
+    """COPY egress emits identical JSONB text content for both paths."""
+    from annotatedvdb_tpu.io.pg_egress import export_store
+
+    s_py, _ = _load(tmp_path, "epy", native=False, monkeypatch=monkeypatch)
+    s_nat, _ = _load(tmp_path, "enat", native=True, monkeypatch=monkeypatch)
+    d_py = tmp_path / "copy_py"
+    d_nat = tmp_path / "copy_nat"
+    export_store(s_py, str(d_py))
+    export_store(s_nat, str(d_nat))
+    for f in sorted(
+        str(p.relative_to(d_py)) for p in d_py.rglob("*") if p.is_file()
+    ):
+        py_text = (d_py / f).read_text().splitlines()
+        nat_text = (d_nat / f).read_text().splitlines()
+        assert len(py_text) == len(nat_text), f
+        for lp, ln in zip(py_text, nat_text):
+            if lp == ln:
+                continue
+            # JSONB fields may differ in key order/whitespace only:
+            # compare parsed per-field
+            fp, fn = lp.split("\t"), ln.split("\t")
+            assert len(fp) == len(fn), f
+            for vp, vn in zip(fp, fn):
+                if vp == vn:
+                    continue
+                assert json.loads(vp) == json.loads(vn), (f, vp, vn)
