@@ -83,10 +83,19 @@ DOCS = [
     # novel combo -> native fallback -> host learn-on-miss (both paths)
     {"input": "1\t1000\trs1\tA\tG",
      "most_severe_consequence": "splice_region_variant",
+     "custom_key": {"from": "fallback_doc"},
      "motif_feature_consequences": [
          {"consequence_terms": ["splice_region_variant",
                                 "non_coding_transcript_variant"],
           "variant_allele": "G"}]},
+    # a NATIVE doc after the fallback doc, updating the SAME store row with
+    # a conflicting vep_output key: deep-merge 'patch wins' makes the final
+    # value order-sensitive, pinning the interleaved apply order
+    {"input": "1\t1000\trs1\tA\tG",
+     "most_severe_consequence": "intron_variant",
+     "custom_key": {"from": "late_native_doc"},
+     "transcript_consequences": [
+         {"consequence_terms": ["intron_variant"], "variant_allele": "G"}]},
 ]
 
 
