@@ -177,36 +177,63 @@ class TpuVepLoader:
             if pending:
                 self._apply_batch(pending, alg_id, commit)
 
+        def count_native(res, doc_lo, doc_hi, row_lo, row_hi) -> None:
+            # per-applied-range accounting ('.'-alt skips, skipped contigs,
+            # per-alt rows) — rows of docs that are re-transformed after a
+            # mid-flush re-rank must not be counted twice
+            self.counters["variant"] += row_hi - row_lo
+            self.counters["skipped"] += int(
+                res.doc_skipped[doc_lo:doc_hi].sum()
+            ) + int((res.doc_fallback[doc_lo:doc_hi] == 2).sum())
+
         def flush() -> None:
-            if use_native:
-                res = native_vep.transform(
-                    lines, self._ranking_blob(), self.is_dbsnp,
-                    self.store.width,
+            # docs the native parser cannot transform faithfully (novel
+            # combos, escapes, malformed inputs) re-run through the
+            # pure-Python path, INTERLEAVED in document order so same-row
+            # update/merge ordering matches the all-Python path exactly.
+            # A fallback doc that LEARNS a novel combo renumbers the whole
+            # rank table, so the remaining docs re-transform with the fresh
+            # table — exactly the version-mix point the Python path has.
+            start = 0
+            while start < len(lines):
+                sub = lines[start:] if start else lines
+                res = (
+                    native_vep.transform(
+                        sub, self._ranking_blob(), self.is_dbsnp,
+                        self.store.width,
+                    )
+                    if use_native else None
                 )
-            else:
-                res = None
-            if res is None:
-                flush_python(lines)
-            else:
-                self.counters["skipped"] += int(
-                    (res.doc_fallback == 2).sum()
-                ) + res.skipped_alts
-                self.counters["variant"] += res.n_rows
-                # docs the native parser could not transform faithfully
-                # (novel combos, escapes, malformed inputs) re-run through
-                # the pure-Python path, INTERLEAVED in document order so
-                # same-row update/merge ordering matches the all-Python
-                # path exactly
+                if res is None:
+                    flush_python(sub)
+                    break
+                doc_of_row = res.doc_of_row
                 fb_docs = np.where(res.doc_fallback == 1)[0]
-                lo = 0
+                lo_row, lo_doc = 0, 0
+                restart = None
                 for f in fb_docs.tolist():
-                    hi = int(np.searchsorted(res.doc_of_row, f))
-                    if hi > lo:
-                        self._apply_native(res, alg_id, commit, lo, hi)
-                    flush_python([lines[f]])
-                    lo = int(np.searchsorted(res.doc_of_row, f, side="right"))
-                if res.n_rows > lo:
-                    self._apply_native(res, alg_id, commit, lo, res.n_rows)
+                    hi_row = int(np.searchsorted(doc_of_row, f))
+                    count_native(res, lo_doc, f, lo_row, hi_row)
+                    if hi_row > lo_row:
+                        self._apply_native(res, alg_id, commit, lo_row, hi_row)
+                    v0 = self.parser.ranker.version
+                    flush_python([sub[f]])
+                    lo_row = int(
+                        np.searchsorted(doc_of_row, f, side="right")
+                    )
+                    lo_doc = f + 1
+                    if self.parser.ranker.version != v0:
+                        restart = start + f + 1
+                        break
+                if restart is not None:
+                    start = restart
+                    continue
+                count_native(
+                    res, lo_doc, res.doc_fallback.size, lo_row, res.n_rows
+                )
+                if res.n_rows > lo_row:
+                    self._apply_native(res, alg_id, commit, lo_row, res.n_rows)
+                break
             lines.clear()
             self._cadence.maybe_log(self.counters["line"], self.counters)
 

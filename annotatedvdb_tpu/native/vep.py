@@ -72,9 +72,9 @@ def load():
             + [c.c_void_p] * 4           # ref_off/slen, alt_off/slen
             + [c.c_void_p]               # is_multi
             + [c.c_void_p] * 8           # ms/rk/fq/vo off+len
-            + [c.c_int64, c.c_void_p]    # docs_cap, doc_fallback
+            + [c.c_int64, c.c_void_p, c.c_void_p]  # docs_cap, doc_fallback, doc_skipped
             + [c.c_void_p, c.c_int64]    # arena, arena_cap
-            + [c.c_void_p] * 4           # out_rows, out_docs, arena_used, skipped
+            + [c.c_void_p] * 3           # out_rows, out_docs, arena_used
         )
         _lib = lib
         return _lib
@@ -126,9 +126,9 @@ class VepTransform(NamedTuple):
     vo_off: np.ndarray
     vo_len: np.ndarray
     doc_fallback: np.ndarray   # 0 ok, 1 python-path, 2 skipped contig
+    doc_skipped: np.ndarray    # '.'-alt skips per doc (applied docs only)
     arena: bytes
     text: bytes                # the joined input lines (spans reference it)
-    skipped_alts: int
 
 
 def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
@@ -167,11 +167,11 @@ def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
             "vo_len": np.zeros(rows_cap, np.int32),
         }
         doc_fallback = np.zeros(n_docs + 1, np.uint8)
+        doc_skipped = np.zeros(n_docs + 1, np.int32)
         arena = ctypes.create_string_buffer(arena_cap)
         out_rows = c.c_int64(0)
         out_docs = c.c_int64(0)
         arena_used = c.c_int64(0)
-        skipped = c.c_int64(0)
         rc = lib.avdb_vep_transform(
             text, len(text), blob, len(blob),
             1 if is_dbsnp else 0, width, rows_cap,
@@ -185,9 +185,9 @@ def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
             )),
             n_docs + 1,
             doc_fallback.ctypes.data_as(c.c_void_p),
+            doc_skipped.ctypes.data_as(c.c_void_p),
             arena, arena_cap,
             c.byref(out_rows), c.byref(out_docs), c.byref(arena_used),
-            c.byref(skipped),
         )
         if rc == 1:
             rows_cap *= 2
@@ -202,7 +202,7 @@ def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
             n_rows=n,
             **{k: v[:n] for k, v in a.items()},
             doc_fallback=doc_fallback[: out_docs.value],
+            doc_skipped=doc_skipped[: out_docs.value],
             arena=arena.raw[: arena_used.value],
             text=text,
-            skipped_alts=skipped.value,
         )

@@ -565,15 +565,13 @@ int64_t avdb_vep_transform(
     int64_t* rk_off, int32_t* rk_len,
     int64_t* fq_off, int32_t* fq_len,
     int64_t* vo_off, int32_t* vo_len,
-    int64_t docs_cap, uint8_t* doc_fallback,
+    int64_t docs_cap, uint8_t* doc_fallback, int32_t* doc_skipped,
     char* arena_buf, int64_t arena_cap,
-    int64_t* out_rows, int64_t* out_docs, int64_t* arena_used,
-    int64_t* skipped_alts) {
+    int64_t* out_rows, int64_t* out_docs, int64_t* arena_used) {
     RankTable table = parse_table(table_blob, table_len);
     Arena arena{arena_buf, arena_cap};
     int64_t rows = 0;
     int64_t docs = 0;
-    int64_t skipped = 0;
     int64_t li = 0;
 
     while (li < n_bytes) {
@@ -593,9 +591,9 @@ int64_t avdb_vep_transform(
         if (docs >= docs_cap) return 1;
         int64_t doc_idx = docs++;
         doc_fallback[doc_idx] = 0;
+        doc_skipped[doc_idx] = 0;
         int64_t row_mark = rows;
         int64_t arena_mark = arena.mark();
-        int64_t skip_mark = skipped;
 
         Cur c{text, li, le};
         Doc d;
@@ -746,7 +744,7 @@ int64_t avdb_vep_transform(
             while (y < aend && text[y] != ',') ++y;
             int32_t alen_s = static_cast<int32_t>(y - x);
             if (alen_s == 1 && text[x] == '.') {
-                ++skipped;
+                ++doc_skipped[doc_idx];
                 x = y + 1;
                 if (y >= aend) break;
                 continue;
@@ -876,7 +874,6 @@ int64_t avdb_vep_transform(
             // contributions (the Python re-run counts them afresh)
             rows = row_mark;
             arena.used = arena_mark;
-            skipped = skip_mark;
         }
         if (arena.overflow) return 2;
         li = le + 1;
@@ -884,7 +881,6 @@ int64_t avdb_vep_transform(
     *out_rows = rows;
     *out_docs = docs;
     *arena_used = arena.used;
-    *skipped_alts = skipped;
     return 0;
 }
 

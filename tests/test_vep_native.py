@@ -186,3 +186,122 @@ def test_pg_egress_splices_rawjson(tmp_path, monkeypatch):
                 if vp == vn:
                     continue
                 assert json.loads(vp) == json.loads(vn), (f, vp, vn)
+
+def test_fuzz_parity(tmp_path, monkeypatch):
+    """Seeded random docs — odd keys, unicode, escapes, numbers in exotic
+    formats, missing blocks — through both paths; stores must match (docs
+    the native parser rejects fall back, which is also parity)."""
+    import random
+
+    rng = random.Random(20260730)
+    terms_pool = ["missense_variant", "intron_variant", "stop_gained",
+                  "synonymous_variant", "downstream_gene_variant",
+                  "3_prime_UTR_variant", "NMD_transcript_variant"]
+    bases = "ACGT"
+
+    def rand_value(depth=0):
+        r = rng.random()
+        if depth > 2 or r < 0.3:
+            return rng.choice([
+                1, -2.5, 1e-7, 0.30000000000000004, True, False, None,
+                "plain", "esc\taped", "uniécode", "q\"uote", 12345678901234,
+            ])
+        if r < 0.6:
+            return {rng.choice(["a", "b", "weird key", "x\ty"]):
+                    rand_value(depth + 1) for _ in range(rng.randint(0, 3))}
+        return [rand_value(depth + 1) for _ in range(rng.randint(0, 3))]
+
+    docs, vcf_rows = [], []
+    for i in range(200):
+        pos = 1000 + i * 10
+        ref = rng.choice(bases) if rng.random() < 0.7 else "".join(
+            rng.choice(bases) for _ in range(rng.randint(2, 5)))
+        n_alts = rng.randint(1, 3)
+        alts = []
+        for _ in range(n_alts):
+            a = rng.choice(bases) if rng.random() < 0.7 else "".join(
+                rng.choice(bases) for _ in range(rng.randint(2, 5)))
+            alts.append(a)
+        alt_col = ",".join(alts)
+        vcf_rows.append(f"1\t{pos}\trs{i}\t{ref}\t{alt_col}\t.\t.\t.")
+        doc = {"input": f"1\t{pos}\trs{i}\t{ref}\t{alt_col}",
+               "most_severe_consequence": rng.choice(terms_pool)}
+        for ctype in ("transcript", "regulatory_feature", "motif_feature",
+                      "intergenic"):
+            if rng.random() < 0.6:
+                conseqs = []
+                for _ in range(rng.randint(0, 3)):
+                    alt0 = rng.choice(alts)
+                    p = 0
+                    while p < min(len(ref), len(alt0)) and ref[p] == alt0[p]:
+                        p += 1
+                    norm = alt0[p:] or "-"
+                    conseqs.append({
+                        "consequence_terms": sorted(
+                            {rng.choice(terms_pool)
+                             for _ in range(rng.randint(1, 2))}),
+                        "variant_allele": rng.choice([norm, alt0, "Z"]),
+                        "extra": rand_value(),
+                    })
+                doc[ctype + "_consequences"] = conseqs
+        if rng.random() < 0.5:
+            covars = []
+            for _ in range(rng.randint(1, 3)):
+                cv = {"id": rng.choice([f"rs{i}", "rsX", "COSV9"]),
+                      "allele_string": rng.choice(
+                          [f"{ref}/{alts[0]}", "COSMIC_MUTATION"])}
+                if rng.random() < 0.8:
+                    alt0 = rng.choice(alts)
+                    p = 0
+                    while p < min(len(ref), len(alt0)) and ref[p] == alt0[p]:
+                        p += 1
+                    norm = alt0[p:] or "-"
+                    cv["frequencies"] = {
+                        rng.choice([norm, "T"]): {
+                            rng.choice(["af", "aa", "gnomad", "gnomad_afr",
+                                        "eas"]): rng.random()
+                            for _ in range(rng.randint(1, 3))
+                        }
+                    }
+                covars.append(cv)
+            doc["colocated_variants"] = covars
+        if rng.random() < 0.4:
+            doc["junk_" + str(i)] = rand_value()
+        docs.append(doc)
+
+    vcf_text = ("##fileformat=VCFv4.2\n"
+                "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+                + "\n".join(vcf_rows) + "\n")
+    vep_text = "".join(json.dumps(d) + "\n" for d in docs)
+
+    stores = {}
+    for tag, native in (("py", False), ("nat", True)):
+        monkeypatch.setenv("AVDB_NATIVE_VEP", "1" if native else "0")
+        work = tmp_path / ("fuzz_" + tag)
+        work.mkdir()
+        (work / "t.vcf").write_text(vcf_text)
+        (work / "t.vep.json").write_text(vep_text)
+        store = VariantStore(width=16)
+        ledger = AlgorithmLedger(str(work / "l.jsonl"))
+        TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(
+            str(work / "t.vcf"), commit=True
+        )
+        loader = TpuVepLoader(
+            store, ledger, ConsequenceRanker(), datasource="dbSNP",
+            log=lambda *a: None, batch_size=64,  # multiple flushes
+        )
+        stores[tag] = (store, loader.load_file(str(work / "t.vep.json"),
+                                               commit=True))
+    s_py, c_py = stores["py"]
+    s_nat, c_nat = stores["nat"]
+    for k in ("variant", "skipped", "update", "not_found"):
+        assert c_py[k] == c_nat[k], (k, c_py[k], c_nat[k])
+    for code in s_py.shards:
+        a, b = s_py.shard(code), s_nat.shard(code)
+        a.compact(), b.compact()
+        for col in JSONB_COLUMNS:
+            av, bv = a.annotations[col], b.annotations[col]
+            for i in range(a.n):
+                assert _materialize(av[i]) == _materialize(bv[i]), (
+                    code, col, i, av[i], bv[i]
+                )
