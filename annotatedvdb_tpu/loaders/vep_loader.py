@@ -195,6 +195,7 @@ class TpuVepLoader:
             # rank table, so the remaining docs re-transform with the fresh
             # table — exactly the version-mix point the Python path has.
             start = 0
+            restarts = 0
             while start < len(lines):
                 sub = lines[start:] if start else lines
                 res = (
@@ -202,7 +203,11 @@ class TpuVepLoader:
                         sub, self._ranking_blob(), self.is_dbsnp,
                         self.store.width,
                     )
-                    if use_native else None
+                    # novel-combo-dense input (first load against a stale
+                    # table) would otherwise re-transform the tail once per
+                    # learned combo; past a few restarts the Python path is
+                    # cheaper AND exact by definition
+                    if use_native and restarts < 4 else None
                 )
                 if res is None:
                     flush_python(sub)
@@ -227,6 +232,7 @@ class TpuVepLoader:
                         break
                 if restart is not None:
                     start = restart
+                    restarts += 1
                     continue
                 count_native(
                     res, lo_doc, res.doc_fallback.size, lo_row, res.n_rows

@@ -874,6 +874,7 @@ int64_t avdb_vep_transform(
             // contributions (the Python re-run counts them afresh)
             rows = row_mark;
             arena.used = arena_mark;
+            doc_skipped[doc_idx] = 0;
         }
         if (arena.overflow) return 2;
         li = le + 1;

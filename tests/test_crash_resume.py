@@ -25,7 +25,8 @@ pytestmark = pytest.mark.skipif(
            "persistent compile cache): set AVDB_CRASH_TEST=1",
 )
 
-N_ROWS = 24_000
+N_ROWS = 200_000  # large enough that a cache-warm victim is still mid-load
+                  # when the kill lands at its first durable checkpoint
 
 
 def _write_vcf(path):
@@ -73,12 +74,14 @@ def test_sigkill_mid_load_then_resume(tmp_path):
         if p.poll() is not None:
             break  # finished before we could kill it — still a valid run
         if os.path.exists(manifest):
-            time.sleep(0.3)  # let it get partway into later batches
+            # kill IMMEDIATELY at the first durable checkpoint: with the
+            # shared compile cache the victim loads at full speed, so any
+            # fixed grace period risks letting it finish
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
                 killed = True
             break
-        time.sleep(0.05)
+        time.sleep(0.02)
     p.wait(timeout=60)
     if not killed:
         # the victim finishing on its own is fine — but only cleanly; a
