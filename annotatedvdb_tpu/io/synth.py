@@ -70,3 +70,65 @@ def batch_chunk(batch: VariantBatch, line_start: int = 1):
         rs_weird=np.zeros(n, np.bool_),
         has_freq=np.zeros(n, np.bool_),
     )
+
+
+def synthetic_cadd_setup(cadd_dir: str, n_variants: int, table_positions: int,
+                         seed: int = 7, width: int = 16):
+    """One chr1 store of SNVs plus a matching gzipped CADD SNV table (3 alt
+    rows per position) — shared by the CADD throughput gate and bench leg so
+    the bench always measures exactly what the gate pins.
+
+    Returns ``(store, expected_matches)``: matching is by unordered allele
+    set (the reference's allele-set compare, ``cadd_updater.py:200-217``),
+    and the table at each position carries (base, x) for every x != base —
+    so a variant matches iff the position's cycling base is one of its two
+    alleles."""
+    import gzip
+    import os
+    import random
+
+    from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+    from annotatedvdb_tpu.store import VariantStore
+
+    rng = random.Random(seed)
+    store = VariantStore(width=width)
+    sh = store.shard(1)
+    pos = np.sort(np.array(
+        rng.sample(range(10_000, 10_000 + table_positions), n_variants),
+        np.int32,
+    ))
+    ref = np.zeros((n_variants, width), np.uint8)
+    alt = np.zeros((n_variants, width), np.uint8)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    ri = np.array([rng.randrange(4) for _ in range(n_variants)])
+    off = np.array([rng.randrange(1, 4) for _ in range(n_variants)])
+    rr = bases[ri]
+    aa = bases[(ri + off) % 4]  # always a REAL base distinct from ref
+    ref[:, 0] = rr
+    alt[:, 0] = aa
+    ones = np.ones(n_variants, np.int32)
+    h = np.asarray(allele_hash_jit(ref, alt, ones, ones))
+    sh.append({"pos": pos, "h": h, "ref_len": ones, "alt_len": ones},
+              ref, alt)
+
+    os.makedirs(cadd_dir, exist_ok=True)
+    with gzip.open(os.path.join(cadd_dir, "whole_genome_SNVs.tsv.gz"),
+                   "wt", compresslevel=1) as f:
+        f.write("## CADD\n#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED\n")
+        lines = []
+        for p in range(10_000, 10_000 + table_positions):
+            b = "ACGT"[p % 4]
+            for a in "ACGT":
+                if a != b:
+                    lines.append(f"1\t{p}\t{b}\t{a}\t0.5\t10.0")
+            if len(lines) > 200_000:
+                f.write("\n".join(lines) + "\n")
+                lines = []
+        if lines:
+            f.write("\n".join(lines) + "\n")
+    with gzip.open(os.path.join(cadd_dir, "gnomad.genomes.r3.0.indel.tsv.gz"),
+                   "wt") as f:
+        f.write("## CADD\n#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED\n")
+    table_base = bases[pos % 4]
+    expected = int(((rr == table_base) | (aa == table_base)).sum())
+    return store, expected

@@ -57,6 +57,8 @@ class SnpEffLofStrategy(UpdateStrategy):
     def __init__(self, update_existing: bool = False):
         self.update_existing = update_existing
 
+    jsonb_columns = ("loss_of_function",)
+
     def values(self, row: dict, existing: dict | None):
         info = row["info"]
         lof = parse_lof_string(info.get("LOF"))

@@ -23,6 +23,7 @@ class QcPvcfStrategy(UpdateStrategy):
     (``update_from_qc_pvcf_file.py:117-149``)."""
 
     insert_novel = True
+    jsonb_columns = ("adsp_qc",)
 
     def __init__(self, version: str, update_existing: bool = False):
         # one canonical release key: the reference writes the datasource tag

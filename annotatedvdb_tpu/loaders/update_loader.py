@@ -45,6 +45,10 @@ class UpdateStrategy:
     #: (annotation JSONB columns are always included)
     numeric_columns: tuple = ()
 
+    #: JSONB columns the strategy reads from ``existing``; None = all ten.
+    #: Narrow this — each column is one per-row store fetch on the hot loop
+    jsonb_columns: tuple | None = None
+
     def values(self, row: dict, existing: dict | None):
         raise NotImplementedError
 
@@ -147,16 +151,31 @@ class TpuUpdateLoader:
 
     def _apply_chunk(self, chunk: VcfChunk, alg_id: int, commit: bool) -> None:
         novel: list[int] = []
+        ann_cols = (
+            JSONB_COLUMNS if self.strategy.jsonb_columns is None
+            else self.strategy.jsonb_columns
+        )
         for code, shard, sel, found, idx in chunk_lookup(self.store, chunk):
+            # store writes buffer per chunk and land as ONE vectorized call
+            # per column (the reference likewise buffers jsonb_merge UPDATEs
+            # and flushes with execute_values, variant_loader.py:457-476) —
+            # per-row single-element update/set calls dominated this loop.
+            # Within-chunk duplicate variants therefore see the PRE-chunk
+            # stored state (exactly the reference's accumulate-lookups-then-
+            # process behavior, update_from_qc_pvcf_file.py:371-372): both
+            # occurrences count as updates and their values merge in order
+            upd_ids: dict[str, list[int]] = {}
+            upd_vals: dict[str, list] = {}
+            flag_ids: dict[str, list[int]] = {}
+            flag_vals: dict[str, list[int]] = {}
+            touched: list[int] = []
             for j, i in enumerate(sel):
                 self.counters["variant"] += 1
                 if not found[j]:
                     novel.append(int(i))
                     continue
                 row_idx = int(idx[j])
-                existing = {
-                    c: shard.get_ann(c, row_idx) for c in JSONB_COLUMNS
-                }
+                existing = {c: shard.get_ann(c, row_idx) for c in ann_cols}
                 for c in self.strategy.numeric_columns:
                     existing[c] = int(shard.get_col(c, [row_idx])[0])
                 do_update, flags, jsonb = self.strategy.values(
@@ -168,12 +187,26 @@ class TpuUpdateLoader:
                 self.counters["update"] += 1
                 if not commit:
                     continue
-                one = np.array([row_idx])
+                touched.append(row_idx)
                 for col, value in jsonb.items():
-                    shard.update_annotation(one, col, [value])
+                    upd_ids.setdefault(col, []).append(row_idx)
+                    upd_vals.setdefault(col, []).append(value)
                 for col, value in flags.items():
-                    shard.set_col(col, one, value)
-                shard.set_col("row_algorithm_id", one, alg_id)
+                    flag_ids.setdefault(col, []).append(row_idx)
+                    flag_vals.setdefault(col, []).append(value)
+            for col, ids in upd_ids.items():
+                shard.update_annotation(
+                    np.asarray(ids, np.int64), col, upd_vals[col]
+                )
+            for col, ids in flag_ids.items():
+                shard.set_col(
+                    col, np.asarray(ids, np.int64),
+                    np.asarray(flag_vals[col]),
+                )
+            if touched:
+                shard.set_col(
+                    "row_algorithm_id", np.asarray(touched, np.int64), alg_id
+                )
 
         if novel and self.strategy.insert_novel:
             self._insert_novel(chunk, novel, alg_id, commit)

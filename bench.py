@@ -216,6 +216,83 @@ def bench_end_to_end():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_cadd_join(n_variants: int = 100_000, table_positions: int = 300_000):
+    """BASELINE measurement config #3 (CADD whole-genome SNV join): stream
+    a scored-SNV table once and join against the store's device-shaped
+    columns — the reference equivalent is a server-side cursor with one
+    tabix fetch per variant (``load_cadd_scores.py:98-141``)."""
+    from annotatedvdb_tpu.io.synth import synthetic_cadd_setup
+    from annotatedvdb_tpu.loaders.cadd_loader import TpuCaddUpdater
+    from annotatedvdb_tpu.store import AlgorithmLedger
+
+    work = tempfile.mkdtemp(prefix="avdb_cadd_")
+    try:
+        cadd_dir = os.path.join(work, "cadd")
+        store, _expected = synthetic_cadd_setup(
+            cadd_dir, n_variants, table_positions
+        )
+        up = TpuCaddUpdater(
+            store, AlgorithmLedger(os.path.join(work, "l.jsonl")), cadd_dir,
+            log=lambda *a: None,
+        )
+        t0 = time.perf_counter()
+        counters = up.update_all(commit=True)
+        dt = time.perf_counter() - t0
+        n_rows = 3 * table_positions
+        return {
+            "table_rows_per_sec": round(n_rows / dt, 1),
+            "matched": counters["snv"],
+            "variants": n_variants,
+            "seconds": round(dt, 2),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def bench_qc_update(n_rows: int = 100_000):
+    """BASELINE measurement config #4 shape (ADSP QC pVCF batch
+    annotation): stream a QC pVCF against a loaded store, writing
+    ``adsp_qc`` JSONB + the ``is_adsp_variant`` flag
+    (``update_from_qc_pvcf_file.py`` semantics)."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.loaders.qc_loader import TpuQcPvcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+    from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
+
+    work = tempfile.mkdtemp(prefix="avdb_qc_")
+    try:
+        vcf = os.path.join(work, "base.vcf")
+        write_synth_vcf(vcf, n_rows)
+        store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
+        ledger = AlgorithmLedger(os.path.join(work, "l.jsonl"))
+        TpuVcfLoader(store, ledger, batch_size=1 << 16,
+                     log=lambda *a: None).load_file(vcf, commit=True)
+        qc = os.path.join(work, "qc.vcf")
+        with open(vcf) as src, open(qc, "w", buffering=1 << 20) as out:
+            out.write("##fileformat=VCFv4.2\n"
+                      "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\n")
+            k = 0
+            for line in src:
+                if line.startswith("#"):
+                    continue
+                chrom, pos, vid, ref, alt = line.split("\t")[:5]
+                flt = "PASS" if k % 3 else "LowQual"
+                out.write(f"{chrom}\t{pos}\t{vid}\t{ref}\t{alt}\t50\t{flt}"
+                          f"\tABHet=0.5;AC={k % 7}\tGT:DP\n")
+                k += 1
+        loader = TpuQcPvcfLoader(store, ledger, "r4", log=lambda *a: None)
+        t0 = time.perf_counter()
+        counters = loader.load_file(qc, commit=True)
+        dt = time.perf_counter() - t0
+        return {
+            "rows_per_sec": round(k / dt, 1),
+            "updated": counters["update"],
+            "seconds": round(dt, 2),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_multichip_virtual(n_devices: int = 8):
     """Mesh insert-step timing on a VIRTUAL n-device CPU mesh — a labeled
     scaling datapoint (reshard + annotate + dedup + membership as one mesh
@@ -313,6 +390,8 @@ def main():
 
     kernel_vps, kernel_kind = bench_kernel()
     e2e = bench_end_to_end()
+    cadd = bench_cadd_join()
+    qc = bench_qc_update()
     multichip = bench_multichip_virtual()
 
     print(
@@ -335,6 +414,8 @@ def main():
                     else {"skipped": "explicit platform pin"}
                 ),
                 "end_to_end": e2e,
+                "cadd_join": cadd,
+                "qc_update": qc,
                 "multichip_virtual": multichip,
             }
         )

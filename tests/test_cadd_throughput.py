@@ -7,17 +7,13 @@ fetch (``load_cadd_scores.py:98-141``); this pass streams the scored table
 once and joins on device-shaped columns.
 """
 
-import gzip
 import os
-import random
 import time
 
-import numpy as np
 import pytest
 
 from annotatedvdb_tpu.loaders.cadd_loader import TpuCaddUpdater
-from annotatedvdb_tpu.ops.hashing import allele_hash_jit
-from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.store import AlgorithmLedger
 
 pytestmark = pytest.mark.skipif(
     not os.environ.get("AVDB_SCALE_TEST"),
@@ -29,46 +25,14 @@ TABLE_POSITIONS = 500_000  # x3 alt rows = 1.5M table rows
 
 
 def test_cadd_sequential_join_throughput(tmp_path):
-    rng = random.Random(7)
-    store = VariantStore(width=16)
-    sh = store.shard(1)
-    pos = np.sort(np.array(
-        rng.sample(range(10_000, 10_000 + TABLE_POSITIONS), N_VARIANTS),
-        np.int32,
-    ))
-    ref = np.zeros((N_VARIANTS, 16), np.uint8)
-    alt = np.zeros((N_VARIANTS, 16), np.uint8)
-    bases = np.frombuffer(b"ACGT", np.uint8)
-    ri = np.array([rng.randrange(4) for _ in range(N_VARIANTS)])
-    off = np.array([rng.randrange(1, 4) for _ in range(N_VARIANTS)])
-    rr = bases[ri]
-    aa = bases[(ri + off) % 4]  # always a REAL base distinct from ref
-    ref[:, 0] = rr
-    alt[:, 0] = aa
-    ones = np.ones(N_VARIANTS, np.int32)
-    h = np.asarray(allele_hash_jit(ref, alt, ones, ones))
-    sh.append({"pos": pos, "h": h, "ref_len": ones, "alt_len": ones},
-              ref, alt)
+    from annotatedvdb_tpu.io.synth import synthetic_cadd_setup
 
     cadd_dir = str(tmp_path / "cadd")
-    os.makedirs(cadd_dir)
-    with gzip.open(os.path.join(cadd_dir, "whole_genome_SNVs.tsv.gz"),
-                   "wt", compresslevel=1) as f:
-        f.write("## CADD\n#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED\n")
-        lines = []
-        for p in range(10_000, 10_000 + TABLE_POSITIONS):
-            b = "ACGT"[p % 4]
-            for a in "ACGT":
-                if a != b:
-                    lines.append(f"1\t{p}\t{b}\t{a}\t0.5\t10.0")
-            if len(lines) > 200_000:
-                f.write("\n".join(lines) + "\n")
-                lines = []
-        if lines:
-            f.write("\n".join(lines) + "\n")
-    with gzip.open(os.path.join(cadd_dir, "gnomad.genomes.r3.0.indel.tsv.gz"),
-                   "wt") as f:
-        f.write("## CADD\n#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED\n")
+    # shared fixture builder: the bench's cadd_join leg uses the SAME
+    # setup, so the bench always measures exactly what this gate pins
+    store, expected = synthetic_cadd_setup(
+        cadd_dir, N_VARIANTS, TABLE_POSITIONS
+    )
 
     up = TpuCaddUpdater(store, AlgorithmLedger(str(tmp_path / "l.jsonl")),
                         cadd_dir, log=lambda *a: None)
@@ -77,13 +41,6 @@ def test_cadd_sequential_join_throughput(tmp_path):
     dt = time.perf_counter() - t0
     n_rows = 3 * TABLE_POSITIONS
     rate = n_rows / dt
-    # exact match accounting: matching is by unordered allele set (the
-    # reference's allele-set compare, cadd_updater.py:200-217), and the
-    # table at each position carries (base, x) for every x != base — so a
-    # variant matches iff the position's cycling base is one of its two
-    # alleles
-    table_base = np.frombuffer(b"ACGT", np.uint8)[pos % 4]
-    expected = int(((rr == table_base) | (aa == table_base)).sum())
     assert counters["snv"] == expected
     assert counters["snv"] + counters["not_matched"] == N_VARIANTS
     assert rate > 50_000, f"CADD join regressed to {rate:,.0f} rows/s"

@@ -21,8 +21,8 @@ from annotatedvdb_tpu.store import VariantStore
 
 pytestmark = pytest.mark.skipif(
     not os.environ.get("AVDB_CRASH_TEST"),
-    reason="three CLI subprocess loads (budgeted <240s on CPU via a shared "
-           "persistent compile cache): set AVDB_CRASH_TEST=1",
+    reason="three CLI subprocess loads over 200k rows (~15-30s on CPU with "
+           "the shared persistent compile cache): set AVDB_CRASH_TEST=1",
 )
 
 N_ROWS = 200_000  # large enough that a cache-warm victim is still mid-load
