@@ -23,7 +23,6 @@ import numpy as np
 
 from annotatedvdb_tpu.loaders.cadd_loader import TpuCaddUpdater
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
-from annotatedvdb_tpu.types import chromosome_code
 
 # chromosome set shorthands from the reference drivers
 # (load_vep_result.py:306-309)

@@ -17,7 +17,6 @@ them.
 
 from __future__ import annotations
 
-import os
 import struct
 import zlib
 

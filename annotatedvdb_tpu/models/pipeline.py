@@ -9,7 +9,6 @@ few HBM-bandwidth-bound loops; there is no data-dependent control flow.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
