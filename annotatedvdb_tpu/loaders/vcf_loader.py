@@ -784,7 +784,19 @@ class TpuVcfLoader:
         with self.timer.stage("gather", items=int(sum(r.size for r in insert_rows))):
             sel = np.concatenate(insert_rows)
             sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
-            sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
+            if not self.store_display_attributes:
+                # slim annotations: only 4 of the 12 fields carry data
+                # (_slim_annotated zero-fills the display fields) — gather
+                # those, rebuild the zeros at the new size
+                sub_ann = _slim_annotated(
+                    sel.size,
+                    np.asarray(ann.bin_level)[sel],
+                    np.asarray(ann.leaf_bin)[sel],
+                    np.asarray(ann.needs_digest)[sel],
+                    np.asarray(ann.host_fallback)[sel],
+                )
+            else:
+                sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
             over = (
                 (sub.ref_len > self.store.width)
                 | (sub.alt_len > self.store.width)

@@ -393,11 +393,12 @@ def main():
     import jax
 
     try:
+        # the accelerator-dependent legs only: the virtual-mesh leg below
+        # is CPU-side and must not throw away completed device results
         kernel_vps, kernel_kind = bench_kernel()
         e2e = bench_end_to_end()
         cadd = bench_cadd_join()
         qc = bench_qc_update()
-        multichip = bench_multichip_virtual()
     except Exception as exc:
         # an accelerator that probed healthy can still die MID-BENCH (the
         # round-1 record was exactly this: rc=1, no number).  The backend
@@ -407,7 +408,13 @@ def main():
         if platform == "cpu":
             raise  # CPU run failed: a real bug, surface it
         import sys
+        import traceback
 
+        # the execv below replaces this process: the traceback must reach
+        # stderr NOW or the failure is undiagnosable from the record
+        traceback.print_exc()
+        sys.stdout.flush()
+        sys.stderr.flush()
         os.environ["AVDB_JAX_PLATFORM"] = "cpu"
         os.environ.pop("AVDB_JAX_PLATFORM_SOURCE", None)  # explicit pin
         os.environ["AVDB_BENCH_RETRY_REASON"] = (
@@ -415,6 +422,10 @@ def main():
             f"{type(exc).__name__}: {exc}"[:500]
         )
         os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+    try:
+        multichip = bench_multichip_virtual()
+    except Exception as exc:  # a failed CPU-side projection leg never
+        multichip = {"error": f"{type(exc).__name__}: {exc}"[:300]}  # aborts the record
 
     print(
         json.dumps(
