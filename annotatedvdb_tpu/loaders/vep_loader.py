@@ -41,6 +41,13 @@ from annotatedvdb_tpu.types import VariantBatch, chromosome_code
 R_CODE, R_POS, R_REF, R_ALT, R_ANN, R_FREQ, R_CLEANED, R_SHARED = range(8)
 
 
+def _pyfast():
+    """The C column-assembly binding, or None (pure-Python fallback)."""
+    from annotatedvdb_tpu.native import pyfast
+
+    return pyfast if pyfast.available() else None
+
+
 def _np_scalar(obj):
     """json.dumps ``default`` hook: numpy scalars (a future rank field that
     skips prefetch_ranks' int()/bool() coercion) degrade to their Python
@@ -415,30 +422,47 @@ class TpuVepLoader:
             counters["update"] += int(rows_i.size)
             if not commit or rows_i.size == 0:
                 continue
-            # bulk assembly (C-level zips; the per-row Python loop was the
-            # path's bottleneck once parsing went native)
+            # bulk assembly: the C extension builds each column's wrapper
+            # list in one call (consecutive shared spans — a doc's
+            # vep_output across its alts — collapse to one instance);
+            # fallback is the same assembly as a Python comprehension
             fmask = fq_len[rows_i] > 0
             fq_rows = rows_i[fmask]
-            upd_freq = [
-                raw(o, l)
-                for o, l in zip(fq_off[fq_rows].tolist(),
-                                fq_len[fq_rows].tolist())
-            ]
-            upd_ms = [
-                raw(o, l)
-                for o, l in zip(ms_off[rows_i].tolist(),
-                                ms_len[rows_i].tolist())
-            ]
-            upd_ranked = [
-                raw(o, l)
-                for o, l in zip(rk_off[rows_i].tolist(),
-                                rk_len[rows_i].tolist())
-            ]
-            upd_vep = [
-                raw(o, l)
-                for o, l in zip(vo_off[rows_i].tolist(),
-                                vo_len[rows_i].tolist())
-            ]
+            pf = _pyfast() if arena_s is not None else None
+            if pf is not None:
+                upd_freq = pf.raw_rows(
+                    arena_s, fq_off[fq_rows], fq_len[fq_rows], RawJson
+                )
+                upd_ms = pf.raw_rows(
+                    arena_s, ms_off[rows_i], ms_len[rows_i], RawJson
+                )
+                upd_ranked = pf.raw_rows(
+                    arena_s, rk_off[rows_i], rk_len[rows_i], RawJson
+                )
+                upd_vep = pf.raw_rows(
+                    arena_s, vo_off[rows_i], vo_len[rows_i], RawJson
+                )
+            else:
+                upd_freq = [
+                    raw(o, l)
+                    for o, l in zip(fq_off[fq_rows].tolist(),
+                                    fq_len[fq_rows].tolist())
+                ]
+                upd_ms = [
+                    raw(o, l)
+                    for o, l in zip(ms_off[rows_i].tolist(),
+                                    ms_len[rows_i].tolist())
+                ]
+                upd_ranked = [
+                    raw(o, l)
+                    for o, l in zip(rk_off[rows_i].tolist(),
+                                    rk_len[rows_i].tolist())
+                ]
+                upd_vep = [
+                    raw(o, l)
+                    for o, l in zip(vo_off[rows_i].tolist(),
+                                    vo_len[rows_i].tolist())
+                ]
             ids = np.asarray(ids, np.int64)
             if fq_rows.size:
                 shard.update_annotation(

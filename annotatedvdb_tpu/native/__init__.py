@@ -26,20 +26,37 @@ _lib = None
 _lib_error: str | None = None
 
 
-def _build() -> str:
-    with open(_SOURCE, "rb") as f:
+def build_shared_lib(source: str, stem: str, extra_flags: tuple = ()) -> str:
+    """Content-hashed lazy g++ build shared by every native component
+    (the VCF tokenizer, the VEP transformer, the pyfast extension): a
+    source change triggers a rebuild, stale binaries are never loaded,
+    and the tmp+rename publish is atomic under concurrent builders.
+    Compiler stderr is preserved in the raised error on failure."""
+    with open(source, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    so_path = os.path.join(_CACHE_DIR, f"avdb_native-{digest}.so")
+    so_path = os.path.join(_CACHE_DIR, f"{stem}-{digest}.so")
     if os.path.exists(so_path):
         return so_path
     os.makedirs(_CACHE_DIR, exist_ok=True)
     tmp = so_path + f".tmp{os.getpid()}"
-    subprocess.run(
-        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SOURCE],
-        check=True, capture_output=True,
-    )
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             *extra_flags, "-o", tmp, source],
+            check=True, capture_output=True, text=True,
+        )
+    except subprocess.CalledProcessError as err:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise RuntimeError(
+            f"native build of {stem} failed:\n{err.stderr[-2000:]}"
+        ) from err
     os.replace(tmp, so_path)  # atomic under concurrent builders
     return so_path
+
+
+def _build() -> str:
+    return build_shared_lib(_SOURCE, "avdb_native")
 
 
 def load():
@@ -52,7 +69,8 @@ def load():
             return _lib
         try:
             lib = ctypes.CDLL(_build())
-        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as err:
+        except (OSError, RuntimeError, subprocess.CalledProcessError,
+                FileNotFoundError) as err:
             _lib_error = str(err)
             return None
         c = ctypes

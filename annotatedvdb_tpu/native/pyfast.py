@@ -1,0 +1,103 @@
+"""Loader for the ``avdb_pyfast`` CPython extension
+(``native/avdb_pyfast.cpp``): C assembly of RawJson column lists for the
+native VEP apply path.
+
+Unlike the ctypes libraries, this is a real extension module (it creates
+Python objects), imported from a content-hashed build via
+``importlib.machinery.ExtensionFileLoader``.  A load-time probe verifies
+the slot-offset construction produces working RawJson instances; any
+failure (no compiler, ABI surprise) latches unavailable and callers keep
+the pure-Python assembly loop.  Callers go through :func:`raw_rows`, which
+validates buffer dtypes before handing them to C.
+"""
+
+from __future__ import annotations
+
+import os
+import sysconfig
+import threading
+
+import numpy as np
+
+_SOURCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "avdb_pyfast.cpp",
+)
+
+_lock = threading.Lock()
+_mod = None
+_error: str | None = None
+
+
+def _probe(mod) -> None:
+    """The slot-offset construction must yield REAL RawJson behavior:
+    text round trip, lazy parse, consecutive-span sharing, empty->dict.
+    Explicit raises (not asserts): this is the safety gate that keeps a
+    broken ABI assumption from writing corrupt values into stores, and it
+    must survive ``python -O``."""
+    from annotatedvdb_tpu.store.variant_store import RawJson
+
+    arena = '{"a": 1}{"b": [2, 3]}'
+    offs = np.array([0, 8, 8, 0], np.int64)
+    lens = np.array([8, 13, 13, 0], np.int32)
+    out = mod.raw_rows(arena, offs, lens, RawJson)
+    checks = (
+        (isinstance(out[0], RawJson), "row 0 not a RawJson"),
+        (out[0].text == '{"a": 1}', "text slot wrong"),
+        (out[0]["a"] == 1, "lazy parse broken"),
+        (out[1] is out[2], "consecutive span not shared"),
+        (out[1]["b"] == [2, 3], "shared span content wrong"),
+        (out[3] == {} and isinstance(out[3], dict), "empty span not a dict"),
+        (out[0].fresh() == {"a": 1}, "fresh() broken"),
+    )
+    for ok, what in checks:
+        if not ok:
+            raise RuntimeError(f"avdb_pyfast probe failed: {what}")
+
+
+def load():
+    """The extension module, building on first use; None when unavailable."""
+    global _mod, _error
+    if _mod is not None or _error is not None:
+        return _mod
+    with _lock:
+        if _mod is not None or _error is not None:
+            return _mod
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            from annotatedvdb_tpu.native import build_shared_lib
+
+            so = build_shared_lib(
+                _SOURCE, "avdb_pyfast",
+                (f"-I{sysconfig.get_paths()['include']}",),
+            )
+            loader = importlib.machinery.ExtensionFileLoader("avdb_pyfast", so)
+            spec = importlib.util.spec_from_loader("avdb_pyfast", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            _probe(mod)
+            _mod = mod
+        except Exception as err:  # degrade, never crash the load path
+            _error = str(err)
+            return None
+        return _mod
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def raw_rows(arena: str, offs: np.ndarray, lens: np.ndarray, cls) -> list:
+    """Validated front door for the C assembly: the extension reinterprets
+    the buffers as int64/int32, so dtype mistakes must fail HERE, loudly,
+    not read garbage offsets in C."""
+    if offs.dtype != np.int64 or lens.dtype != np.int32:
+        raise TypeError(
+            f"raw_rows needs int64 offs / int32 lens, got "
+            f"{offs.dtype}/{lens.dtype}"
+        )
+    return _mod.raw_rows(
+        arena, np.ascontiguousarray(offs), np.ascontiguousarray(lens), cls
+    )

@@ -34,19 +34,9 @@ _lib_error: str | None = None
 
 
 def _build() -> str:
-    with open(_SOURCE, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    so_path = os.path.join(_CACHE_DIR, f"avdb_vep-{digest}.so")
-    if os.path.exists(so_path):
-        return so_path
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    tmp = so_path + f".tmp{os.getpid()}"
-    subprocess.run(
-        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SOURCE],
-        check=True, capture_output=True,
-    )
-    os.replace(tmp, so_path)
-    return so_path
+    from annotatedvdb_tpu.native import build_shared_lib
+
+    return build_shared_lib(_SOURCE, "avdb_vep")
 
 
 def load():
@@ -59,7 +49,8 @@ def load():
             return _lib
         try:
             lib = ctypes.CDLL(_build())
-        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as err:
+        except (OSError, RuntimeError, subprocess.CalledProcessError,
+                FileNotFoundError) as err:
             _lib_error = str(err)
             return None
         c = ctypes
