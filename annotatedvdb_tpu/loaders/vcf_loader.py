@@ -746,8 +746,12 @@ class TpuVcfLoader:
                 if rows.size == 0:
                     continue
                 key = combined_key(batch.pos[rows], h[rows])
-                order = np.argsort(key, kind="stable")
-                rows, key = rows[order], key[order]
+                # position-sorted sources arrive key-sorted already (ties
+                # broken by hash are the only exception): detect in O(n)
+                # and skip the O(n log n) argsort + gathers
+                if rows.size > 1 and not bool((key[1:] >= key[:-1]).all()):
+                    order = np.argsort(key, kind="stable")
+                    rows, key = rows[order], key[order]
                 if rows.size > 1:
                     cand = np.where(key[1:] == key[:-1])[0]
                     if cand.size:
@@ -770,6 +774,12 @@ class TpuVcfLoader:
                     qrl, qal = batch.ref_len[rows], batch.alt_len[rows]
                     found = np.zeros(rows.size, np.bool_)
                     for seg in segs:
+                        # range pruning: monotonic loads probe only the
+                        # (usually zero) segments overlapping this chunk's
+                        # key range — key is sorted here
+                        if (seg.n == 0 or seg.key_max < key[0]
+                                or seg.key_min > key[-1]):
+                            continue
                         if found.all():
                             break
                         f, _ = seg.probe(key, qpos, qh, qref, qalt, qrl, qal)
@@ -783,12 +793,20 @@ class TpuVcfLoader:
             return None
         with self.timer.stage("gather", items=int(sum(r.size for r in insert_rows))):
             sel = np.concatenate(insert_rows)
-            sub = VariantBatch(*(np.asarray(x)[sel] for x in batch))
+            # all-insert sorted chunks (the steady state of a bulk load from
+            # a position-sorted source) select every row in input order:
+            # skip the per-column fancy-index copies entirely
+            ident = sel.size == batch.n and bool(
+                (sel == np.arange(batch.n)).all()
+            )
+            sub = batch if ident else VariantBatch(
+                *(np.asarray(x)[sel] for x in batch)
+            )
             if not self.store_display_attributes:
                 # slim annotations: only 4 of the 12 fields carry data
                 # (_slim_annotated zero-fills the display fields) — gather
                 # those, rebuild the zeros at the new size
-                sub_ann = _slim_annotated(
+                sub_ann = ann if ident else _slim_annotated(
                     sel.size,
                     np.asarray(ann.bin_level)[sel],
                     np.asarray(ann.leaf_bin)[sel],
@@ -796,7 +814,9 @@ class TpuVcfLoader:
                     np.asarray(ann.host_fallback)[sel],
                 )
             else:
-                sub_ann = AnnotatedBatch(*(np.asarray(x)[sel] for x in ann))
+                sub_ann = ann if ident else AnnotatedBatch(
+                    *(np.asarray(x)[sel] for x in ann)
+                )
             over = (
                 (sub.ref_len > self.store.width)
                 | (sub.alt_len > self.store.width)

@@ -110,6 +110,16 @@ DEVICE_UPLOAD_AMORTIZE = 4
 # read paths that need a single flat view call compact() explicitly.
 MERGE_SEGMENT_CAP = 1 << 20
 
+# Segments whose key ranges are DISJOINT are never cascade-merged: a
+# position-sorted load appends strictly-ascending runs, and membership
+# probes skip non-overlapping segments entirely (range pruning in
+# ``ChromosomeShard.lookup`` / the loader's pending-segment loop), so
+# merging them buys nothing and costs an O(n) copy per flush.  The shard
+# therefore accumulates one segment per flush on sorted input; once the
+# count passes this bound, ``maintain`` collapses consecutive runs back
+# into MERGE_SEGMENT_CAP-sized segments (amortized O(1) copies per row).
+MAX_SEGMENTS = 512
+
 
 _DEVICE_LOOKUP_MODE: str | None = None
 
@@ -292,6 +302,23 @@ class Segment:
             self._key = combined_key(self.cols["pos"], self.cols["h"])
         return self._key
 
+    @property
+    def key_min(self) -> np.uint64:
+        return self.key[0]
+
+    @property
+    def key_max(self) -> np.uint64:
+        return self.key[-1]
+
+    def overlaps(self, other: "Segment") -> bool:
+        """Whether this segment's key range intersects ``other``'s.
+        Disjoint segments cannot share an identity, so probes and merges
+        may skip the pair entirely."""
+        if self.n == 0 or other.n == 0:
+            return False
+        return not (self.key_max < other.key_min
+                    or other.key_max < self.key_min)
+
     # -- construction -------------------------------------------------------
 
     @classmethod
@@ -378,6 +405,59 @@ class Segment:
         # this exact row order) — the append-only persistence invariant
         if not older.dirty and not newer.dirty and older.backing and newer.backing:
             seg.backing = older.backing + newer.backing
+            seg.dirty = False
+        return seg
+
+    @classmethod
+    def merge_many(cls, parts: list["Segment"]) -> "Segment":
+        """Merge an ordered list of segments in one pass.
+
+        The common shape — consecutive ascending DISJOINT runs, which is
+        what a position-sorted load accumulates and what a backing group
+        persists — is a single multi-way ``np.concatenate`` per column
+        (each row copied once).  Anything else falls back to a balanced
+        pairwise tree, O(n log k) instead of the O(n·k) a left fold pays."""
+        if not parts:
+            raise ValueError("merge_many of an empty part list")
+        if len(parts) == 1:
+            return parts[0]
+        live = [p for p in parts if p.n > 0]
+        chain = all(
+            live[i].key_max < live[i + 1].key_min
+            for i in range(len(live) - 1)
+        )
+        if not chain or len(live) < 2:
+            merged = parts
+            while len(merged) > 1:  # balanced pairwise tree
+                merged = [
+                    cls.merge(merged[i], merged[i + 1])
+                    if i + 1 < len(merged) else merged[i]
+                    for i in range(0, len(merged), 2)
+                ]
+            return merged[0]
+        cols = {
+            name: np.concatenate([p.cols[name] for p in live])
+            for name, _ in _NUMERIC_COLUMNS
+        }
+        obj = {}
+        for c in OBJECT_COLUMNS:
+            if all(p.obj[c] is None for p in live):
+                obj[c] = None
+            else:
+                obj[c] = np.concatenate(
+                    [_dense(p.obj[c], p.n) for p in live]
+                )
+        seg = cls(
+            cols,
+            np.concatenate([p.ref for p in live]),
+            np.concatenate([p.alt for p in live]),
+            obj,
+        )
+        seg._key = np.concatenate([p.key for p in live])
+        # backing/dirty propagate over ALL parts (an empty persisted part
+        # still owns its on-disk files and must stay referenced)
+        if all(not p.dirty and p.backing for p in parts):
+            seg.backing = [sid for p in parts for sid in p.backing]
             seg.dirty = False
         return seg
 
@@ -585,10 +665,10 @@ class ChromosomeShard:
 
     def compact(self) -> None:
         """Merge all segments into one (position-sorted global ids)."""
-        while len(self.segments) > 1:
-            # same atomic-splice discipline as maintain()
-            merged = Segment.merge(self.segments[-2], self.segments[-1])
-            self.segments[-2:] = [merged]
+        if len(self.segments) > 1:
+            # single splice AFTER the merge completes — same atomic-splice
+            # discipline as maintain()
+            self.segments[:] = [Segment.merge_many(list(self.segments))]
         self._starts_cache = None
 
     # -- whole-column views (any segment count, global-id order) ------------
@@ -722,8 +802,17 @@ class ChromosomeShard:
         if not self.segments:
             return found, index
         qkey = combined_key(pos, h)
+        if qkey.size == 0:
+            return found, index
+        # range pruning: a segment whose key range misses the query range
+        # entirely cannot match — on position-sorted loads (many disjoint
+        # segments, see maintain) this reduces the probe set to O(1)
+        # segments per batch
+        qlo, qhi = qkey.min(), qkey.max()
         starts = self._starts()
         for si, seg in enumerate(self.segments):
+            if seg.n == 0 or seg.key_max < qlo or seg.key_min > qhi:
+                continue
             if found.all():
                 break
             f, idx = seg.probe(qkey, pos, h, ref, alt, ref_len, alt_len)
@@ -764,15 +853,25 @@ class ChromosomeShard:
         self._starts_cache = None
 
     def maintain(self) -> None:
-        """Size-tiered cascade merge: keep strictly geometric segment sizes
-        so the segment count stays O(log n) and total merge work O(n log n).
-        Segments past MERGE_SEGMENT_CAP freeze (written to disk once,
-        never re-merged mid-load): re-merging the biggest segment costs
-        O(n) memcpy per flush at whole-genome scale, while probing the
-        extra frozen segments is a few searchsorteds."""
+        """Keep membership-probe cost flat without paying merge copies.
+
+        Two-part policy (Postgres analog: append heap pages, defer vacuum,
+        ``createVariant.sql:4`` / ``alterAutoVacuum.sql:2-19``):
+
+        - OVERLAPPING tail segments cascade-merge size-tiered (geometric
+          sizes, O(log n) count, O(n log n) total work) — range pruning
+          cannot skip them, so their count must stay logarithmic;
+        - DISJOINT tail segments are left alone: a position-sorted load
+          appends strictly-ascending runs, probes skip them by range
+          (``lookup``), and merging would copy every row O(log n) times
+          for no probe savings.  Only when the count passes MAX_SEGMENTS
+          does ``_collapse`` concatenate consecutive runs back into
+          MERGE_SEGMENT_CAP-sized segments (amortized O(1) copies/row).
+        """
         while (len(self.segments) >= 2
                and self.segments[-2].n <= 2 * self.segments[-1].n
-               and self.segments[-2].n <= MERGE_SEGMENT_CAP):
+               and self.segments[-2].n <= MERGE_SEGMENT_CAP
+               and self.segments[-2].overlaps(self.segments[-1])):
             merged = Segment.merge(self.segments[-2], self.segments[-1])
             # single splice AFTER the merge completes: a concurrent reader
             # snapshotting the list (the loader's membership probe) must
@@ -780,7 +879,29 @@ class ChromosomeShard:
             # the list nor the in-flight set — pop-then-merge would open
             # one for the whole O(n) merge
             self.segments[-2:] = [merged]
+        if len(self.segments) > MAX_SEGMENTS:
+            self._collapse()
         self._starts_cache = None
+
+    def _collapse(self) -> None:
+        """Merge consecutive segments into ~MERGE_SEGMENT_CAP-row groups.
+
+        Runs every ~MAX_SEGMENTS flushes at most, so each row is copied
+        amortized O(1) times between collapses.  Same atomic-splice
+        discipline as ``maintain`` — the list is rewritten group by group,
+        never holding rows outside it."""
+        i = 0
+        while i < len(self.segments) - 1:
+            j = i + 1
+            total = self.segments[i].n
+            while (j < len(self.segments)
+                   and total + self.segments[j].n <= MERGE_SEGMENT_CAP):
+                total += self.segments[j].n
+                j += 1
+            if j - i >= 2:
+                merged = Segment.merge_many(self.segments[i:j])
+                self.segments[i:j] = [merged]
+            i += 1
 
     def update_annotation(self, index: np.ndarray, column: str,
                           values: Iterable, merge: bool = True) -> int:
@@ -845,6 +966,15 @@ class VariantStore:
         self.width = width
         self.shards: dict[int, ChromosomeShard] = {}
         self._next_seg_id = 1
+        # identity of THIS store's on-disk lineage: save() only trusts
+        # pre-existing segment files in a directory whose manifest carries
+        # this uid — a same-stem file left by a DIFFERENT store must be
+        # rewritten, not silently adopted as this segment's data.  The
+        # manifest is re-read every save (no cache): another store may
+        # overwrite the directory between our saves.
+        import uuid
+
+        self._uid = uuid.uuid4().hex
 
     def shard(self, chrom_code: int) -> ChromosomeShard:
         code = int(chrom_code)
@@ -890,10 +1020,27 @@ class VariantStore:
     # updated since the last save) — the reference's analog is the WAL-less
     # UNLOGGED-table commit, not a full table rewrite.
 
+    def _dir_trusted(self, path: str) -> bool:
+        """Whether pre-existing segment files in ``path`` belong to THIS
+        store's lineage (its manifest carries our uid).  Untrusted
+        directories get every segment rewritten — stale same-stem files
+        from another/older store must never be adopted as this segment's
+        data."""
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                uid = json.load(f).get("store_uid")
+        except (OSError, ValueError):
+            return False
+        return uid is not None and uid == self._uid
+
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+        trusted = self._dir_trusted(path)
         live_files = {"manifest.json"}
-        manifest = {"format": 3, "width": self.width, "shards": {}}
+        manifest = {
+            "format": 3, "width": self.width, "store_uid": self._uid,
+            "shards": {},
+        }
         for code, shard in sorted(self.shards.items()):
             label = chromosome_label(code)
             groups = []
@@ -902,11 +1049,15 @@ class VariantStore:
                     [f"chr{label}.{sid:06d}" for sid in seg.backing]
                     if seg.backing else []
                 )
-                if (seg.dirty or not stems
+                if (seg.dirty or not stems or not trusted
                         # a clean segment saved to a DIFFERENT directory
-                        # earlier: its files aren't here, rewrite fresh
-                        or not all(os.path.exists(os.path.join(path, s + ".npz"))
-                                   for s in stems)):
+                        # earlier: its files aren't here (or are another
+                        # store's — both npz AND jsonl must exist), rewrite
+                        or not all(
+                            os.path.exists(os.path.join(path, s + ".npz"))
+                            and os.path.exists(
+                                os.path.join(path, s + ".ann.jsonl"))
+                            for s in stems)):
                     # EVERY (re-)write takes a fresh seg id, so a
                     # manifested segment's files are never touched in
                     # place — the manifest swap below is the single
@@ -926,20 +1077,24 @@ class VariantStore:
         # atomic swap: a PROCESS crash mid-save must leave the previous
         # manifest intact (segments are also written via tmp+rename, so the
         # old manifest's files are never mutated in place) — the store is
-        # always loadable, possibly one checkpoint behind.  The small
-        # manifest is always fsynced; segment DATA fsync is opt-in
-        # (AVDB_FSYNC=1) because per-checkpoint writeback of 100MB+
-        # segments costs real throughput, and the survivable fault model
-        # matches the reference's own bulk loads (UNLOGGED tables are
-        # truncated by Postgres crash recovery, createVariant.sql:4) —
-        # process death is covered, power loss needs the opt-in.
+        # always loadable, possibly one checkpoint behind.  Process death
+        # needs only the atomic rename (the page cache survives it); ALL
+        # fsyncs — segment data, manifest, rename metadata — are the
+        # power-loss opt-in (AVDB_FSYNC=1), because on journaling
+        # filesystems one small-file fsync per checkpoint forces the whole
+        # preceding segment write to disk and costs real throughput.  The
+        # survivable default matches the reference's own bulk loads
+        # (UNLOGGED tables are truncated by Postgres crash recovery,
+        # createVariant.sql:4).
+        fsync_data = _fsync_wanted()
         mtmp = os.path.join(path, f".manifest.tmp{os.getpid()}")
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
+            if fsync_data:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(mtmp, os.path.join(path, "manifest.json"))
-        if _fsync_wanted():
+        if fsync_data:
             # commit the rename METADATA too (every segment rename above
             # shares this directory, so one directory fsync after the
             # manifest swap covers them all)
@@ -1013,6 +1168,13 @@ class VariantStore:
             )
         store = cls(manifest["width"])
         store._next_seg_id = manifest.get("next_seg_id", 1)
+        uid = manifest.get("store_uid")
+        if uid:
+            # resume this store's on-disk lineage: saves back into this
+            # directory may trust its existing segment files.  Manifests
+            # predating store_uid keep the fresh uid — the first save into
+            # their directory rewrites segments once, then records the uid.
+            store._uid = uid
         from annotatedvdb_tpu.types import chromosome_code
 
         for label, groups in manifest["shards"].items():
@@ -1023,12 +1185,22 @@ class VariantStore:
                 parts = [
                     cls._read_segment(path, label, sid) for sid in group
                 ]
-                seg = parts[0]
-                for part in parts[1:]:
-                    seg = Segment.merge(seg, part)
-                # merge() already propagated backing == group for clean
-                # inputs; assert the invariant rather than trusting it
-                assert seg.backing == list(group) and not seg.dirty
+                # multi-way (concat for the common ascending-disjoint
+                # chain, balanced tree otherwise) — a frozen group built
+                # from many small checkpoints loads with each row copied
+                # once, not O(parts) times
+                seg = Segment.merge_many(parts)
+                # merge propagated backing == group for clean inputs;
+                # verify the invariant rather than trusting it (an
+                # explicit raise — asserts vanish under ``python -O`` and
+                # a violation here would persist wrong backing metadata
+                # on the next save)
+                if seg.backing != list(group) or seg.dirty:
+                    raise ValueError(
+                        f"store load: backing group {group} did not "
+                        f"reassemble cleanly (got {seg.backing}, "
+                        f"dirty={seg.dirty}); store files are inconsistent"
+                    )
                 shard.segments.append(seg)
             shard._starts_cache = None
         return store

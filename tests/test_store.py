@@ -336,3 +336,125 @@ def test_save_is_atomic_against_kill(tmp_path, monkeypatch):
     # and a clean retry completes the save
     store.save(out)
     assert VariantStore.load(out).n == 200
+
+
+def _tiny_store(pos_list, width=8):
+    store = VariantStore(width=width)
+    n = len(pos_list)
+    store.shard(1).append(
+        {"pos": np.asarray(pos_list, np.int32),
+         "h": np.arange(n, dtype=np.uint32)},
+        np.full((n, width), 65, np.uint8),
+        np.full((n, width), 67, np.uint8),
+    )
+    return store
+
+
+def test_save_rejects_stale_files_from_other_store(tmp_path):
+    """A same-stem segment file written by a DIFFERENT store must be
+    rewritten, not adopted — including after the directory is overwritten
+    BETWEEN two saves of the same store (the uid check re-reads the
+    manifest every save; no trust cache)."""
+    d = str(tmp_path / "vdb")
+    a = _tiny_store([100, 200, 300])
+    a.save(d)
+    b = _tiny_store([111, 222, 333])
+    b.save(d)  # same stems, different lineage: must not adopt a's files
+    got = VariantStore.load(d).shards[1].column("pos").tolist()
+    assert got == [111, 222, 333]
+    # store A saves again into the (now foreign) directory: must rewrite,
+    # not reference b's same-stem files
+    a.save(d)
+    got = VariantStore.load(d).shards[1].column("pos").tolist()
+    assert got == [100, 200, 300]
+
+
+def test_save_requires_both_segment_files(tmp_path):
+    """A clean segment whose .ann.jsonl sibling vanished is rewritten on
+    the next save (both files are the segment's on-disk identity)."""
+    import os
+
+    d = str(tmp_path / "vdb")
+    store = _tiny_store([5, 6, 7])
+    store.save(d)
+    [ann] = [f for f in os.listdir(d) if f.endswith(".ann.jsonl")]
+    os.remove(os.path.join(d, ann))
+    store.save(d)
+    got = VariantStore.load(d).shards[1].column("pos").tolist()
+    assert got == [5, 6, 7]
+
+
+def test_lookup_empty_query(rng):
+    """Empty query batches return empty results (public-API contract)."""
+    store = _tiny_store([10, 20])
+    shard = store.shards[1]
+    found, idx = shard.lookup(
+        np.zeros(0, np.int32), np.zeros(0, np.uint32),
+        np.zeros((0, 8), np.uint8), np.zeros((0, 8), np.uint8),
+        np.zeros(0, np.int32), np.zeros(0, np.int32),
+    )
+    assert found.size == 0 and idx.size == 0
+
+
+def test_disjoint_segments_not_merged_and_collapse(rng):
+    """Monotonic appends stay one-segment-per-flush (no merge copies);
+    overlapping appends still cascade; the MAX_SEGMENTS bound collapses
+    runs back into capped segments."""
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    store = _tiny_store([10, 20, 30])
+    shard = store.shards[1]
+    n0 = len(shard.segments)
+    # disjoint (all-later keys): appended as a new segment, not merged
+    shard.append(
+        {"pos": np.asarray([40, 50], np.int32),
+         "h": np.arange(2, dtype=np.uint32),
+         "ref_len": np.full(2, 8, np.int32),
+         "alt_len": np.full(2, 8, np.int32)},
+        np.full((2, 8), 65, np.uint8), np.full((2, 8), 67, np.uint8),
+    )
+    assert len(shard.segments) == n0 + 1
+    # overlapping append (key range intersects): cascade merges
+    shard.append(
+        {"pos": np.asarray([45, 60], np.int32),
+         "h": np.asarray([9, 9], np.uint32),
+         "ref_len": np.full(2, 8, np.int32),
+         "alt_len": np.full(2, 8, np.int32)},
+        np.full((2, 8), 65, np.uint8), np.full((2, 8), 67, np.uint8),
+    )
+    assert len(shard.segments) == n0 + 1  # merged into the tail segment
+    # lookup still finds everything across segments
+    h = np.arange(2, dtype=np.uint32)
+    found, _ = shard.lookup(
+        np.asarray([40, 50], np.int32), h,
+        np.full((2, 8), 65, np.uint8), np.full((2, 8), 67, np.uint8),
+        np.full(2, 8, np.int32), np.full(2, 8, np.int32),
+    )
+    assert found.all()
+
+
+def test_collapse_bounds_segment_count(monkeypatch):
+    from annotatedvdb_tpu.store import variant_store as vs
+
+    monkeypatch.setattr(vs, "MAX_SEGMENTS", 8)
+    store = VariantStore(width=8)
+    shard = store.shard(1)
+    for k in range(40):
+        base = k * 100
+        shard.append(
+            {"pos": np.asarray([base + 1, base + 2], np.int32),
+             "h": np.arange(2, dtype=np.uint32),
+             "ref_len": np.full(2, 8, np.int32),
+             "alt_len": np.full(2, 8, np.int32)},
+            np.full((2, 8), 65, np.uint8), np.full((2, 8), 67, np.uint8),
+        )
+    assert len(shard.segments) <= 9
+    assert shard.n == 80
+    # every row still reachable
+    found, _ = shard.lookup(
+        np.asarray([1, 1901, 3902], np.int32),
+        np.asarray([0, 0, 1], np.uint32),
+        np.full((3, 8), 65, np.uint8), np.full((3, 8), 67, np.uint8),
+        np.full(3, 8, np.int32), np.full(3, 8, np.int32),
+    )
+    assert found.all()
