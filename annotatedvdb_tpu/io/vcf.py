@@ -158,6 +158,12 @@ class VcfChunk:
     #: and found out-of-alphabet bytes (don't re-try on the host), None =
     #: packing was never attempted (Python engine / synthetic chunks)
     alleles_packable: bool | None = None
+    #: uint32 allele-identity hash per row, computed by the native tokenizer
+    #: during the scan (bit-exact ``ops.hashing.allele_hash`` twin over the
+    #: width-bounded arrays).  None from the Python engine / synthetic
+    #: chunks — consumers fall back to the device/numpy hash.  Over-width
+    #: rows still need the host full-string re-hash, same as every engine.
+    h_native: np.ndarray | None = None
 
 
 class VcfBatchReader:

@@ -21,9 +21,13 @@ def chunk_hashes(store: VariantStore, chunk: VcfChunk) -> np.ndarray:
     from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
 
     batch = chunk.batch
-    h = np.array(
-        allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
-    )
+    if chunk.h_native is not None:
+        # tokenizer-computed twin: skip the device kernel + result fetch
+        h = chunk.h_native.copy()
+    else:
+        h = np.array(
+            allele_hash_jit(batch.ref, batch.alt, batch.ref_len, batch.alt_len)
+        )
     over = (batch.ref_len > store.width) | (batch.alt_len > store.width)
     for i in np.where(over)[0]:
         h[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])

@@ -16,8 +16,6 @@ re-chunked through the standard :class:`TpuVcfLoader` insert path.
 
 from __future__ import annotations
 
-from dataclasses import replace as dc_replace
-
 import numpy as np
 
 from annotatedvdb_tpu.io.vcf import VcfBatchReader, VcfChunk
@@ -246,20 +244,28 @@ def _subset_chunk(chunk: VcfChunk, rows: list[int]) -> VcfChunk:
     from annotatedvdb_tpu.types import VariantBatch
 
     sel = np.asarray(rows)
-    return dc_replace(
-        chunk,
-        batch=VariantBatch(*(np.asarray(x)[sel] for x in chunk.batch)),
-        refs=[chunk.refs[i] for i in rows],
-        alts=[chunk.alts[i] for i in rows],
-        ref_snp=[chunk.ref_snp[i] for i in rows],
-        variant_id=[chunk.variant_id[i] for i in rows],
-        is_multi_allelic=chunk.is_multi_allelic[sel],
-        frequencies=[chunk.frequencies[i] for i in rows],
-        rs_position=[chunk.rs_position[i] for i in rows],
-        info=[chunk.info[i] for i in rows],
-        line_number=chunk.line_number[sel],
-        qual=[chunk.qual[i] for i in rows],
-        filter=[chunk.filter[i] for i in rows],
-        format=[chunk.format[i] for i in rows],
-        counters={},
-    )
+    import dataclasses
+
+    n = chunk.batch.n
+    out = {
+        "batch": VariantBatch(*(np.asarray(x)[sel] for x in chunk.batch)),
+        "counters": {},
+    }
+    # EVERY per-row field must be subset alongside the batch: a stale
+    # full-length column silently indexes the wrong rows (novel-row
+    # inserts once stored wrong rs ids exactly this way).  Subsetting is
+    # therefore GENERIC over the dataclass — per-row ndarrays gather,
+    # per-row lists/LazyColumns re-materialize — so a newly added sidecar
+    # can never reintroduce the bug.
+    for f in dataclasses.fields(chunk):
+        if f.name in out:
+            continue
+        v = getattr(chunk, f.name)
+        if isinstance(v, np.ndarray) and v.shape[:1] == (n,):
+            out[f.name] = v[sel]
+        elif hasattr(v, "__len__") and not isinstance(
+                v, (str, bytes, dict, np.ndarray)) and len(v) == n:
+            out[f.name] = [v[i] for i in rows]
+        else:
+            out[f.name] = v
+    return VcfChunk(**out)

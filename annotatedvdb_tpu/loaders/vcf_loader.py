@@ -356,6 +356,22 @@ class TpuVcfLoader:
             batch.ref, batch.alt, batch.ref_len, batch.alt_len
         )
         np.asarray(ann.variant_class), np.asarray(h)
+        if self.mesh is None and not self._will_pack():
+            # width-bucketed dispatch (see _dispatch_chunk): pre-compile
+            # EVERY pow2 bucket the runtime gate can produce so a
+            # native-engine load never compiles mid-stream — the gate
+            # condition here must mirror _dispatch_chunk's exactly
+            w = 8
+            while w < batch.ref.shape[1]:
+                a = annotate_fn()(
+                    batch.chrom, batch.pos,
+                    np.ascontiguousarray(batch.ref[:, :w]),
+                    np.ascontiguousarray(batch.alt[:, :w]),
+                    np.minimum(batch.ref_len, w),
+                    np.minimum(batch.alt_len, w),
+                )
+                np.asarray(a.variant_class)
+                w *= 2
         if self.mesh is None and not self.store_display_attributes:
             # compile the output packer AND verify the packed transport
             # bit-exactly reproduces the individual fields on this backend
@@ -392,6 +408,20 @@ class TpuVcfLoader:
                             f"packed transport probe passed but full-shape "
                             f"pack mismatched in {name!r}"
                         )
+
+    def _will_pack(self) -> bool:
+        """Single definition of the packed-transport predicate: dispatch
+        (skip hash kernel / width-bucket) and warmup (which bucket shapes
+        to pre-compile) must agree or a load compiles mid-stream."""
+        from annotatedvdb_tpu.ops.pack import (
+            transport_verified,
+            transport_wanted,
+        )
+
+        return (
+            not self.store_display_attributes
+            and transport_wanted() and transport_verified()
+        )
 
     def _annotate(self, batch: VariantBatch) -> AnnotatedBatch:
         """One annotate step: distributed over the mesh when present, else
@@ -487,6 +517,9 @@ class TpuVcfLoader:
             # the sharded step scatters through numpy already (synchronous);
             # pipelining matters for the single-device transfer-bound path
             ann_p = self._annotate_distributed(padded)
+            if chunk.h_native is not None:
+                return {"padded": padded, "dev": None, "ann_p": ann_p,
+                        "h_dev": None, "h_host": chunk.h_native}
             h_dev = allele_hash_jit(
                 padded.ref, padded.alt, padded.ref_len, padded.alt_len
             )
@@ -498,8 +531,15 @@ class TpuVcfLoader:
             encode_alleles_nibble,
             inflate_alleles_jit,
             nibble_verified,
+            transport_verified,
             transport_wanted,
         )
+
+        # decided up front: the packed transport folds the DEVICE hash into
+        # its 10-byte row, so configurations that will pack must upload
+        # full-width arrays and run the hash kernel; everything else rides
+        # the tokenizer hash when present
+        will_pack = self._will_pack()
 
         # the allele matrices are ~90% of the upload bytes; send them
         # nibble-packed when the chunk's alphabet allows and inflate on
@@ -536,39 +576,65 @@ class TpuVcfLoader:
                 jax.device_put(padded.ref_len), jax.device_put(padded.alt_len),
             )
         else:
-            dev = tuple(jax.device_put(x) for x in padded)
+            # width bucketing: annotate compute (and upload bytes) scale
+            # with the allele-matrix width, but dbSNP/gnomAD chunks top out
+            # at ~8 bytes inside width-49 arrays.  Slice to the pow2 bucket
+            # covering this chunk's longest allele — annotate outputs are
+            # width-independent (they depend on bytes+lengths only), and
+            # the identity hash is NOT affected because this path is taken
+            # only with a tokenizer-computed hash (h_native), which is
+            # always store-width.  Bucketing keeps the compile count
+            # O(log width).
+            upload = padded
+            if (chunk.h_native is not None and not will_pack
+                    and padded.ref.shape[1] > 8):
+                from annotatedvdb_tpu.utils.arrays import next_pow2
+
+                w_act = int(max(
+                    int(padded.ref_len.max()), int(padded.alt_len.max()), 1
+                ))
+                w = next_pow2(max(w_act, 8))
+                if w < padded.ref.shape[1]:
+                    upload = padded._replace(
+                        ref=np.ascontiguousarray(padded.ref[:, :w]),
+                        alt=np.ascontiguousarray(padded.alt[:, :w]),
+                    )
+            dev = tuple(jax.device_put(x) for x in upload)
         ann_p = annotate_fn()(*dev)
+        # the packed transport needs the device hash (folded into its
+        # 10-byte row); every other configuration uses the tokenizer's
+        # host hash when present (skipping the hash kernel AND its result
+        # fetch — on a 1-core CPU host that is ~15% of e2e)
+        if chunk.h_native is not None and not will_pack:
+            handles = {"padded": padded, "dev": dev, "ann_p": ann_p,
+                       "h_dev": None, "h_host": chunk.h_native}
+            return handles
         h_dev = allele_hash_jit(dev[2], dev[3], dev[4], dev[5])
         handles = {"padded": padded, "dev": dev, "ann_p": ann_p,
                    "h_dev": h_dev}
-        if not self.store_display_attributes:
+        if will_pack:
             # remote-attached TPUs pay a fixed round trip PER materialized
             # array; pack the six per-row outputs on device so process time
-            # fetches once.  transport_verified() probes bit-exactness of
-            # the bitcast byte order once per process — backends that fail
-            # it keep the per-field fetch path.
-            from annotatedvdb_tpu.ops.pack import (
-                pack_outputs_jit,
-                transport_verified,
+            # fetches once (_will_pack already probed the transport's
+            # bit-exactness on this backend).
+            import jax.numpy as jnp
+
+            from annotatedvdb_tpu.ops.pack import pack_outputs_jit
+
+            # the dup lane of the packed layout is unused since in-batch
+            # dedup moved into the host identity sort; zeros keep the
+            # 10-byte row format (and its bit-exactness probe) stable
+            packed = pack_outputs_jit(
+                h_dev, jnp.zeros(h_dev.shape, jnp.bool_),
+                ann_p.bin_level, ann_p.leaf_bin,
+                ann_p.needs_digest, ann_p.host_fallback,
             )
-
-            if transport_wanted() and transport_verified():
-                import jax.numpy as jnp
-
-                # the dup lane of the packed layout is unused since in-batch
-                # dedup moved into the host identity sort; zeros keep the
-                # 10-byte row format (and its bit-exactness probe) stable
-                packed = pack_outputs_jit(
-                    h_dev, jnp.zeros(h_dev.shape, jnp.bool_),
-                    ann_p.bin_level, ann_p.leaf_bin,
-                    ann_p.needs_digest, ann_p.host_fallback,
-                )
-                # the device->host copy releases the GIL: prefetch it on a
-                # worker thread so the transfer overlaps the next chunk's
-                # ingest/dispatch instead of blocking process time
-                handles["packed"] = self._prefetch().submit(
-                    np.asarray, packed
-                )
+            # the device->host copy releases the GIL: prefetch it on a
+            # worker thread so the transfer overlaps the next chunk's
+            # ingest/dispatch instead of blocking process time
+            handles["packed"] = self._prefetch().submit(
+                np.asarray, packed
+            )
         return handles
 
     # -- async store writer --------------------------------------------------
@@ -702,6 +768,12 @@ class TpuVcfLoader:
                 cols = unpack_outputs(handles["packed"].result())
                 h_p = cols["h"].copy()
                 host_rows = cols["host_fallback"][:n]
+            elif handles.get("h_host") is not None:
+                # tokenizer-computed hash: no device fetch to force (the
+                # over-width re-hash below still applies, so copy first)
+                h_p = handles["h_host"].copy()
+                host_rows = np.asarray(ann_p.host_fallback)[:n]
+                cols = None
             else:
                 h_p = np.array(handles["h_dev"])
                 host_rows = np.asarray(ann_p.host_fallback)[:n]

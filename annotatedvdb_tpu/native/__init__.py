@@ -87,6 +87,7 @@ def load():
             c.c_void_p, c.c_void_p,                            # alt_index, n_alts
             c.c_void_p, c.c_void_p,                            # rs_number, rs_weird
             c.c_void_p, c.c_void_p,                            # id_verbatim, has_freq
+            c.c_void_p,                                        # hash
             c.c_void_p, c.c_void_p, c.c_void_p,               # ref_packed, alt_packed, pack_ok
             c.c_int32, c.c_int32,                              # identity_only, want_packed
             c.c_void_p, c.c_void_p, c.c_void_p,               # counters, consumed, need_more

@@ -55,6 +55,7 @@ class _Arrays:
         self.rs_weird = np.zeros(cap, np.uint8)
         self.id_verbatim = np.zeros(cap, np.uint8)
         self.has_freq = np.zeros(cap, np.uint8)
+        self.hash = np.zeros(cap, np.uint32)
         self.ref_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
         self.alt_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
         self.pack_ok = np.zeros(cap, np.uint8)
@@ -74,7 +75,7 @@ class _Arrays:
             p(self.altcol_off), p(self.altcol_len),
             p(self.alt_index), p(self.n_alts),
             p(self.rs_number), p(self.rs_weird), p(self.id_verbatim),
-            p(self.has_freq),
+            p(self.has_freq), p(self.hash),
             p(self.ref_packed), p(self.alt_packed), p(self.pack_ok),
         ]
 
@@ -253,6 +254,7 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     alt_index = arrays.alt_index[:n].copy()
     n_alts = arrays.n_alts[:n].copy()
     rs_number = arrays.rs_number[:n].copy()
+    h_native = arrays.hash[:n].copy()
     rs_weird = arrays.rs_weird[:n].astype(bool)
     id_verbatim = arrays.id_verbatim[:n].astype(bool)
     has_freq = arrays.has_freq[:n].astype(bool)
@@ -334,6 +336,7 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         ref_packed=ref_packed,
         alt_packed=alt_packed,
         alleles_packable=packable,
+        h_native=h_native,
         qual=LazyColumn(n, opt(qual_off, qual_len)),
         filter=LazyColumn(n, opt(filter_off, filter_len)),
         format=LazyColumn(n, opt(format_off, format_len)),

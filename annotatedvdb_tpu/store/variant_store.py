@@ -1120,10 +1120,27 @@ class VariantStore:
         # references if the process dies mid-write
         fsync_data = _fsync_wanted()
         tmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.npz")
+        # width-trim the allele matrices to this segment's longest allele:
+        # dbSNP/gnomAD-shaped data stores <=8-byte alleles in width-49
+        # arrays, so ~85% of segment bytes would be zero padding (load
+        # inflates back to the store width)
+        ref, alt = seg.ref, seg.alt
+        if seg.n and ref.shape[1] > 1:
+            # clamp to the array width: over-width rows store full lengths
+            # but only width bytes, so one 300bp indel must not forfeit the
+            # whole segment's trim
+            width = ref.shape[1]
+            w = int(max(
+                np.minimum(seg.cols["ref_len"], width).max(),
+                np.minimum(seg.cols["alt_len"], width).max(), 1,
+            ))
+            if w < ref.shape[1]:
+                ref = np.ascontiguousarray(ref[:, :w])
+                alt = np.ascontiguousarray(alt[:, :w])
         with open(tmp, "wb") as f:
             np.savez(
                 f,
-                ref=seg.ref, alt=seg.alt,
+                ref=ref, alt=alt,
                 **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
             )
             if fsync_data:
@@ -1183,7 +1200,8 @@ class VariantStore:
             shard = store.shard(chromosome_code(label))
             for group in groups:
                 parts = [
-                    cls._read_segment(path, label, sid) for sid in group
+                    cls._read_segment(path, label, sid, store.width)
+                    for sid in group
                 ]
                 # multi-way (concat for the common ascending-disjoint
                 # chain, balanced tree otherwise) — a frozen group built
@@ -1206,11 +1224,22 @@ class VariantStore:
         return store
 
     @staticmethod
-    def _read_segment(path: str, label: str, seg_id: int) -> Segment:
+    def _read_segment(path: str, label: str, seg_id: int,
+                      width: int) -> Segment:
         stem = f"chr{label}.{seg_id:06d}"
         data = np.load(os.path.join(path, stem + ".npz"))
         cols = {name: data[name] for name, _ in _NUMERIC_COLUMNS}
         n = data["ref"].shape[0]
+        ref, alt = data["ref"], data["alt"]
+        if ref.shape[1] < width:
+            # width-trimmed on save: inflate back to the store width
+            # (trailing pad bytes are zeros by construction)
+            full = np.zeros((n, width), np.uint8)
+            full[:, :ref.shape[1]] = ref
+            ref = full
+            full = np.zeros((n, width), np.uint8)
+            full[:, :alt.shape[1]] = alt
+            alt = full
         obj: dict = {c: None for c in OBJECT_COLUMNS}
         with open(os.path.join(path, stem + ".ann.jsonl")) as f:
             for line in f:
@@ -1220,6 +1249,6 @@ class VariantStore:
                     if obj[c] is None:
                         obj[c] = np.full((n,), None, object)
                     obj[c][i] = tuple(v) if c == _LONG_ALLELES else v
-        seg = Segment(cols, data["ref"], data["alt"], obj, backing=[seg_id])
+        seg = Segment(cols, ref, alt, obj, backing=[seg_id])
         seg.dirty = False
         return seg

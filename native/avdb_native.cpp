@@ -104,6 +104,34 @@ inline bool pack_row(const uint8_t* src, int width, uint8_t* dst) {
     return true;
 }
 
+// FNV-1a over (ref_len&0xFF, alt_len&0xFF, padded ref row, padded alt row):
+// the bit-exact twin of ops/hashing.py::allele_hash over the width-bounded
+// device arrays.  Zero pad bytes fold to h *= prime^pad (x ^ 0 == x), so the
+// caller passes a prime-power table and content bytes are the only loop.
+inline uint32_t pad_fold(uint32_t h, int pad, const uint32_t* pp, int pp_n) {
+    while (pad >= pp_n) {  // widths beyond the table: fold in steps
+        h *= pp[pp_n - 1];
+        pad -= pp_n - 1;
+    }
+    return h * pp[pad];
+}
+
+inline uint32_t fnv_row(const uint8_t* ref_row, const uint8_t* alt_row,
+                        int width, int32_t rl, int32_t al,
+                        const uint32_t* primepow, int pp_n) {
+    uint32_t h = 2166136261u;
+    const uint32_t prime = 16777619u;
+    h = (h ^ static_cast<uint32_t>(rl & 0xFF)) * prime;
+    h = (h ^ static_cast<uint32_t>(al & 0xFF)) * prime;
+    int rc = rl < width ? rl : width;
+    for (int i = 0; i < rc; ++i) h = (h ^ ref_row[i]) * prime;
+    h = pad_fold(h, width - rc, primepow, pp_n);
+    int ac = al < width ? al : width;
+    for (int i = 0; i < ac; ++i) h = (h ^ alt_row[i]) * prime;
+    h = pad_fold(h, width - ac, primepow, pp_n);
+    return h;
+}
+
 // refsnp number for one site: ID "rs<digits>" wins, else INFO "RS=<digits>"
 // (key-anchored: start of INFO or after ';'), else -1.  Mirrors the Python
 // reader's ref_snp derivation + loaders' _rs_number parse so the insert path
@@ -231,6 +259,10 @@ int64_t avdb_parse_vcf_chunk(
     // the frequencies column for every row; this flag lets it skip the lazy
     // INFO parse wholesale on FREQ-less rows/chunks)
     uint8_t* has_freq,
+    // uint32 FNV-1a allele-identity hash per row (ops/hashing.py twin over
+    // the width-bounded arrays) — computed during the scan while the allele
+    // bytes are cache-hot, so host paths never pay a device hash round trip
+    uint32_t* hash_out,
     // nibble-packed allele uploads: [cap, ceil(width/2)] each + per-row
     // packable flag (0 when the row holds out-of-alphabet bytes).
     // want_packed=0 skips the pack work entirely (consumers that never
@@ -242,6 +274,13 @@ int64_t avdb_parse_vcf_chunk(
     int64_t offset = 0;
     int64_t line = line_base;
     *need_more = 0;
+
+    // prime^k table for zero-pad folding in fnv_row (k in [0, width])
+    uint32_t primepow_buf[4096];
+    int pp_n = width + 1 <= 4096 ? width + 1 : 4096;
+    primepow_buf[0] = 1u;
+    for (int k = 1; k < pp_n; ++k)
+        primepow_buf[k] = primepow_buf[k - 1] * 16777619u;
 
     while (offset < n_bytes) {
         const char* nl = static_cast<const char*>(
@@ -382,6 +421,9 @@ int64_t avdb_parse_vcf_chunk(
                     rs_weird[r] = rs_w;
                     id_verbatim[r] = id_verb;
                     has_freq[r] = freq_flag;
+                    hash_out[r] = fnv_row(
+                        ref + r * width, alt + r * width, width,
+                        ref_len[r], alt_len[r], primepow_buf, pp_n);
                     if (want_packed) {
                         int cols = (width + 1) / 2;
                         bool ok = pack_row(ref + r * width, width,

@@ -260,3 +260,44 @@ def test_native_forced_with_chromosome_map_raises(tmp_path):
     with pytest.raises(RuntimeError, match="chromosome_map"):
         list(VcfBatchReader(str(p), engine="native",
                             chromosome_map={"NC_1": "1"}))
+
+
+def test_native_hash_matches_kernel(tmp_path):
+    """The tokenizer's in-scan FNV hash is the bit-exact twin of
+    ops.hashing.allele_hash over the width-bounded arrays (membership and
+    dedup compare the two, so they must never diverge)."""
+    from annotatedvdb_tpu.ops.hashing import allele_hash_np
+
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    for chunk in VcfBatchReader(path, batch_size=4, width=16,
+                                engine="native"):
+        if chunk.batch.n == 0:
+            continue
+        assert chunk.h_native is not None
+        want = allele_hash_np(
+            chunk.batch.ref, chunk.batch.alt,
+            chunk.batch.ref_len, chunk.batch.alt_len,
+        )
+        np.testing.assert_array_equal(chunk.h_native, want)
+
+
+def test_subset_chunk_subsets_all_sidecars(tmp_path):
+    """_subset_chunk must subset every per-row numpy sidecar: a stale
+    full-length rs_number column made novel-row inserts store the WRONG
+    rs ids (regression)."""
+    from annotatedvdb_tpu.loaders.update_loader import _subset_chunk
+
+    path = write_vcf(tmp_path, TRICKY_VCF)
+    [chunk] = [
+        c for c in VcfBatchReader(path, batch_size=64, width=16,
+                                  engine="native")
+        if c.batch.n
+    ]
+    rows = [2, 4]
+    sub = _subset_chunk(chunk, rows)
+    assert sub.batch.n == 2
+    np.testing.assert_array_equal(sub.rs_number, chunk.rs_number[rows])
+    np.testing.assert_array_equal(sub.h_native, chunk.h_native[rows])
+    np.testing.assert_array_equal(sub.rs_weird, chunk.rs_weird[rows])
+    np.testing.assert_array_equal(sub.id_verbatim, chunk.id_verbatim[rows])
+    np.testing.assert_array_equal(sub.has_freq, chunk.has_freq[rows])
