@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import gzip
 import io
+import json
+import math
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -87,6 +89,70 @@ def parse_info(info_str: str) -> dict:
         elif item:
             out[item] = True
     return out
+
+
+import re as _re
+
+# \Z anchors, not $: '$' also matches before a trailing newline, which
+# would splice raw control characters (or dodge the inf abort) for values
+# ending in '\n'
+_INT_RE = _re.compile(r"[+-]?\d+\Z", _re.ASCII)
+_FLOAT_RE = _re.compile(
+    r"[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?\Z", _re.ASCII
+)
+# safe to splice into JSON between quotes verbatim; must not LOOK numeric
+# (int()/float() accept whitespace padding, underscores, inf/nan forms —
+# anything matching this charset that is not screened above takes the
+# exact to_numeric fallback)
+_SAFE_STR_RE = _re.compile(r'[A-Za-z_][A-Za-z0-9_:,./|\-]*\Z', _re.ASCII)
+# the only alpha tokens float() accepts (unsigned forms; signed ones fail
+# the leading-alpha SAFE screen already): these must take the exact
+# fallback so the allow_nan=False abort fires
+_FLOAT_WORDS = frozenset(("inf", "infinity", "nan"))
+
+
+def info_to_json(info_str: str) -> str:
+    """INFO field -> the JSON TEXT of ``parse_info``'s dict, directly.
+
+    The QC/annotation update paths store the parsed INFO dict per row;
+    building the dict and re-serializing it (parse_info + json.dumps) is
+    the dominant per-row cost at 100k rows/sec.  This transformer emits
+    the identical JSON in one pass: regex-screened int/float/safe-string
+    tokens splice verbatim-canonically, everything else falls back to
+    ``to_numeric`` + ``json.dumps`` for exact parity (pinned by
+    ``tests/test_qc_update.py::test_info_to_json_parity``).
+
+    Raises ValueError on Infinity/NaN values — same abort the reference's
+    ``json.dumps(..., allow_nan=False)`` check produces
+    (``update_from_qc_pvcf_file.py:141-145``)."""
+    s = info_str.replace("\\x2c", ",").replace("\\x59", "/").replace("#", ":")
+    parts = []
+    for item in s.split(";"):
+        eq = item.find("=")
+        if eq < 0:
+            if item:
+                key = (
+                    f'"{item}"' if _SAFE_STR_RE.match(item)
+                    else json.dumps(item)
+                )
+                parts.append(f"{key}:true")
+            continue
+        k, v = item[:eq], item[eq + 1:]
+        key = f'"{k}"' if _SAFE_STR_RE.match(k) else json.dumps(k)
+        if _INT_RE.match(v):
+            parts.append(f"{key}:{int(v)}")
+        elif _FLOAT_RE.match(v) and math.isfinite(fv := float(v)):
+            # isfinite guard: '1e400' overflows float() to inf — bare
+            # 'inf' spliced here would be invalid JSON AND dodge the
+            # allow_nan=False abort the fallback enforces
+            parts.append(f"{key}:{fv!r}")
+        elif _SAFE_STR_RE.match(v) and v.lower() not in _FLOAT_WORDS:
+            parts.append(f'{key}:"{v}"')
+        else:
+            # exact-parity fallback (whitespace-padded numbers, underscores,
+            # inf/nan, escapes, empty, non-ascii)
+            parts.append(f"{key}:{json.dumps(to_numeric(v), allow_nan=False)}")
+    return "{" + ",".join(parts) + "}"
 
 
 def parse_freq(info: dict, n_alts: int) -> list:
@@ -158,6 +224,12 @@ class VcfChunk:
     #: and found out-of-alphabet bytes (don't re-try on the host), None =
     #: packing was never attempted (Python engine / synthetic chunks)
     alleles_packable: bool | None = None
+    #: raw INFO column text per row (None when absent/'.') — lets update
+    #: strategies transform INFO to stored JSON without the parse_info
+    #: dict round trip (``info_to_json``).  None when the engine does not
+    #: expose spans (Python reader / synthetic chunks): consumers fall
+    #: back to serializing the parsed ``info`` dict.
+    info_raw: list | None = None
     #: uint32 allele-identity hash per row, computed by the native tokenizer
     #: during the scan (bit-exact ``ops.hashing.allele_hash`` twin over the
     #: width-bounded arrays).  None from the Python engine / synthetic

@@ -50,6 +50,22 @@ class UpdateStrategy:
     def values(self, row: dict, existing: dict | None):
         raise NotImplementedError
 
+    def values_batch(self, chunk, rows, existing, numeric):
+        """Optional vectorized fast path over one chunk's FOUND rows.
+
+        ``rows`` are chunk row indices ([K] int); ``existing`` maps each of
+        the strategy's JSONB columns to a [K] object array of stored values
+        (None where the row has none) and ``numeric`` maps each declared
+        numeric column to a [K] int array.  Return ``None`` to fall back to
+        the per-row :meth:`values` loop, else
+        ``(do_mask [K] bool, {flag col: [K] int array},
+        {jsonb col: [K] list})`` — jsonb entries may be
+        :class:`~annotatedvdb_tpu.store.variant_store.RawJson` (preferred:
+        the store then skips dict materialization end to end).  Batch
+        strategies see the PRE-chunk stored state, exactly like the
+        buffered per-row path (update_from_qc_pvcf_file.py:371-372)."""
+        return None
+
 
 class TpuUpdateLoader:
     """Streams a VCF and applies an :class:`UpdateStrategy` per known row."""
@@ -147,6 +163,26 @@ class TpuUpdateLoader:
             "variant_id": chunk.variant_id[i],
         }
 
+    def _fetch_existing(self, shard, ids: np.ndarray, ann_cols) -> dict:
+        """Vectorized stored-value view for a batch of global row ids:
+        {column: [K] object array} — per segment, one fancy-index gather
+        replaces K per-row ``get_ann`` locate calls.  Values are returned
+        as stored (dicts or RawJson — both support the read accessors
+        strategies use); mutation still goes through update_annotation."""
+        out = {}
+        seg_idx, off = shard._locate(ids)
+        uniq = np.unique(seg_idx)
+        for c in ann_cols:
+            vals = np.full(ids.shape, None, object)
+            for si in uniq:
+                col = shard.segments[int(si)].obj[c]
+                if col is None:
+                    continue
+                m = seg_idx == si
+                vals[m] = col[off[m]]
+            out[c] = vals
+        return out
+
     def _apply_chunk(self, chunk: VcfChunk, alg_id: int, commit: bool) -> None:
         novel: list[int] = []
         ann_cols = (
@@ -162,22 +198,52 @@ class TpuUpdateLoader:
             # stored state (exactly the reference's accumulate-lookups-then-
             # process behavior, update_from_qc_pvcf_file.py:371-372): both
             # occurrences count as updates and their values merge in order
+            self.counters["variant"] += int(sel.size)
+            novel.extend(int(i) for i in sel[~found])
+            rows = sel[found]
+            if rows.size == 0:
+                continue
+            ids = idx[found].astype(np.int64)
+            existing = self._fetch_existing(shard, ids, ann_cols)
+            numeric = {
+                c: shard.get_col(c, ids)
+                for c in self.strategy.numeric_columns
+            }
+            batched = self.strategy.values_batch(
+                chunk, rows, existing, numeric
+            )
+            if batched is not None:
+                do, flag_upd, jsonb_upd = batched
+                n_do = int(do.sum())
+                self.counters["update"] += n_do
+                self.counters["skipped"] += int(rows.size - n_do)
+                if not commit or n_do == 0:
+                    continue
+                upd_ids = ids[do]
+                keep = None if n_do == rows.size else np.where(do)[0]
+                for col, vals in jsonb_upd.items():
+                    shard.update_annotation(
+                        upd_ids, col,
+                        vals if keep is None else [vals[k] for k in keep],
+                    )
+                for col, vals in flag_upd.items():
+                    shard.set_col(col, upd_ids, np.asarray(vals)[do])
+                shard.set_col("row_algorithm_id", upd_ids, alg_id)
+                continue
+            # per-row fallback (strategies without a batch path)
             upd_ids: dict[str, list[int]] = {}
             upd_vals: dict[str, list] = {}
             flag_ids: dict[str, list[int]] = {}
             flag_vals: dict[str, list[int]] = {}
             touched: list[int] = []
-            for j, i in enumerate(sel):
-                self.counters["variant"] += 1
-                if not found[j]:
-                    novel.append(int(i))
-                    continue
-                row_idx = int(idx[j])
-                existing = {c: shard.get_ann(c, row_idx) for c in ann_cols}
+            for j in range(rows.size):
+                i = int(rows[j])
+                row_idx = int(ids[j])
+                ex = {c: existing[c][j] for c in ann_cols}
                 for c in self.strategy.numeric_columns:
-                    existing[c] = int(shard.get_col(c, [row_idx])[0])
+                    ex[c] = int(numeric[c][j])
                 do_update, flags, jsonb = self.strategy.values(
-                    self._row_dict(chunk, int(i)), existing
+                    self._row_dict(chunk, i), ex
                 )
                 if not do_update:
                     self.counters["skipped"] += 1
@@ -192,13 +258,13 @@ class TpuUpdateLoader:
                 for col, value in flags.items():
                     flag_ids.setdefault(col, []).append(row_idx)
                     flag_vals.setdefault(col, []).append(value)
-            for col, ids in upd_ids.items():
+            for col, cids in upd_ids.items():
                 shard.update_annotation(
-                    np.asarray(ids, np.int64), col, upd_vals[col]
+                    np.asarray(cids, np.int64), col, upd_vals[col]
                 )
-            for col, ids in flag_ids.items():
+            for col, cids in flag_ids.items():
                 shard.set_col(
-                    col, np.asarray(ids, np.int64),
+                    col, np.asarray(cids, np.int64),
                     np.asarray(flag_vals[col]),
                 )
             if touched:

@@ -102,6 +102,10 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool,
         eof = False
         while not eof or tail:
             window = tail
+            # one-slot decoded-text cache SHARED by every chunk cut from
+            # this window (chunk_from_native fills it lazily on first span
+            # access; multiple fills of one window must not re-decode)
+            decoded_cache: list = []
             if not eof:
                 block = fh.read(READ_SIZE)
                 if block:
@@ -142,22 +146,15 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool,
                     continue
                 # count lines consumed for stable line numbers
                 line_base += window.count(b"\n", start, start + consumed.value)
-                if n:
+                if n or counters.any():
+                    # zero-row fills with consumed lines still surface
+                    # their counters so totals stay exact
                     yield arrays, int(n), window, start, {
                         "line": int(counters[0]),
                         "skipped_contig": int(counters[1]),
                         "skipped_alt": int(counters[2]),
                         "malformed": int(counters[3]),
-                    }
-                elif counters.any():
-                    # lines consumed but zero rows (all filtered): surface
-                    # the counters so totals stay exact
-                    yield arrays, 0, window, start, {
-                        "line": int(counters[0]),
-                        "skipped_contig": int(counters[1]),
-                        "skipped_alt": int(counters[2]),
-                        "malformed": int(counters[3]),
-                    }
+                    }, decoded_cache
                 start += consumed.value
                 if not need_more.value:
                     break
@@ -221,7 +218,8 @@ class LazyColumn:
 
 def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
                       counters: dict, width: int, identity_only: bool,
-                      pack_alleles: bool = True):
+                      pack_alleles: bool = True,
+                      decoded_cache: list | None = None):
     """Assemble a :class:`~annotatedvdb_tpu.io.vcf.VcfChunk` from one native
     batch.  Device arrays are copied out (the buffers are reused by the next
     fill); sidecar columns are lazy views over the window bytes."""
@@ -269,11 +267,19 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     else:
         ref_packed = alt_packed = None
     line_no = arrays.line_no[:n].copy()
-    mv = memoryview(window)
+    # the window decodes ONCE on first span access (ascii is 1 byte -> 1
+    # char, so byte offsets index the str directly): per-field str slices
+    # beat per-field bytes().decode() when consumers touch several sidecar
+    # fields per row (QC/LoF updates read 4-5).  The cache is shared by
+    # every chunk cut from the same window (scan_native owns it) so
+    # multi-fill windows decode once, not once per chunk.
+    decoded = decoded_cache if decoded_cache is not None else []
 
     def span(off, length, i):
+        if not decoded:
+            decoded.append(window.decode("ascii", errors="replace"))
         o = base + int(off[i])
-        return bytes(mv[o:o + int(length[i])]).decode("ascii", errors="replace")
+        return decoded[0][o:o + int(length[i])]
 
     refs = LazyColumn(n, lambda i: span(ref_off, batch.ref_len, i))
     alts = LazyColumn(n, lambda i: span(alt_off, batch.alt_len, i))
@@ -329,6 +335,15 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         has_freq=has_freq,
         rs_position=LazyColumn(n, lambda i: info_at(i)[0].get("RSPOS")),
         info=LazyColumn(n, lambda i: info_at(i)[0]),
+        info_raw=LazyColumn(
+            n, lambda i: (
+                # identity_only parity with info_at: both INFO views must
+                # agree (a batch strategy reading raw text where the
+                # per-row path sees {} would fork behavior)
+                span(info_off, info_len, i)
+                if info_len[i] > 0 and not identity_only else None
+            )
+        ),
         line_number=line_no,
         rs_number=rs_number,
         rs_weird=rs_weird,
@@ -349,7 +364,7 @@ def iter_native_chunks(path: str, batch_size: int, width: int,
     """VcfChunk iterator over the native scanner (engine='native')."""
     pending_counters = {"line": 0, "skipped_contig": 0, "skipped_alt": 0,
                         "malformed": 0}
-    for arrays, n, window, base, counters in scan_native(
+    for arrays, n, window, base, counters, decoded_cache in scan_native(
             path, batch_size, width, identity_only, pack_alleles):
         for k, v in counters.items():
             pending_counters[k] = pending_counters.get(k, 0) + v
@@ -357,7 +372,7 @@ def iter_native_chunks(path: str, batch_size: int, width: int,
             continue
         chunk = chunk_from_native(
             arrays, n, window, base, pending_counters, width, identity_only,
-            pack_alleles,
+            pack_alleles, decoded_cache,
         )
         pending_counters = {k: 0 for k in pending_counters}
         yield chunk

@@ -908,29 +908,75 @@ class ChromosomeShard:
         """Set/merge a JSONB column at given global ids; returns update count.
 
         ``merge=True`` applies jsonb_merge deep-merge semantics (patch wins);
-        ``merge=False`` replaces, matching plain-assignment UPDATEs."""
+        ``merge=False`` replaces, matching plain-assignment UPDATEs.
+        Fresh rows (no stored value — the bulk of any first-pass update
+        load) are assigned with one fancy-index scatter per segment; only
+        rows that actually merge pay per-row work.  Duplicate ids within
+        one call keep strict in-order semantics (the second occurrence
+        merges into the first's result) via the ordered fallback."""
         index = np.asarray(index, np.int64)
+        if index.size == 0:
+            return 0
+        vals = np.empty(index.shape, object)
+        if isinstance(values, np.ndarray) and values.dtype == object:
+            vals[:] = values  # array->array copy: elements not probed
+        else:
+            # element-wise on purpose: bulk list->object-array assignment
+            # probes each element's __len__ (numpy sniffing for nested
+            # sequences), and RawJson.__len__ parses its JSON — one hidden
+            # json.loads per row
+            for k, v in enumerate(values):
+                vals[k] = v
+        valid = index >= 0
+        count = int(valid.sum())
+        if count == 0:
+            return 0
+        if not valid.all():
+            index, vals = index[valid], vals[valid]
         seg_idx, off = self._locate(index)
-        count = 0
-        for i, si, j, v in zip(index, seg_idx, off, values):
-            if i < 0:
-                continue
+        for si in np.unique(seg_idx):
             s = self.segments[int(si)]
             col = s.obj_dense(column)
-            j = int(j)
-            cur = col[j]
-            if merge and cur is not None and (
-                    isinstance(cur, (dict, RawJson))
-                    and isinstance(v, (dict, RawJson))):
-                # deep-merge: materialize raw values per row (fresh — a
-                # RawJson may back several rows) before mutating
-                if isinstance(cur, RawJson):
-                    cur = col[j] = cur.fresh()
-                deep_update(cur, v.fresh() if isinstance(v, RawJson) else v)
-            else:
-                col[j] = v
+            m = seg_idx == si
+            offs, vs = off[m], vals[m]
             s.dirty = True
-            count += 1
+            if np.unique(offs).size != offs.size:
+                # duplicate rows in one call: order is observable (later
+                # values merge into earlier results) — per-row loop
+                for j, v in zip(offs, vs):
+                    j = int(j)
+                    cur = col[j]
+                    if merge and cur is not None and (
+                            isinstance(cur, (dict, RawJson))
+                            and isinstance(v, (dict, RawJson))):
+                        if isinstance(cur, RawJson):
+                            cur = col[j] = cur.fresh()
+                        deep_update(
+                            cur, v.fresh() if isinstance(v, RawJson) else v
+                        )
+                    else:
+                        col[j] = v
+                continue
+            cur = col[offs]
+            if merge:
+                replace = np.fromiter(
+                    (c is None
+                     or not isinstance(c, (dict, RawJson))
+                     or not isinstance(v, (dict, RawJson))
+                     for c, v in zip(cur, vs)),
+                    bool, offs.size,
+                )
+            else:
+                replace = np.ones(offs.size, bool)
+            col[offs[replace]] = vs[replace]
+            if not replace.all():
+                km = ~replace
+                for j, c, v in zip(offs[km], cur[km], vs[km]):
+                    # deep-merge: materialize raw values per row (fresh —
+                    # a RawJson may back several rows) before mutating
+                    if isinstance(c, RawJson):
+                        c = col[int(j)] = c.fresh()
+                    deep_update(c, v.fresh() if isinstance(v, RawJson) else v)
         return count
 
     def set_flag(self, index: np.ndarray, column: str, values) -> None:

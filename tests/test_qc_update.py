@@ -145,3 +145,40 @@ def test_qc_cli(tmp_path):
     assert reloaded.n == 4
     shard, i = find_row(reloaded, 1, 100)
     assert shard.annotations["adsp_qc"][i]["r4"]["filter"] == "PASS"
+
+
+def test_info_to_json_parity():
+    """info_to_json must emit JSON that parses to exactly parse_info's
+    dict, for every token class (fast paths AND fallbacks)."""
+    import json
+
+    import pytest
+
+    from annotatedvdb_tpu.io.vcf import info_to_json, parse_info
+
+    cases = [
+        "ABHet=0.5;AC=3",
+        "RS=12;RSPOS=100;FREQ=GnomAD:0.5,0.25|TOPMED:.,0.1",
+        "DP=100;VDB=1.3e-2;INDEL;MQ0F=0",
+        "K=007;NEG=-5;PLUS=+12;UND=1_0",
+        "S=INDEL;T=NA;U=GT:DP;EMPTY=;DOT=.",
+        "WS= 12 ;TAB=\t3\t",
+        "ESC=a\\x2cb;HASH=a#b;SLASH=c\\x59d",
+        'QUOTE="x";BACK=a\\b',
+        "BIG=123456789012345678901234567890",
+        "F=.5;G=5.;H=1e3;I=-1.5E-3",
+        "MIXED=12ab;UNI=é",
+        "NANISH=nankeeper;INFY=infinite",  # prefixes, NOT float words
+    ]
+    for s in cases:
+        assert json.loads(info_to_json(s)) == parse_info(s), s
+    for bad in ("X=inf", "X=Infinity", "X=nan", "X=NaN", "X=-inf",
+                "X= inf ", "X=1e400", "X=-1e999"):
+        with pytest.raises(ValueError):
+            info_to_json(bad)
+    # trailing-newline values must not splice control characters (or dodge
+    # the abort) via '$'-anchor newline matching
+    assert json.loads(info_to_json("X=abc\n")) == parse_info("X=abc\n")
+    assert json.loads(info_to_json("X=5\n")) == parse_info("X=5\n")
+    with pytest.raises(ValueError):
+        info_to_json("X=inf\n")
