@@ -72,6 +72,12 @@ def _open_text(path: str):
     return open(path, "r", encoding="utf-8")
 
 
+def _open_bytes(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
 class TpuVepLoader:
     """Update-only loader: annotates variants already present in the store."""
 
@@ -159,18 +165,18 @@ class TpuVepLoader:
         # update loads probe a static store per flush: pin membership
         # caches in HBM where the link makes that a win (no-op otherwise)
         self.store.pin_for_updates()
-        lines: list[str] = []
+        lines: list[bytes] = []
         n_added_before = len(self.parser.ranker.added)
         use_native = (
             os.environ.get("AVDB_NATIVE_VEP", "1") != "0"
             and native_vep.available()
         )
 
-        def flush_python(batch_lines: list[str]) -> None:
+        def flush_python(batch_lines: list[bytes]) -> None:
             # ONE json.loads over the whole flush (lines joined into a JSON
             # array) — the C decoder amortizes per-call setup and allocator
             # churn across the batch, ~2x a per-line loads loop
-            raw = json.loads(f'[{",".join(batch_lines)}]')
+            raw = json.loads(b"[" + b",".join(batch_lines) + b"]")
             # batched combo->rank resolution through the compiled rank-table
             # snapshot first (device path for large batches); the per-row
             # parse below then hits the memo, and only novel combos take the
@@ -250,16 +256,33 @@ class TpuVepLoader:
             lines.clear()
             self._cadence.maybe_log(self.counters["line"], self.counters)
 
-        for line in _open_text(path):
-            if not line.strip():
-                continue
-            self.counters["line"] += 1
-            lines.append(line)
-            if len(lines) >= self.batch_size:
-                flush()
-                if test:
+        # binary chunked read: lines stay bytes end to end (json.loads and
+        # the native transformer both take bytes; only rare Python-fallback
+        # docs ever decode) — a per-line text iterator costs ~10% of the
+        # whole leg
+        stop = False
+        with _open_bytes(path) as fh:
+            tail = b""
+            while not stop:
+                block = fh.read(4 << 20)
+                if not block:
                     break
-        if lines:
+                parts = (tail + block).split(b"\n")
+                tail = parts.pop()
+                for ln in parts:
+                    if not ln.strip():
+                        continue
+                    self.counters["line"] += 1
+                    lines.append(ln)
+                    if len(lines) >= self.batch_size:
+                        flush()
+                        if test:
+                            stop = True
+                            break
+            if not stop and tail.strip():
+                self.counters["line"] += 1
+                lines.append(tail)
+        if lines and not stop:
             flush()
         added = self.parser.ranker.added[n_added_before:]
         if added:
@@ -337,7 +360,6 @@ class TpuVepLoader:
         (``store.variant_store.RawJson``), and sharing one RawJson across a
         doc's alts is safe because raw values are immutable (the store
         materializes fresh objects per row on any merge/read)."""
-        from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
         from annotatedvdb_tpu.utils.arrays import next_pow2
 
         if hi is None:
@@ -362,7 +384,11 @@ class TpuVepLoader:
         rk_off, rk_len = res.rk_off[sl], res.rk_len[sl]
         fq_off, fq_len = res.fq_off[sl], res.fq_len[sl]
         vo_off, vo_len = res.vo_off[sl], res.vo_len[sl]
-        h, _prefix, host = self._batch_identity(batch)
+        # identity straight from the transformer: the C++ hash is the
+        # device kernel's bit-exact twin, with over-width rows already
+        # full-string re-hashed (parity pinned by tests/test_vep_native) —
+        # the apply side makes no device round trip at all
+        h = res.hash[sl]
         arena = res.arena
         # ASCII arenas (the normal case) decode once; byte offsets then
         # equal str offsets so per-value slicing stays on the str
@@ -386,15 +412,6 @@ class TpuVepLoader:
 
         for code in np.unique(batch.chrom):
             sel = np.where(batch.chrom == code)[0]
-            for i in sel[host[sel]]:
-                # over-width alleles: identity from the original strings
-                ref_s = res.text[
-                    ref_off[i]:ref_off[i] + ref_slen[i]
-                ].decode()
-                alt_s = res.text[
-                    alt_off[i]:alt_off[i] + alt_slen[i]
-                ].decode()
-                h[i] = _fnv32_str(ref_s, alt_s)
             shard = self.store.shard(int(code))
             found, idx = shard.lookup(
                 batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],

@@ -26,6 +26,26 @@ _lib = None
 _lib_error: str | None = None
 
 
+def _host_tag() -> bytes:
+    """CPU identity folded into the build digest: -march=native binaries
+    are only valid on the microarchitecture that built them, so a cache
+    directory carried to a different host (image copy, shared FS) must
+    rebuild rather than SIGILL on the first vectorized call."""
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags")):
+                    tag += line
+                    if line.startswith("flags"):
+                        break
+    except OSError:
+        pass
+    return tag.encode()
+
+
 def build_shared_lib(source: str, stem: str, extra_flags: tuple = ()) -> str:
     """Content-hashed lazy g++ build shared by every native component
     (the VCF tokenizer, the VEP transformer, the pyfast extension): a
@@ -33,7 +53,9 @@ def build_shared_lib(source: str, stem: str, extra_flags: tuple = ()) -> str:
     and the tmp+rename publish is atomic under concurrent builders.
     Compiler stderr is preserved in the raised error on failure."""
     with open(source, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        digest = hashlib.sha256(
+            f.read() + repr(extra_flags).encode() + _host_tag()
+        ).hexdigest()[:16]
     so_path = os.path.join(_CACHE_DIR, f"{stem}-{digest}.so")
     if os.path.exists(so_path):
         return so_path
@@ -41,8 +63,11 @@ def build_shared_lib(source: str, stem: str, extra_flags: tuple = ()) -> str:
     tmp = so_path + f".tmp{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             *extra_flags, "-o", tmp, source],
+            # -march=native: these libs are built AND run on the same
+            # machine (content-hashed local cache), so vectorized byte
+            # loops may use whatever the host offers
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             "-std=c++17", *extra_flags, "-o", tmp, source],
             check=True, capture_output=True, text=True,
         )
     except subprocess.CalledProcessError as err:

@@ -61,7 +61,7 @@ def load():
             + [c.c_void_p] * 3           # doc_of_row, chrom, pos
             + [c.c_void_p] * 4           # ref_mat, alt_mat, ref_len, alt_len
             + [c.c_void_p] * 4           # ref_off/slen, alt_off/slen
-            + [c.c_void_p]               # is_multi
+            + [c.c_void_p] * 3           # is_multi, hash, host_fb
             + [c.c_void_p] * 8           # ms/rk/fq/vo off+len
             + [c.c_int64, c.c_void_p, c.c_void_p]  # docs_cap, doc_fallback, doc_skipped
             + [c.c_void_p, c.c_int64]    # arena, arena_cap
@@ -108,6 +108,9 @@ class VepTransform(NamedTuple):
     alt_off: np.ndarray
     alt_slen: np.ndarray
     is_multi: np.ndarray
+    hash: np.ndarray           # uint32 identity hash (device-kernel twin;
+    #                            over-width rows already host-re-hashed)
+    host_fb: np.ndarray        # 1 where an allele exceeds the matrix width
     ms_off: np.ndarray
     ms_len: np.ndarray
     rk_off: np.ndarray
@@ -122,44 +125,92 @@ class VepTransform(NamedTuple):
     text: bytes                # the joined input lines (spans reference it)
 
 
-def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
+# reusable output-buffer pool, keyed by (rows_cap, width) / capacity: a
+# transformer flush allocates ~40MB of numpy outputs, and per-call fresh
+# allocations pay first-touch page faults every flush.  CONTRACT: the
+# arrays inside a VepTransform are views into these pooled buffers and are
+# valid only until the NEXT transform() call in the process — consumers
+# (the VEP loader) fully drain a result before the next flush; anything
+# that retains data copies it (fancy indexing / .tobytes() already do).
+_ROW_POOL: dict = {}
+_DOC_POOL: list = []
+_ARENA_POOL: list = []
+
+
+def _row_buffers(rows_cap: int, width: int) -> dict:
+    key = (rows_cap, width)
+    bufs = _ROW_POOL.get(key)
+    if bufs is None:
+        if len(_ROW_POOL) > 8:
+            _ROW_POOL.clear()  # unbounded shape churn: keep the pool tiny
+        bufs = _ROW_POOL[key] = {
+            "doc_of_row": np.empty(rows_cap, np.int32),
+            "chrom": np.empty(rows_cap, np.int8),
+            "pos": np.empty(rows_cap, np.int32),
+            "ref": np.empty((rows_cap, width), np.uint8),
+            "alt": np.empty((rows_cap, width), np.uint8),
+            "ref_len": np.empty(rows_cap, np.int32),
+            "alt_len": np.empty(rows_cap, np.int32),
+            "ref_off": np.empty(rows_cap, np.int64),
+            "ref_slen": np.empty(rows_cap, np.int32),
+            "alt_off": np.empty(rows_cap, np.int64),
+            "alt_slen": np.empty(rows_cap, np.int32),
+            "is_multi": np.empty(rows_cap, np.uint8),
+            "hash": np.empty(rows_cap, np.uint32),
+            "host_fb": np.empty(rows_cap, np.uint8),
+            "ms_off": np.empty(rows_cap, np.int64),
+            "ms_len": np.empty(rows_cap, np.int32),
+            "rk_off": np.empty(rows_cap, np.int64),
+            "rk_len": np.empty(rows_cap, np.int32),
+            "fq_off": np.empty(rows_cap, np.int64),
+            "fq_len": np.empty(rows_cap, np.int32),
+            "vo_off": np.empty(rows_cap, np.int64),
+            "vo_len": np.empty(rows_cap, np.int32),
+        }
+    return bufs
+
+
+def _doc_buffers(n: int) -> tuple:
+    if not _DOC_POOL or _DOC_POOL[0][0].shape[0] < n:
+        _DOC_POOL[:] = [(np.empty(n, np.uint8), np.empty(n, np.int32))]
+    fb, sk = _DOC_POOL[0]
+    return fb[:n], sk[:n]
+
+
+def _arena_buffer(cap: int) -> np.ndarray:
+    if not _ARENA_POOL or _ARENA_POOL[0].shape[0] < cap:
+        _ARENA_POOL[:] = [np.empty(cap, np.uint8)]
+    return _ARENA_POOL[0]
+
+
+def transform(lines: "list[bytes] | list[str]", blob: bytes, is_dbsnp: bool,
               width: int) -> VepTransform | None:
-    """Run the native transformer over one flush; None when the library is
-    unavailable (callers use the pure-Python path)."""
+    """Run the native transformer over one flush (bytes lines preferred —
+    the loader reads binary and never decodes the hot path); None when the
+    library is unavailable (callers use the pure-Python path).
+
+    The returned row/doc arrays are views into pooled buffers, valid until
+    the next ``transform`` call (see the pool contract above)."""
     lib = load()
     if lib is None:
         return None
-    text = "\n".join(lines).encode()
+    joiner = b"\n" if lines and isinstance(lines[0], bytes) else "\n"
+    text = joiner.join(lines)
+    if isinstance(text, str):
+        text = text.encode()
     n_docs = len(lines)
     rows_cap = max(2 * n_docs + 64, 256)
     arena_cap = 4 * len(text) + (1 << 20)
     c = ctypes
     while True:
-        a = {
-            "doc_of_row": np.zeros(rows_cap, np.int32),
-            "chrom": np.zeros(rows_cap, np.int8),
-            "pos": np.zeros(rows_cap, np.int32),
-            "ref": np.zeros((rows_cap, width), np.uint8),
-            "alt": np.zeros((rows_cap, width), np.uint8),
-            "ref_len": np.zeros(rows_cap, np.int32),
-            "alt_len": np.zeros(rows_cap, np.int32),
-            "ref_off": np.zeros(rows_cap, np.int64),
-            "ref_slen": np.zeros(rows_cap, np.int32),
-            "alt_off": np.zeros(rows_cap, np.int64),
-            "alt_slen": np.zeros(rows_cap, np.int32),
-            "is_multi": np.zeros(rows_cap, np.uint8),
-            "ms_off": np.zeros(rows_cap, np.int64),
-            "ms_len": np.zeros(rows_cap, np.int32),
-            "rk_off": np.zeros(rows_cap, np.int64),
-            "rk_len": np.zeros(rows_cap, np.int32),
-            "fq_off": np.zeros(rows_cap, np.int64),
-            "fq_len": np.zeros(rows_cap, np.int32),
-            "vo_off": np.zeros(rows_cap, np.int64),
-            "vo_len": np.zeros(rows_cap, np.int32),
-        }
-        doc_fallback = np.zeros(n_docs + 1, np.uint8)
-        doc_skipped = np.zeros(n_docs + 1, np.int32)
-        arena = ctypes.create_string_buffer(arena_cap)
+        # pooled np.empty buffers: the transformer writes every field of
+        # every emitted row AND every doc's fallback/skip entries, so
+        # neither zero-initialization (the original create_string_buffer
+        # memset was the dominant per-call cost) nor fresh pages per flush
+        # are needed
+        a = _row_buffers(rows_cap, width)
+        doc_fallback, doc_skipped = _doc_buffers(n_docs + 1)
+        arena = _arena_buffer(arena_cap)
         out_rows = c.c_int64(0)
         out_docs = c.c_int64(0)
         arena_used = c.c_int64(0)
@@ -170,14 +221,14 @@ def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
                 a["doc_of_row"], a["chrom"], a["pos"],
                 a["ref"], a["alt"], a["ref_len"], a["alt_len"],
                 a["ref_off"], a["ref_slen"], a["alt_off"], a["alt_slen"],
-                a["is_multi"],
+                a["is_multi"], a["hash"], a["host_fb"],
                 a["ms_off"], a["ms_len"], a["rk_off"], a["rk_len"],
                 a["fq_off"], a["fq_len"], a["vo_off"], a["vo_len"],
             )),
             n_docs + 1,
             doc_fallback.ctypes.data_as(c.c_void_p),
             doc_skipped.ctypes.data_as(c.c_void_p),
-            arena, arena_cap,
+            arena.ctypes.data_as(c.c_void_p), arena_cap,
             c.byref(out_rows), c.byref(out_docs), c.byref(arena_used),
         )
         if rc == 1:
@@ -194,6 +245,6 @@ def transform(lines: list[str], blob: bytes, is_dbsnp: bool,
             **{k: v[:n] for k, v in a.items()},
             doc_fallback=doc_fallback[: out_docs.value],
             doc_skipped=doc_skipped[: out_docs.value],
-            arena=arena.raw[: arena_used.value],
+            arena=arena[: arena_used.value].tobytes(),
             text=text,
         )

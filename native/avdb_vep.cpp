@@ -305,6 +305,27 @@ struct Doc {
     // kept top-level keys for cleaned vep_output, in original order
     std::vector<std::pair<Span, Span>> kept;   // (key, value span)
     int64_t input_key_index = -1;              // position of "input" in kept order
+    // colocated-variant scratch (parse_doc); lives here so one Doc reused
+    // across a whole transform call keeps every vector's capacity
+    std::vector<Span> covar_freqs;
+    std::vector<Span> covar_ids;
+    std::vector<Span> covar_alleles;
+
+    // clear per doc, retaining heap capacity (per-doc construction cost
+    // ~10 allocations/frees at millions of docs)
+    void reset() {
+        input_str = Span{};
+        for (int t = 0; t < N_CTYPES; ++t) {
+            conseqs[t].clear();
+            has_ctype[t] = false;
+        }
+        freq_obj = Span{};
+        kept.clear();
+        input_key_index = -1;
+        covar_freqs.clear();
+        covar_ids.clear();
+        covar_alleles.clear();
+    }
 };
 
 inline int8_t chrom_code(const char* s, int len) {
@@ -331,18 +352,75 @@ inline int8_t chrom_code(const char* s, int len) {
     return 0;
 }
 
+// per-transform memo: raw bytes of a "consequence_terms" array -> rank
+// entry (nullptr = known-novel combo).  Real VEP files repeat a few dozen
+// distinct combos across millions of consequences; caching on the RAW
+// span skips per-conseq term parsing, canonical sort/join allocations and
+// the hash-map lookup.  Spans index the call's text, so the cache lives
+// for exactly one transform call.
+struct ComboCache {
+    struct E {
+        uint32_t h;
+        Span raw;
+        const RankEntry* entry;
+    };
+    std::vector<E> entries;
+};
+
+inline uint32_t span_fnv(const char* s, const Span& sp) {
+    uint32_t h = 2166136261u;
+    for (int32_t k = 0; k < sp.len; ++k)
+        h = (h ^ static_cast<uint8_t>(s[sp.off + k])) * 16777619u;
+    return h;
+}
+
+// resolve one raw consequence_terms span to its rank entry via the cache;
+// *ok=false on malformed JSON inside the span
+const RankEntry* resolve_combo(const char* s, Span raw,
+                               const RankTable& table, ComboCache* cache,
+                               bool* ok) {
+    *ok = true;
+    uint32_t h = span_fnv(s, raw);
+    for (const ComboCache::E& e : cache->entries)
+        if (e.h == h && e.raw.len == raw.len
+            && std::memcmp(s + e.raw.off, s + raw.off, raw.len) == 0)
+            return e.entry;
+    // slow path (once per distinct combo): parse, canonize, look up
+    Cur tc{s, raw.off, raw.off + raw.len};
+    ArrIter ta(tc);
+    if (ta.fail) { *ok = false; return nullptr; }
+    std::vector<std::string> tv;
+    while (ta.next()) {
+        Span t;
+        if (!plain_string(tc, &t)) { *ok = false; return nullptr; }
+        tv.emplace_back(s + t.off, t.len);
+    }
+    if (ta.fail) { *ok = false; return nullptr; }
+    std::sort(tv.begin(), tv.end());
+    std::string canon;
+    for (size_t k = 0; k < tv.size(); ++k) {
+        if (k) canon.push_back(',');
+        canon += tv[k];
+    }
+    auto it = table.find(canon);
+    const RankEntry* entry = it == table.end() ? nullptr : &it->second;
+    if (cache->entries.size() < 4096)
+        cache->entries.push_back({h, raw, entry});
+    return entry;
+}
+
 // parse the 4 consequence-block arrays + colocated + kept keys of one doc
 bool parse_doc(Cur& c, const RankTable& table, bool is_dbsnp, Doc* d,
-               Span id_for_match) {
+               Span id_for_match, ComboCache* combos) {
     ObjIter top(c);
     if (top.fail) return false;
     Span key;
     // colocated candidates: reference keeps the LAST covar with
-    // frequencies (matching the id when is_dbsnp and the id is an rs)
-    std::vector<std::pair<Span, Span>> covars;  // (allele_string?, whole) unused; store freq spans
-    std::vector<Span> covar_freqs;
-    std::vector<Span> covar_ids;
-    std::vector<Span> covar_alleles;
+    // frequencies (matching the id when is_dbsnp and the id is an rs);
+    // scratch vectors live on the Doc (capacity reuse across docs)
+    std::vector<Span>& covar_freqs = d->covar_freqs;
+    std::vector<Span>& covar_ids = d->covar_ids;
+    std::vector<Span>& covar_alleles = d->covar_alleles;
     bool saw_coloc = false;
     int64_t n_covars = 0;
 
@@ -366,18 +444,13 @@ bool parse_doc(Cur& c, const RankTable& table, bool is_dbsnp, Doc* d,
                 ObjIter el(c);
                 if (el.fail) return false;
                 Span ekey;
-                std::vector<Span> terms;
+                Span terms_raw{};
                 bool have_terms = false, have_allele = false;
                 while (el.next(&ekey)) {
                     if (span_eq(c.s, ekey, "consequence_terms")) {
-                        ArrIter ta(c);
-                        if (ta.fail) return false;
-                        while (ta.next()) {
-                            Span t;
-                            if (!plain_string(c, &t)) return false;
-                            terms.push_back(t);
-                        }
-                        if (ta.fail) return false;
+                        // raw span only; the combo cache resolves it (and
+                        // parses term-wise just once per distinct combo)
+                        if (!skip_value(c, &terms_raw)) return false;
                         have_terms = true;
                     } else if (span_eq(c.s, ekey, "variant_allele")) {
                         if (!plain_string(c, &q.allele)) return false;
@@ -390,20 +463,11 @@ bool parse_doc(Cur& c, const RankTable& table, bool is_dbsnp, Doc* d,
                 q.obj.off = el_start;
                 q.obj.len = static_cast<int32_t>(c.i - el_start);
                 q.order = order++;
-                // canon combo: terms sorted bytewise, joined with ','
-                std::vector<std::string> tv;
-                tv.reserve(terms.size());
-                for (const Span& t : terms)
-                    tv.emplace_back(c.s + t.off, t.len);
-                std::sort(tv.begin(), tv.end());
-                std::string canon;
-                for (size_t k = 0; k < tv.size(); ++k) {
-                    if (k) canon.push_back(',');
-                    canon += tv[k];
-                }
-                auto it = table.find(canon);
-                if (it == table.end()) return false;  // novel combo -> host
-                q.rank = &it->second;
+                bool combo_ok;
+                q.rank = resolve_combo(c.s, terms_raw, table, combos,
+                                       &combo_ok);
+                if (!combo_ok) return false;       // malformed terms array
+                if (q.rank == nullptr) return false;  // novel combo -> host
                 d->conseqs[ctype].push_back(q);
             }
             if (arr.fail) return false;
@@ -561,6 +625,9 @@ int64_t avdb_vep_transform(
     int64_t* ref_off, int32_t* ref_slen,
     int64_t* alt_off, int32_t* alt_slen,
     uint8_t* is_multi,
+    // identity hash per row (uint32 FNV-1a; see fnv comment at the emit
+    // site) + over-width flag (allele longer than the matrix width)
+    uint32_t* hash_out, uint8_t* host_fb,
     int64_t* ms_off, int32_t* ms_len,
     int64_t* rk_off, int32_t* rk_len,
     int64_t* fq_off, int32_t* fq_len,
@@ -573,6 +640,17 @@ int64_t avdb_vep_transform(
     int64_t rows = 0;
     int64_t docs = 0;
     int64_t li = 0;
+
+    // prime^k table for zero-pad folding in the identity hash (pad bytes
+    // are zeros: x ^ 0 == x, so each contributes one multiply)
+    uint32_t primepow[4096];
+    int pp_n = width + 1 <= 4096 ? width + 1 : 4096;
+    primepow[0] = 1u;
+    for (int k = 1; k < pp_n; ++k) primepow[k] = primepow[k - 1] * 16777619u;
+
+    ComboCache combos;  // per-call: spans reference this call's text
+    Doc d;              // reused across docs (reset() keeps capacities)
+    std::vector<const Conseq*> mine;  // per-(row,ctype) scratch
 
     while (li < n_bytes) {
         int64_t le = li;
@@ -596,7 +674,7 @@ int64_t avdb_vep_transform(
         int64_t arena_mark = arena.mark();
 
         Cur c{text, li, le};
-        Doc d;
+        d.reset();
         // the id field of the parsed input line feeds dbSNP freq matching;
         // parse input FIRST via a pre-scan?  The doc object may put
         // "input" after colocated_variants; two-pass: first locate input.
@@ -656,13 +734,21 @@ int64_t avdb_vep_transform(
             if (nf < 5) ok = false;
         }
         int8_t code = 0;
+        long pos_val = 0;
         if (ok) {
             code = chrom_code(text + fields[0].off, fields[0].len);
-            // position must be plain digits for the verbatim splice
+            // position must be plain digits for the verbatim splice, and
+            // must fit int32 — an overflowing value here would silently
+            // wrap where the Python path raises, so such docs take the
+            // fallback (explicit-failure parity)
             if (fields[1].len == 0) ok = false;
-            for (int32_t k = 0; ok && k < fields[1].len; ++k)
-                if (text[fields[1].off + k] < '0' || text[fields[1].off + k] > '9')
-                    ok = false;
+            for (int32_t k = 0; ok && k < fields[1].len; ++k) {
+                char pc = text[fields[1].off + k];
+                if (pc < '0' || pc > '9') ok = false;
+                else if (pos_val > (INT64_C(0x7fffffff) - (pc - '0')) / 10)
+                    ok = false;  // exact int32 bound
+                else pos_val = pos_val * 10 + (pc - '0');
+            }
         }
         if (ok)
             ok = parse_doc(c, table, is_dbsnp != 0, &d,
@@ -670,7 +756,8 @@ int64_t avdb_vep_transform(
                            (fields[2].len >= 2 && text[fields[2].off] == 'r' &&
                             text[fields[2].off + 1] == 's')
                                ? fields[2]
-                               : Span{});
+                               : Span{},
+                           &combos);
         if (!ok) {
             doc_fallback[doc_idx] = 1;
             rows = row_mark;
@@ -736,9 +823,7 @@ int64_t avdb_vep_transform(
         uint8_t multi = usable_alts > 1 ? 1 : 0;
 
         int64_t x = as;
-        long pos_val = std::strtol(std::string(text + fields[1].off,
-                                               fields[1].len).c_str(),
-                                   nullptr, 10);
+        // pos_val parsed (and int32-bounded) during validation above
         while (x <= aend) {
             int64_t y = x;
             while (y < aend && text[y] != ',') ++y;
@@ -771,6 +856,46 @@ int64_t avdb_vep_transform(
             std::memcpy(rrow, rs, std::min<int32_t>(rl, width));
             std::memcpy(arow, text + x, std::min<int32_t>(alen_s, width));
 
+            // identity hash, FNV-1a over (rl&0xFF, al&0xFF, bytes...):
+            // width-bounded rows mirror ops/hashing.py::allele_hash over
+            // the padded matrices (zero pads fold to prime powers);
+            // over-width rows mirror the loaders' _fnv32_str full-string
+            // host re-hash and are flagged host_fb — this is exactly the
+            // hash the Python path would compute, so no device round trip
+            // (or per-row re-hash) remains on the apply side
+            {
+                const uint32_t prime = 16777619u;
+                bool over = rl > width || alen_s > width;
+                host_fb[r] = over ? 1 : 0;
+                uint32_t h = 2166136261u;
+                h = (h ^ static_cast<uint32_t>(rl & 0xFF)) * prime;
+                h = (h ^ static_cast<uint32_t>(alen_s & 0xFF)) * prime;
+                if (over) {
+                    for (int32_t i2 = 0; i2 < rl; ++i2)
+                        h = (h ^ static_cast<uint8_t>(rs[i2])) * prime;
+                    for (int32_t i2 = 0; i2 < alen_s; ++i2)
+                        h = (h ^ static_cast<uint8_t>(text[x + i2])) * prime;
+                } else {
+                    for (int32_t i2 = 0; i2 < rl; ++i2)
+                        h = (h ^ static_cast<uint8_t>(rs[i2])) * prime;
+                    int pad = width - rl;
+                    while (pad >= pp_n) {
+                        h *= primepow[pp_n - 1];
+                        pad -= pp_n - 1;
+                    }
+                    h *= primepow[pad];
+                    for (int32_t i2 = 0; i2 < alen_s; ++i2)
+                        h = (h ^ static_cast<uint8_t>(text[x + i2])) * prime;
+                    pad = width - alen_s;
+                    while (pad >= pp_n) {
+                        h *= primepow[pp_n - 1];
+                        pad -= pp_n - 1;
+                    }
+                    h *= primepow[pad];
+                }
+                hash_out[r] = h;
+            }
+
             // ---- left-normalize: shared prefix of ref vs THIS alt
             int32_t p = 0;
             if (!(rl == 1 && alen_s == 1)) {  // SNVs untouched
@@ -793,7 +918,7 @@ int64_t avdb_vep_transform(
             arena.ch('{');
             for (int t = 0; t < N_CTYPES; ++t) {
                 // collect this allele's conseqs, sorted by (rank, order)
-                std::vector<const Conseq*> mine;
+                mine.clear();
                 for (const Conseq& q : d.conseqs[t]) {
                     if (q.allele.len == norm_len &&
                         std::memcmp(text + q.allele.off, norm, norm_len) == 0)

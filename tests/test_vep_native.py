@@ -306,3 +306,44 @@ def test_fuzz_parity(tmp_path, monkeypatch, seed):
                 assert _materialize(av[i]) == _materialize(bv[i]), (
                     code, col, i, av[i], bv[i]
                 )
+
+
+def test_native_hash_matches_python_identity():
+    """The transformer's per-row identity hash must be the bit-exact twin
+    of the device kernel over the width-bounded matrices, with over-width
+    rows full-string re-hashed exactly like the loaders' _fnv32_str."""
+    from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
+    from annotatedvdb_tpu.ops.hashing import allele_hash_np
+
+    width = 8
+    long_ref = "A" * 20
+    docs = [
+        {"input": "1\t100\trs1\tA\tG", "most_severe_consequence": "x",
+         "transcript_consequences": [
+             {"consequence_terms": ["intron_variant"],
+              "variant_allele": "G"}]},
+        {"input": f"1\t200\trs2\t{long_ref}\tA", "most_severe_consequence":
+         "x", "transcript_consequences": [
+             {"consequence_terms": ["intron_variant"],
+              "variant_allele": "A"}]},
+        {"input": "2\t300\trs3\tCA\tC,CTT", "most_severe_consequence": "x",
+         "transcript_consequences": [
+             {"consequence_terms": ["intron_variant"],
+              "variant_allele": "-"}]},
+    ]
+    lines = [json.dumps(d) for d in docs]
+    blob = native_vep.ranking_blob(ConsequenceRanker())
+    res = native_vep.transform(lines, blob, True, width)
+    assert res is not None and res.n_rows == 4
+    want = allele_hash_np(res.ref, res.alt, res.ref_len, res.alt_len)
+    over = (res.ref_len > width) | (res.alt_len > width)
+    np.testing.assert_array_equal(res.host_fb.astype(bool), over)
+    for i in range(res.n_rows):
+        if over[i]:
+            want[i] = _fnv32_str(
+                bytes(res.text[res.ref_off[i]:res.ref_off[i]
+                               + res.ref_slen[i]]).decode(),
+                bytes(res.text[res.alt_off[i]:res.alt_off[i]
+                               + res.alt_slen[i]]).decode(),
+            )
+    np.testing.assert_array_equal(res.hash, want)
