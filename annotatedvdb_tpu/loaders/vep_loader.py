@@ -91,13 +91,22 @@ class TpuVepLoader:
         batch_size: int = 1 << 14,
         log=print,
         log_after: int | None = None,
+        mesh=None,
     ):
+        """``mesh``: optional multi-device :class:`jax.sharding.Mesh`; the
+        per-chunk identity resolution then runs as ONE sharded program
+        (chromosome re-shard + in-mesh lookup against a device-resident
+        store snapshot, ``parallel.distributed.distributed_update_step``) —
+        the TPU replacement for the reference's 10-process VEP update
+        fan-out (``load_vep_result.py:304-311``)."""
         self.store = store
         self.ledger = ledger
         self.parser = VepResultParser(ranker)
         self.datasource = datasource.lower() if datasource else None
         self.skip_existing = skip_existing
         self.batch_size = batch_size
+        self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
+        self._dev_snapshot = None
         self.log = log
         from annotatedvdb_tpu.utils.logging import ProgressCadence
 
@@ -171,6 +180,19 @@ class TpuVepLoader:
             os.environ.get("AVDB_NATIVE_VEP", "1") != "0"
             and native_vep.available()
         )
+        if self.mesh is not None and use_native:
+            # freeze the per-shard device snapshot once (the store is
+            # static for the whole update load); every native chunk then
+            # resolves identities in ONE sharded program.  Only the native
+            # path consumes it — copying/sorting the whole store for the
+            # Python fallback path would be pure waste.
+            from annotatedvdb_tpu.parallel.device_store import (
+                build_device_shard_store,
+            )
+
+            self._dev_snapshot = build_device_shard_store(
+                self.store, self.mesh.devices.size
+            )
 
         def flush_python(batch_lines: list[bytes]) -> None:
             # ONE json.loads over the whole flush (lines joined into a JSON
@@ -352,6 +374,53 @@ class TpuVepLoader:
             np.asarray(ann_p.host_fallback)[:n],
         )
 
+    def _mesh_lookup(self, batch: VariantBatch, h: np.ndarray,
+                     host_fb: np.ndarray):
+        """Resolve one slice's identities through the sharded update step.
+
+        Returns ``(found [N] bool, global id [N] int64)`` in input row
+        order.  Over-width rows (``host_fb``) are excluded on device (their
+        tokenizer hash is full-string, the device snapshot's is width-
+        bounded) and re-resolved with the host shard lookup — the same
+        split the single-device path applies."""
+        from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
+        from annotatedvdb_tpu.parallel.distributed import (
+            distributed_update_step,
+        )
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        n = batch.n
+        # pad to the pow2 shape bound (not just a device multiple):
+        # per-flush row counts vary, and every distinct padded size would
+        # trace + compile a fresh mesh program (~35s each on TPU)
+        q = _pad_batch(batch, max(next_pow2(n), self.mesh.devices.size))
+        rid_out, found_s, store_row, _counters = distributed_update_step(
+            self.mesh, q, self._dev_snapshot
+        )
+        rid_out = np.asarray(rid_out)
+        take = rid_out >= 0
+        src = rid_out[take]
+        found = np.zeros(n, np.bool_)
+        ids = np.full(n, -1, np.int64)
+        keep = src < n  # pad rows carry chrom 0 and never come back real
+        found[src[keep]] = np.asarray(found_s)[take][keep]
+        ids[src[keep]] = np.asarray(store_row)[take][keep]
+        # over-width tail: host re-resolve with the full-string hashes the
+        # transformer already produced
+        for i in np.where(host_fb)[0]:
+            code = int(batch.chrom[i])
+            shard = self.store.shards.get(code)
+            if shard is None:
+                continue
+            f, idx = shard.lookup(
+                batch.pos[i:i + 1], h[i:i + 1],
+                batch.ref[i:i + 1], batch.alt[i:i + 1],
+                batch.ref_len[i:i + 1], batch.alt_len[i:i + 1],
+            )
+            found[i] = bool(f[0])
+            ids[i] = int(idx[0])
+        return found, ids
+
     def _apply_native(self, res, alg_id: int, commit: bool,
                       lo: int = 0, hi: int | None = None) -> None:
         """Apply rows [lo, hi) of a native-transformed flush: identity
@@ -410,13 +479,21 @@ class TpuVepLoader:
                 )
             return v
 
+        mesh_found = mesh_ids = None
+        if self.mesh is not None and self._dev_snapshot is not None:
+            mesh_found, mesh_ids = self._mesh_lookup(
+                batch, h, res.host_fb[sl].astype(bool)
+            )
         for code in np.unique(batch.chrom):
             sel = np.where(batch.chrom == code)[0]
             shard = self.store.shard(int(code))
-            found, idx = shard.lookup(
-                batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
-                batch.ref_len[sel], batch.alt_len[sel],
-            )
+            if mesh_found is not None:
+                found, idx = mesh_found[sel], mesh_ids[sel]
+            else:
+                found, idx = shard.lookup(
+                    batch.pos[sel], h[sel], batch.ref[sel], batch.alt[sel],
+                    batch.ref_len[sel], batch.alt_len[sel],
+                )
             counters["not_found"] += int((~found).sum())
             rows_i = sel[found]
             ids = idx[found]

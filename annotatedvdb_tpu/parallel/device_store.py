@@ -45,6 +45,8 @@ class DeviceShardStore(NamedTuple):
     alt: np.ndarray       # [S, M, W] uint8
     ref_len: np.ndarray   # [S, M] int32
     alt_len: np.ndarray   # [S, M] int32
+    row_id: np.ndarray    # [S, M] int64 host-store global row id (-1 pad);
+    #                       valid until the host shard is appended/merged
     n_rows: np.ndarray    # [S] int64 real rows per shard
 
     @property
@@ -62,7 +64,8 @@ def build_device_shard_store(
     width = store.width
     for code, shard in store.shards.items():
         s = owner[min(code, len(owner) - 1)]
-        for seg in list(shard.segments):
+        starts = shard._starts()
+        for si, seg in enumerate(list(shard.segments)):
             per_shard[s].append(
                 (
                     np.full(seg.n, code, np.int8),
@@ -72,6 +75,10 @@ def build_device_shard_store(
                     seg.alt,
                     seg.cols["ref_len"],
                     seg.cols["alt_len"],
+                    # host-store global ids (segment-list order): the
+                    # update step hands matches back as these, so the host
+                    # applies annotation writes without re-looking-up
+                    int(starts[si]) + np.arange(seg.n, dtype=np.int64),
                 )
             )
     m = max(
@@ -88,6 +95,7 @@ def build_device_shard_store(
         "alt": np.zeros((n_shards, m, width), np.uint8),
         "ref_len": np.zeros((n_shards, m), np.int32),
         "alt_len": np.zeros((n_shards, m), np.int32),
+        "row_id": np.full((n_shards, m), -1, np.int64),
     }
     n_rows = np.zeros((n_shards,), np.int64)
     for s, bucket in enumerate(per_shard):
@@ -100,6 +108,7 @@ def build_device_shard_store(
         alt = np.concatenate([b[4] for b in bucket])
         rl = np.concatenate([b[5] for b in bucket])
         al = np.concatenate([b[6] for b in bucket])
+        rid = np.concatenate([b[7] for b in bucket])
         hm = h ^ (chrom.astype(np.uint32) * np.uint32(CHROM_MIX))
         key = (pos.astype(np.uint64) << np.uint64(32)) | hm
         order = np.argsort(key, kind="stable")
@@ -112,4 +121,5 @@ def build_device_shard_store(
         out["alt"][s, :k] = alt[order]
         out["ref_len"][s, :k] = rl[order]
         out["alt_len"][s, :k] = al[order]
+        out["row_id"][s, :k] = rid[order]
     return DeviceShardStore(n_rows=n_rows, **out)

@@ -145,6 +145,31 @@ def exact_capacity(owner: np.ndarray, n_shards: int) -> int:
     return next_pow2(cap)
 
 
+def _step_prologue(mesh, batch: VariantBatch, capacity: int | None, row_id,
+                   owner: np.ndarray | None = None):
+    """Shared entry checks/defaults for the three distributed steps:
+    divisibility, lossless default capacity for the owner map, and the
+    identity row-id map.  Returns (n_shards, capacity, row_id)."""
+    n_shards = mesh.devices.size
+    if batch.n % n_shards:
+        raise ValueError(
+            f"batch size {batch.n} not divisible by {n_shards} shards — pad "
+            "with chrom-0 rows first (loaders use _pad_batch)"
+        )
+    n_local = batch.n // n_shards
+    if capacity is None:
+        if owner is not None:
+            capacity = min(exact_capacity(owner, n_shards), n_local)
+        else:
+            host_owner = np.asarray(chromosome_owner_table(n_shards))[
+                np.clip(np.asarray(batch.chrom, np.int32), 0, NUM_CHROMOSOMES)
+            ]
+            capacity = min(exact_capacity(host_owner, n_shards), n_local)
+    if row_id is None:
+        row_id = np.arange(batch.n, dtype=np.int32)
+    return n_shards, capacity, row_id
+
+
 def distributed_annotate_step(
     mesh, batch: VariantBatch, capacity: int | None = None, row_id=None,
     owner: np.ndarray | None = None,
@@ -174,23 +199,9 @@ def distributed_annotate_step(
     (for the chromosome map on sorted input that is ``n_local`` — the whole
     slice may route to one owner).  Row conservation invariant:
     ``sum(counts) + n_fallback + n_dropped == non-pad input rows``."""
-    n_shards = mesh.devices.size
-    if batch.n % n_shards:
-        raise ValueError(
-            f"batch size {batch.n} not divisible by {n_shards} shards — pad "
-            "with chrom-0 rows first (TpuVcfLoader does this)"
-        )
-    n_local = batch.n // n_shards
-    if capacity is None:
-        if owner is not None:
-            capacity = min(exact_capacity(owner, n_shards), n_local)
-        else:
-            host_owner = np.asarray(chromosome_owner_table(n_shards))[
-                np.clip(np.asarray(batch.chrom, np.int32), 0, NUM_CHROMOSOMES)
-            ]
-            capacity = min(exact_capacity(host_owner, n_shards), n_local)
-    if row_id is None:
-        row_id = np.arange(batch.n, dtype=np.int32)
+    n_shards, capacity, row_id = _step_prologue(
+        mesh, batch, capacity, row_id, owner
+    )
     owner_in = (
         np.asarray(owner, np.int32) if owner is not None
         else np.full(batch.n, -1, np.int32)  # -1: chromosome routing in-trace
@@ -289,20 +300,7 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
     Host-fallback rows (alleles wider than the device arrays) are excluded
     from both verdicts — their truncated-prefix identity could collide, so
     the host re-checks them exactly as the single-device path does."""
-    n_shards = mesh.devices.size
-    if batch.n % n_shards:
-        raise ValueError(
-            f"batch size {batch.n} not divisible by {n_shards} shards — pad "
-            "with chrom-0 rows first"
-        )
-    n_local = batch.n // n_shards
-    if capacity is None:
-        host_owner = np.asarray(chromosome_owner_table(n_shards))[
-            np.clip(np.asarray(batch.chrom, np.int32), 0, NUM_CHROMOSOMES)
-        ]
-        capacity = min(exact_capacity(host_owner, n_shards), n_local)
-    if row_id is None:
-        row_id = np.arange(batch.n, dtype=np.int32)
+    n_shards, capacity, row_id = _step_prologue(mesh, batch, capacity, row_id)
     has_store = dev_store is not None
     store_arrays = tuple(dev_store[:7]) if has_store else ()
     step = _insert_step_program(mesh, n_shards, capacity, has_store)
@@ -310,6 +308,110 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
         batch.chrom, batch.pos, batch.ref, batch.alt,
         batch.ref_len, batch.alt_len, row_id, *store_arrays,
     )
+
+
+def distributed_update_step(mesh, batch: VariantBatch, dev_store,
+                            capacity: int | None = None, row_id=None):
+    """Sharded UPDATE-identity step: chromosome re-shard + in-mesh store
+    lookup, one mesh program.  The TPU mapping of the reference's
+    multi-process update fan-out (``load_vep_result.py:304-311``,
+    ``load_cadd_scores.py:305-313``): each shard resolves the update rows
+    of the chromosomes it owns against its resident snapshot slice, and
+    the host gets back *store row ids* — it applies the annotation writes
+    directly, no host-side identity search remains.
+
+    No annotate kernel runs (updates need identity only), so the step is
+    one all_to_all + hash + two-level sorted lookup per shard plus psum'd
+    match counters.
+
+    Returns ``(rid_out, found, store_row, counters)``:
+
+    - ``rid_out``: input row id per post-exchange slot (-1 = empty/pad);
+    - ``found``: bool per slot — identity present in the snapshot;
+    - ``store_row``: int64 host-store global row id per slot (-1 when not
+      found) — valid until the host shard is appended/compacted;
+    - ``counters``: psum'd ``{"n_matched", "n_missing", "n_fallback",
+      "n_dropped"}``; fallback rows (alleles wider than the device arrays)
+      are excluded from both verdicts and re-checked host-side, exactly
+      like the insert step.  ``n_dropped`` is nonzero only with an
+      explicit undersized ``capacity`` — dropped rows return no rid, so
+      callers must treat them as unresolved, not missing."""
+    n_shards, capacity, row_id = _step_prologue(mesh, batch, capacity, row_id)
+    step = _update_step_program(mesh, n_shards, capacity)
+    return step(
+        batch.chrom, batch.pos, batch.ref, batch.alt,
+        batch.ref_len, batch.alt_len, row_id,
+        *(dev_store[:7] + (dev_store.row_id,)),
+    )
+
+
+@lru_cache(maxsize=64)
+def _update_step_program(mesh, n_shards: int, capacity: int):
+    """The shard_map program for :func:`distributed_update_step`, cached by
+    (mesh, shape parameters) — same re-compile trap as the other steps."""
+    from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_multi, mix_chrom_hash
+    from annotatedvdb_tpu.ops.hashing import allele_hash
+
+    spec = P(SHARD_AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * 7 + (spec,) * 8,
+        out_specs=(
+            spec, spec, spec,
+            {"n_matched": P(), "n_missing": P(), "n_fallback": P(),
+             "n_dropped": P()},
+        ),
+        check_vma=False,
+    )
+    def step(chrom, pos, ref, alt, ref_len, alt_len, rid, *store_cols):
+        owner = chromosome_owner(chrom, n_shards)
+        arrays = (chrom, pos, ref, alt, ref_len, alt_len, rid)
+        (chrom, pos, ref, alt, ref_len, alt_len, rid), valid, dropped = (
+            reshard_by_owner(owner, arrays, n_shards, capacity)
+        )
+        (s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al, s_rid) = store_cols
+        s_chrom, s_pos, s_hm = s_chrom[0], s_pos[0], s_hm[0]
+        s_ref, s_alt, s_rl, s_al = s_ref[0], s_alt[0], s_rl[0], s_al[0]
+        s_rid = s_rid[0]
+        real = valid & (chrom > 0)
+        # over-width rows: truncated-prefix identity could collide — the
+        # host re-checks them with full-string hashes (same discipline as
+        # the insert step)
+        fallback = real & (
+            (ref_len > ref.shape[1]) | (alt_len > alt.shape[1])
+        )
+        usable = real & ~fallback
+        h = allele_hash(ref, alt, ref_len, alt_len)
+        slot = jnp.arange(pos.shape[0], dtype=jnp.int32)
+        pos_k = jnp.where(usable, pos, -1 - slot)
+        hm = mix_chrom_hash(h, chrom)
+        found, idx = lookup_in_sorted_multi(
+            s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al,
+            chrom, pos_k, hm, ref, alt, ref_len, alt_len,
+        )
+        found = found & usable
+        store_row = jnp.where(
+            found, s_rid[jnp.clip(idx, 0, s_rid.shape[0] - 1)], -1
+        )
+        counters = {
+            "n_matched": jax.lax.psum(
+                jnp.sum(found, dtype=jnp.int32), SHARD_AXIS
+            ),
+            "n_missing": jax.lax.psum(
+                jnp.sum(usable & ~found, dtype=jnp.int32), SHARD_AXIS
+            ),
+            "n_fallback": jax.lax.psum(
+                jnp.sum(fallback, dtype=jnp.int32), SHARD_AXIS
+            ),
+            "n_dropped": dropped,
+        }
+        rid_out = jnp.where(real, rid, -1)
+        return rid_out, found, store_row, counters
+
+    # see _annotate_step_program: un-jitted shard_map executes eagerly
+    return jax.jit(step)
 
 
 @lru_cache(maxsize=64)

@@ -461,7 +461,10 @@ def main():
             f"{platform} backend failed mid-bench: "
             f"{type(exc).__name__}: {exc}"[:500]
         )
-        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+        os.execv(
+            sys.executable,
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+        )
     try:
         multichip = bench_multichip_virtual()
     except Exception as exc:  # a failed CPU-side projection leg never
