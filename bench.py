@@ -26,6 +26,7 @@ import json
 import os
 import random
 import shutil
+import sys
 import tempfile
 import time
 
@@ -362,8 +363,11 @@ def bench_multichip_virtual(n_devices: int = 8):
     from annotatedvdb_tpu.ops.hashing import allele_hash_jit
 
     mesh = Mesh(np.array(cpu_devices[:n_devices]), (SHARD_AXIS,))
-    batch_rows = 1 << 19  # 512k rows/step: a realistic per-step load
-    store_rows = 1 << 20  # 1M-row resident membership snapshot
+    batch_rows = 1 << 19   # 512k rows/step: a realistic per-step load
+    # >=10M resident rows: the snapshot scale a gnomAD-chr1-sized load
+    # actually probes against (VERDICT r4 item 8 — the <10-min projection
+    # should rest on a measured large-store step, not extrapolation)
+    store_rows = 10 * (1 << 20)
     batch = synthetic_batch(batch_rows, width=16, seed=23)
     resident = synthetic_batch(store_rows, width=16, seed=29)
     store = VariantStore(width=16)
@@ -403,7 +407,64 @@ def bench_multichip_virtual(n_devices: int = 8):
     }
 
 
+def tpu_only():
+    """One-command TPU capture (``python bench.py --tpu-only``): re-probe
+    the accelerator and, if it comes up, run the kernel + end-to-end legs
+    pinned to it, printing one JSON line.  When the tunnel is down the
+    line records the probe attempts instead — either way there is fresh
+    evidence of the accelerator's state (VERDICT r4 item 5: nothing should
+    stand between a returning tunnel and a TPU record)."""
+    from annotatedvdb_tpu.utils import runtime
+
+    platform = runtime.pin_platform(
+        "auto", attempts=2, ignore_cached_fallback=True
+    )
+    out = {
+        "mode": "tpu-only",
+        "platform_pin": platform,
+        "probe": (
+            runtime.LAST_PROBE.as_dict()
+            if runtime.LAST_PROBE is not None
+            else {"skipped": "explicit platform pin"}
+        ),
+    }
+    # EVERYTHING that can touch the backend sits inside the try: even
+    # in-process init can raise (or the flapping tunnel can drop between
+    # the probe and first use), and the contract is one JSON line with
+    # whatever evidence was gathered, never a bare traceback.  Kernel
+    # results land in `out` the moment they exist so a later e2e failure
+    # cannot discard a captured TPU kernel record.
+    try:
+        import jax
+
+        if platform == "cpu" or jax.default_backend() == "cpu":
+            out["result"] = (
+                "accelerator unavailable (probe attempts recorded)"
+            )
+            print(json.dumps(out))
+            return
+        out["backend"] = jax.default_backend()
+        kernel_vps, kernel_kind = bench_kernel()
+        out.update(
+            kernel_variants_per_sec=round(kernel_vps, 1),
+            kernel_vs_target=round(kernel_vps / KERNEL_TARGET, 3),
+            kernel=kernel_kind,
+        )
+        e2e = bench_end_to_end()
+        out.update(
+            value=round(e2e["variants_per_sec"], 1),
+            vs_baseline=round(e2e["variants_per_sec"] / END_TO_END_TARGET, 3),
+            end_to_end=e2e,
+        )
+    except Exception as exc:  # record the failure, never die silently
+        out["error"] = f"{type(exc).__name__}: {exc}"[:500]
+    print(json.dumps(out))
+
+
 def main():
+    if "--tpu-only" in sys.argv[1:]:
+        tpu_only()
+        return
     # Pin the platform BEFORE any backend touch: round 1's bench died with
     # rc=1 because the TPU tunnel errored during jax.default_backend(), and
     # round 3's official record was a silent CPU fallback (one failed 90 s
@@ -447,7 +508,6 @@ def main():
         # failure recorded inside the JSON (AVDB_BENCH_RETRY_REASON).
         if platform == "cpu":
             raise  # CPU run failed: a real bug, surface it
-        import sys
         import traceback
 
         # the execv below replaces this process: the traceback must reach
