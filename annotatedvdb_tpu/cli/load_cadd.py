@@ -63,12 +63,10 @@ def vcf_subsets(updater: TpuCaddUpdater, path: str) -> dict[int, np.ndarray]:
 
 
 def main(argv=None) -> int:
-    from annotatedvdb_tpu.utils.runtime import pin_platform
-
-    # environment-robust platform pin (probe accelerator, CPU fallback)
-    pin_platform("auto")
+    from annotatedvdb_tpu.config import add_runtime_args, runtime_from_args
 
     ap = argparse.ArgumentParser(description=__doc__)
+    add_runtime_args(ap)
     ap.add_argument("--databaseDir", required=True,
                     help="directory holding the CADD score tables")
     ap.add_argument("--storeDir", required=True)
@@ -92,6 +90,12 @@ def main(argv=None) -> int:
                     help="log file (default: beside --fileName or the store)")
     args = ap.parse_args(argv)
 
+    runtime = runtime_from_args(args)
+    try:
+        runtime.validate()
+    except ValueError as err:
+        ap.error(str(err))
+
     if args.buildIndex:
         from annotatedvdb_tpu.io.cadd import (
             CADD_INDEL_FILE, CADD_SNV_FILE, CaddIndex,
@@ -105,6 +109,10 @@ def main(argv=None) -> int:
             else:
                 print(f"{path}: absent, skipped")
         return 0
+
+    # platform pin + multihost + update mesh — AFTER the host-only
+    # --buildIndex branch, which must not block on collective init
+    mesh = runtime.apply()
 
     from annotatedvdb_tpu.utils.logging import load_logger
 
@@ -122,7 +130,7 @@ def main(argv=None) -> int:
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
     updater = TpuCaddUpdater(
         store, ledger, args.databaseDir,
-        skip_existing=not args.updateExisting, log=log,
+        skip_existing=not args.updateExisting, log=log, mesh=mesh,
     )
 
     subsets = vcf_subsets(updater, args.fileName) if args.fileName else None

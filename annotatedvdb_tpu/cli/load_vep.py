@@ -17,11 +17,9 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 
 
 def main(argv=None):
-    from annotatedvdb_tpu.utils.runtime import pin_platform
-
-    # environment-robust platform pin (probe accelerator, CPU fallback)
-    pin_platform("auto")
-
+    # platform pinning happens in runtime.apply() AFTER argparse — an
+    # early pin_platform("auto") here would cache its probe verdict in
+    # AVDB_JAX_PLATFORM and silently override a user's --platform flag
     parser = argparse.ArgumentParser(description="load VEP JSON results")
     parser.add_argument("--fileName", required=True)
     parser.add_argument("--storeDir", required=True)
@@ -35,12 +33,25 @@ def main(argv=None):
                              "the shipped default seed)")
     parser.add_argument("--saveOnAddConsequence", action="store_true")
     parser.add_argument("--datasource", default=None)
-    from annotatedvdb_tpu.config import add_lifecycle_args, effective_log_after
+    from annotatedvdb_tpu.config import (
+        add_lifecycle_args,
+        add_runtime_args,
+        effective_log_after,
+        runtime_from_args,
+    )
 
     add_lifecycle_args(parser)
+    add_runtime_args(parser)
     parser.add_argument("--skipExisting", action="store_true",
                         help="skip variants that already have vep_output")
     args = parser.parse_args(argv)
+
+    runtime = runtime_from_args(args)
+    try:
+        runtime.validate()
+    except ValueError as err:
+        parser.error(str(err))
+    mesh = runtime.apply()  # platform pin + multihost + update mesh
 
     from annotatedvdb_tpu.utils.logging import load_logger
 
@@ -61,6 +72,7 @@ def main(argv=None):
         skip_existing=args.skipExisting,
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 14),
+        mesh=mesh,
     )
     counters = loader.load_file(args.fileName, commit=args.commit, test=args.test)
     if args.commit:

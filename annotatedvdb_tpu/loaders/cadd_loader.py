@@ -44,6 +44,12 @@ from annotatedvdb_tpu.types import chromosome_code
 from annotatedvdb_tpu.utils.arrays import pad_pow2
 
 
+def _allele_lengths(mat: np.ndarray) -> np.ndarray:
+    """True lengths of width-bounded allele rows (alleles are ACGTN... text,
+    never NUL, so the zero-pad boundary is the length)."""
+    return (mat != 0).sum(axis=1).astype(np.int32)
+
+
 def _resolve_code(chrom) -> int:
     code = int(chrom) if isinstance(chrom, (int, np.integer)) else chromosome_code(chrom)
     if not 1 <= code <= 25:
@@ -61,6 +67,9 @@ class _ChromState:
         self.raw = np.zeros(sel.shape, np.float64)
         self.phred = np.zeros(sel.shape, np.float64)
         self.examined_hi = 0                    # rows with a completed chance to match
+        # positions whose TABLE rows include long alleles: store rows there
+        # take the host path only (mesh parity with _join_block's host_mask)
+        self.host_excl: set = set()
 
 
 class TpuCaddUpdater:
@@ -75,12 +84,20 @@ class TpuCaddUpdater:
         indel_file: str = CADD_INDEL_FILE,
         skip_existing: bool = True,
         log=print,
+        mesh=None,
     ):
+        """``mesh``: optional multi-device :class:`jax.sharding.Mesh`; the
+        sequential table pass then resolves score rows against the store
+        through the sharded identity step (chromosome re-shard + in-mesh
+        lookup, both allele orientations — CADD matches allele SETS) —
+        the TPU mapping of the reference's per-chromosome CADD worker
+        fan-out (``load_cadd_scores.py:305-313``)."""
         self.store = store
         self.ledger = ledger
         self.snv_path = os.path.join(database_dir, snv_file)
         self.indel_path = os.path.join(database_dir, indel_file)
         self.skip_existing = skip_existing
+        self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         self.log = log
         self.counters = {"snv": 0, "indel": 0, "not_matched": 0,
                          "skipped": 0, "update": 0}
@@ -169,6 +186,7 @@ class TpuCaddUpdater:
                     f"every table; missing/stale: {missing or 'all tables'} "
                     "(build with load_cadd --buildIndex)"
                 )
+        mesh_ctx = self._mesh_context() if self.mesh is not None else None
         for kind, path, probe in self._tables():
             states: dict[int, _ChromState] = {}
             for code in codes:
@@ -181,10 +199,19 @@ class TpuCaddUpdater:
             stop = False
             for code, block in reader.blocks_all():
                 if code in states:
-                    self._join_block(states[code], self.store.shard(code), block, probe)
+                    if mesh_ctx is not None:
+                        self._join_block_mesh(
+                            states[code], code, block, mesh_ctx
+                        )
+                    else:
+                        self._join_block(
+                            states[code], self.store.shard(code), block, probe
+                        )
                     if test:
                         stop = True
                         break
+            if mesh_ctx is not None:
+                self._flush_mesh(states, mesh_ctx)
             self._finalize(states, kind, commit, complete=not stop)
         self.ledger.finish(alg_id, dict(self.counters))
         self.counters["alg_id"] = alg_id
@@ -283,6 +310,171 @@ class TpuCaddUpdater:
                         break
                 bytes_read += reader.bytes_read
         self.counters["bytes_read"] = bytes_read
+
+    # -- mesh path -----------------------------------------------------------
+
+    MESH_FLUSH_ROWS = 1 << 17  # score rows buffered per sharded resolve
+
+    def _mesh_context(self) -> dict:
+        """Frozen device snapshot + the score-row buffer the mesh join
+        accumulates between flushes."""
+        from annotatedvdb_tpu.parallel.device_store import (
+            build_device_shard_store,
+        )
+
+        return {
+            "snapshot": build_device_shard_store(
+                self.store, self.mesh.devices.size
+            ),
+            "buf": [],       # (code, pos, ref, alt, raw, phred) per block
+            "buf_rows": 0,
+        }
+
+    def _join_block_mesh(self, state: _ChromState, code: int, block,
+                         ctx: dict) -> None:
+        """Buffer one block's score rows for the sharded resolve; host
+        semantics (examined range, over-width/host-row matching) stay
+        identical to :meth:`_join_block`."""
+        vlo = np.searchsorted(state.pos, block.min_pos, side="left")
+        vhi = np.searchsorted(state.pos, block.max_pos, side="right")
+        state.examined_hi = max(state.examined_hi, vhi)
+        shard = self.store.shard(code)
+        if block.n:
+            k = block.n
+            ctx["buf"].append(
+                (code, block.pos[:k], block.ref[:k], block.alt[:k],
+                 block.raw[:k], block.phred[:k])
+            )
+            ctx["buf_rows"] += int(k)
+        if block.host_rows:
+            state.host_excl.update(int(p) for p in block.host_rows)
+        # host-row tail (long alleles in the TABLE): match per store row,
+        # exactly like the sequential path — but only the rows that can
+        # host-match (host positions / over-width variants), not the whole
+        # window
+        if block.host_rows and vlo != vhi:
+            window = state.sel[vlo:vhi]
+            w = self.store.width
+            over_width = (
+                (shard.cols["ref_len"][window] > w)
+                | (shard.cols["alt_len"][window] > w)
+            )
+            host_pos = np.isin(
+                shard.cols["pos"][window], list(block.host_rows)
+            )
+            cand = np.where(
+                (over_width | host_pos) & ~state.matched[vlo:vhi]
+            )[0]
+            for j in cand:
+                row = int(window[j])
+                ref, alt = shard.alleles(row)
+                for s_ref, s_alt, raw, phred in block.host_rows.get(
+                        int(shard.cols["pos"][row]), []):
+                    if ref in (s_ref, s_alt) and alt in (s_ref, s_alt):
+                        state.matched[vlo + j] = True
+                        state.raw[vlo + j] = raw
+                        state.phred[vlo + j] = phred
+                        break
+        if ctx["buf_rows"] >= self.MESH_FLUSH_ROWS:
+            self._flush_mesh_buffer(ctx)
+
+    def _flush_mesh(self, states: dict[int, "_ChromState"], ctx: dict) -> None:
+        """Resolve any buffered rows, then apply pending matches to the
+        per-chromosome states."""
+        self._flush_mesh_buffer(ctx)
+        self._apply_mesh_matches(states, ctx)
+
+    def _flush_mesh_buffer(self, ctx: dict) -> None:
+        """One sharded resolve over the buffered score rows: probe BOTH
+        allele orientations (CADD matches allele sets — a store row (A,G)
+        matches table row G/A too), first table row wins per store row."""
+        if not ctx["buf"]:
+            return
+        from annotatedvdb_tpu.loaders.vcf_loader import _pad_batch
+        from annotatedvdb_tpu.parallel.distributed import (
+            distributed_update_step,
+        )
+        from annotatedvdb_tpu.types import VariantBatch
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        buf, ctx["buf"], ctx["buf_rows"] = ctx["buf"], [], 0
+        chrom = np.concatenate([
+            np.full(b[1].shape[0], b[0], np.int8) for b in buf
+        ])
+        pos = np.concatenate([b[1] for b in buf]).astype(np.int32)
+        ref = np.concatenate([b[2] for b in buf])
+        alt = np.concatenate([b[3] for b in buf])
+        raw = np.concatenate([b[4] for b in buf])
+        phred = np.concatenate([b[5] for b in buf])
+        n = pos.shape[0]
+        rl = _allele_lengths(ref)
+        al = _allele_lengths(alt)
+        # both orientations in one query batch: rows [0,n) as-is, rows
+        # [n,2n) swapped; rid % n recovers the table row, so table order
+        # (first match wins) survives the fold
+        q = VariantBatch(
+            np.concatenate([chrom, chrom]),
+            np.concatenate([pos, pos]),
+            np.concatenate([ref, alt]),
+            np.concatenate([alt, ref]),
+            np.concatenate([rl, al]),
+            np.concatenate([al, rl]),
+        )
+        q = _pad_batch(q, max(next_pow2(q.n), self.mesh.devices.size))
+        rid, found, store_row, _c = distributed_update_step(
+            self.mesh, q, ctx["snapshot"]
+        )
+        rid = np.asarray(rid)
+        found = np.asarray(found)
+        store_row = np.asarray(store_row)
+        take = (rid >= 0) & found
+        src = rid[take]
+        real = src < 2 * n  # pad rows never come back found, but be safe
+        src, rows_g = src[real], store_row[take][real]
+        table_idx = src % n
+        # first table row wins per store row: sort by table order, keep the
+        # first occurrence of each store row
+        order = np.argsort(table_idx, kind="stable")
+        rows_o, tidx_o = rows_g[order], table_idx[order]
+        # (code, store_row) is unique per shard only — pair with chrom
+        key = (chrom[tidx_o].astype(np.int64) << 48) | rows_o
+        _, first = np.unique(key, return_index=True)
+        ctx.setdefault("pending", []).append((
+            chrom[tidx_o[first]], rows_o[first],
+            raw[tidx_o[first]], phred[tidx_o[first]],
+        ))
+
+    def _apply_mesh_matches(self, states: dict[int, "_ChromState"],
+                            ctx: dict) -> None:
+        """Scatter resolved matches into the per-chromosome states (store
+        row -> candidate position via one searchsorted per flush)."""
+        for chrom_m, rows_m, raw_m, phred_m in ctx.pop("pending", []):
+            for code in np.unique(chrom_m):
+                state = states.get(int(code))
+                if state is None:
+                    continue
+                m = chrom_m == code
+                rows_c, raw_c, phred_c = rows_m[m], raw_m[m], phred_m[m]
+                pos_in_sel = np.searchsorted(state.sel, rows_c)
+                safe = np.clip(pos_in_sel, 0, state.sel.size - 1)
+                ok = (pos_in_sel < state.sel.size) & (
+                    state.sel[safe] == rows_c
+                )
+                ok &= ~state.matched[safe]
+                if state.host_excl:
+                    # store rows at long-table-allele positions host-match
+                    # only (same exclusion as _join_block's host_mask)
+                    excl = np.isin(
+                        state.pos[safe], np.fromiter(
+                            state.host_excl, np.int64,
+                            len(state.host_excl),
+                        )
+                    )
+                    ok &= ~excl
+                p = pos_in_sel[ok]
+                state.matched[p] = True
+                state.raw[p] = raw_c[ok]
+                state.phred[p] = phred_c[ok]
 
     def _join_block(self, state: _ChromState, shard, block, probe: int) -> None:
         vlo = np.searchsorted(state.pos, block.min_pos, side="left")
