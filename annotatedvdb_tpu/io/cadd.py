@@ -28,7 +28,11 @@ from typing import Iterator
 
 import numpy as np
 
-from annotatedvdb_tpu.types import chromosome_code, encode_allele_array
+from annotatedvdb_tpu.types import (
+    chromosome_code,
+    decode_allele,
+    encode_allele_array,
+)
 from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, next_pow2
 
 # Canonical file names from the CADD distribution (cadd_updater.py:21-22).
@@ -262,7 +266,23 @@ class CaddFileReader:
     def blocks_all(self) -> Iterator[tuple[int, "CaddBlock"]]:
         """One sequential pass over the whole table, yielding
         (chromosome_code, block) — the multi-chromosome driver path (the
-        reference instead re-opens the tabix file per chromosome worker)."""
+        reference instead re-opens the tabix file per chromosome worker).
+
+        Takes the native C++ tokenizer when available (columnar fills, no
+        per-line Python; ``AVDB_NATIVE_CADD=0`` disables); the pure-Python
+        loop below is the fallback and the parity baseline
+        (``tests/test_cadd.py::test_native_cadd_blocks_parity``)."""
+        import os as _os
+
+        if _os.environ.get("AVDB_NATIVE_CADD", "1") != "0":
+            from annotatedvdb_tpu.native import cadd as native_cadd
+
+            if native_cadd.available():
+                yield from self._blocks_all_native()
+                return
+        yield from self._blocks_all_python()
+
+    def _blocks_all_python(self) -> Iterator[tuple[int, "CaddBlock"]]:
         rows: list[tuple[int, str, str, float, float]] = []
         current_code = None
         with _open_text(self.path) as fh:
@@ -275,15 +295,19 @@ class CaddFileReader:
                 code = chromosome_code(fields[0])
                 if code == 0:
                     continue
+                try:
+                    row = (int(fields[1]), fields[2], fields[3],
+                           float(fields[4]), float(fields[5]))
+                except ValueError:
+                    continue  # malformed numerics: skip, like the tokenizer
+                if not 0 < row[0] <= 0x7FFFFFFF or not fields[2] or not fields[3]:
+                    continue
                 if code != current_code:
                     if rows:
                         yield current_code, self._build(rows)
                         rows = []
                     current_code = code
-                rows.append(
-                    (int(fields[1]), fields[2], fields[3],
-                     float(fields[4]), float(fields[5]))
-                )
+                rows.append(row)
                 if len(rows) >= self.block_rows:
                     emit, rows = self._split_on_run(rows)
                     if emit:
@@ -300,6 +324,117 @@ class CaddFileReader:
                 yield block
             elif seen:
                 break  # sorted file: past the target chromosome
+
+    def _blocks_all_native(self) -> Iterator[tuple[int, "CaddBlock"]]:
+        """Columnar streaming: concatenate native fills into a pending
+        column buffer, emit blocks at chromosome changes and at capacity
+        (peeling the trailing same-position run, like the Python path)."""
+        from annotatedvdb_tpu.native import cadd as native_cadd
+
+        cols = ("chrom", "pos", "ref", "alt", "ref_len", "alt_len",
+                "raw", "phred", "ref_str", "alt_str")
+        pend: dict | None = None
+
+        def emit_ready(pend, final: bool):
+            """Yield (code, block, remainder) splits from the pending buffer."""
+            while pend is not None and pend["pos"].size:
+                chrom = pend["chrom"]
+                n = chrom.shape[0]
+                # run of the leading chromosome
+                change = np.flatnonzero(chrom != chrom[0])
+                b = int(change[0]) if change.size else n
+                if b >= self.block_rows:
+                    # >=, not >: the Python loop peels/emits the moment a
+                    # chromosome's accumulated rows REACH capacity, and the
+                    # two engines must segment identically (parity test)
+                    cut = min(b, self.block_rows)
+                    # never split a same-position run across blocks
+                    last = pend["pos"][cut - 1]
+                    while cut > 0 and pend["pos"][cut - 1] == last:
+                        cut -= 1
+                    if cut == 0:
+                        # degenerate single-position run filling the whole
+                        # capacity: the Python engine emits exactly
+                        # block_rows rows (mid-run) — mirror it
+                        cut = min(b, self.block_rows)
+                elif change.size or final:
+                    cut = b
+                else:
+                    return pend  # incomplete chromosome run: wait for more
+                code = int(chrom[0])
+                head = {k: pend[k][:cut] for k in cols}
+                pend = (
+                    {k: pend[k][cut:] for k in cols} if cut < n else None
+                )
+                yield code, self._build_columns(head)
+            return pend
+
+        def drain(gen):
+            # the generator both yields blocks AND returns the remainder
+            nonlocal pend
+            while True:
+                try:
+                    item = next(gen)
+                except StopIteration as stop:
+                    pend = stop.value
+                    return
+                yield item
+
+        for fill in native_cadd.scan(self.path, self.block_rows, self.width):
+            if pend is None:
+                pend = fill
+            else:
+                pend = {
+                    k: np.concatenate([pend[k], fill[k]]) for k in cols
+                }
+            yield from drain(emit_ready(pend, final=False))
+        if pend is not None and pend["pos"].size:
+            yield from drain(emit_ready(pend, final=True))
+
+    def _build_columns(self, colsd: dict) -> "CaddBlock":
+        """CaddBlock from one chromosome-uniform column slice — the
+        vectorized twin of :meth:`_build` (host rows = positions carrying
+        any over-width allele, strings from the tokenizer's span decode)."""
+        width = self.width
+        pos_a = colsd["pos"]
+        over = (colsd["ref_len"] > width) | (colsd["alt_len"] > width)
+        if over.any():
+            long_pos = np.unique(pos_a[over])
+            host_mask = np.isin(pos_a, long_pos)
+        else:
+            host_mask = np.zeros(pos_a.shape, bool)
+        host_rows: dict[int, list] = {}
+        for i in np.where(host_mask)[0]:
+            r = colsd["ref_str"][i]
+            a = colsd["alt_str"][i]
+            if r is None:
+                r = decode_allele(colsd["ref"][i], int(colsd["ref_len"][i]))
+            if a is None:
+                a = decode_allele(colsd["alt"][i], int(colsd["alt_len"][i]))
+            host_rows.setdefault(int(pos_a[i]), []).append(
+                (r, a, float(colsd["raw"][i]), float(colsd["phred"][i]))
+            )
+        dev = ~host_mask
+        n = int(dev.sum())
+        cap = next_pow2(max(n, 1))
+        pos = np.full((cap,), POS_SENTINEL, np.int32)
+        raw = np.zeros((cap,), np.float64)
+        phred = np.zeros((cap,), np.float64)
+        ref = np.zeros((cap, width), np.uint8)
+        alt = np.zeros((cap, width), np.uint8)
+        if n:
+            pos[:n] = pos_a[dev]
+            raw[:n] = colsd["raw"][dev]
+            phred[:n] = colsd["phred"][dev]
+            ref[:n] = colsd["ref"][dev]
+            alt[:n] = colsd["alt"][dev]
+            runs = np.diff(np.flatnonzero(
+                np.diff(pos[:n], prepend=-1, append=-2)
+            ))
+            max_run = int(runs.max()) if runs.size else 0
+        else:
+            max_run = 0
+        return CaddBlock(pos, ref, alt, raw, phred, n, max_run, host_rows)
 
     @staticmethod
     def _split_on_run(rows):

@@ -323,8 +323,11 @@ class TpuCaddUpdater:
         )
 
         return {
+            # position-block partition: CADD tables stream chromosome-
+            # sorted, so chromosome routing would land every flush on one
+            # shard — position blocks spread each flush across the mesh
             "snapshot": build_device_shard_store(
-                self.store, self.mesh.devices.size
+                self.store, self.mesh.devices.size, routing="position"
             ),
             "buf": [],       # (code, pos, ref, alt, raw, phred) per block
             "buf_rows": 0,
@@ -422,7 +425,7 @@ class TpuCaddUpdater:
         )
         q = _pad_batch(q, max(next_pow2(q.n), self.mesh.devices.size))
         rid, found, store_row, _c = distributed_update_step(
-            self.mesh, q, ctx["snapshot"]
+            self.mesh, q, ctx["snapshot"], routing="position"
         )
         rid = np.asarray(rid)
         found = np.asarray(found)

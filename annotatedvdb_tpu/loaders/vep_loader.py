@@ -190,8 +190,11 @@ class TpuVepLoader:
                 build_device_shard_store,
             )
 
+            # position-block partition: VEP files arrive chromosome-
+            # sorted, so chromosome routing would land every flush on one
+            # shard — position blocks spread each flush across the mesh
             self._dev_snapshot = build_device_shard_store(
-                self.store, self.mesh.devices.size
+                self.store, self.mesh.devices.size, routing="position"
             )
 
         def flush_python(batch_lines: list[bytes]) -> None:
@@ -395,7 +398,7 @@ class TpuVepLoader:
         # trace + compile a fresh mesh program (~35s each on TPU)
         q = _pad_batch(batch, max(next_pow2(n), self.mesh.devices.size))
         rid_out, found_s, store_row, _counters = distributed_update_step(
-            self.mesh, q, self._dev_snapshot
+            self.mesh, q, self._dev_snapshot, routing="position"
         )
         rid_out = np.asarray(rid_out)
         take = rid_out >= 0

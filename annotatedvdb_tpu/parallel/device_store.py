@@ -28,7 +28,10 @@ from typing import NamedTuple
 import numpy as np
 
 from annotatedvdb_tpu.ops.dedup import CHROM_MIX
-from annotatedvdb_tpu.parallel.distributed import chromosome_owner_table
+from annotatedvdb_tpu.parallel.distributed import (
+    chromosome_owner_table,
+    position_block_owner,
+)
 from annotatedvdb_tpu.store import VariantStore
 from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, next_pow2
 
@@ -55,32 +58,54 @@ class DeviceShardStore(NamedTuple):
 
 
 def build_device_shard_store(
-    store: VariantStore, n_shards: int, build: str = "GRCh38"
+    store: VariantStore, n_shards: int, build: str = "GRCh38",
+    routing: str = "chrom",
 ) -> DeviceShardStore:
     """Snapshot ``store``'s identity columns into the stacked per-shard
-    layout.  O(store rows): one concat + one sort per shard."""
+    layout.  O(store rows): one concat + one sort per shard.
+
+    ``routing`` selects the partition:
+
+    - ``"chrom"`` — all of a chromosome's rows on its owning shard (the
+      INSERT-step invariant: per-shard dedup is then globally correct);
+    - ``"position"`` — 16kb position blocks round-robin across shards
+      (``parallel.distributed.position_block_owner``).  UPDATE lookups
+      need no dedup invariant, and real update streams (VEP results,
+      CADD tables) arrive chromosome-sorted — chromosome routing would
+      land every flush on ONE shard, forfeiting the fan-out.  The query
+      side must route the same way (``distributed_update_step``'s
+      ``routing`` parameter)."""
+    if routing not in ("chrom", "position"):
+        raise ValueError(f"unknown snapshot routing {routing!r}")
     owner = chromosome_owner_table(n_shards, build)
     per_shard: list[list] = [[] for _ in range(n_shards)]
     width = store.width
     for code, shard in store.shards.items():
-        s = owner[min(code, len(owner) - 1)]
         starts = shard._starts()
         for si, seg in enumerate(list(shard.segments)):
-            per_shard[s].append(
-                (
-                    np.full(seg.n, code, np.int8),
-                    seg.cols["pos"],
-                    seg.cols["h"],
-                    seg.ref,
-                    seg.alt,
-                    seg.cols["ref_len"],
-                    seg.cols["alt_len"],
-                    # host-store global ids (segment-list order): the
-                    # update step hands matches back as these, so the host
-                    # applies annotation writes without re-looking-up
-                    int(starts[si]) + np.arange(seg.n, dtype=np.int64),
-                )
+            # host-store global ids (segment-list order): the update step
+            # hands matches back as these, so the host applies annotation
+            # writes without re-looking-up
+            rid = int(starts[si]) + np.arange(seg.n, dtype=np.int64)
+            cols = (
+                np.full(seg.n, code, np.int8),
+                seg.cols["pos"],
+                seg.cols["h"],
+                seg.ref,
+                seg.alt,
+                seg.cols["ref_len"],
+                seg.cols["alt_len"],
+                rid,
             )
+            if routing == "chrom":
+                per_shard[owner[min(code, len(owner) - 1)]].append(cols)
+                continue
+            row_owner = position_block_owner(
+                np.full(seg.n, code, np.int64), seg.cols["pos"], n_shards
+            )
+            for s in np.unique(row_owner):
+                m = row_owner == s
+                per_shard[int(s)].append(tuple(c[m] for c in cols))
     m = max(
         (sum(parts[0].shape[0] for parts in bucket) for bucket in per_shard
          if bucket),

@@ -311,7 +311,8 @@ def distributed_insert_step(mesh, batch: VariantBatch, dev_store=None,
 
 
 def distributed_update_step(mesh, batch: VariantBatch, dev_store,
-                            capacity: int | None = None, row_id=None):
+                            capacity: int | None = None, row_id=None,
+                            routing: str = "chrom"):
     """Sharded UPDATE-identity step: chromosome re-shard + in-mesh store
     lookup, one mesh program.  The TPU mapping of the reference's
     multi-process update fan-out (``load_vep_result.py:304-311``,
@@ -335,9 +336,27 @@ def distributed_update_step(mesh, batch: VariantBatch, dev_store,
       are excluded from both verdicts and re-checked host-side, exactly
       like the insert step.  ``n_dropped`` is nonzero only with an
       explicit undersized ``capacity`` — dropped rows return no rid, so
-      callers must treat them as unresolved, not missing."""
-    n_shards, capacity, row_id = _step_prologue(mesh, batch, capacity, row_id)
-    step = _update_step_program(mesh, n_shards, capacity)
+      callers must treat them as unresolved, not missing.
+
+    ``routing`` must match the snapshot's partition
+    (``build_device_shard_store``): ``"chrom"`` routes whole chromosomes,
+    ``"position"`` spreads 16kb position blocks across shards — the right
+    choice for chromosome-sorted update streams, which would otherwise
+    land every flush on one shard."""
+    if routing not in ("chrom", "position"):
+        raise ValueError(f"unknown update routing {routing!r}")
+    owner = (
+        position_block_owner(
+            np.asarray(batch.chrom, np.int64),
+            np.asarray(batch.pos, np.int64), mesh.devices.size,
+        )
+        if routing == "position" else None
+    )
+    n_shards, capacity, row_id = _step_prologue(
+        mesh, batch, capacity, row_id, owner
+    )
+    step = _update_step_program(mesh, n_shards, capacity,
+                                routing == "position")
     return step(
         batch.chrom, batch.pos, batch.ref, batch.alt,
         batch.ref_len, batch.alt_len, row_id,
@@ -346,7 +365,8 @@ def distributed_update_step(mesh, batch: VariantBatch, dev_store,
 
 
 @lru_cache(maxsize=64)
-def _update_step_program(mesh, n_shards: int, capacity: int):
+def _update_step_program(mesh, n_shards: int, capacity: int,
+                         position_routing: bool = False):
     """The shard_map program for :func:`distributed_update_step`, cached by
     (mesh, shape parameters) — same re-compile trap as the other steps."""
     from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_multi, mix_chrom_hash
@@ -366,7 +386,17 @@ def _update_step_program(mesh, n_shards: int, capacity: int):
         check_vma=False,
     )
     def step(chrom, pos, ref, alt, ref_len, alt_len, rid, *store_cols):
-        owner = chromosome_owner(chrom, n_shards)
+        if position_routing:
+            # in-trace twin of position_block_owner — must stay identical
+            # to the host formula the snapshot was partitioned with.
+            # int32 is exact: pos < 2^31 and the shift only shrinks it
+            # (int64 would be silently truncated under 32-bit jax anyway)
+            owner = (
+                ((pos.astype(jnp.int32) >> POSITION_BLOCK_BITS)
+                 + chrom.astype(jnp.int32)) % n_shards
+            ).astype(jnp.int32)
+        else:
+            owner = chromosome_owner(chrom, n_shards)
         arrays = (chrom, pos, ref, alt, ref_len, alt_len, rid)
         (chrom, pos, ref, alt, ref_len, alt_len, rid), valid, dropped = (
             reshard_by_owner(owner, arrays, n_shards, capacity)

@@ -242,3 +242,53 @@ def test_cli_vcf_restricted(tmp_path):
     assert scores["1:100:A:G"] == {"CADD_raw_score": 0.2, "CADD_phred": 2.0}
     assert scores["1:400:TC:T"] == {}
     assert scores["1:200:C:T"] is None  # untouched: not in the subset VCF
+
+
+def test_native_cadd_blocks_parity(tmp_path, monkeypatch):
+    """The C++ table tokenizer must produce the exact block stream the
+    Python parse loop produces: same codes, same device rows, same
+    host-row side tables, across chromosome changes, capacity splits with
+    trailing-run peels, long alleles, and malformed lines."""
+    import gzip as _gzip
+
+    from annotatedvdb_tpu.io.cadd import CaddFileReader
+    from annotatedvdb_tpu.native import cadd as native_cadd
+
+    if not native_cadd.available():
+        pytest.skip("no C++ toolchain")
+
+    path = str(tmp_path / "t.tsv.gz")
+    with _gzip.open(path, "wt") as f:
+        f.write("## CADD v1.6\n#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED\n")
+        # chr1: runs of 3 per position, crossing the capacity boundary
+        for p in range(100, 160):
+            for a in "CGT":
+                f.write(f"1\t{p}\tA\t{a}\t{p / 7:.4f}\t{p % 13}.5\n")
+        # malformed rows: bad pos, short line, unknown contig, bad score
+        f.write("1\tnotanum\tA\tC\t0.1\t1\n")
+        f.write("1\t200\n")
+        f.write("GL000\t300\tA\tC\t0.1\t1\n")
+        f.write("1\t201\tA\tC\tx\t1\n")
+        # long alleles at one position (host rows) + short row at same pos
+        f.write(f"2\t500\t{'A' * 40}\tG\t0.9\t9\n")
+        f.write("2\t500\tA\tG\t0.8\t8\n")
+        f.write("chrX\t700\tT\tC\t1e-3\t2.5\n")
+
+    def collect(native: bool):
+        monkeypatch.setenv("AVDB_NATIVE_CADD", "1" if native else "0")
+        reader = CaddFileReader(path, width=16, block_rows=64)
+        out = []
+        for code, block in reader.blocks_all():
+            n = block.n
+            out.append((
+                code, n,
+                block.pos[:n].tolist(),
+                block.ref[:n].tolist(), block.alt[:n].tolist(),
+                block.raw[:n].tolist(), block.phred[:n].tolist(),
+                block.max_run,
+                {k: sorted(v) for k, v in block.host_rows.items()},
+            ))
+        return out
+
+    a, b = collect(False), collect(True)
+    assert a == b
