@@ -42,6 +42,7 @@ from annotatedvdb_tpu.ops.cadd_join import (
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.types import chromosome_code
 from annotatedvdb_tpu.utils.arrays import pad_pow2
+from annotatedvdb_tpu.utils.profiling import bulk_load_gc
 
 
 def _allele_lengths(mat: np.ndarray) -> np.ndarray:
@@ -104,6 +105,7 @@ class TpuCaddUpdater:
 
     # ------------------------------------------------------------------
 
+    @bulk_load_gc()
     def update_all(self, chromosomes=None, commit: bool = False,
                    test: bool = False,
                    subsets: dict[int, np.ndarray] | None = None,

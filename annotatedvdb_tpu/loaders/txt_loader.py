@@ -31,6 +31,7 @@ from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
 from annotatedvdb_tpu.types import VariantBatch, chromosome_code
 from annotatedvdb_tpu.utils.strings import to_numeric
+from annotatedvdb_tpu.utils.profiling import bulk_load_gc
 
 #: Variant-table columns a TSV header may target
 #: (``variant_loader.py:63-69`` ALLOWABLE_COPY_FIELDS minus the
@@ -142,6 +143,7 @@ class TpuTextLoader:
 
     # ------------------------------------------------------------------
 
+    @bulk_load_gc()
     def load_file(self, path: str, commit: bool = False, test: bool = False,
                   persist=None, resume: bool = True) -> dict:
         alg_id = self.ledger.begin(

@@ -23,6 +23,7 @@ from annotatedvdb_tpu.loaders.lookup import chunk_lookup
 from annotatedvdb_tpu.loaders.vcf_loader import TpuVcfLoader
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
+from annotatedvdb_tpu.utils.profiling import bulk_load_gc
 
 
 class UpdateStrategy:
@@ -100,6 +101,7 @@ class TpuUpdateLoader:
             "inserted": 0,
         }
 
+    @bulk_load_gc()
     def load_file(self, path: str, commit: bool = False, test: bool = False,
                   persist=None, resume: bool = True) -> dict:
         alg_id = self.ledger.begin(

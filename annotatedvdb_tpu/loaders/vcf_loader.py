@@ -32,6 +32,7 @@ from annotatedvdb_tpu.ops.hashing import allele_hash_jit
 from annotatedvdb_tpu.ops.vrs import VrsDigestGenerator
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.store.variant_store import Segment
+from annotatedvdb_tpu.utils.profiling import bulk_load_gc
 
 def _pad_batch(batch: VariantBatch, n_target: int) -> VariantBatch:
     """Pad to a fixed row count so jitted kernels see a bounded set of
@@ -169,6 +170,7 @@ class TpuVcfLoader:
     def is_adsp(self) -> bool:
         return self.datasource == "adsp"
 
+    @bulk_load_gc()
     def load_file(
         self,
         path: str,

@@ -35,6 +35,7 @@ from annotatedvdb_tpu.ops.hashing import allele_hash_jit
 from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 from annotatedvdb_tpu.store.variant_store import RawJson
 from annotatedvdb_tpu.types import VariantBatch, chromosome_code
+from annotatedvdb_tpu.utils.profiling import bulk_load_gc
 
 
 # pending-row tuple layout (see _parse_result)
@@ -165,6 +166,7 @@ class TpuVepLoader:
             else:
                 np.asarray(ann.prefix_len), np.asarray(h)
 
+    @bulk_load_gc()
     def load_file(self, path: str, commit: bool = False, test: bool = False) -> dict:
         alg_id = self.ledger.begin(
             "TpuVepLoader.load_file",

@@ -76,3 +76,29 @@ def device_trace(trace_dir: str | None):
 
     with jax.profiler.trace(trace_dir):
         yield
+
+
+@contextlib.contextmanager
+def bulk_load_gc():
+    """Suspend the cyclic GC for the duration of a bulk load.
+
+    Load hot loops allocate millions of objects that mostly SURVIVE (store
+    annotation values): generational collection then rescans the growing
+    survivor pile every few ten-thousand allocations for zero reclaimed
+    garbage — measured ~10-15% of the VEP update leg.  The standard bulk
+    discipline applies: disable, run, one collect afterwards.  Re-entrant
+    (a nested loader — e.g. an update load's novel-insert path — must not
+    re-enable mid-outer-load) and exception-safe.  AVDB_LOAD_GC=1 keeps
+    the collector on for debugging."""
+    import gc
+    import os
+
+    if os.environ.get("AVDB_LOAD_GC") == "1" or not gc.isenabled():
+        yield
+        return
+    gc.disable()
+    try:
+        yield
+    finally:
+        gc.enable()
+        gc.collect()
