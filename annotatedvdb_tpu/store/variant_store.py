@@ -936,11 +936,16 @@ class ChromosomeShard:
         seg_idx, off = self._locate(index)
         for si in np.unique(seg_idx):
             s = self.segments[int(si)]
-            col = s.obj_dense(column)
-            m = seg_idx == si
+            fresh_col = s.obj[column] is None  # never materialized: every
+            col = s.obj_dense(column)          # target row is fresh, no
+            m = seg_idx == si                  # per-row merge check needed
             offs, vs = off[m], vals[m]
             s.dirty = True
-            if np.unique(offs).size != offs.size:
+            has_dups = np.unique(offs).size != offs.size
+            if fresh_col and not has_dups:
+                col[offs] = vs
+                continue
+            if has_dups:
                 # duplicate rows in one call: order is observable (later
                 # values merge into earlier results) — per-row loop
                 for j, v in zip(offs, vs):

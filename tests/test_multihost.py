@@ -5,9 +5,12 @@ True multi-host needs multiple machines; a num_processes=1 world exercises
 the same initialization path, and the distributed step's collectives are
 already covered on the virtual 8-device mesh (``test_distributed.py``)."""
 
+import os
 import socket
 import subprocess
 import sys
+
+import pytest
 
 from annotatedvdb_tpu.parallel.multihost import multihost_env
 
@@ -77,14 +80,15 @@ print("DISTRIBUTED_WORLD_OK")
 
 _WORKER_SRC = """
 import os, sys
-port, pid = sys.argv[1], sys.argv[2]
+port, pid, n_procs, local_dev = sys.argv[1:5]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["AVDB_JAX_PLATFORM"] = "cpu"
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=" + local_dev
 )
 os.environ["AVDB_COORDINATOR"] = "127.0.0.1:" + port
-os.environ["AVDB_NUM_PROCESSES"] = "2"
+os.environ["AVDB_NUM_PROCESSES"] = n_procs
 os.environ["AVDB_PROCESS_ID"] = pid
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -93,17 +97,18 @@ from annotatedvdb_tpu.parallel.distributed import (
     distributed_annotate_step, position_block_owner,
 )
 assert init_multihost()
-assert process_info() == (int(pid), 2)
-assert len(jax.devices()) == 8, jax.devices()  # 4 local x 2 processes
+assert process_info() == (int(pid), int(n_procs))
+assert len(jax.devices()) == int(n_procs) * int(local_dev), jax.devices()
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from annotatedvdb_tpu.io.synth import synthetic_batch
 from annotatedvdb_tpu.parallel.mesh import SHARD_AXIS
 from annotatedvdb_tpu.types import VariantBatch
 
-mesh = make_mesh(8)
-batch = synthetic_batch(256, width=16)  # same seed in both processes
-owner = position_block_owner(batch.chrom, batch.pos, 8)
+n_global = int(n_procs) * int(local_dev)
+mesh = make_mesh(n_global)
+batch = synthetic_batch(256, width=16)  # same seed in every process
+owner = position_block_owner(batch.chrom, batch.pos, n_global)
 sharding = NamedSharding(mesh, P(SHARD_AXIS))
 dev = VariantBatch(*(jax.device_put(x, sharding) for x in batch))
 ann, rid, counts, dropped, n_fb = distributed_annotate_step(
@@ -115,25 +120,20 @@ print("COUNTS", np.asarray(counts).tolist(), int(np.asarray(dropped)),
 """
 
 
-def test_two_process_distributed_world():
-    """Two REAL jax.distributed processes (loopback coordinator, 4 virtual
-    CPU devices each) run the sharded annotate step over the global
-    8-device mesh; psum'd counters must agree across processes AND match a
-    single-process 8-device run of the same batch (the reference's only
-    concurrency analog is its 10-process worker pool,
-    load_vcf_file.py:307-313 — this is the first >1-process exercise of
-    ours)."""
-    import numpy as np
-
+def _run_world(n_procs: int, local_dev: int) -> list[str]:
+    """Spawn a real jax.distributed world on an ephemeral loopback
+    coordinator and return each process's COUNTS line (asserting they all
+    agree)."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER_SRC, str(port), str(pid)],
+            [sys.executable, "-c", _WORKER_SRC, str(port), str(pid),
+             str(n_procs), str(local_dev)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
-        for pid in (0, 1)
+        for pid in range(n_procs)
     ]
     outs = []
     for p in procs:
@@ -144,9 +144,14 @@ def test_two_process_distributed_world():
         next(l for l in out.splitlines() if l.startswith("COUNTS"))
         for out in outs
     ]
-    assert lines[0] == lines[1], ("processes disagree", lines)
+    assert len(set(lines)) == 1, ("processes disagree", lines)
+    return lines
 
-    # single-process ground truth on the same (seeded) batch
+
+def _ground_truth_counts() -> str:
+    """Single-process 8-device run of the same seeded batch."""
+    import numpy as np
+
     from annotatedvdb_tpu.io.synth import synthetic_batch
     from annotatedvdb_tpu.parallel import make_mesh
     from annotatedvdb_tpu.parallel.distributed import (
@@ -160,8 +165,35 @@ def test_two_process_distributed_world():
     _ann, _rid, counts, dropped, n_fb = distributed_annotate_step(
         mesh, batch, owner=owner
     )
-    want = (
+    return (
         f"COUNTS {np.asarray(counts).tolist()} "
         f"{int(np.asarray(dropped))} {int(np.asarray(n_fb))}"
     )
+
+
+def test_two_process_distributed_world():
+    """Two REAL jax.distributed processes (loopback coordinator, 4 virtual
+    CPU devices each) run the sharded annotate step over the global
+    8-device mesh; psum'd counters must agree across processes AND match a
+    single-process 8-device run of the same batch (the reference's only
+    concurrency analog is its 10-process worker pool,
+    load_vcf_file.py:307-313 — this is the first >1-process exercise of
+    ours)."""
+    lines = _run_world(n_procs=2, local_dev=4)
+    want = _ground_truth_counts()
+    assert lines[0] == want, (lines[0], want)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("AVDB_SCALE_TEST"),
+    reason="4-process world: set AVDB_SCALE_TEST=1 (4 concurrent compiles "
+           "on a 1-core host run ~minutes)",
+)
+def test_four_process_distributed_world():
+    """Four REAL jax.distributed processes (2 virtual devices each, global
+    8-device mesh) agree with the single-process ground truth — the
+    >2-process exercise of SURVEY §5.8's comm backend (the reference fans
+    10 OS processes; collectives here cross process boundaries 4 ways)."""
+    lines = _run_world(n_procs=4, local_dev=2)
+    want = _ground_truth_counts()
     assert lines[0] == want, (lines[0], want)
