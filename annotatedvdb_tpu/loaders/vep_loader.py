@@ -176,7 +176,6 @@ class TpuVepLoader:
         # update loads probe a static store per flush: pin membership
         # caches in HBM where the link makes that a win (no-op otherwise)
         self.store.pin_for_updates()
-        lines: list[bytes] = []
         n_added_before = len(self.parser.ranker.added)
         use_native = (
             os.environ.get("AVDB_NATIVE_VEP", "1") != "0"
@@ -226,20 +225,33 @@ class TpuVepLoader:
                 res.doc_skipped[doc_lo:doc_hi].sum()
             ) + int((res.doc_fallback[doc_lo:doc_hi] == 2).sum())
 
-        def flush() -> None:
-            # docs the native parser cannot transform faithfully (novel
-            # combos, escapes, malformed inputs) re-run through the
-            # pure-Python path, INTERLEAVED in document order so same-row
-            # update/merge ordering matches the all-Python path exactly.
-            # A fallback doc that LEARNS a novel combo renumbers the whole
-            # rank table, so the remaining docs re-transform with the fresh
-            # table — exactly the version-mix point the Python path has.
-            start = 0
+        def flush_python_text(sub: bytes, count: bool) -> None:
+            batch_lines = [ln for ln in sub.split(b"\n") if ln.strip()]
+            if count:
+                self.counters["line"] += len(batch_lines)
+            if batch_lines:
+                flush_python(batch_lines)
+
+        def flush_text(text: bytes) -> None:
+            # one raw byte block of complete lines straight into the C++
+            # transformer — no per-line Python list, no join.  Docs the
+            # native parser cannot transform faithfully (novel combos,
+            # escapes, malformed inputs) re-run through the pure-Python
+            # path, INTERLEAVED in document order so same-row update/merge
+            # ordering matches the all-Python path exactly.  A fallback doc
+            # that LEARNS a novel combo renumbers the whole rank table, so
+            # the remaining docs re-transform with the fresh table —
+            # exactly the version-mix point the Python path has.
+            start_off = 0
             restarts = 0
-            while start < len(lines):
-                sub = lines[start:] if start else lines
+            counted = False  # input lines are counted once per flush: by
+            # the FIRST transform (its out_docs covers every doc of the
+            # block; restarts re-scan tails) or by the whole-block Python
+            # path when the native engine is off
+            while start_off < len(text):
+                sub = text[start_off:] if start_off else text
                 res = (
-                    native_vep.transform(
+                    native_vep.transform_text(
                         sub, self._ranking_blob(), self.is_dbsnp,
                         self.store.width,
                     )
@@ -250,8 +262,12 @@ class TpuVepLoader:
                     if use_native and restarts < 4 else None
                 )
                 if res is None:
-                    flush_python(sub)
+                    flush_python_text(sub, count=not counted)
                     break
+                n_docs = int(res.doc_fallback.size)
+                if not counted:
+                    self.counters["line"] += n_docs
+                    counted = True
                 doc_of_row = res.doc_of_row
                 fb_docs = np.where(res.doc_fallback == 1)[0]
                 lo_row, lo_doc = 0, 0
@@ -262,31 +278,33 @@ class TpuVepLoader:
                     if hi_row > lo_row:
                         self._apply_native(res, alg_id, commit, lo_row, hi_row)
                     v0 = self.parser.ranker.version
-                    flush_python([sub[f]])
+                    o = int(res.doc_off[f])
+                    e = sub.find(b"\n", o)
+                    flush_python([sub[o:] if e < 0 else sub[o:e]])
                     lo_row = int(
                         np.searchsorted(doc_of_row, f, side="right")
                     )
                     lo_doc = f + 1
                     if self.parser.ranker.version != v0:
-                        restart = start + f + 1
+                        # resume from the doc AFTER the fallback one
+                        if f + 1 < n_docs:
+                            restart = start_off + int(res.doc_off[f + 1])
+                        else:
+                            restart = len(text)  # fallback doc was last
                         break
                 if restart is not None:
-                    start = restart
+                    start_off = restart
                     restarts += 1
                     continue
-                count_native(
-                    res, lo_doc, res.doc_fallback.size, lo_row, res.n_rows
-                )
+                count_native(res, lo_doc, n_docs, lo_row, res.n_rows)
                 if res.n_rows > lo_row:
                     self._apply_native(res, alg_id, commit, lo_row, res.n_rows)
                 break
-            lines.clear()
             self._cadence.maybe_log(self.counters["line"], self.counters)
 
-        # binary chunked read: lines stay bytes end to end (json.loads and
-        # the native transformer both take bytes; only rare Python-fallback
-        # docs ever decode) — a per-line text iterator costs ~10% of the
-        # whole leg
+        # binary chunked read, flushed per block of complete lines (the
+        # transformer takes raw bytes; only rare Python-fallback docs are
+        # ever re-materialized as line strings)
         stop = False
         with _open_bytes(path) as fh:
             tail = b""
@@ -294,23 +312,23 @@ class TpuVepLoader:
                 block = fh.read(4 << 20)
                 if not block:
                     break
-                parts = (tail + block).split(b"\n")
-                tail = parts.pop()
-                for ln in parts:
-                    if not ln.strip():
-                        continue
-                    self.counters["line"] += 1
-                    lines.append(ln)
-                    if len(lines) >= self.batch_size:
-                        flush()
-                        if test:
-                            stop = True
-                            break
+                block = tail + block
+                cut = block.rfind(b"\n")
+                if cut < 0:
+                    tail = block
+                    continue
+                flush_text(block[:cut + 1])
+                tail = block[cut + 1:]
+                if test:
+                    stop = True
+                    # one-batch smoke runs must still cover a SMALL file
+                    # completely: if nothing follows, the unterminated
+                    # final line belongs to this (only) batch
+                    if not fh.read(1) and tail.strip():
+                        flush_text(tail + b"\n")
+                        tail = b""
             if not stop and tail.strip():
-                self.counters["line"] += 1
-                lines.append(tail)
-        if lines and not stop:
-            flush()
+                flush_text(tail + b"\n")
         added = self.parser.ranker.added[n_added_before:]
         if added:
             self.log(f"added {len(added)} new consequence combos: {added}")

@@ -64,6 +64,7 @@ def load():
             + [c.c_void_p] * 3           # is_multi, hash, host_fb
             + [c.c_void_p] * 8           # ms/rk/fq/vo off+len
             + [c.c_int64, c.c_void_p, c.c_void_p]  # docs_cap, doc_fallback, doc_skipped
+            + [c.c_void_p]                          # doc_off
             + [c.c_void_p, c.c_int64]    # arena, arena_cap
             + [c.c_void_p] * 3           # out_rows, out_docs, arena_used
         )
@@ -121,6 +122,7 @@ class VepTransform(NamedTuple):
     vo_len: np.ndarray
     doc_fallback: np.ndarray   # 0 ok, 1 python-path, 2 skipped contig
     doc_skipped: np.ndarray    # '.'-alt skips per doc (applied docs only)
+    doc_off: np.ndarray        # byte offset of each doc's line in `text`
     arena: bytes
     text: bytes                # the joined input lines (spans reference it)
 
@@ -172,9 +174,10 @@ def _row_buffers(rows_cap: int, width: int) -> dict:
 
 def _doc_buffers(n: int) -> tuple:
     if not _DOC_POOL or _DOC_POOL[0][0].shape[0] < n:
-        _DOC_POOL[:] = [(np.empty(n, np.uint8), np.empty(n, np.int32))]
-    fb, sk = _DOC_POOL[0]
-    return fb[:n], sk[:n]
+        _DOC_POOL[:] = [(np.empty(n, np.uint8), np.empty(n, np.int32),
+                         np.empty(n, np.int64))]
+    fb, sk, do = _DOC_POOL[0]
+    return fb[:n], sk[:n], do[:n]
 
 
 def _arena_buffer(cap: int) -> np.ndarray:
@@ -185,20 +188,31 @@ def _arena_buffer(cap: int) -> np.ndarray:
 
 def transform(lines: "list[bytes] | list[str]", blob: bytes, is_dbsnp: bool,
               width: int) -> VepTransform | None:
-    """Run the native transformer over one flush (bytes lines preferred —
-    the loader reads binary and never decodes the hot path); None when the
-    library is unavailable (callers use the pure-Python path).
-
-    The returned row/doc arrays are views into pooled buffers, valid until
-    the next ``transform`` call (see the pool contract above)."""
-    lib = load()
-    if lib is None:
-        return None
+    """Run the native transformer over one flush of LINES; see
+    :func:`transform_text` for the zero-copy whole-block entry the loader
+    uses.  None when the library is unavailable."""
     joiner = b"\n" if lines and isinstance(lines[0], bytes) else "\n"
     text = joiner.join(lines)
     if isinstance(text, str):
         text = text.encode()
-    n_docs = len(lines)
+    return transform_text(text, blob, is_dbsnp, width, n_docs=len(lines))
+
+
+def transform_text(text: bytes, blob: bytes, is_dbsnp: bool,
+                   width: int, n_docs: int | None = None) -> VepTransform | None:
+    """Run the native transformer over a raw byte block of complete
+    newline-separated JSON lines — the loader's hot path (no per-line
+    Python list, no join).  ``n_docs`` is an optional upper bound on the
+    line count (derived by scanning when absent); None when the library is
+    unavailable (callers use the pure-Python path).
+
+    The returned row/doc arrays are views into pooled buffers, valid until
+    the next transform call (see the pool contract above)."""
+    lib = load()
+    if lib is None:
+        return None
+    if n_docs is None:
+        n_docs = text.count(b"\n") + 1
     rows_cap = max(2 * n_docs + 64, 256)
     arena_cap = 4 * len(text) + (1 << 20)
     c = ctypes
@@ -209,7 +223,7 @@ def transform(lines: "list[bytes] | list[str]", blob: bytes, is_dbsnp: bool,
         # memset was the dominant per-call cost) nor fresh pages per flush
         # are needed
         a = _row_buffers(rows_cap, width)
-        doc_fallback, doc_skipped = _doc_buffers(n_docs + 1)
+        doc_fallback, doc_skipped, doc_off = _doc_buffers(n_docs + 1)
         arena = _arena_buffer(arena_cap)
         out_rows = c.c_int64(0)
         out_docs = c.c_int64(0)
@@ -228,6 +242,7 @@ def transform(lines: "list[bytes] | list[str]", blob: bytes, is_dbsnp: bool,
             n_docs + 1,
             doc_fallback.ctypes.data_as(c.c_void_p),
             doc_skipped.ctypes.data_as(c.c_void_p),
+            doc_off.ctypes.data_as(c.c_void_p),
             arena.ctypes.data_as(c.c_void_p), arena_cap,
             c.byref(out_rows), c.byref(out_docs), c.byref(arena_used),
         )
@@ -245,6 +260,7 @@ def transform(lines: "list[bytes] | list[str]", blob: bytes, is_dbsnp: bool,
             **{k: v[:n] for k, v in a.items()},
             doc_fallback=doc_fallback[: out_docs.value],
             doc_skipped=doc_skipped[: out_docs.value],
+            doc_off=doc_off[: out_docs.value].copy(),
             arena=arena[: arena_used.value].tobytes(),
             text=text,
         )

@@ -633,6 +633,9 @@ int64_t avdb_vep_transform(
     int64_t* fq_off, int32_t* fq_len,
     int64_t* vo_off, int32_t* vo_len,
     int64_t docs_cap, uint8_t* doc_fallback, int32_t* doc_skipped,
+    // byte offset of each doc's line within `text` (fallback docs re-parse
+    // from here; a restart re-transforms from a doc's offset)
+    int64_t* doc_off,
     char* arena_buf, int64_t arena_cap,
     int64_t* out_rows, int64_t* out_docs, int64_t* arena_used) {
     RankTable table = parse_table(table_blob, table_len);
@@ -669,6 +672,7 @@ int64_t avdb_vep_transform(
         if (docs >= docs_cap) return 1;
         int64_t doc_idx = docs++;
         doc_fallback[doc_idx] = 0;
+        doc_off[doc_idx] = li;
         doc_skipped[doc_idx] = 0;
         int64_t row_mark = rows;
         int64_t arena_mark = arena.mark();
