@@ -59,6 +59,29 @@ class SnpEffLofStrategy(UpdateStrategy):
 
     jsonb_columns = ("loss_of_function",)
 
+    def prefilter(self, chunk):
+        """Skip LOF/NMD-less lines BEFORE the store lookup
+        (``load_snpeff_lof.py:264-266``).  Substring screen on the raw
+        INFO text (conservative-inclusive: a false positive just reaches
+        ``values``, which rejects it with the same counter)."""
+        import numpy as np
+
+        n = chunk.batch.n
+        out = np.zeros(n, bool)
+        raws = chunk.info_raw
+        if raws is not None:
+            for i in range(n):
+                raw = raws[i]
+                out[i] = raw is not None and (
+                    "LOF=" in raw or "NMD=" in raw
+                )
+        else:
+            infos = chunk.info
+            for i in range(n):
+                info = infos[i]
+                out[i] = "LOF" in info or "NMD" in info
+        return out
+
     def values(self, row: dict, existing: dict | None):
         info = row["info"]
         lof = parse_lof_string(info.get("LOF"))

@@ -51,6 +51,16 @@ class UpdateStrategy:
     def values(self, row: dict, existing: dict | None):
         raise NotImplementedError
 
+    def prefilter(self, chunk):
+        """Optional pre-lookup row filter: return a [N] bool mask of rows
+        worth processing, or None for all.  Excluded rows count as
+        skipped WITHOUT paying the store lookup — the reference skips
+        e.g. LOF-less SnpEff lines before any SQL
+        (``load_snpeff_lof.py:264-266``).  The mask may be conservative
+        (include rows ``values`` will reject) but must never exclude a
+        row ``values`` would accept."""
+        return None
+
     def values_batch(self, chunk, rows, existing, numeric):
         """Optional vectorized fast path over one chunk's FOUND rows.
 
@@ -186,6 +196,18 @@ class TpuUpdateLoader:
         return out
 
     def _apply_chunk(self, chunk: VcfChunk, alg_id: int, commit: bool) -> None:
+        mask = self.strategy.prefilter(chunk)
+        if mask is not None and not mask.all():
+            n_excluded = int((~mask).sum())
+            # excluded rows count as SKIPPED without a lookup — reference
+            # semantics (it skips LOF-less lines before any SQL, so such a
+            # line is "skipped" even when its variant is absent from the
+            # store; an unfiltered pass would report those as not_found)
+            self.counters["variant"] += n_excluded
+            self.counters["skipped"] += n_excluded
+            if not mask.any():
+                return
+            chunk = _subset_chunk(chunk, np.where(mask)[0].tolist())
         novel: list[int] = []
         ann_cols = (
             JSONB_COLUMNS if self.strategy.jsonb_columns is None

@@ -120,3 +120,74 @@ def test_lof_cli(tmp_path):
     reloaded = VariantStore.load(str(store_dir))
     shard, i = find_row(reloaded, 1, 100)
     assert "LOF" in shard.annotations["loss_of_function"][i]
+
+
+def test_prefilter_matches_unfiltered(tmp_path, monkeypatch):
+    """The pre-lookup LOF/NMD screen must not change stored values or the
+    update/variant counters.  Accounting difference BY DESIGN (reference
+    semantics — it skips LOF-less lines before any SQL): an excluded row
+    absent from the store counts skipped, where an unfiltered pass would
+    report not_found; the combined skipped+not_found total is invariant."""
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.loaders.lof_loader import (
+        SnpEffLofStrategy,
+        TpuSnpEffLofLoader,
+    )
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    base = tmp_path / "base.vcf"
+    lof = tmp_path / "lof.vcf"
+    rows = []
+    for i in range(40):
+        info = "."
+        if i % 5 == 0:
+            info = "LOF=(G%d|ENSG%d|10|0.5)" % (i, i)
+        elif i % 7 == 0:
+            info = "NMD=(G%d|ENSG%d|3|0.1)" % (i, i)
+        elif i % 3 == 0:
+            info = "DP=55;AC=2"  # LOF-less: must be screened out pre-lookup
+        rows.append(f"1\t{1000 + i}\trs{i}\tA\tG\t.\t.\t{info}")
+    # a LOF-less row whose variant is NOT in the store: exercises the
+    # skipped-vs-not_found accounting divergence
+    rows.append("1\t9999\trsX\tC\tT\t.\t.\tDP=9")
+    header = "##fileformat=VCFv4.2\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    base.write_text(header + "\n".join(
+        r.replace("LOF=", "X=").replace("NMD=", "Y=")
+        for r in rows[:-1]) + "\n")
+    lof.write_text(header + "\n".join(rows) + "\n")
+
+    def run(disable_prefilter):
+        if disable_prefilter:
+            monkeypatch.setattr(
+                SnpEffLofStrategy, "prefilter", lambda self, chunk: None
+            )
+        else:
+            monkeypatch.undo()
+        store = VariantStore(width=8)
+        ledger = AlgorithmLedger(str(tmp_path / f"l{disable_prefilter}.jsonl"))
+        TpuVcfLoader(store, ledger, log=lambda *a: None).load_file(
+            str(base), commit=True
+        )
+        c = TpuSnpEffLofLoader(store, ledger, log=lambda *a: None).load_file(
+            str(lof), commit=True
+        )
+        vals = [
+            store.shards[1].get_ann("loss_of_function", i)
+            for i in range(store.shards[1].n)
+        ]
+        return {k: c[k] for k in ("variant", "update", "skipped",
+                                  "not_found")}, vals
+
+    c_off, v_off = run(disable_prefilter=True)
+    c_on, v_on = run(disable_prefilter=False)
+    assert v_on == v_off
+    for key in ("variant", "update"):
+        assert c_on[key] == c_off[key], (key, c_on, c_off)
+    # the screened row missing from the store: skipped (reference
+    # semantics) instead of not_found; the combined total is invariant
+    assert (c_on["skipped"] + c_on["not_found"]
+            == c_off["skipped"] + c_off["not_found"])
+    assert c_on["not_found"] == c_off["not_found"] - 1
+    assert c_on["update"] > 0 and c_on["skipped"] > 0
