@@ -124,22 +124,33 @@ def info_to_json(info_str: str) -> str:
 
     Raises ValueError on Infinity/NaN values — same abort the reference's
     ``json.dumps(..., allow_nan=False)`` check produces
-    (``update_from_qc_pvcf_file.py:141-145``)."""
+    (``update_from_qc_pvcf_file.py:141-145``).
+
+    Repeated INFO keys de-duplicate LAST-WINS at the ORIGINAL position —
+    exactly the dict semantics ``parse_info`` + ``json.dumps`` produce
+    (Python dicts keep first-insertion order on re-assignment), so the
+    persisted raw text is byte-identical to the fallback path even for
+    malformed inputs like ``AC=1;AC=2``."""
     s = info_str.replace("\\x2c", ",").replace("\\x59", "/").replace("#", ":")
-    parts = []
+    # pass 1 — de-duplicate RAW tokens, keyed by parse_info's dict key
+    # (re-assignment keeps first position, exactly like the dict).  Only
+    # survivors render: an overwritten non-finite value must NOT abort,
+    # because the fallback path's dict never sees it either.
+    items: dict[str, str | None] = {}  # None = bare flag (-> true)
     for item in s.split(";"):
         eq = item.find("=")
         if eq < 0:
             if item:
-                key = (
-                    f'"{item}"' if _SAFE_STR_RE.match(item)
-                    else json.dumps(item)
-                )
-                parts.append(f"{key}:true")
-            continue
-        k, v = item[:eq], item[eq + 1:]
+                items[item] = None
+        else:
+            items[item[:eq]] = item[eq + 1:]
+    # pass 2 — render each surviving value once
+    parts = []
+    for k, v in items.items():
         key = f'"{k}"' if _SAFE_STR_RE.match(k) else json.dumps(k)
-        if _INT_RE.match(v):
+        if v is None:
+            parts.append(f"{key}:true")
+        elif _INT_RE.match(v):
             parts.append(f"{key}:{int(v)}")
         elif _FLOAT_RE.match(v) and math.isfinite(fv := float(v)):
             # isfinite guard: '1e400' overflows float() to inf — bare
@@ -151,7 +162,9 @@ def info_to_json(info_str: str) -> str:
         else:
             # exact-parity fallback (whitespace-padded numbers, underscores,
             # inf/nan, escapes, empty, non-ascii)
-            parts.append(f"{key}:{json.dumps(to_numeric(v), allow_nan=False)}")
+            parts.append(
+                f"{key}:{json.dumps(to_numeric(v), allow_nan=False)}"
+            )
     return "{" + ",".join(parts) + "}"
 
 
@@ -238,6 +251,9 @@ class VcfChunk:
     h_native: np.ndarray | None = None
 
 
+_SCAN_DONE = object()
+
+
 class VcfBatchReader:
     """Stream a VCF into fixed-size per-alt row chunks.
 
@@ -297,6 +313,38 @@ class VcfBatchReader:
             )
             return
         yield from self._iter_python()
+
+    def iter_prefetched(self, depth: int = 2, timer=None):
+        """Chunk iterator with the scan on a background ingest thread.
+
+        The tokenizer fills chunk *N+1* while the consumer still holds
+        chunk *N* — the first stage of the overlapped load executor
+        (``loaders/vcf_loader.py``).  ``depth`` bounds the unconsumed
+        chunks (backpressure blocks the scan, so memory stays O(depth)).
+        Chunks are safe to hand across the thread boundary: both engines
+        emit self-owned arrays (the native scanner transfers buffer
+        ownership per fill, ``native/vcf.py``) and sidecar columns only
+        reference immutable window bytes.
+
+        ``timer``: optional :class:`~annotatedvdb_tpu.utils.profiling.StageTimer`;
+        scan time is attributed to its ``ingest`` stage *on the ingest
+        thread* (busy time, not consumer wall).  Returns a
+        :class:`~annotatedvdb_tpu.utils.pipeline.BoundedStage` — callers
+        that stop early must ``close()`` it."""
+        from annotatedvdb_tpu.utils.pipeline import BoundedStage
+
+        source = iter(self)
+        if timer is not None:
+            def timed(it=source):
+                while True:
+                    with timer.stage("ingest"):
+                        chunk = next(it, _SCAN_DONE)
+                    if chunk is _SCAN_DONE:
+                        return
+                    yield chunk
+
+            source = timed()
+        return BoundedStage(source, depth=depth, name="vcf-ingest")
 
     def _iter_python(self) -> Iterator[VcfChunk]:
         rows: list = []

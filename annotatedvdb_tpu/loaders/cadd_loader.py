@@ -400,7 +400,6 @@ class TpuCaddUpdater:
             distributed_update_step,
         )
         from annotatedvdb_tpu.types import VariantBatch
-        from annotatedvdb_tpu.utils.arrays import next_pow2
 
         buf, ctx["buf"], ctx["buf_rows"] = ctx["buf"], [], 0
         chrom = np.concatenate([
@@ -425,7 +424,11 @@ class TpuCaddUpdater:
             np.concatenate([rl, al]),
             np.concatenate([al, rl]),
         )
-        q = _pad_batch(q, max(next_pow2(q.n), self.mesh.devices.size))
+        # pow2 shape bound rounded to a shard-count multiple (non-pow2
+        # meshes) — see mesh_capacity
+        from annotatedvdb_tpu.utils.arrays import mesh_capacity
+
+        q = _pad_batch(q, mesh_capacity(q.n, self.mesh.devices.size))
         rid, found, store_row, _c = distributed_update_step(
             self.mesh, q, ctx["snapshot"], routing="position"
         )

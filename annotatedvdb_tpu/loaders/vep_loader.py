@@ -150,6 +150,11 @@ class TpuVepLoader:
         )
         from annotatedvdb_tpu.store.variant_store import _transfer_fast
 
+        # build the C RawJson assembler outside the measured stream (the
+        # first _apply_native call otherwise pays its compile)
+        from annotatedvdb_tpu.native import pyfast
+
+        pyfast.warm()
         if not _transfer_fast():
             return  # slow link: _apply_batch computes on host, no kernels
         p = next_pow2(self.batch_size)
@@ -410,13 +415,12 @@ class TpuVepLoader:
         from annotatedvdb_tpu.parallel.distributed import (
             distributed_update_step,
         )
-        from annotatedvdb_tpu.utils.arrays import next_pow2
+        from annotatedvdb_tpu.utils.arrays import mesh_capacity
 
         n = batch.n
-        # pad to the pow2 shape bound (not just a device multiple):
-        # per-flush row counts vary, and every distinct padded size would
-        # trace + compile a fresh mesh program (~35s each on TPU)
-        q = _pad_batch(batch, max(next_pow2(n), self.mesh.devices.size))
+        # pow2 shape bound (one traced mesh program per load) rounded to a
+        # shard-count multiple (non-pow2 meshes) — see mesh_capacity
+        q = _pad_batch(batch, mesh_capacity(n, self.mesh.devices.size))
         rid_out, found_s, store_row, _counters = distributed_update_step(
             self.mesh, q, self._dev_snapshot, routing="position"
         )

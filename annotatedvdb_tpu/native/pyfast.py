@@ -89,6 +89,15 @@ def available() -> bool:
     return load() is not None
 
 
+def warm() -> bool:
+    """Build + probe the extension NOW (idempotent, thread-safe via the
+    load lock) so the first measured flush of a loader never pays the C++
+    compile.  Loader ``warmup()`` paths call this alongside their kernel
+    pre-compiles; returns availability.  Safe to call from any pipeline
+    stage thread — the verdict latches once."""
+    return available()
+
+
 def raw_rows(arena: str, offs: np.ndarray, lens: np.ndarray, cls) -> list:
     """Validated front door for the C assembly: the extension reinterprets
     the buffers as int64/int32, so dtype mistakes must fail HERE, loudly,

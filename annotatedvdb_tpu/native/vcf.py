@@ -23,42 +23,50 @@ READ_SIZE = 8 << 20  # decompressed bytes per window
 
 
 class _Arrays:
-    """Preallocated per-batch output buffers for the C call."""
+    """Per-batch output buffers for the C call.
 
-    def __init__(self, cap: int, width: int):
+    ``np.empty``, not ``np.zeros``: the tokenizer writes every per-row slot
+    for rows [0, n) and consumers only ever view ``[:n]``, so pre-zeroing
+    ~20MB per fill is pure page-fault cost.  With ``pack=False`` the nibble
+    matrices shrink to 1-element dummies (valid pointers the C call never
+    writes through — ``want_packed=0`` skips the pack work)."""
+
+    def __init__(self, cap: int, width: int, pack: bool = True):
         self.cap = cap
-        self.chrom = np.zeros(cap, np.int8)
-        self.pos = np.zeros(cap, np.int32)
-        self.ref = np.zeros((cap, width), np.uint8)
-        self.alt = np.zeros((cap, width), np.uint8)
-        self.ref_len = np.zeros(cap, np.int32)
-        self.alt_len = np.zeros(cap, np.int32)
-        self.multi = np.zeros(cap, np.uint8)
-        self.line_no = np.zeros(cap, np.int64)
-        self.ref_off = np.zeros(cap, np.int64)
-        self.alt_off = np.zeros(cap, np.int64)
-        self.id_off = np.zeros(cap, np.int64)
-        self.id_len = np.zeros(cap, np.int32)
-        self.qual_off = np.zeros(cap, np.int64)
-        self.qual_len = np.zeros(cap, np.int32)
-        self.filter_off = np.zeros(cap, np.int64)
-        self.filter_len = np.zeros(cap, np.int32)
-        self.info_off = np.zeros(cap, np.int64)
-        self.info_len = np.zeros(cap, np.int32)
-        self.format_off = np.zeros(cap, np.int64)
-        self.format_len = np.zeros(cap, np.int32)
-        self.altcol_off = np.zeros(cap, np.int64)
-        self.altcol_len = np.zeros(cap, np.int32)
-        self.alt_index = np.zeros(cap, np.int32)
-        self.n_alts = np.zeros(cap, np.int32)
-        self.rs_number = np.zeros(cap, np.int64)
-        self.rs_weird = np.zeros(cap, np.uint8)
-        self.id_verbatim = np.zeros(cap, np.uint8)
-        self.has_freq = np.zeros(cap, np.uint8)
-        self.hash = np.zeros(cap, np.uint32)
-        self.ref_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
-        self.alt_packed = np.zeros((cap, (width + 1) // 2), np.uint8)
-        self.pack_ok = np.zeros(cap, np.uint8)
+        self.chrom = np.empty(cap, np.int8)
+        self.pos = np.empty(cap, np.int32)
+        self.ref = np.empty((cap, width), np.uint8)
+        self.alt = np.empty((cap, width), np.uint8)
+        self.ref_len = np.empty(cap, np.int32)
+        self.alt_len = np.empty(cap, np.int32)
+        self.multi = np.empty(cap, np.uint8)
+        self.line_no = np.empty(cap, np.int64)
+        self.ref_off = np.empty(cap, np.int64)
+        self.alt_off = np.empty(cap, np.int64)
+        self.id_off = np.empty(cap, np.int64)
+        self.id_len = np.empty(cap, np.int32)
+        self.qual_off = np.empty(cap, np.int64)
+        self.qual_len = np.empty(cap, np.int32)
+        self.filter_off = np.empty(cap, np.int64)
+        self.filter_len = np.empty(cap, np.int32)
+        self.info_off = np.empty(cap, np.int64)
+        self.info_len = np.empty(cap, np.int32)
+        self.format_off = np.empty(cap, np.int64)
+        self.format_len = np.empty(cap, np.int32)
+        self.altcol_off = np.empty(cap, np.int64)
+        self.altcol_len = np.empty(cap, np.int32)
+        self.alt_index = np.empty(cap, np.int32)
+        self.n_alts = np.empty(cap, np.int32)
+        self.rs_number = np.empty(cap, np.int64)
+        self.rs_weird = np.empty(cap, np.uint8)
+        self.id_verbatim = np.empty(cap, np.uint8)
+        self.has_freq = np.empty(cap, np.uint8)
+        self.hash = np.empty(cap, np.uint32)
+        pack_rows = cap if pack else 1
+        pack_cols = (width + 1) // 2 if pack else 1
+        self.ref_packed = np.empty((pack_rows, pack_cols), np.uint8)
+        self.alt_packed = np.empty((pack_rows, pack_cols), np.uint8)
+        self.pack_ok = np.empty(cap, np.uint8)
 
     def pointers(self):
         def p(a):
@@ -91,8 +99,8 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool,
         raise RuntimeError("native ingest library unavailable")
 
     opener = gzip.open if path.endswith(".gz") else open
-    arrays = _Arrays(batch_size, width)
-    counters = np.zeros(4, np.int64)
+    arrays = _Arrays(batch_size, width, pack_alleles)
+    counters = np.zeros(5, np.int64)
     consumed = ctypes.c_int64(0)
     need_more = ctypes.c_int32(0)
 
@@ -142,10 +150,11 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool,
                     # one source line holds more alt rows than the buffer:
                     # grow and retry (the Python engine likewise lets a chunk
                     # exceed batch_size rather than split a line)
-                    arrays = _Arrays(arrays.cap * 2, width)
+                    arrays = _Arrays(arrays.cap * 2, width, pack_alleles)
                     continue
-                # count lines consumed for stable line numbers
-                line_base += window.count(b"\n", start, start + consumed.value)
+                # absolute line numbers: the tokenizer reports the lines it
+                # consumed (headers included), so no host newline re-scan
+                line_base += int(counters[4])
                 if n or counters.any():
                     # zero-row fills with consumed lines still surface
                     # their counters so totals stay exact
@@ -155,6 +164,14 @@ def scan_native(path: str, batch_size: int, width: int, identity_only: bool,
                         "skipped_alt": int(counters[2]),
                         "malformed": int(counters[3]),
                     }, decoded_cache
+                if n:
+                    # ownership handoff: the consumer keeps VIEWS of these
+                    # buffers (chunk_from_native copies nothing), so the
+                    # next fill writes into a fresh set.  Allocating beats
+                    # copying ~200B/row out of the old buffers, and it is
+                    # what makes chunks safe to hand to another pipeline
+                    # thread.
+                    arrays = _Arrays(arrays.cap, width, pack_alleles)
                 start += consumed.value
                 if not need_more.value:
                     break
@@ -221,52 +238,55 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
                       pack_alleles: bool = True,
                       decoded_cache: list | None = None):
     """Assemble a :class:`~annotatedvdb_tpu.io.vcf.VcfChunk` from one native
-    batch.  Device arrays are copied out (the buffers are reused by the next
-    fill); sidecar columns are lazy views over the window bytes."""
+    batch.  The chunk takes zero-copy VIEWS: ``scan_native`` hands the
+    ``_Arrays`` buffers over with the rows (allocating a fresh set for the
+    next fill), so nothing here aliases a buffer a later fill writes into —
+    which also makes chunks safe to pass to another pipeline thread
+    (``VcfBatchReader.iter_prefetched``).  Sidecar columns are lazy views
+    over the immutable window bytes."""
     from annotatedvdb_tpu.io.vcf import VcfChunk, parse_freq, parse_info
 
     batch = VariantBatch(
-        chrom=arrays.chrom[:n].copy(),
-        pos=arrays.pos[:n].copy(),
-        ref=arrays.ref[:n].copy(),
-        alt=arrays.alt[:n].copy(),
-        ref_len=arrays.ref_len[:n].copy(),
-        alt_len=arrays.alt_len[:n].copy(),
+        chrom=arrays.chrom[:n],
+        pos=arrays.pos[:n],
+        ref=arrays.ref[:n],
+        alt=arrays.alt[:n],
+        ref_len=arrays.ref_len[:n],
+        alt_len=arrays.alt_len[:n],
     )
-    # snapshot the span indexes (small int arrays; the _Arrays buffers are
-    # overwritten by the next fill, the window bytes are immutable)
-    ref_off = arrays.ref_off[:n].copy()
-    alt_off = arrays.alt_off[:n].copy()
-    id_off = arrays.id_off[:n].copy()
-    id_len = arrays.id_len[:n].copy()
-    qual_off = arrays.qual_off[:n].copy()
-    qual_len = arrays.qual_len[:n].copy()
-    filter_off = arrays.filter_off[:n].copy()
-    filter_len = arrays.filter_len[:n].copy()
-    info_off = arrays.info_off[:n].copy()
-    info_len = arrays.info_len[:n].copy()
-    format_off = arrays.format_off[:n].copy()
-    format_len = arrays.format_len[:n].copy()
-    altcol_off = arrays.altcol_off[:n].copy()
-    altcol_len = arrays.altcol_len[:n].copy()
-    alt_index = arrays.alt_index[:n].copy()
-    n_alts = arrays.n_alts[:n].copy()
-    rs_number = arrays.rs_number[:n].copy()
-    h_native = arrays.hash[:n].copy()
-    rs_weird = arrays.rs_weird[:n].astype(bool)
-    id_verbatim = arrays.id_verbatim[:n].astype(bool)
-    has_freq = arrays.has_freq[:n].astype(bool)
+    ref_off = arrays.ref_off[:n]
+    alt_off = arrays.alt_off[:n]
+    id_off = arrays.id_off[:n]
+    id_len = arrays.id_len[:n]
+    qual_off = arrays.qual_off[:n]
+    qual_len = arrays.qual_len[:n]
+    filter_off = arrays.filter_off[:n]
+    filter_len = arrays.filter_len[:n]
+    info_off = arrays.info_off[:n]
+    info_len = arrays.info_len[:n]
+    format_off = arrays.format_off[:n]
+    format_len = arrays.format_len[:n]
+    altcol_off = arrays.altcol_off[:n]
+    altcol_len = arrays.altcol_len[:n]
+    alt_index = arrays.alt_index[:n]
+    n_alts = arrays.n_alts[:n]
+    rs_number = arrays.rs_number[:n]
+    h_native = arrays.hash[:n]
+    # uint8 0/1 -> bool reinterpret (same itemsize): no copy
+    rs_weird = arrays.rs_weird[:n].view(np.bool_)
+    id_verbatim = arrays.id_verbatim[:n].view(np.bool_)
+    has_freq = arrays.has_freq[:n].view(np.bool_)
     # pre-packed alleles travel with the chunk only when EVERY row packs
     # (the loader uploads whole chunks either packed or raw).  When packing
     # was never attempted (pack_alleles=False), packable stays None — the
     # tri-state contract lets downstream host-encode if it wants to.
     packable = bool(arrays.pack_ok[:n].all()) if pack_alleles else None
     if packable:
-        ref_packed = arrays.ref_packed[:n].copy()
-        alt_packed = arrays.alt_packed[:n].copy()
+        ref_packed = arrays.ref_packed[:n]
+        alt_packed = arrays.alt_packed[:n]
     else:
         ref_packed = alt_packed = None
-    line_no = arrays.line_no[:n].copy()
+    line_no = arrays.line_no[:n]
     # the window decodes ONCE on first span access (ascii is 1 byte -> 1
     # char, so byte offsets index the str directly): per-field str slices
     # beat per-field bytes().decode() when consumers touch several sidecar

@@ -1129,10 +1129,15 @@ class VariantStore:
         # manifest intact (segments are also written via tmp+rename, so the
         # old manifest's files are never mutated in place) — the store is
         # always loadable, possibly one checkpoint behind.  Process death
-        # needs only the atomic rename (the page cache survives it); ALL
-        # fsyncs — segment data, manifest, rename metadata — are the
-        # power-loss opt-in (AVDB_FSYNC=1), because on journaling
-        # filesystems one small-file fsync per checkpoint forces the whole
+        # needs only the atomic rename (the page cache survives it).  The
+        # MANIFEST's flush+fsync is unconditional: it is one tiny file per
+        # checkpoint and it is what keeps a power-loss rename from landing
+        # a zero-length/corrupt manifest.json on filesystems that don't
+        # order rename after data — without it the store could become
+        # unloadable instead of "at most one checkpoint behind".  The
+        # expensive fsyncs — segment data and directory metadata — remain
+        # the power-loss opt-in (AVDB_FSYNC=1), because on journaling
+        # filesystems one data fsync per checkpoint forces the whole
         # preceding segment write to disk and costs real throughput.  The
         # survivable default matches the reference's own bulk loads
         # (UNLOGGED tables are truncated by Postgres crash recovery,
@@ -1141,9 +1146,8 @@ class VariantStore:
         mtmp = os.path.join(path, f".manifest.tmp{os.getpid()}")
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
-            if fsync_data:
-                f.flush()
-                os.fsync(f.fileno())
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(mtmp, os.path.join(path, "manifest.json"))
         if fsync_data:
             # commit the rename METADATA too (every segment rename above
@@ -1188,12 +1192,25 @@ class VariantStore:
             if w < ref.shape[1]:
                 ref = np.ascontiguousarray(ref[:, :w])
                 alt = np.ascontiguousarray(alt[:, :w])
-        with open(tmp, "wb") as f:
-            np.savez(
-                f,
-                ref=ref, alt=alt,
-                **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
+        # flat sequential container, NOT an npz: np.savez's zipfile
+        # machinery (per-member seek-back size patching, 8KB buffered
+        # writes, crc32 passes) was ~45% of checkpoint-persist CPU on
+        # syscall-expensive filesystems.  Layout: one JSON name line, then
+        # one raw .npy stream per column in that order.  The extension
+        # stays .npz for manifest compatibility; _read_segment sniffs the
+        # leading byte ('{' here vs zip's 'P'), so stores persisted by
+        # older builds keep loading.
+        arrays = {
+            "ref": ref, "alt": alt,
+            **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
+        }
+        with open(tmp, "wb", buffering=1 << 20) as f:
+            f.write(
+                (json.dumps({"seg": 1, "names": list(arrays)}) + "\n")
+                .encode()
             )
+            for arr in arrays.values():
+                np.lib.format.write_array(f, arr, allow_pickle=False)
             if fsync_data:
                 f.flush()
                 os.fsync(f.fileno())
@@ -1278,7 +1295,22 @@ class VariantStore:
     def _read_segment(path: str, label: str, seg_id: int,
                       width: int) -> Segment:
         stem = f"chr{label}.{seg_id:06d}"
-        data = np.load(os.path.join(path, stem + ".npz"))
+        fp = os.path.join(path, stem + ".npz")
+        with open(fp, "rb") as f:
+            head = f.read(1)
+            if head == b"{":
+                # flat container (see _write_segment): JSON name line +
+                # sequential raw .npy streams
+                f.seek(0)
+                names = json.loads(f.readline())["names"]
+                data = {
+                    name: np.lib.format.read_array(f, allow_pickle=False)
+                    for name in names
+                }
+            else:  # legacy zip-backed npz from older builds
+                f.seek(0)
+                with np.load(f) as z:
+                    data = {name: z[name] for name in z.files}
         cols = {name: data[name] for name, _ in _NUMERIC_COLUMNS}
         n = data["ref"].shape[0]
         ref, alt = data["ref"], data["alt"]

@@ -1,0 +1,131 @@
+"""Host-side pipeline plumbing: bounded background stages.
+
+The overlapped streaming executor (``loaders/vcf_loader.py``) runs ingest,
+dispatch, and process as concurrent stages.  Each boundary is one
+:class:`BoundedStage`: a daemon thread pulls items from its source iterator,
+applies a stage function, and hands results downstream through a bounded
+queue — full queue = backpressure (the producer blocks), so a fast tokenizer
+can never race an unbounded chunk pile into memory.
+
+Contract:
+
+- items flow strictly in order (one worker per stage, FIFO queue) — the
+  executor's byte-for-byte parity with the serial path depends on this;
+- an exception anywhere upstream travels the queue and re-raises at the
+  consumer's ``next()``, never dies silently on a daemon thread;
+- ``close()`` stops the producer promptly even mid-``put`` (the put loop
+  polls a stop event), drains, and joins — safe to call repeatedly, so the
+  executor's ``finally`` can always tear the pipeline down.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+_END = object()
+
+
+class _StageError:
+    """Exception envelope: raised at the consumer, not on the stage thread."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class BoundedStage:
+    """One pipeline stage on a daemon thread.
+
+    ``source`` is any iterator (often another BoundedStage); ``fn`` maps
+    each item (identity when None).  At most ``depth`` results sit
+    unconsumed before the producer blocks.
+    """
+
+    def __init__(self, source, fn=None, depth: int = 2, name: str = "stage"):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._run, args=(source, fn), name=f"avdb-{name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that stays responsive to ``close()``."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, source, fn) -> None:
+        try:
+            for item in source:
+                if self._stop.is_set():
+                    return
+                out = fn(item) if fn is not None else item
+                if not self._put(out):
+                    return
+            self._put(_END)
+        except BaseException as exc:  # re-raised at the consumer
+            self._put(_StageError(exc))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # polling get, never a bare blocking one: when a CHAINED stage's
+        # producer is torn down (its close() stops the thread without a
+        # terminal sentinel), this consumer must observe that within one
+        # poll interval instead of blocking forever — stage teardown in
+        # any order stays prompt and leak-free
+        while True:
+            if self._done or self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # producer gone without _END (closed/aborted upstream)
+                    self._done = True
+                    raise StopIteration
+                continue
+            break
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _StageError):
+            self._done = True
+            raise item.exc
+        return item
+
+    def close(self, timeout: float = 10.0) -> bool:
+        """Stop the producer and reclaim the thread (idempotent).  Pending
+        items are discarded — callers own any cross-stage cleanup.
+
+        Returns True when the thread is gone.  False means the stage fn is
+        stuck in a long uninterruptible call (e.g. a fresh XLA compile) —
+        the daemon thread is abandoned and will exit when that call
+        returns and its next put/pull observes the stop flag."""
+        self._stop.set()
+        deadline = None
+        while True:
+            while True:  # unblock a producer waiting on a full queue
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=0.25)
+            if not self._thread.is_alive():
+                return True
+            import time
+
+            if deadline is None:
+                deadline = time.monotonic() + timeout
+            elif time.monotonic() >= deadline:
+                return False

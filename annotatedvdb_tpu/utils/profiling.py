@@ -13,24 +13,40 @@ Perfetto.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 
 class StageTimer:
-    """Accumulates wall-clock + item counts per named stage.
+    """Accumulates busy seconds + item counts per named stage, plus the
+    wall-clock of the enclosing run.
 
     Usage::
 
-        with timer.stage("annotate", items=batch.n):
-            ...
+        with timer.wall():                      # once around the whole load
+            with timer.stage("annotate", items=batch.n):
+                ...
 
-    ``summary()`` reports seconds, share of measured time, and items/sec.
+    Stages may run CONCURRENTLY on pipeline threads (overlapped executor:
+    ingest / dispatch / process / store-writer), so accumulation is
+    lock-guarded and per-stage seconds are *busy* time, not exclusive
+    wall-clock: with real overlap ``total()`` exceeds ``wall_seconds``.
+    ``overlap()`` reports that ratio — it is how the stage table stays
+    honest once stages stop being serial (a stage can no longer hide
+    inside another's measurement, and the sum no longer bounds the wall).
+
+    ``summary()`` reports seconds, share of measured busy time, items/sec,
+    and — when a wall window was recorded — the busy/wall overlap factor.
     """
 
     def __init__(self, clock=time.perf_counter):
         self._clock = clock
+        self._lock = threading.Lock()
         self.seconds: dict[str, float] = {}
         self.items: dict[str, int] = {}
+        #: wall-clock of the runs wrapped in ``wall()`` (accumulates across
+        #: files like the per-stage counters do)
+        self.wall_seconds: float = 0.0
 
     @contextlib.contextmanager
     def stage(self, name: str, items: int = 0):
@@ -39,31 +55,75 @@ class StageTimer:
             yield
         finally:
             dt = self._clock() - t0
-            self.seconds[name] = self.seconds.get(name, 0.0) + dt
-            self.items[name] = self.items.get(name, 0) + items
+            with self._lock:
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
+                self.items[name] = self.items.get(name, 0) + items
+
+    @contextlib.contextmanager
+    def wall(self):
+        """Record one run's wall-clock (the overlapped-critical-path
+        denominator for ``overlap()``)."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            with self._lock:
+                self.wall_seconds += dt
 
     def total(self) -> float:
-        return sum(self.seconds.values())
+        with self._lock:
+            return sum(self.seconds.values())
+
+    def overlap(self) -> float | None:
+        """Busy-seconds / wall-seconds across all recorded runs, or None
+        when no wall window was recorded.  1.0 = fully serial; >1.0 = the
+        pipeline genuinely ran stages concurrently."""
+        if not self.wall_seconds:
+            return None
+        return self.total() / self.wall_seconds
 
     def summary(self) -> str:
-        total = self.total() or 1e-12
+        with self._lock:  # one snapshot: total must equal sum(snapshot)
+            snapshot = dict(self.seconds)
+            items = dict(self.items)
+        total = sum(snapshot.values()) or 1e-12
         parts = []
-        for name in sorted(self.seconds, key=self.seconds.get, reverse=True):
-            s = self.seconds[name]
+        for name in sorted(snapshot, key=snapshot.get, reverse=True):
+            s = snapshot[name]
             line = f"{name}: {s:.2f}s ({100 * s / total:.0f}%)"
-            if self.items.get(name):
-                line += f" {self.items[name] / s:,.0f}/s"
+            if items.get(name) and s > 0:
+                line += f" {items[name] / s:,.0f}/s"
             parts.append(line)
+        if self.wall_seconds:
+            parts.append(
+                f"wall: {self.wall_seconds:.2f}s "
+                f"(busy {total:.2f}s, {total / self.wall_seconds:.2f}x overlap)"
+            )
         return " | ".join(parts)
 
     def as_dict(self) -> dict:
-        return {
-            name: {
-                "seconds": round(self.seconds[name], 4),
-                "items": self.items.get(name, 0),
+        with self._lock:
+            return {
+                name: {
+                    "seconds": round(self.seconds[name], 4),
+                    "items": self.items.get(name, 0),
+                }
+                for name in self.seconds
             }
-            for name in self.seconds
+
+    def wall_dict(self) -> dict:
+        """Wall vs busy accounting for bench records: per-stage seconds are
+        busy time on their pipeline thread; ``overlap`` > 1 proves stages
+        actually ran concurrently instead of the sum hiding inside the wall."""
+        busy = self.total()
+        out = {
+            "wall_seconds": round(self.wall_seconds, 4),
+            "busy_seconds": round(busy, 4),
         }
+        if self.wall_seconds:
+            out["overlap"] = round(busy / self.wall_seconds, 3)
+        return out
 
 
 @contextlib.contextmanager

@@ -78,6 +78,69 @@ class ProbeResult:
 LAST_PROBE: ProbeResult | None = None
 
 
+def _marker_path() -> str:
+    import tempfile
+
+    # per-user path: tempdirs are world-shared, and another user's stale
+    # marker (whose file we may not even be able to remove) must never
+    # mask a returning accelerator from this user's probes
+    uid = getattr(os, "getuid", lambda: "u")()
+    return os.environ.get("AVDB_TPU_MARKER") or os.path.join(
+        tempfile.gettempdir(), f"avdb_tpu_down.{uid}.json"
+    )
+
+
+def _marker_ttl() -> float:
+    try:
+        return float(os.environ.get("AVDB_TPU_MARKER_TTL_S", "3600"))
+    except ValueError:
+        return 3600.0
+
+
+def read_down_marker() -> dict | None:
+    """The cached tunnel-down verdict, if fresh.
+
+    A wedged TPU tunnel costs ``attempts x timeout`` (~290 s of the
+    round-5 bench) PER PROCESS; the first process to conclude "down"
+    records it here so every later probe in the same round returns in
+    milliseconds.  ``bench.py --tpu-only`` forces a re-probe (and a
+    successful probe deletes the marker), so a returning tunnel is never
+    masked for more than one explicit re-check."""
+    import json
+
+    try:
+        with open(_marker_path()) as f:
+            marker = json.load(f)
+        age = time.time() - float(marker.get("ts", 0))
+    except (OSError, ValueError, TypeError):
+        return None
+    if not 0 <= age < _marker_ttl():
+        return None
+    marker["age_seconds"] = round(age, 1)
+    return marker
+
+
+def write_down_marker(probe: ProbeResult) -> None:
+    import json
+
+    try:
+        with open(_marker_path(), "w") as f:
+            json.dump(
+                {"status": "down", "ts": time.time(),
+                 "probe": probe.as_dict()},
+                f,
+            )
+    except OSError:
+        pass  # advisory cache only
+
+
+def clear_down_marker() -> None:
+    try:
+        os.remove(_marker_path())
+    except OSError:
+        pass
+
+
 def _probe_once(timeout: float) -> tuple[str | None, str | None]:
     """One subprocess probe; returns (platform, error)."""
     try:
@@ -103,7 +166,8 @@ def _probe_once(timeout: float) -> tuple[str | None, str | None]:
 
 
 def probe_accelerator(
-    timeout: float | None = None, attempts: int = 1, backoff: float = 10.0
+    timeout: float | None = None, attempts: int = 1, backoff: float = 10.0,
+    honor_marker: bool = True,
 ) -> str | None:
     """Platform name of the default device, probed in a subprocess.
 
@@ -114,8 +178,25 @@ def probe_accelerator(
     tries — a tunnel-backed accelerator can be transiently wedged (r1 bench
     rc=1, r3 bench fallback) and one 90 s coin flip must not decide the
     round's official record.  Per-attempt detail lands in :data:`LAST_PROBE`.
-    """
+
+    ``honor_marker``: consult the cached tunnel-down marker first (see
+    :func:`read_down_marker`) so a second probe in the same round skips the
+    full wedged-tunnel wait; pass False to force a real probe
+    (``bench.py --tpu-only``).  A down verdict writes the marker; a
+    successful probe clears it."""
     global LAST_PROBE
+    if honor_marker:
+        marker = read_down_marker()
+        if marker is not None:
+            result = ProbeResult()
+            result.errors.append(
+                "cached tunnel-down marker honored "
+                f"(age {marker['age_seconds']}s, recorded errors: "
+                f"{marker.get('probe', {}).get('errors', [])}); "
+                "bench.py --tpu-only forces a re-probe"
+            )
+            LAST_PROBE = result
+            return None
     if timeout is None:
         timeout = _probe_timeout()
     result = ProbeResult()
@@ -131,6 +212,14 @@ def probe_accelerator(
         result.errors.append(f"attempt {attempt + 1}: {error}")
     result.seconds = time.monotonic() - t0
     LAST_PROBE = result
+    if result.platform is None:
+        # only a DELIBERATE multi-attempt probe (the bench's) may cache a
+        # down verdict: a casual CLI's single-attempt probe hitting a 15s
+        # tunnel blip must not pin the next hour of processes to CPU
+        if attempts > 1:
+            write_down_marker(result)
+    else:
+        clear_down_marker()
     return result.platform
 
 
@@ -155,6 +244,7 @@ def pin_platform(
     timeout: float | None = None,
     attempts: int = 1,
     ignore_cached_fallback: bool = False,
+    force_probe: bool = False,
 ) -> str:
     """Pin the JAX platform robustly; returns the chosen platform name.
 
@@ -165,7 +255,11 @@ def pin_platform(
     bench passes 3 so one wedged-tunnel window can't pin the round to CPU).
     ``ignore_cached_fallback`` re-probes even when ``AVDB_JAX_PLATFORM=cpu``
     is already set, *iff* that value was written by a previous pin_platform
-    probe rather than by the user (tracked via ``AVDB_JAX_PLATFORM_SOURCE``)."""
+    probe rather than by the user (tracked via ``AVDB_JAX_PLATFORM_SOURCE``).
+
+    ``force_probe`` bypasses the cached tunnel-down marker (a fresh down
+    verdict otherwise short-circuits the probe in milliseconds — see
+    :func:`read_down_marker`)."""
     explicit = os.environ.get("AVDB_JAX_PLATFORM", "").strip().lower()
     if (
         explicit == "cpu"
@@ -176,7 +270,9 @@ def pin_platform(
     choice = explicit or (prefer or "auto").strip().lower()
     probed = False
     if choice == "auto":
-        choice = probe_accelerator(timeout, attempts=attempts) or "cpu"
+        choice = probe_accelerator(
+            timeout, attempts=attempts, honor_marker=not force_probe
+        ) or "cpu"
         probed = True
     os.environ["AVDB_JAX_PLATFORM"] = choice
     if probed:

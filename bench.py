@@ -12,9 +12,13 @@ Two numbers, one JSON line:
   annotate+bin device pipeline alone (the >=1M/s/chip north star, reported
   as ``kernel_vs_target``).
 
-``stages`` breaks the end-to-end wall-clock down by pipeline stage
+``stages`` breaks the end-to-end load down by pipeline stage
 (ingest / annotate / lookup / egress / append / persist) via the loader's
-built-in StageTimer.
+built-in StageTimer.  Under the overlapped executor these are per-stage
+BUSY seconds on their pipeline threads; ``stage_wall`` reports the load's
+wall-clock against the busy sum (overlap > 1 = stages genuinely ran
+concurrently).  Legs with multiple measured runs report the MEDIAN as
+their headline (``median_headline``), with every run recorded.
 
 Row count via AVDB_BENCH_ROWS (default 2M — enough to amortize store
 behavior into the steady-state regime).  At ~10M rows on the shared
@@ -60,6 +64,17 @@ END_TO_END_TARGET = 90_000_000 / 600.0  # gnomAD chr1 in <10 min
 
 E2E_ROWS = int(os.environ.get("AVDB_BENCH_ROWS", 1 << 21))
 _BASES = "ACGT"
+
+
+def median_headline(runs: list) -> float:
+    """The reporting policy for EVERY leg: the median of its measured runs
+    (single-run legs trivially report that run).  Replaces the VEP leg's
+    best-of-2, which read optimistically against the other legs'
+    single-run numbers (ADVICE r5 #3 / VERDICT r5 weak #4).  Best and
+    worst stay visible in each leg's ``runs`` list."""
+    import statistics
+
+    return round(statistics.median(runs), 1)
 
 
 def bench_kernel():
@@ -216,15 +231,19 @@ def bench_end_to_end():
             dt = time.perf_counter() - t0
 
         # update path: VEP results over a slice of the loaded store.
-        # Measured TWICE (the second run against the pristine pre-VEP store
-        # reloaded from disk) and reported as the better run — the shared
-        # 1-core host drifts minute to minute, and this sub-leg runs last
-        # so it wears the most drift.  Both runs are recorded.
+        # Measured N times (run 0 against the live store, later runs
+        # against the pristine pre-VEP store reloaded from disk) with the
+        # MEDIAN as the headline — this sub-leg runs last so it wears the
+        # most host drift, and best-of-N was flagged as optimistic
+        # (ADVICE r5 #3).  Every run is recorded.
         vep_json = os.path.join(work, "bench.vep.json")
         n_vep = write_synth_vep(vcf, vep_json, min(E2E_ROWS // 5, 200_000))
         vep_runs = []
-        for vep_store in (store, None):
-            if vep_store is None:
+        n_runs = max(1, int(os.environ.get("AVDB_BENCH_VEP_RUNS", "3")))
+        for run in range(n_runs):
+            if run == 0:
+                vep_store = store
+            else:
                 from annotatedvdb_tpu.store import VariantStore as _VS
 
                 vep_store = _VS.load(store_dir)  # pre-VEP state (never saved after)
@@ -237,7 +256,8 @@ def bench_end_to_end():
             t1 = time.perf_counter()
             vep_counters = vep_loader.load_file(vep_json, commit=True)
             vep_runs.append(round(n_vep / (time.perf_counter() - t1), 1))
-        vep_dt = n_vep / max(vep_runs)
+        vep_rps = median_headline(vep_runs)
+        vep_dt = n_vep / vep_rps
 
         return {
             "variants_per_sec": counters["variant"] / dt,
@@ -247,8 +267,14 @@ def bench_end_to_end():
             "vcf_mb": round(vcf_bytes / 1e6, 1),
             "mb_per_sec": round(vcf_bytes / 1e6 / dt, 1),
             "stages": loader.timer.as_dict(),
+            # wall vs per-stage busy time: the overlapped executor runs
+            # ingest/dispatch/process/store-writer concurrently, so busy
+            # seconds legitimately sum past wall (overlap > 1 proves the
+            # pipeline overlapped instead of hiding stages in each other)
+            "stage_wall": loader.timer.wall_dict(),
+            "pipeline": os.environ.get("AVDB_PIPELINE", "overlapped"),
             "vep_update": {
-                "results_per_sec": max(vep_runs),
+                "results_per_sec": vep_rps,
                 "runs": vep_runs,
                 "updated": vep_counters["update"],
                 "seconds": round(vep_dt, 2),
@@ -427,8 +453,10 @@ def tpu_only():
     stand between a returning tunnel and a TPU record)."""
     from annotatedvdb_tpu.utils import runtime
 
+    # --tpu-only is the explicit "has the tunnel come back?" check: it
+    # must bypass the cached tunnel-down marker (and refresh/clear it)
     platform = runtime.pin_platform(
-        "auto", attempts=2, ignore_cached_fallback=True
+        "auto", attempts=2, ignore_cached_fallback=True, force_probe=True
     )
     out = {
         "mode": "tpu-only",
@@ -498,6 +526,10 @@ def main():
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
 
+    # the full bench honors the cached tunnel-down marker: after one
+    # process has eaten the wedged-tunnel wait this round, a re-run starts
+    # its measured legs in seconds (the marker's recorded errors land in
+    # the probe JSON; --tpu-only forces a fresh probe)
     platform = runtime.pin_platform(
         "auto", attempts=3, ignore_cached_fallback=True
     )

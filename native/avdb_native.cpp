@@ -223,6 +223,9 @@ extern "C" {
 //   [1] skipped_contig
 //   [2] skipped_alt
 //   [3] malformed (fewer than 5 columns or bad POS)
+//   [4] TOTAL lines consumed (headers/blank included) — the caller's
+//       absolute line_base advance, so it never re-scans the window for
+//       newlines
 //
 // Returns the number of rows written.  *consumed is the byte count of fully
 // processed lines; *need_more is set to 1 when the row buffers filled up
@@ -346,6 +349,7 @@ int64_t avdb_parse_vcf_chunk(
             if (fields[4].ptr[i] == ',') ++n_alts;
         if (rows + n_alts > max_rows) {
             counters[0]--;  // the line is re-fed (and re-counted) next call
+            --line;         // ... and is NOT consumed this call
             *need_more = 1;
             break;  // line does not fit: flush and re-feed
         }
@@ -446,6 +450,7 @@ int64_t avdb_parse_vcf_chunk(
         // exceeds width — the device flags such rows host_fallback, exactly
         // like the Python reader.
     }
+    counters[4] = line - line_base;
     *consumed = offset;
     return rows;
 }

@@ -48,7 +48,7 @@ def test_reshard_routes_to_owner(rng):
     """After the all_to_all, every valid row sits on its owning shard."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from annotatedvdb_tpu.parallel.distributed import shard_map
     from jax.sharding import PartitionSpec as P
     from annotatedvdb_tpu.parallel import make_mesh, reshard_by_owner
     from annotatedvdb_tpu.parallel.distributed import chromosome_owner

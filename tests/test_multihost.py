@@ -138,6 +138,16 @@ def _run_world(n_procs: int, local_dev: int) -> list[str]:
     outs = []
     for p in procs:
         out, err = p.communicate(timeout=540)
+        if p.returncode != 0 and (
+                "Multiprocess computations aren't implemented" in err):
+            # this jax/XLA build has no cross-process CPU collectives —
+            # an environment limit, not a wiring bug (the 1-process world
+            # and the virtual 8-device mesh still cover the step)
+            for q in procs:
+                q.kill()
+            pytest.skip(
+                "jax build lacks multiprocess CPU collectives"
+            )
         assert p.returncode == 0, (out[-1000:], err[-3000:])
         outs.append(out)
     lines = [

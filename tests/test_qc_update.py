@@ -182,3 +182,29 @@ def test_info_to_json_parity():
     assert json.loads(info_to_json("X=5\n")) == parse_info("X=5\n")
     with pytest.raises(ValueError):
         info_to_json("X=inf\n")
+
+
+def test_info_to_json_duplicate_keys_byte_parity():
+    """Repeated INFO keys (malformed but occurring in the wild) must
+    de-duplicate last-wins at first position — BYTE-identical to the
+    parse_info + json.dumps fallback, so persisted raw text never diverges
+    between the fast path and the dict path (ADVICE r5 #4)."""
+    import json
+
+    from annotatedvdb_tpu.io.vcf import info_to_json, parse_info
+
+    cases = [
+        "AC=1;AC=2",                      # simple last-wins
+        "AC=1;DP=9;AC=2",                 # position = first occurrence
+        "FLAG;FLAG",                      # repeated bare flag
+        "AC;AC=3",                        # flag then pair, same key
+        "AC=3;AC",                        # pair then flag
+        "A=1;B=2;A=x;C=3;B=0.5",          # interleaved, type changes
+        "X=1;X=1e400;X=2",                # overflowing middle replaced
+    ]
+    for s in cases:
+        fast = info_to_json(s)
+        exact = json.dumps(
+            parse_info(s), separators=(",", ":"), allow_nan=False
+        )
+        assert fast == exact, (s, fast, exact)

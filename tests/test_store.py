@@ -458,3 +458,47 @@ def test_collapse_bounds_segment_count(monkeypatch):
         np.full(3, 8, np.int32), np.full(3, 8, np.int32),
     )
     assert found.all()
+
+
+def test_legacy_npz_segments_still_load(tmp_path):
+    """Stores persisted by older builds carry zip-backed npz segment files;
+    the flat-container reader must sniff and load them unchanged."""
+    import json
+    import os
+
+    from annotatedvdb_tpu.store.variant_store import _NUMERIC_COLUMNS
+
+    store = VariantStore(width=8)
+    store.shard(1).append(
+        {"pos": np.asarray([10, 20, 30], np.int32),
+         "h": np.asarray([7, 8, 9], np.uint32),
+         "ref_len": np.full(3, 1, np.int32),
+         "alt_len": np.full(3, 1, np.int32)},
+        np.full((3, 8), 65, np.uint8), np.full((3, 8), 67, np.uint8),
+    )
+    d = str(tmp_path / "vdb")
+    store.save(d)
+    # rewrite every segment file in the LEGACY np.savez layout
+    for name in os.listdir(d):
+        if not name.endswith(".npz"):
+            continue
+        fp = os.path.join(d, name)
+        with open(fp, "rb") as f:
+            assert f.read(1) == b"{"  # current flat container
+            f.seek(0)
+            names = json.loads(f.readline())["names"]
+            data = {
+                n_: np.lib.format.read_array(f, allow_pickle=False)
+                for n_ in names
+            }
+        with open(fp, "wb") as f:
+            np.savez(f, **data)
+        with open(fp, "rb") as f:
+            assert f.read(1) == b"P"  # genuinely zip-backed now
+    loaded = VariantStore.load(d)
+    assert loaded.n == 3
+    s = loaded.shard(1)
+    np.testing.assert_array_equal(np.sort(s.cols["pos"]), [10, 20, 30])
+    np.testing.assert_array_equal(np.sort(s.cols["h"]), [7, 8, 9])
+    for col, _ in _NUMERIC_COLUMNS:
+        assert col in s.cols
