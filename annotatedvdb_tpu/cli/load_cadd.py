@@ -88,6 +88,9 @@ def main(argv=None) -> int:
                          "--fileName is given and indexes exist)")
     ap.add_argument("--logFilePath", default=None,
                     help="log file (default: beside --fileName or the store)")
+    from annotatedvdb_tpu.obs import ObsSession, add_obs_args
+
+    add_obs_args(ap)
     args = ap.parse_args(argv)
 
     runtime = runtime_from_args(args)
@@ -133,15 +136,30 @@ def main(argv=None) -> int:
         skip_existing=not args.updateExisting, log=log, mesh=mesh,
     )
 
-    subsets = vcf_subsets(updater, args.fileName) if args.fileName else None
-    counters = updater.update_all(
-        parse_chromosomes(args.chromosomes),
-        commit=args.commit, test=args.test, subsets=subsets,
-        random_access=args.randomAccess,
-    )
+    obs = ObsSession.from_args("load-cadd", args, {
+        "database": args.databaseDir, "store": args.storeDir,
+        "file": args.fileName, "chromosomes": args.chromosomes,
+        "commit": args.commit, "test": args.test,
+        "update_existing": args.updateExisting,
+        "random_access": args.randomAccess,
+    })
+    obs.attach(updater)
+    try:
+        subsets = vcf_subsets(updater, args.fileName) if args.fileName else None
+        counters = updater.update_all(
+            parse_chromosomes(args.chromosomes),
+            commit=args.commit, test=args.test, subsets=subsets,
+            random_access=args.randomAccess,
+        )
+        # inside the try: a failed commit save is an abort the run
+        # ledger must witness too
+        if args.commit:
+            store.save(args.storeDir)
+    except BaseException as exc:
+        obs.abort(ledger, exc, store=store)
+        raise
 
-    if args.commit:
-        store.save(args.storeDir)
+    obs.finish(ledger, counters, store=store)
     print(json.dumps(counters))
     print(counters["alg_id"])
     return 0

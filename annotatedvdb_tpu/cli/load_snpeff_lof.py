@@ -32,8 +32,10 @@ def main(argv=None) -> int:
                     help="overwrite existing loss_of_function values")
     ap.add_argument("--chromosomeMap")
     from annotatedvdb_tpu.config import add_lifecycle_args, effective_log_after
+    from annotatedvdb_tpu.obs import ObsSession, add_obs_args
 
     add_lifecycle_args(ap)
+    add_obs_args(ap)
     args = ap.parse_args(argv)
 
     from annotatedvdb_tpu.utils.logging import load_logger
@@ -50,10 +52,21 @@ def main(argv=None) -> int:
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 15),
     )
-    counters = loader.load_file(
-        args.fileName, commit=args.commit, test=args.test,
-        persist=(lambda: store.save(args.storeDir)) if args.commit else None,
-    )
+    obs = ObsSession.from_args("load-snpeff-lof", args, {
+        "file": args.fileName, "store": args.storeDir,
+        "commit": args.commit, "test": args.test,
+        "update_existing": args.updateExisting,
+    })
+    obs.attach(loader)
+    try:
+        counters = loader.load_file(
+            args.fileName, commit=args.commit, test=args.test,
+            persist=(lambda: store.save(args.storeDir)) if args.commit else None,
+        )
+    except BaseException as exc:
+        obs.abort(ledger, exc, store=store)
+        raise
+    obs.finish(ledger, counters, store=store)
     print(json.dumps(counters))
     print(counters["alg_id"])
     return 0

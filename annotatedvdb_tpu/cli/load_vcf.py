@@ -17,6 +17,7 @@ Usage:  python -m annotatedvdb_tpu.cli.load_vcf --fileName x.vcf[.gz] \
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from annotatedvdb_tpu.config import (
@@ -53,6 +54,9 @@ def main(argv=None):
     parser.add_argument("--profile", default=None, metavar="DIR",
                         help="capture a jax.profiler (XLA) trace of the load "
                              "into DIR (view in TensorBoard/Perfetto)")
+    from annotatedvdb_tpu.obs import add_obs_args
+
+    add_obs_args(parser)
     args = parser.parse_args(argv)
 
     runtime = runtime_from_args(args)
@@ -94,30 +98,57 @@ def main(argv=None):
         log=log,
         log_after=cfg.effective_log_after,
     )
-    # compile the device kernels (and probe the packed-output transport)
-    # before streaming begins: a steady-state load should not pay the
-    # first-compile cost mid-stream
-    loader.warmup()
-    with device_trace(args.profile):
-        counters = loader.load_file(
-            args.fileName,
-            commit=cfg.commit,
-            test=cfg.test,
-            fail_at=cfg.fail_at,
-            mapping_path=args.fileName + ".mapping",
-            resume=cfg.resume,
-            # persist before every checkpoint so the durable store never
-            # lags the resume cursor (crash between them would silently
-            # skip rows)
-            persist=lambda: store.save(args.storeDir),
-        )
-    loader.close()
+    # telemetry session: --metricsOut / --traceOut exports + the per-load
+    # run-ledger record (appended on success AND abort)
+    from annotatedvdb_tpu.obs import ObsSession
+    from annotatedvdb_tpu.utils.profiling import stall_summary
+
+    obs = ObsSession.from_args("load-vcf", args, {
+        "file": args.fileName, "store": args.storeDir,
+        "commit": cfg.commit, "test": cfg.test, "resume": cfg.resume,
+        "datasource": cfg.datasource, "batch_size": cfg.commit_after,
+        "skip_existing": args.skipExisting,
+        "pipeline": os.environ.get("AVDB_PIPELINE", "overlapped"),
+    })
+    obs.attach(loader)
+    # the whole load lifecycle sits in one try: warmup compiles, the load
+    # itself, close() (which surfaces deferred store-writer exceptions),
+    # and the final save can each die — the run ledger must witness every
+    # abort, not just mid-stream ones
+    try:
+        # compile the device kernels (and probe the packed-output
+        # transport) before streaming begins: a steady-state load should
+        # not pay the first-compile cost mid-stream
+        loader.warmup()
+        with device_trace(args.profile):
+            counters = loader.load_file(
+                args.fileName,
+                commit=cfg.commit,
+                test=cfg.test,
+                fail_at=cfg.fail_at,
+                mapping_path=args.fileName + ".mapping",
+                resume=cfg.resume,
+                # persist before every checkpoint so the durable store never
+                # lags the resume cursor (crash between them would silently
+                # skip rows)
+                persist=lambda: store.save(args.storeDir),
+            )
+        loader.close()
+        if cfg.commit:
+            store.save(args.storeDir)
+    except BaseException as exc:
+        # witness the crash in the run ledger, then propagate unchanged
+        obs.abort(ledger, exc, store=store)
+        raise
     if cfg.commit:
-        store.save(args.storeDir)
         log(f"COMMITTED {counters}")
     else:
         log(f"ROLLING BACK (dry run) {counters}")
     log(f"stage breakdown: {loader.timer.summary()}")
+    if loader.queue_stalls:
+        log(f"queue stalls: "
+            f"{stall_summary(loader.queue_stalls, loader.timer.wall_seconds)}")
+    obs.finish(ledger, counters, store=store)
     print(counters["alg_id"])  # undo handle, like load_vcf_file.py:220
     return 0
 

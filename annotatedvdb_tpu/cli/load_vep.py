@@ -44,6 +44,9 @@ def main(argv=None):
     add_runtime_args(parser)
     parser.add_argument("--skipExisting", action="store_true",
                         help="skip variants that already have vep_output")
+    from annotatedvdb_tpu.obs import ObsSession, add_obs_args
+
+    add_obs_args(parser)
     args = parser.parse_args(argv)
 
     runtime = runtime_from_args(args)
@@ -74,12 +77,28 @@ def main(argv=None):
         log_after=effective_log_after(args.logAfter, 1 << 14),
         mesh=mesh,
     )
-    counters = loader.load_file(args.fileName, commit=args.commit, test=args.test)
+    obs = ObsSession.from_args("load-vep", args, {
+        "file": args.fileName, "store": args.storeDir,
+        "commit": args.commit, "test": args.test,
+        "datasource": args.datasource, "skip_existing": args.skipExisting,
+    })
+    obs.attach(loader)
+    try:
+        counters = loader.load_file(
+            args.fileName, commit=args.commit, test=args.test
+        )
+        # the commit save sits inside the try: a full-disk save is an
+        # abort the run ledger must witness too
+        if args.commit:
+            store.save(args.storeDir)
+    except BaseException as exc:
+        obs.abort(ledger, exc, store=store)
+        raise
     if args.commit:
-        store.save(args.storeDir)
         log(f"COMMITTED {counters}")
     else:
         log(f"ROLLING BACK (dry run) {counters}")
+    obs.finish(ledger, counters, store=store)
     print(counters["alg_id"])
     return 0
 

@@ -36,8 +36,10 @@ def main(argv=None) -> int:
     ap.add_argument("--skipExisting", action="store_true",
                     help="skip known variants instead of updating them")
     from annotatedvdb_tpu.config import add_lifecycle_args, effective_log_after
+    from annotatedvdb_tpu.obs import ObsSession, add_obs_args
 
     add_lifecycle_args(ap)
+    add_obs_args(ap)
     args = ap.parse_args(argv)
 
     from annotatedvdb_tpu.utils.logging import load_logger
@@ -55,10 +57,22 @@ def main(argv=None) -> int:
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 15),
     )
-    counters = loader.load_file(
-        args.fileName, commit=args.commit, test=args.test,
-        persist=(lambda: store.save(args.storeDir)) if args.commit else None,
-    )
+    obs = ObsSession.from_args("update-variant-annotation", args, {
+        "file": args.fileName, "store": args.storeDir,
+        "id_type": args.variantIdType, "commit": args.commit,
+        "test": args.test, "datasource": args.datasource,
+        "skip_existing": args.skipExisting,
+    })
+    obs.attach(loader)
+    try:
+        counters = loader.load_file(
+            args.fileName, commit=args.commit, test=args.test,
+            persist=(lambda: store.save(args.storeDir)) if args.commit else None,
+        )
+    except BaseException as exc:
+        obs.abort(ledger, exc, store=store)
+        raise
+    obs.finish(ledger, counters, store=store)
     print(json.dumps(counters))
     print(counters["alg_id"])
     return 0

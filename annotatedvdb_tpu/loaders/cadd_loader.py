@@ -100,8 +100,18 @@ class TpuCaddUpdater:
         self.skip_existing = skip_existing
         self.mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
         self.log = log
+        from annotatedvdb_tpu.utils.profiling import StageTimer
+
+        #: same observability surface as the VCF loader: scan (table
+        #: streaming) / join busy seconds + whole-pass wall
+        self.timer = StageTimer()
+        #: chunk-granularity metrics hook (ObsSession.attach)
+        self.obs = None
         self.counters = {"snv": 0, "indel": 0, "not_matched": 0,
                          "skipped": 0, "update": 0}
+
+    #: metric label / run-ledger script name (obs.ObsSession)
+    obs_name = "load-cadd"
 
     # ------------------------------------------------------------------
 
@@ -189,32 +199,45 @@ class TpuCaddUpdater:
                     "(build with load_cadd --buildIndex)"
                 )
         mesh_ctx = self._mesh_context() if self.mesh is not None else None
-        for kind, path, probe in self._tables():
-            states: dict[int, _ChromState] = {}
-            for code in codes:
-                sel = candidates[code][kind]
-                if sel.size:
-                    states[code] = _ChromState(sel, self.store.shard(code))
-            if not states or not os.path.exists(path):
-                continue
-            reader = CaddFileReader(path, width=self.store.width)
-            stop = False
-            for code, block in reader.blocks_all():
-                if code in states:
-                    if mesh_ctx is not None:
-                        self._join_block_mesh(
-                            states[code], code, block, mesh_ctx
-                        )
-                    else:
-                        self._join_block(
-                            states[code], self.store.shard(code), block, probe
-                        )
-                    if test:
-                        stop = True
+        with self.timer.wall():
+            for kind, path, probe in self._tables():
+                states: dict[int, _ChromState] = {}
+                for code in codes:
+                    sel = candidates[code][kind]
+                    if sel.size:
+                        states[code] = _ChromState(sel, self.store.shard(code))
+                if not states or not os.path.exists(path):
+                    continue
+                reader = CaddFileReader(path, width=self.store.width)
+                stop = False
+                blocks = iter(reader.blocks_all())
+                while True:
+                    with self.timer.stage("scan"):
+                        item = next(blocks, None)
+                    if item is None:
                         break
-            if mesh_ctx is not None:
-                self._flush_mesh(states, mesh_ctx)
-            self._finalize(states, kind, commit, complete=not stop)
+                    code, block = item
+                    if code in states:
+                        n_rows = int(getattr(block, "n", 0) or 0)
+                        with self.timer.stage("join", items=n_rows):
+                            if mesh_ctx is not None:
+                                self._join_block_mesh(
+                                    states[code], code, block, mesh_ctx
+                                )
+                            else:
+                                self._join_block(
+                                    states[code], self.store.shard(code),
+                                    block, probe,
+                                )
+                        if self.obs is not None:
+                            self.obs.chunk(n_rows)
+                        if test:
+                            stop = True
+                            break
+                if mesh_ctx is not None:
+                    self._flush_mesh(states, mesh_ctx)
+                with self.timer.stage("finalize"):
+                    self._finalize(states, kind, commit, complete=not stop)
         self.ledger.finish(alg_id, dict(self.counters))
         self.counters["alg_id"] = alg_id
         return dict(self.counters)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import csv
 import json
 import re
+import time
 
 import numpy as np
 
@@ -126,8 +127,14 @@ class TpuTextLoader:
         self.batch_size = batch_size
         self.log = log
         from annotatedvdb_tpu.utils.logging import ProgressCadence
+        from annotatedvdb_tpu.utils.profiling import StageTimer
 
         self._cadence = ProgressCadence(log, log_after)
+        #: same observability surface as TpuVcfLoader (apply/persist busy
+        #: seconds + load wall; tracer-mirrorable via ObsSession)
+        self.timer = StageTimer()
+        #: chunk-granularity metrics hook (ObsSession.attach)
+        self.obs = None
         self.insert_loader = TpuVcfLoader(
             store, ledger, datasource=datasource, skip_existing=False, log=log
         )
@@ -136,6 +143,9 @@ class TpuTextLoader:
             "line": 0, "variant": 0, "update": 0, "skipped": 0,
             "duplicates": 0, "not_found": 0, "inserted": 0,
         }
+
+    #: metric label / run-ledger script name (obs.ObsSession)
+    obs_name = "update-variant-annotation"
 
     @property
     def is_adsp(self) -> bool:
@@ -154,7 +164,23 @@ class TpuTextLoader:
         resume_line = self.ledger.last_checkpoint(path) if resume else 0
         if resume_line:
             self.log(f"resuming {path} after committed line {resume_line}")
-        with open(path, newline="") as fh:
+        def flush(pending) -> None:
+            t0 = time.perf_counter() if self.obs is not None else 0.0
+            with self.timer.stage("apply", items=len(pending)):
+                self._apply_batch(pending, alg_id, commit)
+            if commit:
+                with self.timer.stage("persist"):
+                    if persist is not None:
+                        persist()
+                    self.ledger.checkpoint(
+                        alg_id, path, pending[-1][0], dict(self.counters)
+                    )
+            if self.obs is not None:
+                self.obs.chunk(
+                    len(pending), seconds=time.perf_counter() - t0
+                )
+
+        with self.timer.wall(), open(path, newline="") as fh:
             reader = csv.DictReader(fh, delimiter="\t")
             if reader.fieldnames is None or "variant" not in reader.fieldnames:
                 raise ValueError(f"{path}: no 'variant' column in header")
@@ -171,26 +197,17 @@ class TpuTextLoader:
                 pending.append((line_no, row))
                 self._cadence.maybe_log(self.counters["line"], self.counters)
                 if len(pending) >= self.batch_size:
-                    self._apply_batch(pending, alg_id, commit)
-                    if commit:
-                        if persist is not None:
-                            persist()
-                        self.ledger.checkpoint(
-                            alg_id, path, pending[-1][0], dict(self.counters)
-                        )
+                    flush(pending)
                     pending = []
                     if test:
                         self.log("test mode: stopping after first batch")
                         break
             if pending:
-                self._apply_batch(pending, alg_id, commit)
-                if commit:
-                    if persist is not None:
-                        persist()
-                    self.ledger.checkpoint(
-                        alg_id, path, pending[-1][0], dict(self.counters)
-                    )
+                flush(pending)
         self.ledger.finish(alg_id, dict(self.counters))
+        self._cadence.finish(
+            self.counters["line"], self.counters, self.timer.summary()
+        )
         self.counters["alg_id"] = alg_id
         return dict(self.counters)
 

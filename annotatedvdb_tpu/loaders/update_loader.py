@@ -16,6 +16,8 @@ re-chunked through the standard :class:`TpuVcfLoader` insert path.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from annotatedvdb_tpu.io.vcf import VcfBatchReader, VcfChunk
@@ -100,8 +102,14 @@ class TpuUpdateLoader:
         self.chromosome_map = chromosome_map
         self.log = log
         from annotatedvdb_tpu.utils.logging import ProgressCadence
+        from annotatedvdb_tpu.utils.profiling import StageTimer
 
         self._cadence = ProgressCadence(log, log_after)
+        #: same observability surface as TpuVcfLoader: per-stage busy
+        #: seconds (ingest / apply / persist) + wall, tracer-mirrorable
+        self.timer = StageTimer()
+        #: chunk-granularity metrics hook (ObsSession.attach)
+        self.obs = None
         self.insert_loader = insert_loader or TpuVcfLoader(
             store, ledger, datasource=datasource, skip_existing=False,
             log=log,
@@ -110,6 +118,9 @@ class TpuUpdateLoader:
             "line": 0, "variant": 0, "update": 0, "skipped": 0, "not_found": 0,
             "inserted": 0,
         }
+
+    #: metric/run-ledger label; subclasses override with their CLI name
+    obs_name = "update-loader"
 
     @bulk_load_gc()
     def load_file(self, path: str, commit: bool = False, test: bool = False,
@@ -131,32 +142,50 @@ class TpuUpdateLoader:
             chromosome_map=self.chromosome_map,
             pack_alleles=False,  # update path never uploads allele matrices
         )
-        for chunk in reader:
-            self.counters["line"] += chunk.counters.get("line", 0)
-            self.counters["malformed"] = (
-                self.counters.get("malformed", 0)
-                + chunk.counters.get("malformed", 0)
-            )
-            if chunk.batch.n == 0:  # trailing counters-only chunk
-                continue
-            # chunks fully covered by a previous committed checkpoint replay
-            # as no-ops (idempotent resume; partially-covered chunks are
-            # impossible because checkpoints land on chunk boundaries)
-            if resume_line and chunk.line_number[-1] <= resume_line:
-                self.counters["skipped"] += chunk.batch.n
-                continue
-            self._apply_chunk(chunk, alg_id, commit)
-            self._cadence.maybe_log(self.counters["line"], self.counters)
-            if commit:
-                if persist is not None:
-                    persist()
-                self.ledger.checkpoint(
-                    alg_id, path, int(chunk.line_number[-1]), dict(self.counters)
+        with self.timer.wall():
+            chunks = iter(reader)
+            while True:
+                with self.timer.stage("ingest"):
+                    chunk = next(chunks, None)
+                if chunk is None:
+                    break
+                self.counters["line"] += chunk.counters.get("line", 0)
+                self.counters["malformed"] = (
+                    self.counters.get("malformed", 0)
+                    + chunk.counters.get("malformed", 0)
                 )
-            if test:
-                self.log("test mode: stopping after first batch")
-                break
+                if chunk.batch.n == 0:  # trailing counters-only chunk
+                    continue
+                # chunks fully covered by a previous committed checkpoint
+                # replay as no-ops (idempotent resume; partially-covered
+                # chunks are impossible because checkpoints land on chunk
+                # boundaries)
+                if resume_line and chunk.line_number[-1] <= resume_line:
+                    self.counters["skipped"] += chunk.batch.n
+                    continue
+                t_chunk = time.perf_counter() if self.obs is not None else 0.0
+                with self.timer.stage("apply", items=chunk.batch.n):
+                    self._apply_chunk(chunk, alg_id, commit)
+                self._cadence.maybe_log(self.counters["line"], self.counters)
+                if commit:
+                    with self.timer.stage("persist"):
+                        if persist is not None:
+                            persist()
+                        self.ledger.checkpoint(
+                            alg_id, path, int(chunk.line_number[-1]),
+                            dict(self.counters),
+                        )
+                if self.obs is not None:
+                    self.obs.chunk(
+                        chunk.batch.n, seconds=time.perf_counter() - t_chunk
+                    )
+                if test:
+                    self.log("test mode: stopping after first batch")
+                    break
         self.ledger.finish(alg_id, dict(self.counters))
+        self._cadence.finish(
+            self.counters["line"], self.counters, self.timer.summary()
+        )
         self.counters["alg_id"] = alg_id
         return dict(self.counters)
 

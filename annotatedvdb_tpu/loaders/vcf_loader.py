@@ -25,6 +25,7 @@ byte-identical output to the serial double-buffered loop
 from __future__ import annotations
 
 import json
+import time
 from typing import NamedTuple
 
 import numpy as np
@@ -203,6 +204,38 @@ class TpuVcfLoader:
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0, "update": 0,
         }
+        #: backpressure accounting per stage boundary (ingest / dispatch /
+        #: store-writer), accumulated across files like the timer:
+        #: ``producer_block_s`` = that boundary's consumer was the
+        #: bottleneck, ``consumer_wait_s`` = its producer starved it.
+        #: Surfaced as the bench JSON ``queue_stalls`` block and the
+        #: run-ledger record
+        self.queue_stalls: dict[str, dict] = {}
+        #: optional :class:`annotatedvdb_tpu.obs.metrics.LoadObserver`
+        #: (chunk-granularity metrics; set by ``ObsSession.attach``)
+        self.obs = None
+
+    #: metric/run-ledger label for this loader family
+    obs_name = "load-vcf"
+
+    def _stall_rec(self, name: str) -> dict:
+        return self.queue_stalls.setdefault(name, {
+            "items": 0, "producer_block_s": 0.0, "consumer_wait_s": 0.0,
+            "max_depth": 0,
+        })
+
+    def _merge_stage_stats(self, name: str, stats) -> None:
+        """Fold one BoundedStage's StageStats into the cumulative table."""
+        rec = self._stall_rec(name)
+        d = stats.as_dict()
+        rec["items"] += d["items"]
+        rec["producer_block_s"] = round(
+            rec["producer_block_s"] + d["producer_block_s"], 4
+        )
+        rec["consumer_wait_s"] = round(
+            rec["consumer_wait_s"] + d["consumer_wait_s"], 4
+        )
+        rec["max_depth"] = max(rec["max_depth"], d["max_depth"])
 
     @property
     def is_adsp(self) -> bool:
@@ -286,6 +319,11 @@ class TpuVcfLoader:
                     self._run_serial(reader, ctx)
                 self._drain_inflight()
             self.ledger.finish(alg_id, dict(self.counters))
+            # terminal counter line: short files (ending between cadences)
+            # must still log their totals
+            self._cadence.finish(
+                self.counters["line"], self.counters, self.timer.summary()
+            )
         finally:
             try:
                 # earlier chunks' queued commits land even when a later
@@ -353,8 +391,17 @@ class TpuVcfLoader:
             depth=self.PIPELINE_DEPTH,
             name="vcf-dispatch",
         )
+        tracer = self.timer.tracer
         try:
             for entry in dispatch:
+                if tracer is not None:
+                    # queue-depth gauge samples, one counter track per
+                    # boundary (per CHUNK, so ~zero cost)
+                    tracer.counter(
+                        "queue_depth", ingest=ingest.depth(),
+                        dispatch=dispatch.depth(),
+                        store_writer=len(self._inflight),
+                    )
                 if self._consume_entry(entry, ctx):
                     break
         finally:
@@ -366,6 +413,10 @@ class TpuVcfLoader:
             # from ingest, and ingest.close() unblocks it immediately
             ingest.close()
             dispatch.close()
+            # fold this run's backpressure numbers into the cumulative
+            # stall table (the close()s above settled both stage threads)
+            self._merge_stage_stats("ingest", ingest.stats)
+            self._merge_stage_stats("dispatch", dispatch.stats)
 
     def _entry_from_chunk(self, chunk: VcfChunk, resume_line: int) -> tuple:
         """Ingest-side accounting for one chunk: the counter delta that
@@ -406,9 +457,14 @@ class TpuVcfLoader:
         (alg_id, commit, resume_line, mapping_fh, fail_at, persist, path,
          async_store, test) = ctx
         chunk, handles, delta = entry
+        t_chunk = time.perf_counter() if self.obs is not None else 0.0
         for key, v in delta.items():
             self.counters[key] = self.counters.get(key, 0) + v
         if handles is None:
+            # resume-replayed / counters-only chunks are NOT observed:
+            # avdb_rows_total means rows actually processed (the update
+            # loader's resume path skips them the same way), so a resumed
+            # load's metrics never inflate past the work it really did
             return False
         # fault injection fires when the chunk holding the variant is
         # PROCESSED — earlier chunks commit first, exactly like the
@@ -436,6 +492,10 @@ class TpuVcfLoader:
                     alg_id, path, int(chunk.line_number[-1]),
                     dict(self.counters),
                 )
+        if self.obs is not None:
+            self.obs.chunk(
+                chunk.batch.n, seconds=time.perf_counter() - t_chunk
+            )
         if test:
             self.log("test mode: stopping after first batch")
             return True
@@ -828,15 +888,24 @@ class TpuVcfLoader:
 
     def _enqueue_commit(self, payload, persist, alg_id, path, line) -> None:
         """Queue one chunk's store commit; bounded in-flight depth applies
-        backpressure by blocking on the oldest job."""
+        backpressure by blocking on the oldest job (blocked seconds land in
+        the ``store-writer`` stall record: the writer is the bottleneck)."""
         fut = self._writer().submit(
             self._commit_job, payload or [], persist, alg_id, path, line,
             dict(self.counters),
         )
         self._inflight.append((fut, payload or []))
-        while len(self._inflight) > self.MAX_INFLIGHT_COMMITS:
-            self._inflight[0][0].result()
-            self._inflight.popleft()
+        rec = self._stall_rec("store-writer")
+        rec["items"] += 1
+        rec["max_depth"] = max(rec["max_depth"], len(self._inflight))
+        if len(self._inflight) > self.MAX_INFLIGHT_COMMITS:
+            t0 = time.perf_counter()
+            while len(self._inflight) > self.MAX_INFLIGHT_COMMITS:
+                self._inflight[0][0].result()
+                self._inflight.popleft()
+            rec["producer_block_s"] = round(
+                rec["producer_block_s"] + (time.perf_counter() - t0), 4
+            )
 
     def _prune_inflight(self) -> None:
         """Drop completed commits (surfacing writer exceptions promptly)."""

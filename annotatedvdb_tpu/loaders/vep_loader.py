@@ -21,6 +21,7 @@ from __future__ import annotations
 import gzip
 import io as _io
 import json
+import time
 
 import numpy as np
 
@@ -110,8 +111,14 @@ class TpuVepLoader:
         self._dev_snapshot = None
         self.log = log
         from annotatedvdb_tpu.utils.logging import ProgressCadence
+        from annotatedvdb_tpu.utils.profiling import StageTimer
 
         self._cadence = ProgressCadence(log, log_after, unit="results")
+        #: same observability surface as TpuVcfLoader: ingest (file read) /
+        #: process (transform + store apply) busy seconds + load wall
+        self.timer = StageTimer()
+        #: chunk-granularity metrics hook (ObsSession.attach)
+        self.obs = None
         self._blob: bytes | None = None      # native rank-table serialization
         self._blob_version = -1
         self.counters = {
@@ -127,6 +134,9 @@ class TpuVepLoader:
             self._blob = native_vep.ranking_blob(self.parser.ranker)
             self._blob_version = v
         return self._blob
+
+    #: metric label / run-ledger script name (obs.ObsSession)
+    obs_name = "load-vep"
 
     @property
     def is_adsp(self) -> bool:
@@ -307,14 +317,28 @@ class TpuVepLoader:
                 break
             self._cadence.maybe_log(self.counters["line"], self.counters)
 
+        def timed_flush(text: bytes) -> None:
+            # one "process" span + one chunk observation per flushed block
+            # (results-per-flush = the counters' line delta)
+            lines_before = self.counters["line"]
+            t0 = time.perf_counter() if self.obs is not None else 0.0
+            with self.timer.stage("process"):
+                flush_text(text)
+            if self.obs is not None:
+                self.obs.chunk(
+                    self.counters["line"] - lines_before,
+                    seconds=time.perf_counter() - t0,
+                )
+
         # binary chunked read, flushed per block of complete lines (the
         # transformer takes raw bytes; only rare Python-fallback docs are
         # ever re-materialized as line strings)
         stop = False
-        with _open_bytes(path) as fh:
+        with self.timer.wall(), _open_bytes(path) as fh:
             tail = b""
             while not stop:
-                block = fh.read(4 << 20)
+                with self.timer.stage("ingest"):
+                    block = fh.read(4 << 20)
                 if not block:
                     break
                 block = tail + block
@@ -322,7 +346,7 @@ class TpuVepLoader:
                 if cut < 0:
                     tail = block
                     continue
-                flush_text(block[:cut + 1])
+                timed_flush(block[:cut + 1])
                 tail = block[cut + 1:]
                 if test:
                     stop = True
@@ -330,14 +354,17 @@ class TpuVepLoader:
                     # completely: if nothing follows, the unterminated
                     # final line belongs to this (only) batch
                     if not fh.read(1) and tail.strip():
-                        flush_text(tail + b"\n")
+                        timed_flush(tail + b"\n")
                         tail = b""
             if not stop and tail.strip():
-                flush_text(tail + b"\n")
+                timed_flush(tail + b"\n")
         added = self.parser.ranker.added[n_added_before:]
         if added:
             self.log(f"added {len(added)} new consequence combos: {added}")
         self.ledger.finish(alg_id, dict(self.counters))
+        self._cadence.finish(
+            self.counters["line"], self.counters, self.timer.summary()
+        )
         self.counters["alg_id"] = alg_id
         return dict(self.counters)
 

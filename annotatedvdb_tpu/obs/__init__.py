@@ -1,0 +1,49 @@
+"""Unified telemetry: metrics registry, host trace timeline, run ledger.
+
+Three layers, one import surface:
+
+- :mod:`~annotatedvdb_tpu.obs.metrics` — thread-safe counters / gauges /
+  fixed-bucket histograms with JSON-snapshot and Prometheus-textfile export
+  (``--metricsOut``);
+- :mod:`~annotatedvdb_tpu.obs.trace` — Chrome trace-event host spans, one
+  track per pipeline thread, Perfetto-mergeable with the ``jax.profiler``
+  device trace (``--traceOut``);
+- :mod:`~annotatedvdb_tpu.obs.session` — the per-CLI lifecycle gluing both
+  to a load and appending the ``type: "run"`` ledger record.
+
+Backpressure gauges live with the queues themselves
+(:class:`annotatedvdb_tpu.utils.pipeline.BoundedStage` ``.stats``) and are
+exported through the session.
+"""
+
+from annotatedvdb_tpu.obs.metrics import (
+    CHUNK_ROW_EDGES,
+    CHUNK_SECONDS_EDGES,
+    Counter,
+    Gauge,
+    Histogram,
+    LoadObserver,
+    MetricsRegistry,
+)
+from annotatedvdb_tpu.obs.session import (
+    ObsSession,
+    add_obs_args,
+    config_hash,
+    run_record,
+)
+from annotatedvdb_tpu.obs.trace import Tracer
+
+__all__ = [
+    "CHUNK_ROW_EDGES",
+    "CHUNK_SECONDS_EDGES",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LoadObserver",
+    "MetricsRegistry",
+    "ObsSession",
+    "Tracer",
+    "add_obs_args",
+    "config_hash",
+    "run_record",
+]

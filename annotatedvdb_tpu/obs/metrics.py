@@ -1,0 +1,301 @@
+"""Thread-safe metrics registry: counters, gauges, fixed-bucket histograms.
+
+The loaders' hot loops already count through plain dicts (``self.counters``)
+at chunk granularity; this registry is the EXPORT surface on top — named
+metrics with stable types that render as one JSON snapshot and one
+Prometheus-style textfile (the node-exporter textfile-collector convention:
+a load writes the file at exit, a scraper picks it up).  Nothing here calls
+``datetime.now()`` or touches a wall clock: values are handed in by callers
+(per-chunk, never per-row), so the registry adds no timing dependency to any
+hot loop.
+
+Histograms use FIXED bucket edges chosen at creation — two runs of the same
+load are bucket-comparable by construction, and rendering is O(buckets)
+regardless of observation count.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import re
+import threading
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+#: default edges for row-count-per-chunk histograms (pow2-ish ladder that
+#: brackets every loader's batch_size defaults, 2^10 .. 2^20)
+CHUNK_ROW_EDGES = tuple(float(1 << k) for k in range(10, 21))
+
+#: default edges for per-chunk latency histograms (seconds, log-spaced)
+CHUNK_SECONDS_EDGES = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0,
+)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus exposition float formatting (integers stay integral)."""
+    if isinstance(v, float) and (math.isinf(v) or math.isnan(v)):
+        return "+Inf" if v > 0 else ("-Inf" if math.isinf(v) else "NaN")
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    def esc(v) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    inner = ",".join(
+        f'{k}="{esc(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` only; negative increments are rejected."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        self.name, self.help, self.labels = name, help, dict(labels or {})
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"value": self.value}
+
+    def render(self, lines: list) -> None:
+        lines.append(f"{self.name}{_label_str(self.labels)} {_fmt(self.value)}")
+
+
+class Gauge:
+    """Point-in-time value (queue depth, resident rows, overlap factor)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        self.name, self.help, self.labels = name, help, dict(labels or {})
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"value": self.value}
+
+    def render(self, lines: list) -> None:
+        lines.append(f"{self.name}{_label_str(self.labels)} {_fmt(self.value)}")
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative ``le`` semantics on export).
+
+    ``edges`` are the finite upper bounds, strictly increasing; an implicit
+    +Inf bucket catches the tail.  ``observe`` is O(log buckets) and takes
+    one lock — cheap enough for chunk-granularity observation, NOT meant for
+    per-row loops (loaders observe per chunk by design).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, edges, help: str = "",
+                 labels: dict | None = None):
+        edges = tuple(float(e) for e in edges)
+        if not edges:
+            raise ValueError(f"histogram {name}: needs at least one edge")
+        if any(b <= a for a, b in zip(edges, edges[1:])):
+            raise ValueError(
+                f"histogram {name}: edges must be strictly increasing"
+            )
+        self.name, self.help, self.labels = name, help, dict(labels or {})
+        self.edges = edges
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(edges) + 1)  # +1: the +Inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.edges, float(v))
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += float(v)
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            return {
+                "edges": list(self.edges),
+                "counts": counts,
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+    def render(self, lines: list) -> None:
+        snap = self.snapshot()
+        cum = 0
+        for edge, n in zip(self.edges, snap["counts"]):
+            cum += n
+            labels = dict(self.labels, le=_fmt(edge))
+            lines.append(f"{self.name}_bucket{_label_str(labels)} {cum}")
+        labels = dict(self.labels, le="+Inf")
+        lines.append(
+            f"{self.name}_bucket{_label_str(labels)} {snap['count']}"
+        )
+        ls = _label_str(self.labels)
+        lines.append(f"{self.name}_sum{ls} {_fmt(snap['sum'])}")
+        lines.append(f"{self.name}_count{ls} {snap['count']}")
+
+
+class MetricsRegistry:
+    """Named metric store: get-or-create accessors, JSON + Prometheus export.
+
+    Creation is idempotent per (name, frozen labels) — a loader re-run in the
+    same process reuses its metrics; asking for an existing name with a
+    different TYPE is a bug and raises.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, object] = {}
+
+    def _get(self, cls, name: str, help: str, labels: dict | None, **kw):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help=help, labels=labels, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: dict | None = None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: dict | None = None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name: str, edges, help: str = "",
+                  labels: dict | None = None) -> Histogram:
+        h = self._get(Histogram, name, help, labels, edges=edges)
+        if tuple(float(e) for e in edges) != h.edges:
+            raise ValueError(
+                f"histogram {name!r} already registered with different edges"
+            )
+        return h
+
+    def metrics(self) -> list:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> dict:
+        """{name: [{labels, kind, ...values}]} — the JSON export shape."""
+        out: dict[str, list] = {}
+        for m in self.metrics():
+            entry = {"kind": m.kind, "labels": m.labels, **m.snapshot()}
+            out.setdefault(m.name, []).append(entry)
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus exposition text (textfile-collector compatible)."""
+        lines: list[str] = []
+        seen_meta: set[str] = set()
+        for m in sorted(self.metrics(), key=lambda m: m.name):
+            if m.name not in seen_meta:
+                seen_meta.add(m.name)
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+            m.render(lines)
+        return "\n".join(lines) + "\n"
+
+    def write_textfile(self, path: str) -> None:
+        """Atomic write (tmp+rename): a scraper must never read a torn
+        half-written exposition file."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{os.path.basename(path)}.tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(self.render_prometheus())
+        os.replace(tmp, path)
+
+    def write_json(self, path: str) -> None:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{os.path.basename(path)}.tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+
+class LoadObserver:
+    """Chunk-granularity metrics adapter a loader carries as ``self.obs``.
+
+    Loaders call :meth:`chunk` once per processed chunk — never per row —
+    so observation cost is O(chunks) and invisible next to device work.
+    ``loader`` becomes a metric label, so one registry can carry several
+    loaders' series side by side (a VCF load followed by its VEP update).
+    """
+
+    def __init__(self, reg: MetricsRegistry, loader: str):
+        labels = {"loader": loader}
+        self.chunks = reg.counter(
+            "avdb_chunks_total", "pipeline chunks processed", labels
+        )
+        self.rows = reg.counter(
+            "avdb_rows_total", "input rows (post-parse) processed", labels
+        )
+        self.chunk_rows = reg.histogram(
+            "avdb_chunk_rows", CHUNK_ROW_EDGES,
+            "rows per pipeline chunk", labels,
+        )
+        self.chunk_seconds = reg.histogram(
+            "avdb_chunk_seconds", CHUNK_SECONDS_EDGES,
+            "process-thread seconds per chunk", labels,
+        )
+
+    def chunk(self, rows: int, seconds: float | None = None) -> None:
+        self.chunks.inc()
+        if rows:
+            self.rows.inc(rows)
+            self.chunk_rows.observe(rows)
+        if seconds is not None:
+            self.chunk_seconds.observe(seconds)

@@ -107,6 +107,19 @@ class AlgorithmLedger:
             {"type": "finish", "alg_id": alg_id, "counters": counters, "ts": time.time()}
         )
 
+    def run(self, record: dict) -> None:
+        """Append one per-load RUN record (``type: "run"``) — the
+        observability layer's machine-readable load history: input path,
+        config hash, per-stage counters, queue stalls, error class when the
+        load aborted, final throughput (``obs.session.run_record`` builds
+        the payload).  Orthogonal to invocation/checkpoint records: resume
+        logic ignores runs, ops tooling reads them."""
+        self._append({"type": "run", **record, "ts": time.time()})
+
+    def runs(self) -> list[dict]:
+        """All run records, oldest first (the ops/audit read path)."""
+        return [e for e in self._entries if e.get("type") == "run"]
+
     def undo(self, alg_id: int, removed: int) -> None:
         self._append(
             {"type": "undo", "alg_id": alg_id, "removed": removed, "ts": time.time()}

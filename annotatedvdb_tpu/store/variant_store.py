@@ -1125,6 +1125,21 @@ class VariantStore:
                 groups.append(list(seg.backing))
             manifest["shards"][label] = groups
         manifest["next_seg_id"] = self._next_seg_id
+        # residency stats for ops tooling (the obs layer exports these as
+        # avdb_store_rows gauges without loading any segment data).
+        # DETERMINISTIC on store content only — no timestamps/host data:
+        # serial and overlapped loads of the same input must stay
+        # byte-identical, manifest included (tests/test_pipeline_modes.py)
+        manifest["stats"] = {
+            "rows": {
+                chromosome_label(code): int(shard.n)
+                for code, shard in sorted(self.shards.items())
+            },
+            "segments": {
+                chromosome_label(code): len(shard.segments)
+                for code, shard in sorted(self.shards.items())
+            },
+        }
         # atomic swap: a PROCESS crash mid-save must leave the previous
         # manifest intact (segments are also written via tmp+rename, so the
         # old manifest's files are never mutated in place) — the store is

@@ -47,9 +47,16 @@ class StageTimer:
         #: wall-clock of the runs wrapped in ``wall()`` (accumulates across
         #: files like the per-stage counters do)
         self.wall_seconds: float = 0.0
+        #: optional :class:`annotatedvdb_tpu.obs.trace.Tracer`; when set,
+        #: every stage span is mirrored as a B/E trace-event pair on the
+        #: thread that ran it — the host half of the Perfetto timeline
+        self.tracer = None
 
     @contextlib.contextmanager
     def stage(self, name: str, items: int = 0):
+        tracer = self.tracer
+        if tracer is not None:
+            tracer.begin(name)
         t0 = self._clock()
         try:
             yield
@@ -58,11 +65,16 @@ class StageTimer:
             with self._lock:
                 self.seconds[name] = self.seconds.get(name, 0.0) + dt
                 self.items[name] = self.items.get(name, 0) + items
+            if tracer is not None:
+                tracer.end(name)
 
     @contextlib.contextmanager
     def wall(self):
         """Record one run's wall-clock (the overlapped-critical-path
         denominator for ``overlap()``)."""
+        tracer = self.tracer
+        if tracer is not None:
+            tracer.begin("load")
         t0 = self._clock()
         try:
             yield
@@ -70,6 +82,8 @@ class StageTimer:
             dt = self._clock() - t0
             with self._lock:
                 self.wall_seconds += dt
+            if tracer is not None:
+                tracer.end("load")
 
     def total(self) -> float:
         with self._lock:
@@ -79,14 +93,20 @@ class StageTimer:
         """Busy-seconds / wall-seconds across all recorded runs, or None
         when no wall window was recorded.  1.0 = fully serial; >1.0 = the
         pipeline genuinely ran stages concurrently."""
-        if not self.wall_seconds:
+        with self._lock:
+            wall = self.wall_seconds
+            busy = sum(self.seconds.values())
+        if not wall:
             return None
-        return self.total() / self.wall_seconds
+        return busy / wall
 
     def summary(self) -> str:
-        with self._lock:  # one snapshot: total must equal sum(snapshot)
+        with self._lock:  # one snapshot: total must equal sum(snapshot),
+            # and wall is read under the same lock — a wall() exit on
+            # another pipeline thread mid-summary must not tear the line
             snapshot = dict(self.seconds)
             items = dict(self.items)
+            wall = self.wall_seconds
         total = sum(snapshot.values()) or 1e-12
         parts = []
         for name in sorted(snapshot, key=snapshot.get, reverse=True):
@@ -95,10 +115,10 @@ class StageTimer:
             if items.get(name) and s > 0:
                 line += f" {items[name] / s:,.0f}/s"
             parts.append(line)
-        if self.wall_seconds:
+        if wall:
             parts.append(
-                f"wall: {self.wall_seconds:.2f}s "
-                f"(busy {total:.2f}s, {total / self.wall_seconds:.2f}x overlap)"
+                f"wall: {wall:.2f}s "
+                f"(busy {total:.2f}s, {total / wall:.2f}x overlap)"
             )
         return " | ".join(parts)
 
@@ -116,14 +136,44 @@ class StageTimer:
         """Wall vs busy accounting for bench records: per-stage seconds are
         busy time on their pipeline thread; ``overlap`` > 1 proves stages
         actually ran concurrently instead of the sum hiding inside the wall."""
-        busy = self.total()
+        with self._lock:
+            busy = sum(self.seconds.values())
+            wall = self.wall_seconds
         out = {
-            "wall_seconds": round(self.wall_seconds, 4),
+            "wall_seconds": round(wall, 4),
             "busy_seconds": round(busy, 4),
         }
-        if self.wall_seconds:
-            out["overlap"] = round(busy / self.wall_seconds, 3)
+        if wall:
+            out["overlap"] = round(busy / wall, 3)
         return out
+
+
+def stall_summary(queue_stalls: dict, wall_seconds: float | None = None) -> str:
+    """Human line for the backpressure accounting
+    (:class:`annotatedvdb_tpu.utils.pipeline.StageStats` dicts keyed by
+    boundary name): producer-block = the boundary's consumer is the
+    bottleneck, consumer-wait = its producer starved it.  With a wall
+    window the dominant side is expressed as % of wall — the printed fact
+    that turns "overlap 3.1x" into "dispatch starved 40% of wall"."""
+    parts = []
+    for name, rec in (queue_stalls or {}).items():
+        blocked = rec.get("producer_block_s", 0.0)
+        waited = rec.get("consumer_wait_s", 0.0)
+        bits = []
+        if blocked >= 0.005:
+            b = f"blocked {blocked:.2f}s"
+            if wall_seconds:
+                b += f" ({100 * blocked / wall_seconds:.0f}% of wall)"
+            bits.append(b)
+        if waited >= 0.005:
+            w = f"starved {waited:.2f}s"
+            if wall_seconds:
+                w += f" ({100 * waited / wall_seconds:.0f}% of wall)"
+            bits.append(w)
+        if not bits:
+            bits.append("no stalls")
+        parts.append(f"{name}: " + ", ".join(bits))
+    return " | ".join(parts) if parts else "no stage queues ran"
 
 
 @contextlib.contextmanager

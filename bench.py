@@ -194,7 +194,8 @@ def write_synth_vep(vcf_path: str, out_path: str, n_results: int) -> int:
     return written
 
 
-def bench_end_to_end():
+def bench_end_to_end(metrics_out: str | None = None,
+                     trace_out: str | None = None):
     from annotatedvdb_tpu.conseq import ConsequenceRanker
     from annotatedvdb_tpu.loaders import TpuVcfLoader
     from annotatedvdb_tpu.loaders.vep_loader import TpuVepLoader
@@ -213,6 +214,22 @@ def bench_end_to_end():
             store, ledger, datasource="dbSNP", batch_size=1 << 17,
             log=lambda *a: None,
         )
+        # --metrics-out / --trace-out: full telemetry capture of the
+        # measured load (host span tracer on every pipeline thread +
+        # Prometheus textfile on exit).  Span emission is per STAGE per
+        # chunk (~10 events x ~16 chunks), so the measured rate moves by
+        # well under the acceptance budget (<=2%).
+        obs_session = None
+        if metrics_out or trace_out:
+            from annotatedvdb_tpu.obs import ObsSession
+
+            obs_session = ObsSession(
+                "bench-e2e", vcf,
+                {"rows": E2E_ROWS, "batch_size": 1 << 17,
+                 "pipeline": os.environ.get("AVDB_PIPELINE", "overlapped")},
+                metrics_out=metrics_out, trace_out=trace_out,
+            )
+            obs_session.attach(loader)
         loader.warmup()  # steady-state measurement: compile outside the clock
         from annotatedvdb_tpu.utils.profiling import device_trace
 
@@ -229,6 +246,9 @@ def bench_end_to_end():
             )
             store.save(store_dir)
             dt = time.perf_counter() - t0
+        if obs_session is not None:
+            # exports happen OUTSIDE the measured window
+            obs_session.finish(ledger, counters, store=store)
 
         # update path: VEP results over a slice of the loaded store.
         # Measured N times (run 0 against the live store, later runs
@@ -272,6 +292,11 @@ def bench_end_to_end():
             # seconds legitimately sum past wall (overlap > 1 proves the
             # pipeline overlapped instead of hiding stages in each other)
             "stage_wall": loader.timer.wall_dict(),
+            # backpressure accounting per stage boundary: producer_block_s
+            # (that boundary's consumer was the bottleneck) and
+            # consumer_wait_s (its producer starved it) make "overlap 3.1x
+            # but dispatch starved 40% of wall" a recorded fact
+            "queue_stalls": loader.queue_stalls,
             "pipeline": os.environ.get("AVDB_PIPELINE", "overlapped"),
             "vep_update": {
                 "results_per_sec": vep_rps,
@@ -444,6 +469,18 @@ def bench_multichip_virtual(n_devices: int = 8):
     }
 
 
+def _argv_opt(name: str) -> str | None:
+    """Minimal ``--flag VALUE`` / ``--flag=VALUE`` lookup (the bench keeps
+    argv handling dependency-free, like --tpu-only)."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == name and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
 def tpu_only():
     """One-command TPU capture (``python bench.py --tpu-only``): re-probe
     the accelerator and, if it comes up, run the kernel + end-to-end legs
@@ -489,7 +526,10 @@ def tpu_only():
             kernel_vs_target=round(kernel_vps / KERNEL_TARGET, 3),
             kernel=kernel_kind,
         )
-        e2e = bench_end_to_end()
+        e2e = bench_end_to_end(
+            metrics_out=_argv_opt("--metrics-out"),
+            trace_out=_argv_opt("--trace-out"),
+        )
         out.update(
             value=round(e2e["variants_per_sec"], 1),
             vs_baseline=round(e2e["variants_per_sec"] / END_TO_END_TARGET, 3),
@@ -540,7 +580,10 @@ def main():
         # the accelerator-dependent legs only: the virtual-mesh leg below
         # is CPU-side and must not throw away completed device results
         kernel_vps, kernel_kind = bench_kernel()
-        e2e = bench_end_to_end()
+        e2e = bench_end_to_end(
+            metrics_out=_argv_opt("--metrics-out"),
+            trace_out=_argv_opt("--trace-out"),
+        )
         cadd = bench_cadd_join()
         qc = bench_qc_update()
     except Exception as exc:

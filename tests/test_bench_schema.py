@@ -1,0 +1,117 @@
+"""Bench-record schema gate: every committed ``BENCH_*.json`` must validate
+against the documented schema (README "Bench JSON schema"), and the checker
+itself must catch the drift classes it exists for."""
+
+import copy
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from check_bench_schema import validate_file, validate_record  # noqa: E402
+
+GOOD = {
+    "metric": "end_to_end_vcf_to_store_variants_per_sec",
+    "value": 1000000.0,
+    "unit": "variants/sec",
+    "vs_baseline": 6.7,
+    "kernel_variants_per_sec": 4.5e6,
+    "kernel_vs_target": 4.5,
+    "kernel": "jnp",
+    "backend": "cpu",
+    "end_to_end": {
+        "variants_per_sec": 1000000.0,
+        "variants": 2092068,
+        "duplicates": 5084,
+        "seconds": 2.1,
+        "vcf_mb": 67.3,
+        "mb_per_sec": 32.0,
+        "pipeline": "overlapped",
+        "stages": {
+            "ingest": {"seconds": 0.9, "items": 0},
+            "annotate": {"seconds": 0.01, "items": 2092068},
+        },
+        "stage_wall": {
+            "wall_seconds": 2.1, "busy_seconds": 3.2, "overlap": 1.52,
+        },
+        "queue_stalls": {
+            "ingest": {"items": 16, "producer_block_s": 0.4,
+                       "consumer_wait_s": 0.1, "max_depth": 2},
+            "store-writer": {"items": 16, "producer_block_s": 0.0,
+                             "consumer_wait_s": 0.0, "max_depth": 1},
+        },
+        "vep_update": {
+            "results_per_sec": 200000.0, "updated": 200000,
+            "seconds": 1.0, "runs": [199000.0, 200000.0, 201000.0],
+        },
+    },
+    "cadd_join": {"table_rows_per_sec": 2.0e6, "matched": 49778,
+                  "variants": 100000, "seconds": 0.43},
+    "qc_update": {"rows_per_sec": 120000.0, "updated": 100000,
+                  "seconds": 0.82},
+}
+
+
+def test_committed_bench_records_validate():
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_*.json")))
+    assert paths, "no committed BENCH records found"
+    for path in paths:
+        errors = validate_file(path)
+        assert not errors, f"{os.path.basename(path)}: {errors}"
+
+
+def test_good_record_passes_including_new_blocks():
+    assert validate_record(GOOD) == []
+
+
+def test_missing_core_field_fails():
+    bad = copy.deepcopy(GOOD)
+    del bad["value"]
+    errors = validate_record(bad)
+    assert any("value" in e for e in errors)
+
+
+def test_bad_stage_shape_fails():
+    bad = copy.deepcopy(GOOD)
+    bad["end_to_end"]["stages"]["ingest"] = {"items": 0}  # no seconds
+    errors = validate_record(bad)
+    assert any("ingest" in e and "seconds" in e for e in errors)
+
+
+def test_queue_stalls_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["end_to_end"]["queue_stalls"]["ingest"]["consumer_wait_s"]
+    errors = validate_record(bad)
+    assert any("consumer_wait_s" in e for e in errors)
+    neg = copy.deepcopy(GOOD)
+    neg["end_to_end"]["queue_stalls"]["ingest"]["producer_block_s"] = -1.0
+    errors = validate_record(neg)
+    assert any("negative" in e for e in errors)
+
+
+def test_wrapper_with_failed_rc_is_tolerated(tmp_path):
+    # rc != 0 with no parsed record is a legitimate historical record
+    path = tmp_path / "BENCH_rX.json"
+    path.write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 1, "tail": "boom",
+         "parsed": None}
+    ))
+    assert validate_file(str(path)) == []
+    # but rc == 0 with no parsed record is drift
+    path.write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 0, "tail": "", "parsed": None}
+    ))
+    assert validate_file(str(path))
+
+
+def test_checker_cli_over_committed_records():
+    import subprocess
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_bench_schema.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr

@@ -1,0 +1,458 @@
+"""Unified telemetry subsystem (``annotatedvdb_tpu.obs``): metrics registry
+semantics, Chrome-trace well-formedness, BoundedStage backpressure
+accounting, and the per-load run ledger (append-on-abort included)."""
+
+import collections
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from annotatedvdb_tpu.obs import MetricsRegistry, ObsSession, Tracer
+from annotatedvdb_tpu.obs.session import config_hash, run_record
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_counter_and_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("avdb_test_total", "help text")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("avdb_depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+    # get-or-create: same (name, labels) returns the same object
+    assert reg.counter("avdb_test_total") is c
+    # same name as a different type is a bug
+    with pytest.raises(TypeError):
+        reg.gauge("avdb_test_total")
+
+
+def test_histogram_fixed_bucket_edges():
+    reg = MetricsRegistry()
+    h = reg.histogram("avdb_h", edges=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 5, 10, 99, 1000):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["edges"] == [1.0, 10.0, 100.0]
+    # le semantics: observe(edge) falls INTO that edge's bucket
+    # (bisect_left): 0.5,1.0 <= 1; 5,10 <= 10; 99 <= 100; 1000 -> +Inf
+    assert snap["counts"] == [2, 2, 1, 1]
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(1115.5)
+    # edges are FIXED: re-registering with different edges is an error
+    with pytest.raises(ValueError):
+        reg.histogram("avdb_h", edges=(2.0, 20.0))
+    # malformed edges rejected at creation
+    with pytest.raises(ValueError):
+        reg.histogram("avdb_bad", edges=(5.0, 5.0))
+    with pytest.raises(ValueError):
+        reg.histogram("avdb_empty", edges=())
+
+
+def test_prometheus_rendering_and_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("avdb_rows_total", "rows", {"loader": "x"}).inc(7)
+    h = reg.histogram("avdb_lat", (0.1, 1.0), "latency")
+    h.observe(0.05)
+    h.observe(5.0)
+    text = reg.render_prometheus()
+    assert "# TYPE avdb_rows_total counter" in text
+    assert 'avdb_rows_total{loader="x"} 7' in text
+    # cumulative buckets + the implicit +Inf bucket + sum/count
+    assert 'avdb_lat_bucket{le="0.1"} 1' in text
+    assert 'avdb_lat_bucket{le="1"} 1' in text
+    assert 'avdb_lat_bucket{le="+Inf"} 2' in text
+    assert "avdb_lat_count 2" in text
+    snap = reg.snapshot()
+    assert snap["avdb_rows_total"][0]["value"] == 7
+    assert snap["avdb_lat"][0]["count"] == 2
+    with pytest.raises(ValueError):
+        reg.counter("not a valid name!")
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("avdb_mt_total")
+    h = reg.histogram("avdb_mt_h", (10.0, 100.0))
+
+    def work():
+        for i in range(1000):
+            c.inc()
+            h.observe(i % 150)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 4000
+    assert h.snapshot()["count"] == 4000
+    assert sum(h.snapshot()["counts"]) == 4000
+
+
+def test_metrics_files_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("avdb_x_total").inc(3)
+    prom = tmp_path / "m.prom"
+    reg.write_textfile(str(prom))
+    reg.write_json(str(prom) + ".json")
+    assert "avdb_x_total 3" in prom.read_text()
+    snap = json.loads((tmp_path / "m.prom.json").read_text())
+    assert snap["avdb_x_total"][0]["value"] == 3
+
+
+# ------------------------------------------------------------------ trace
+
+
+def _check_trace_events(evs):
+    """The well-formedness contract: sorted ts, per-(pid,tid) matched B/E
+    pairs, named thread tracks."""
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts), "trace events not sorted by ts"
+    stacks = collections.defaultdict(list)
+    for e in evs:
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks[key].append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks[key], f"E without B on {key}: {e['name']}"
+            assert stacks[key].pop() == e["name"], "interleaved B/E pair"
+    assert all(not s for s in stacks.values()), "unclosed B span"
+
+
+def test_tracer_spans_threads_and_save(tmp_path):
+    tracer = Tracer(process_name="test-proc")
+
+    def worker():
+        with tracer.span("worker-stage", items=3):
+            time.sleep(0.002)
+
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            pass
+        t = threading.Thread(target=worker, name="avdb-test-worker")
+        t.start()
+        t.join()
+    tracer.counter("queue_depth", ingest=2, dispatch=0)
+    evs = tracer.events()
+    _check_trace_events(evs)
+    names = {
+        e["args"]["name"] for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "avdb-test-worker" in names
+    assert any(e["ph"] == "C" and e["name"] == "queue_depth" for e in evs)
+    out = tmp_path / "trace.json"
+    tracer.save(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    _check_trace_events(doc["traceEvents"])
+
+
+def test_stage_timer_mirrors_spans_to_tracer():
+    from annotatedvdb_tpu.utils.profiling import StageTimer
+
+    timer = StageTimer()
+    timer.tracer = Tracer()
+    with timer.wall():
+        with timer.stage("annotate", items=10):
+            pass
+        with timer.stage("lookup"):
+            pass
+    evs = timer.tracer.events()
+    _check_trace_events(evs)
+    span_names = [e["name"] for e in evs if e["ph"] == "B"]
+    assert span_names == ["load", "annotate", "lookup"]
+
+
+# ----------------------------------------------------------- backpressure
+
+
+def test_bounded_stage_stall_accounting_under_backpressure():
+    """A fast producer against a slow consumer accumulates producer-block
+    seconds; a slow producer starves its consumer into consumer-wait
+    seconds.  Both live on the stage's StageStats."""
+    from annotatedvdb_tpu.utils.pipeline import BoundedStage
+
+    # fast producer, slow consumer -> producer blocks on the full queue
+    stage = BoundedStage(iter(range(12)), depth=1, name="t-fast")
+    got = []
+    for item in stage:
+        time.sleep(0.02)
+        got.append(item)
+    assert got == list(range(12))
+    assert stage.stats.items == 12
+    assert stage.stats.producer_block_s > 0.05
+    assert stage.stats.max_depth >= 1
+    d = stage.stats.as_dict()
+    assert set(d) == {"items", "producer_block_s", "consumer_wait_s",
+                      "max_depth"}
+
+    # slow producer -> the consumer waits on an empty queue
+    def slow():
+        for i in range(4):
+            time.sleep(0.02)
+            yield i
+
+    stage = BoundedStage(slow(), depth=2, name="t-slow")
+    assert list(stage) == [0, 1, 2, 3]
+    assert stage.stats.consumer_wait_s > 0.05
+    assert stage.stats.producer_block_s < 0.05
+
+
+def test_loader_queue_stalls_populated(tmp_path, monkeypatch):
+    """An overlapped load fills the loader's queue_stalls table with one
+    record per stage boundary."""
+    monkeypatch.setenv("AVDB_PIPELINE", "overlapped")
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    vcf = tmp_path / "s.vcf"
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for i in range(2000):
+        lines.append(f"1\t{1000 + i * 3}\trs{i}\tA\tG\t.\t.\t.")
+    vcf.write_text("\n".join(lines) + "\n")
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "l.jsonl"))
+    loader = TpuVcfLoader(store, ledger, batch_size=128, log=lambda *a: None)
+    loader.load_file(str(vcf), commit=True)
+    loader.close()
+    assert {"ingest", "dispatch", "store-writer"} <= set(loader.queue_stalls)
+    for rec in loader.queue_stalls.values():
+        assert rec["items"] > 0
+        assert rec["producer_block_s"] >= 0
+        assert rec["consumer_wait_s"] >= 0
+    from annotatedvdb_tpu.utils.profiling import stall_summary
+
+    line = stall_summary(loader.queue_stalls, loader.timer.wall_seconds)
+    assert "ingest" in line and "dispatch" in line
+
+
+# ------------------------------------------------------------- run ledger
+
+
+def test_run_record_shape():
+    rec = run_record(
+        "load-vcf", "/x/in.vcf", {"commit": True}, {"variant": 100, "line": 120},
+        wall_seconds=2.0, stages={"annotate": {"seconds": 1.0, "items": 100}},
+        queue_stalls={"ingest": {"items": 1, "producer_block_s": 0.0,
+                                 "consumer_wait_s": 0.1, "max_depth": 2}},
+    )
+    assert rec["status"] == "completed"
+    assert rec["throughput_per_sec"] == 50.0
+    assert rec["config_hash"] == config_hash({"commit": True})
+    err = run_record(
+        "load-vcf", "/x/in.vcf", {}, {"variant": 1}, 1.0,
+        error=RuntimeError("boom"),
+    )
+    assert err["status"] == "aborted"
+    assert err["error_class"] == "RuntimeError"
+
+
+def test_config_hash_stable_and_order_independent():
+    assert config_hash({"a": 1, "b": 2}) == config_hash({"b": 2, "a": 1})
+    assert config_hash({"a": 1}) != config_hash({"a": 2})
+
+
+def test_ledger_run_records_append_and_survive_reload(tmp_path):
+    from annotatedvdb_tpu.store import AlgorithmLedger
+
+    path = str(tmp_path / "ledger.jsonl")
+    ledger = AlgorithmLedger(path)
+    alg_id = ledger.begin("x", {"file": "a.vcf"}, True)
+    ledger.run(run_record("load-vcf", "a.vcf", {}, {"variant": 5}, 1.0))
+    ledger.finish(alg_id, {"variant": 5})
+    # run records never disturb resume-cursor logic
+    assert ledger.last_checkpoint("a.vcf") == 0
+    reloaded = AlgorithmLedger(path)
+    runs = reloaded.runs()
+    assert len(runs) == 1
+    assert runs[0]["script"] == "load-vcf" and runs[0]["type"] == "run"
+
+
+def test_obs_session_appends_run_record_on_abort(tmp_path):
+    """A load that dies mid-file still lands one ``type: "run"`` record
+    with the error class — the CLIs' except-path contract."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    vcf = tmp_path / "a.vcf"
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for i in range(600):
+        vid = "failhere" if i == 300 else f"rs{i}"
+        lines.append(f"1\t{1000 + i * 3}\t{vid}\tA\tG\t.\t.\t.")
+    vcf.write_text("\n".join(lines) + "\n")
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "l.jsonl"))
+    loader = TpuVcfLoader(store, ledger, batch_size=128, log=lambda *a: None)
+    obs = ObsSession(
+        "load-vcf", str(vcf), {"commit": True},
+        metrics_out=str(tmp_path / "m.prom"),
+        trace_out=str(tmp_path / "t.json"),
+    )
+    obs.attach(loader)
+    with pytest.raises(RuntimeError, match="failAt"):
+        try:
+            loader.load_file(str(vcf), commit=True, fail_at="failhere")
+        except BaseException as exc:
+            obs.abort(ledger, exc, store=store)
+            raise
+    loader.close()
+    runs = AlgorithmLedger(str(tmp_path / "l.jsonl")).runs()
+    assert len(runs) == 1
+    assert runs[0]["status"] == "aborted"
+    assert runs[0]["error_class"] == "RuntimeError"
+    assert runs[0]["counters"]["variant"] > 0  # pre-fault chunks committed
+    # exports still happened (the abort path writes the same artifacts)
+    assert (tmp_path / "m.prom").exists()
+    doc = json.loads((tmp_path / "t.json").read_text())
+    _check_trace_events(doc["traceEvents"])
+
+
+def test_obs_session_finish_exports_everything(tmp_path):
+    """Happy path: counters + stages + stalls land in the registry, both
+    metric files and the trace are written, one run record appended."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+
+    vcf = tmp_path / "b.vcf"
+    lines = ["##fileformat=VCFv4.2",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for i in range(500):
+        lines.append(f"1\t{1000 + i * 3}\trs{i}\tA\tG\t.\t.\t.")
+    vcf.write_text("\n".join(lines) + "\n")
+    store = VariantStore(width=16)
+    ledger = AlgorithmLedger(str(tmp_path / "l.jsonl"))
+    loader = TpuVcfLoader(store, ledger, batch_size=128, log=lambda *a: None)
+    obs = ObsSession(
+        "load-vcf", str(vcf), {"commit": True},
+        metrics_out=str(tmp_path / "m.prom"),
+        trace_out=str(tmp_path / "t.json"),
+    )
+    obs.attach(loader)
+    counters = loader.load_file(str(vcf), commit=True)
+    loader.close()
+    obs.finish(ledger, counters, store=store)
+    text = (tmp_path / "m.prom").read_text()
+    assert 'avdb_load_variant_total{loader="load-vcf"} 500' in text
+    assert "avdb_stage_busy_seconds_total" in text
+    assert "avdb_queue_producer_block_seconds_total" in text
+    assert 'avdb_store_rows{chrom="1"} 500' in text
+    doc = json.loads((tmp_path / "t.json").read_text())
+    tracks = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    # host timeline covers every pipeline thread (>= 4 named tracks)
+    assert len(tracks) >= 4, tracks
+    runs = ledger.runs()
+    assert len(runs) == 1 and runs[0]["status"] == "completed"
+    assert runs[0]["queue_stalls"]
+
+
+# -------------------------------------------------- satellites: logging
+
+
+def test_progress_cadence_flushes_final_line():
+    """A load ending between cadences (short file) still logs a terminal
+    PARSED line; one that ended exactly on a cadence does not repeat it."""
+    from annotatedvdb_tpu.utils.logging import ProgressCadence
+
+    logs = []
+    cad = ProgressCadence(lambda m: logs.append(m), 100)
+    cad.maybe_log(40, {"variant": 40})   # below cadence: nothing yet
+    assert logs == []
+    cad.finish(40, {"variant": 40})
+    assert len(logs) == 1 and "final" in logs[0] and "40" in logs[0]
+
+    logs.clear()
+    cad = ProgressCadence(lambda m: logs.append(m), 100)
+    cad.maybe_log(100, {"variant": 100})
+    assert len(logs) == 1
+    cad.finish(100, {"variant": 100})    # already logged at exactly 100
+    assert len(logs) == 1
+
+    logs.clear()
+    cad = ProgressCadence(lambda m: logs.append(m), None)  # cadence off
+    cad.finish(40, {})
+    assert logs == []
+
+
+def test_load_logger_registry_is_bounded(tmp_path):
+    import logging as _logging
+
+    from annotatedvdb_tpu.utils import logging as avdb_logging
+
+    before = {
+        n for n in _logging.Logger.manager.loggerDict if n.startswith("avdb.")
+    }
+    n = avdb_logging.MAX_LIVE_LOGGERS + 8
+    for i in range(n):
+        inp = tmp_path / f"in{i}.vcf"
+        inp.write_text("")
+        log, _logger, _p = avdb_logging.load_logger(str(inp), "t")
+        log("hello")
+    after = {
+        n for n in _logging.Logger.manager.loggerDict if n.startswith("avdb.")
+    }
+    # +1: the "avdb.t" ancestor placeholder logging interns per tag
+    assert len(after - before) <= avdb_logging.MAX_LIVE_LOGGERS + 1
+    # the most recent logger still works (file handler intact)
+    log("still alive")
+    assert "still alive" in (tmp_path / f"in{n-1}.vcf-t.log").read_text()
+
+
+# ------------------------------------------------------------ CLI surface
+
+
+def test_cli_metrics_and_trace_flags(tmp_path):
+    """End-to-end through the real CLI: --metricsOut/--traceOut produce a
+    Prometheus textfile, a JSON snapshot, a loadable Chrome trace, and a
+    run record in the store ledger."""
+    vcf = tmp_path / "in.vcf"
+    body = ["##fileformat=VCFv4.2",
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for i in range(300):
+        body.append(f"1\t{100 + i * 5}\trs{i}\tA\tG\t.\t.\t.")
+    vcf.write_text("\n".join(body) + "\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu.cli.load_vcf",
+         "--fileName", str(vcf), "--storeDir", str(tmp_path / "vdb"),
+         "--commit", "--commitAfter", "64",
+         "--metricsOut", str(tmp_path / "m.prom"),
+         "--traceOut", str(tmp_path / "t.json")],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr
+    text = (tmp_path / "m.prom").read_text()
+    assert "# TYPE avdb_chunk_rows histogram" in text
+    assert "avdb_load_variant_total" in text
+    assert json.loads((tmp_path / "m.prom.json").read_text())
+    doc = json.loads((tmp_path / "t.json").read_text())
+    _check_trace_events(doc["traceEvents"])
+    tracks = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert len(tracks) >= 4, tracks
+    runs = [
+        json.loads(line)
+        for line in (tmp_path / "vdb" / "ledger.jsonl").read_text().splitlines()
+        if '"run"' in line
+    ]
+    runs = [r for r in runs if r.get("type") == "run"]
+    assert len(runs) == 1 and runs[0]["status"] == "completed"
+    assert runs[0]["config_hash"]

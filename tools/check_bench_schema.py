@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Validate BENCH_*.json records against the documented bench schema.
+
+The bench record schema is documented in README.md ("Bench JSON schema").
+This checker is dependency-free (no jsonschema) and runs as a tier-1 test
+(``tests/test_bench_schema.py``), so drift between what ``bench.py`` emits
+and what the docs/analysis tooling expect fails fast instead of surfacing
+rounds later as a KeyError in a comparison script.
+
+Two record shapes are accepted:
+
+- the RAW record ``bench.py`` prints (one JSON object with ``metric`` ...);
+- the driver WRAPPER committed as ``BENCH_r*.json``:
+  ``{"n", "cmd", "rc", "tail", "parsed"}`` where ``parsed`` is the raw
+  record (may be null when ``rc`` != 0 — a failed bench run is a
+  legitimate historical record and must stay loadable).
+
+Validation is presence-tolerant across schema generations (r02 records
+have no ``end_to_end``; pre-PR1 records no ``stage_wall``; pre-PR2 records
+no ``queue_stalls``): required core fields must exist with the right
+types, every OPTIONAL section is validated strictly when present.
+
+Usage::
+
+    python tools/check_bench_schema.py [FILE ...]   # default: BENCH_*.json
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+NUM = (int, float)
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, NUM) and not isinstance(v, bool)
+
+
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _check_fields(obj: dict, spec: dict, where: str, errors: list,
+                  required: tuple = ()) -> None:
+    """``spec`` maps field -> predicate; fields in ``required`` must exist,
+    the rest are validated only when present."""
+    for field in required:
+        if field not in obj:
+            errors.append(f"{where}: missing required field {field!r}")
+    for field, pred in spec.items():
+        if field in obj and not pred(obj[field]):
+            errors.append(
+                f"{where}: field {field!r} has invalid value "
+                f"{obj[field]!r} ({type(obj[field]).__name__})"
+            )
+
+
+def _check_stages(stages, where: str, errors: list) -> None:
+    if not isinstance(stages, dict) or not stages:
+        errors.append(f"{where}: stages must be a non-empty object")
+        return
+    for name, rec in stages.items():
+        if not isinstance(rec, dict):
+            errors.append(f"{where}.stages.{name}: must be an object")
+            continue
+        _check_fields(
+            rec, {"seconds": _is_num, "items": _is_int},
+            f"{where}.stages.{name}", errors, required=("seconds",),
+        )
+
+
+def _check_stage_wall(sw, where: str, errors: list) -> None:
+    if not isinstance(sw, dict):
+        errors.append(f"{where}: stage_wall must be an object")
+        return
+    _check_fields(
+        sw,
+        {"wall_seconds": _is_num, "busy_seconds": _is_num, "overlap": _is_num},
+        f"{where}.stage_wall", errors,
+        required=("wall_seconds", "busy_seconds"),
+    )
+
+
+def _check_queue_stalls(qs, where: str, errors: list) -> None:
+    """The PR-2 backpressure block: one record per stage boundary."""
+    if not isinstance(qs, dict):
+        errors.append(f"{where}: queue_stalls must be an object")
+        return
+    for boundary, rec in qs.items():
+        w = f"{where}.queue_stalls.{boundary}"
+        if not isinstance(rec, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        _check_fields(
+            rec,
+            {"items": _is_int, "producer_block_s": _is_num,
+             "consumer_wait_s": _is_num, "max_depth": _is_int},
+            w, errors,
+            required=("items", "producer_block_s", "consumer_wait_s",
+                      "max_depth"),
+        )
+        for key in ("producer_block_s", "consumer_wait_s"):
+            if _is_num(rec.get(key)) and rec[key] < 0:
+                errors.append(f"{w}.{key}: negative stall seconds")
+
+
+def _check_end_to_end(e2e, where: str, errors: list) -> None:
+    if not isinstance(e2e, dict):
+        errors.append(f"{where}: end_to_end must be an object")
+        return
+    w = f"{where}.end_to_end"
+    _check_fields(
+        e2e,
+        {
+            "variants_per_sec": _is_num, "variants": _is_int,
+            "duplicates": _is_int, "seconds": _is_num, "vcf_mb": _is_num,
+            "mb_per_sec": _is_num,
+            "pipeline": lambda v: isinstance(v, str),
+        },
+        w, errors,
+        required=("variants_per_sec", "variants", "seconds", "stages"),
+    )
+    if "stages" in e2e:
+        _check_stages(e2e["stages"], w, errors)
+    if "stage_wall" in e2e:
+        _check_stage_wall(e2e["stage_wall"], w, errors)
+    if "queue_stalls" in e2e:
+        _check_queue_stalls(e2e["queue_stalls"], w, errors)
+    if "vep_update" in e2e:
+        vu = e2e["vep_update"]
+        if not isinstance(vu, dict):
+            errors.append(f"{w}.vep_update: must be an object")
+        else:
+            _check_fields(
+                vu,
+                {"results_per_sec": _is_num, "updated": _is_int,
+                 "seconds": _is_num,
+                 "runs": lambda v: isinstance(v, list)
+                 and all(_is_num(x) for x in v)},
+                f"{w}.vep_update", errors,
+                required=("results_per_sec", "updated", "seconds"),
+            )
+
+
+def validate_record(rec: dict, where: str = "record") -> list[str]:
+    """Validate one RAW bench record; returns a list of error strings."""
+    errors: list[str] = []
+    if not isinstance(rec, dict):
+        return [f"{where}: not a JSON object"]
+    if rec.get("mode") == "tpu-only":
+        # --tpu-only probe records: evidence of accelerator state, with
+        # kernel/e2e sections only when the tunnel was up
+        _check_fields(
+            rec, {"platform_pin": lambda v: isinstance(v, str)},
+            where, errors, required=("platform_pin",),
+        )
+    else:
+        _check_fields(
+            rec,
+            {
+                "metric": lambda v: isinstance(v, str),
+                "value": _is_num,
+                "unit": lambda v: isinstance(v, str),
+                "vs_baseline": _is_num,
+                "kernel_variants_per_sec": _is_num,
+                "kernel_vs_target": _is_num,
+                "kernel": lambda v: isinstance(v, str),
+                "backend": lambda v: isinstance(v, str),
+            },
+            where, errors,
+            required=("metric", "value", "unit", "vs_baseline", "backend"),
+        )
+    if "end_to_end" in rec:
+        _check_end_to_end(rec["end_to_end"], where, errors)
+    if "cadd_join" in rec and isinstance(rec["cadd_join"], dict) \
+            and "error" not in rec["cadd_join"]:
+        _check_fields(
+            rec["cadd_join"],
+            {"table_rows_per_sec": _is_num, "matched": _is_int,
+             "seconds": _is_num},
+            f"{where}.cadd_join", errors,
+            required=("table_rows_per_sec", "seconds"),
+        )
+    if "qc_update" in rec and isinstance(rec["qc_update"], dict) \
+            and "error" not in rec["qc_update"]:
+        _check_fields(
+            rec["qc_update"],
+            {"rows_per_sec": _is_num, "updated": _is_int, "seconds": _is_num},
+            f"{where}.qc_update", errors,
+            required=("rows_per_sec", "seconds"),
+        )
+    return errors
+
+
+def validate_file(path: str) -> list[str]:
+    """Validate one BENCH file (raw record or driver wrapper)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as err:
+        return [f"{name}: unreadable ({err})"]
+    if not isinstance(obj, dict):
+        return [f"{name}: not a JSON object"]
+    if "parsed" in obj or "rc" in obj:  # driver wrapper
+        errors: list[str] = []
+        if obj.get("rc") == 0 and not isinstance(obj.get("parsed"), dict):
+            errors.append(
+                f"{name}: rc=0 but no parsed record (bench printed no JSON?)"
+            )
+        if isinstance(obj.get("parsed"), dict):
+            errors.extend(validate_record(obj["parsed"], name))
+        return errors
+    return validate_record(obj, name)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    paths = argv or sorted(
+        glob.glob(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_*.json"))
+    )
+    if not paths:
+        print("no BENCH_*.json files found", file=sys.stderr)
+        return 1
+    n_errors = 0
+    for path in paths:
+        errors = validate_file(path)
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}", file=sys.stderr)
+        else:
+            print(f"ok   {os.path.basename(path)}")
+    return 1 if n_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
