@@ -19,6 +19,8 @@ COMMANDS = {
     "update-annotation": ("annotatedvdb_tpu.cli.update_variant_annotation",
                           "TSV-driven column updates"),
     "undo": ("annotatedvdb_tpu.cli.undo_load", "undo a load by invocation id"),
+    "doctor": ("annotatedvdb_tpu.cli.doctor",
+               "store fsck/repair + quarantine replay"),
     "export-vcf": ("annotatedvdb_tpu.cli.export_variant2vcf",
                    "dump the store back to VCF"),
     "split-vcf": ("annotatedvdb_tpu.cli.split_vcf_by_chr",

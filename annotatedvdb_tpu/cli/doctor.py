@@ -1,0 +1,75 @@
+"""CLI: store health — fsck/repair + quarantine replay.
+
+``doctor`` (default verb) audits a store directory against its manifest's
+write-time integrity records and the ledger, and repairs what is safely
+repairable (see ``annotatedvdb_tpu.store.fsck``); ``doctor replay-rejects``
+reconstructs a loadable input file from a quarantine rejects file
+(``utils.quarantine``) after the bad lines have been fixed.
+
+Usage:
+    python -m annotatedvdb_tpu doctor --storeDir ./vdb [--deep] [--repair] [--json]
+    python -m annotatedvdb_tpu doctor replay-rejects \
+        --rejects ./vdb/quarantine/x.vcf.rejects.jsonl --out fixed.vcf
+
+Exit codes (fsck verb): 0 = clean, 1 = warnings / repaired, 2 = errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _replay(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor replay-rejects",
+        description="rebuild a loadable input from a quarantine rejects file",
+    )
+    ap.add_argument("--rejects", required=True,
+                    help="the <input>.rejects.jsonl to replay")
+    ap.add_argument("--out", required=True,
+                    help="reconstructed input file (load it with the same "
+                         "loader CLI that produced the rejects)")
+    args = ap.parse_args(argv)
+    from annotatedvdb_tpu.utils.quarantine import read_rejects, write_replay
+
+    meta, _records = read_rejects(args.rejects)
+    n = write_replay(args.rejects, args.out)
+    loader = meta.get("loader", "<the original loader>")
+    print(f"{n} quarantined line(s) written to {args.out}; "
+          f"load with {loader}", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "replay-rejects":
+        return _replay(argv[1:])
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--deep", action="store_true",
+                    help="crc32-verify every segment file")
+    ap.add_argument("--repair", action="store_true",
+                    help="prune orphans, heal the ledger, roll damaged "
+                         "backing groups back")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from annotatedvdb_tpu.store.fsck import fsck
+
+    report = fsck(
+        args.storeDir, deep=args.deep, repair=args.repair,
+        log=(lambda m: None) if args.json else
+            (lambda m: print(m, file=sys.stderr)),
+    )
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"doctor: {args.storeDir}: {report['status']}", file=sys.stderr)
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

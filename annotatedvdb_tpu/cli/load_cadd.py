@@ -88,6 +88,10 @@ def main(argv=None) -> int:
                          "--fileName is given and indexes exist)")
     ap.add_argument("--logFilePath", default=None,
                     help="log file (default: beside --fileName or the store)")
+    ap.add_argument("--maxErrors", type=int, default=-1, metavar="N",
+                    help="abort once more than N malformed score rows have "
+                         "been rejected (quarantined under the store); "
+                         "default -1 = tolerate all")
     from annotatedvdb_tpu.obs import ObsSession, add_obs_args
 
     add_obs_args(ap)
@@ -131,9 +135,19 @@ def main(argv=None) -> int:
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    from annotatedvdb_tpu.config import quarantine_from_args
+
     updater = TpuCaddUpdater(
         store, ledger, args.databaseDir,
         skip_existing=not args.updateExisting, log=log, mesh=mesh,
+        # rejects come from the SCORE TABLES (not --fileName): one sink
+        # named for them, both tables attributed via the reject reason
+        quarantine=quarantine_from_args(
+            args, args.storeDir, "load-cadd",
+            input_path=os.path.join(args.databaseDir, "cadd-scores"),
+            log=log,
+        ),
+        max_errors=args.maxErrors,
     )
 
     obs = ObsSession.from_args("load-cadd", args, {

@@ -44,6 +44,8 @@ def main(argv=None) -> int:
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    from annotatedvdb_tpu.config import quarantine_from_args
+
     loader = TpuSnpEffLofLoader(
         store, ledger, update_existing=args.updateExisting,
         chromosome_map=(
@@ -51,6 +53,9 @@ def main(argv=None) -> int:
         ),
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 15),
+        quarantine=quarantine_from_args(args, args.storeDir,
+                                        "load-snpeff-lof", log=log),
+        max_errors=args.maxErrors,
     )
     obs = ObsSession.from_args("load-snpeff-lof", args, {
         "file": args.fileName, "store": args.storeDir,

@@ -85,6 +85,8 @@ def main(argv=None):
     log(f"load_vcf {args.fileName} -> {args.storeDir} "
         f"(commit={cfg.commit}, log={log_path})")
 
+    from annotatedvdb_tpu.config import quarantine_from_args
+
     loader = TpuVcfLoader(
         store,
         ledger,
@@ -97,6 +99,9 @@ def main(argv=None):
         mesh=mesh,
         log=log,
         log_after=cfg.effective_log_after,
+        quarantine=quarantine_from_args(args, args.storeDir, "load-vcf",
+                                        log=log),
+        max_errors=args.maxErrors,
     )
     # telemetry session: --metricsOut / --traceOut exports + the per-load
     # run-ledger record (appended on success AND abort)

@@ -69,6 +69,8 @@ def main(argv=None):
         save_on_add=args.saveOnAddConsequence,
         rank_on_load=args.rankOnLoad,
     )
+    from annotatedvdb_tpu.config import quarantine_from_args
+
     loader = TpuVepLoader(
         store, ledger, ranker,
         datasource=args.datasource,
@@ -76,6 +78,9 @@ def main(argv=None):
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 14),
         mesh=mesh,
+        quarantine=quarantine_from_args(args, args.storeDir, "load-vep",
+                                        log=log),
+        max_errors=args.maxErrors,
     )
     obs = ObsSession.from_args("load-vep", args, {
         "file": args.fileName, "store": args.storeDir,

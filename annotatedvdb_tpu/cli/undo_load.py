@@ -29,8 +29,13 @@ def main(argv=None):
     store = VariantStore.load(args.storeDir)
     removed = store.delete_by_algorithm(args.algId)
     if args.commit:
-        store.save(args.storeDir)
+        # intent BEFORE the save: a crash between the store mutation and
+        # the completing `undo` record is then detectable (fsck reports the
+        # dangling intent and prescribes re-running this idempotent undo)
+        # instead of silently leaving store and ledger inconsistent
         ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+        ledger.undo_intent(args.algId)
+        store.save(args.storeDir)
         ledger.undo(args.algId, removed)
         print(f"COMMITTED: removed {removed} rows for algorithm {args.algId}",
               file=sys.stderr)

@@ -44,6 +44,8 @@ def main(argv=None) -> int:
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    from annotatedvdb_tpu.config import quarantine_from_args
+
     loader = TpuQcPvcfLoader(
         store, ledger, args.version,
         update_existing=args.updateExistingValues,
@@ -53,6 +55,9 @@ def main(argv=None) -> int:
         ),
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 15),
+        quarantine=quarantine_from_args(args, args.storeDir, "update-qc",
+                                        log=log),
+        max_errors=args.maxErrors,
     )
     obs = ObsSession.from_args("update-qc", args, {
         "file": args.fileName, "store": args.storeDir,

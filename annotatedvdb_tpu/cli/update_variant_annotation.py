@@ -48,6 +48,8 @@ def main(argv=None) -> int:
 
     store = VariantStore.load(args.storeDir)
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
+    from annotatedvdb_tpu.config import quarantine_from_args
+
     loader = TpuTextLoader(
         store, ledger,
         variant_id_type=args.variantIdType,
@@ -56,6 +58,10 @@ def main(argv=None) -> int:
         skip_existing=args.skipExisting,
         log=log,
         log_after=effective_log_after(args.logAfter, 1 << 15),
+        quarantine=quarantine_from_args(
+            args, args.storeDir, "update-variant-annotation", log=log
+        ),
+        max_errors=args.maxErrors,
     )
     obs = ObsSession.from_args("update-variant-annotation", args, {
         "file": args.fileName, "store": args.storeDir,

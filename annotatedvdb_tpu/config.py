@@ -131,6 +131,27 @@ def add_lifecycle_args(parser: argparse.ArgumentParser) -> None:
                              "(default: the batch size; 0 disables)")
     parser.add_argument("--logFilePath", default=None,
                         help="log file (default: beside the input)")
+    parser.add_argument("--maxErrors", type=int, default=-1, metavar="N",
+                        help="abort once more than N input rows have been "
+                             "rejected to the quarantine sink "
+                             "(<store>/quarantine/<input>.rejects.jsonl); "
+                             "default -1 = tolerate and quarantine all")
+
+
+def quarantine_from_args(args, store_dir: str, loader_name: str,
+                         input_path: str | None = None, log=None):
+    """Build the per-load quarantine sink (``utils.quarantine``) shared by
+    every loader CLI: rejects land replayably under ``<store>/quarantine/``
+    and count against ``--maxErrors``."""
+    from annotatedvdb_tpu.utils.quarantine import ErrorBudget, QuarantineSink
+
+    input_path = input_path or getattr(args, "fileName", None)
+    if not input_path or not store_dir:
+        return None
+    return QuarantineSink(
+        store_dir, input_path, loader_name,
+        budget=ErrorBudget(getattr(args, "maxErrors", -1)), log=log,
+    )
 
 
 def effective_log_after(log_after: int | None, default: int) -> int | None:

@@ -258,10 +258,19 @@ class CaddFileReader:
     next block.  Blocks also never span a chromosome change.
     """
 
-    def __init__(self, path: str, width: int, block_rows: int = 1 << 18):
+    def __init__(self, path: str, width: int, block_rows: int = 1 << 18,
+                 on_reject=None, engine: str = "auto"):
         self.path = path
         self.width = width
         self.block_rows = block_rows
+        #: ``on_reject(line_no, raw_line, reason)`` for malformed score rows
+        #: — the quarantine hook.  Only the Python scanner sees line
+        #: content; callers that need ENFORCED error accounting (an armed
+        #: ``--maxErrors`` budget) pass ``engine="python"``.
+        self.on_reject = on_reject
+        if engine not in ("auto", "python", "native"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.engine = engine
 
     def blocks_all(self) -> Iterator[tuple[int, "CaddBlock"]]:
         """One sequential pass over the whole table, yielding
@@ -274,7 +283,8 @@ class CaddFileReader:
         (``tests/test_cadd.py::test_native_cadd_blocks_parity``)."""
         import os as _os
 
-        if _os.environ.get("AVDB_NATIVE_CADD", "1") != "0":
+        if (self.engine != "python"
+                and _os.environ.get("AVDB_NATIVE_CADD", "1") != "0"):
             from annotatedvdb_tpu.native import cadd as native_cadd
 
             if native_cadd.available():
@@ -285,22 +295,35 @@ class CaddFileReader:
     def _blocks_all_python(self) -> Iterator[tuple[int, "CaddBlock"]]:
         rows: list[tuple[int, str, str, float, float]] = []
         current_code = None
+        reject = self.on_reject
         with _open_text(self.path) as fh:
-            for line in fh:
+            for line_no, line in enumerate(fh, start=1):
                 if line.startswith("#"):
                     continue
                 fields = line.rstrip("\n").split("\t")
                 if len(fields) < 6:
+                    if reject is not None and line.strip():
+                        reject(line_no, line.rstrip("\n"),
+                               "malformed CADD row (needs 6 tab-separated "
+                               "fields: chrom pos ref alt raw phred)")
                     continue
                 code = chromosome_code(fields[0])
                 if code == 0:
-                    continue
+                    continue  # non-standard contig: policy skip, not an error
                 try:
                     row = (int(fields[1]), fields[2], fields[3],
                            float(fields[4]), float(fields[5]))
                 except ValueError:
-                    continue  # malformed numerics: skip, like the tokenizer
+                    # malformed numerics: skip, like the tokenizer
+                    if reject is not None:
+                        reject(line_no, line.rstrip("\n"),
+                               "malformed CADD row (non-numeric pos/score)")
+                    continue
                 if not 0 < row[0] <= 0x7FFFFFFF or not fields[2] or not fields[3]:
+                    if reject is not None:
+                        reject(line_no, line.rstrip("\n"),
+                               "malformed CADD row (position out of range "
+                               "or empty allele)")
                     continue
                 if code != current_code:
                     if rows:

@@ -148,28 +148,50 @@ def export_store(store: VariantStore, out_dir: str,
         with open(os.path.join(schema_dir, f"{name}.sql"), "w") as f:
             f.write(sql)
 
+    from annotatedvdb_tpu.utils import faults
+    from annotatedvdb_tpu.utils.retry import is_transient_io, with_backoff
+
+    def _write_copy(fname: str, row_iter_factory) -> None:
+        """One COPY file, written tmp+rename with bounded retry: a
+        transient I/O error (NFS blip, EIO) re-generates and re-writes the
+        whole file — the row iterators are pure functions of the store, so
+        the retry is idempotent — and a torn write can never be mistaken
+        for a complete COPY stream by the psql replay."""
+        target = os.path.join(data_dir, fname)
+        tmp = os.path.join(data_dir, f".{fname}.tmp{os.getpid()}")
+
+        def attempt():
+            with open(tmp, "w") as f:
+                for values in row_iter_factory():
+                    f.write("\t".join(pg_escape(v) for v in values) + "\n")
+                # crash/transient point: per COPY-file flush (the eio
+                # action exercises exactly this retry path)
+                faults.fire("egress.flush", f)
+                f.flush()
+            os.replace(tmp, target)
+
+        with_backoff(attempt, retryable=is_transient_io,
+                     what=f"egress write of {fname}")
+
     counts: dict[str, int] = {}
     copy_files = []
     for code in sorted(store.shards):
         shard = store.shards[code]
         label = chromosome_label(code)
         fname = f"variant_chr{label}.copy"
-        with open(os.path.join(data_dir, fname), "w") as f:
-            for values in shard_rows(shard):
-                f.write("\t".join(pg_escape(v) for v in values) + "\n")
+        _write_copy(fname, lambda shard=shard: shard_rows(shard))
         counts[label] = shard.n
         copy_files.append(fname)
 
     inv_file = None
     if ledger is not None:
         inv_file = "algorithm_invocation.copy"
-        with open(os.path.join(data_dir, inv_file), "w") as f:
-            for inv in ledger.invocations():
-                f.write("\t".join(pg_escape(v) for v in (
-                    inv["alg_id"], inv.get("script"),
-                    json.dumps(inv.get("params", {})),
-                    bool(inv.get("commit_mode")),
-                )) + "\n")
+        _write_copy(inv_file, lambda: (
+            (inv["alg_id"], inv.get("script"),
+             json.dumps(inv.get("params", {})),
+             bool(inv.get("commit_mode")))
+            for inv in ledger.invocations()
+        ))
 
     cols = ", ".join(VARIANT_COPY_COLUMNS)
     with open(os.path.join(out_dir, "load.sql"), "w") as f:

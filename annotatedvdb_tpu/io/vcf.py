@@ -269,7 +269,8 @@ class VcfBatchReader:
 
     def __init__(self, path: str, batch_size: int = 1 << 16, width: int = 49,
                  chromosome_map: dict | None = None, identity_only: bool = False,
-                 engine: str = "auto", pack_alleles: bool = True):
+                 engine: str = "auto", pack_alleles: bool = True,
+                 on_reject=None):
         self.path = path
         self.batch_size = batch_size
         self.width = width
@@ -279,9 +280,27 @@ class VcfBatchReader:
         #: consumers that never upload (mesh-path loads, export scans)
         #: turn this off to skip the per-byte pack work
         self.pack_alleles = pack_alleles
+        #: ``on_reject(line_no, raw_line, reason)`` for malformed lines —
+        #: the quarantine hook.  Only the Python scanner sees line content
+        #: (the native tokenizer reports counts, not spans); loaders check
+        #: :meth:`rejects_captured` and budget-count from the chunk's
+        #: malformed counter when content capture is unavailable.
+        self.on_reject = on_reject
+        if engine == "auto":
+            # AVDB_INGEST_ENGINE pins the scanner globally — chiefly
+            # `python` for quarantine runs that must capture the CONTENT
+            # of malformed lines (the native tokenizer only counts them)
+            import os
+
+            engine = os.environ.get("AVDB_INGEST_ENGINE", "auto")
         if engine not in ("auto", "python", "native"):
             raise ValueError(f"unknown engine {engine!r}")
         self.engine = engine
+
+    @property
+    def rejects_captured(self) -> bool:
+        """Whether malformed lines will reach ``on_reject`` with content."""
+        return self.on_reject is not None and not self._use_native()
 
     def _use_native(self) -> bool:
         if self.engine == "python":
@@ -304,15 +323,23 @@ class VcfBatchReader:
         return False
 
     def __iter__(self) -> Iterator[VcfChunk]:
+        from annotatedvdb_tpu.utils import faults
+
         if self._use_native():
             from annotatedvdb_tpu.native.vcf import iter_native_chunks
 
-            yield from iter_native_chunks(
+            chunks = iter_native_chunks(
                 self.path, self.batch_size, self.width, self.identity_only,
                 self.pack_alleles
             )
-            return
-        yield from self._iter_python()
+        else:
+            chunks = self._iter_python()
+        for chunk in chunks:
+            # crash point: per parsed chunk, engine-independent (fires on
+            # the ingest thread under the overlapped pipeline, so an
+            # injected raise also exercises the cross-thread error path)
+            faults.fire("ingest.chunk")
+            yield chunk
 
     def iter_prefetched(self, depth: int = 2, timer=None):
         """Chunk iterator with the scan on a background ingest thread.
@@ -359,6 +386,12 @@ class VcfBatchReader:
                         or int(fields[1]) > 0x7FFFFFFF):
                     counters["line"] += 1
                     counters["malformed"] += 1
+                    if self.on_reject is not None:
+                        self.on_reject(
+                            line_no, line.rstrip("\r\n"),
+                            "malformed VCF line (needs >=5 tab-separated "
+                            "fields with an in-range integer POS)",
+                        )
                     continue
                 chrom_str, pos_str, vid, ref, alt_str = fields[:5]
                 if self.chromosome_map is not None:

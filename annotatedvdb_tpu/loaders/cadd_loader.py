@@ -86,6 +86,8 @@ class TpuCaddUpdater:
         skip_existing: bool = True,
         log=print,
         mesh=None,
+        quarantine=None,
+        max_errors: int = -1,
     ):
         """``mesh``: optional multi-device :class:`jax.sharding.Mesh`; the
         sequential table pass then resolves score rows against the store
@@ -109,6 +111,22 @@ class TpuCaddUpdater:
         self.obs = None
         self.counters = {"snv": 0, "indel": 0, "not_matched": 0,
                          "skipped": 0, "update": 0}
+        from annotatedvdb_tpu.utils.quarantine import ErrorBudget
+
+        # quarantine sink + --maxErrors budget for malformed score rows
+        # (Python scanner captures content; see CaddFileReader.on_reject)
+        self.quarantine = quarantine
+        self._budget = (
+            quarantine.budget if quarantine is not None
+            else ErrorBudget(max_errors)
+        )
+
+    def _reject(self, line_no, raw, reason) -> None:
+        self.counters["rejected"] = self.counters.get("rejected", 0) + 1
+        if self.quarantine is not None:
+            self.quarantine.reject(line_no, raw, reason)
+        else:
+            self._budget.add(1, context=f"line {line_no}: {reason}")
 
     #: metric label / run-ledger script name (obs.ObsSession)
     obs_name = "load-cadd"
@@ -208,7 +226,19 @@ class TpuCaddUpdater:
                         states[code] = _ChromState(sel, self.store.shard(code))
                 if not states or not os.path.exists(path):
                     continue
-                reader = CaddFileReader(path, width=self.store.width)
+                reader = CaddFileReader(
+                    path, width=self.store.width,
+                    # both tables share one sink: the table name rides the
+                    # reason so a replayed rejects file is attributable
+                    on_reject=lambda ln, raw, why, _t=os.path.basename(path):
+                        self._reject(ln, raw, f"{_t}: {why}"),
+                    # an armed --maxErrors budget needs per-line accounting
+                    # the native tokenizer cannot provide: pin the Python
+                    # scanner (slower, but the user asked for enforcement)
+                    engine=(
+                        "python" if self._budget.max_errors >= 0 else "auto"
+                    ),
+                )
                 stop = False
                 blocks = iter(reader.blocks_all())
                 while True:

@@ -115,9 +115,22 @@ class TpuTextLoader:
         batch_size: int = 1 << 15,
         log=print,
         log_after: int | None = None,
+        quarantine=None,
+        max_errors: int = -1,
     ):
         if variant_id_type not in VARIANT_ID_TYPES:
             raise ValueError(f"variant_id_type must be one of {VARIANT_ID_TYPES}")
+        from annotatedvdb_tpu.utils.quarantine import ErrorBudget
+
+        # quarantine sink + --maxErrors budget (utils.quarantine); the
+        # sink's meta header is bound once the TSV header is read, so a
+        # replayed rejects file reconstructs a loadable TSV
+        self.quarantine = quarantine
+        self._budget = (
+            quarantine.budget if quarantine is not None
+            else ErrorBudget(max_errors)
+        )
+        self._fieldnames: list[str] | None = None
         self.store = store
         self.ledger = ledger
         self.variant_id_type = variant_id_type
@@ -188,6 +201,9 @@ class TpuTextLoader:
             self.update_fields = [
                 f for f in reader.fieldnames if f in UPDATABLE_FIELDS
             ]
+            self._fieldnames = list(reader.fieldnames)
+            if self.quarantine is not None:
+                self.quarantine.set_header("\t".join(self._fieldnames))
             pending: list[tuple[int, dict]] = []
             for line_no, row in enumerate(reader, start=2):  # 1 = header
                 self.counters["line"] += 1
@@ -213,19 +229,43 @@ class TpuTextLoader:
 
     # ------------------------------------------------------------------
 
+    def _raw_line(self, row: dict) -> str:
+        """Reconstruct the TSV line for quarantine (DictReader consumed the
+        original text; tab-joining the cells in header order round-trips
+        everything the loader can act on)."""
+        fields = self._fieldnames or list(row.keys())
+        return "\t".join(
+            "" if row.get(f) is None else str(row.get(f)) for f in fields
+        )
+
+    def _reject(self, line_no: int, row: dict, reason: str) -> None:
+        self.counters["rejected"] = self.counters.get("rejected", 0) + 1
+        self.counters["skipped"] += 1
+        self.log(f"line {line_no}: {reason}; quarantined")
+        if self.quarantine is not None:
+            self.quarantine.reject(line_no, self._raw_line(row), reason)
+        else:
+            self._budget.add(1, context=f"line {line_no}: {reason}")
+
     def _apply_batch(self, pending: list, alg_id: int, commit: bool) -> None:
-        parsed = []  # (line_no, row, code, pos, ref, alt, rs)
+        parsed = []  # (line_no, row, code, pos, ref, alt, rs, coerced)
         for line_no, row in pending:
             self.counters["variant"] += 1
             try:
                 code, pos, ref, alt, rs = parse_variant_id(
                     row["variant"], self.variant_id_type
                 )
+                # coerce every update cell UP FRONT: a bad JSON cell then
+                # quarantines this one row instead of aborting the load
+                # mid-way through a half-applied store update
+                coerced = {
+                    f: coerce_update_value(f, row.get(f))
+                    for f in self.update_fields
+                }
             except ValueError as err:
-                self.log(f"line {line_no}: {err}; skipping")
-                self.counters["skipped"] += 1
+                self._reject(line_no, row, str(err))
                 continue
-            parsed.append((line_no, row, code, pos, ref, alt, rs))
+            parsed.append((line_no, row, code, pos, ref, alt, rs, coerced))
 
         # REFSNP ids resolve in one np.isin pass per shard, allele-form ids
         # in one vectorized shard.lookup per chromosome — never per row
@@ -253,7 +293,7 @@ class TpuTextLoader:
             if self.skip_existing or not self.update_existing:
                 self.counters["skipped"] += 1
                 continue
-            self._apply_update(found_at, entry[1], alg_id, commit)
+            self._apply_update(found_at, entry[7], alg_id, commit)
 
         if novel:
             self._insert_novel(novel, alg_id, commit)
@@ -294,7 +334,7 @@ class TpuTextLoader:
     def _lookup_entry(self, j: int, entry, rs_index: dict | None,
                       meta_index: dict | None, digest_cache: dict | None = None):
         """Locate one batch entry in the store; returns (shard, row) or None."""
-        _, _, code, pos, ref, _, rs = entry
+        _, _, code, pos, ref, _, rs = entry[:7]
         if self.variant_id_type == "REFSNP":
             return rs_index.get(_rs_number(rs)) if rs_index else None
         if ref is not None:
@@ -322,8 +362,11 @@ class TpuTextLoader:
                 return shard, i
         return None
 
-    def _apply_update(self, found_at, row: dict, alg_id: int, commit: bool,
-                      count: bool = True):
+    def _apply_update(self, found_at, coerced: dict, alg_id: int,
+                      commit: bool, count: bool = True):
+        """Apply one row's PRE-COERCED update values (coercion — and its
+        failure mode — happens in ``_apply_batch``, before any store
+        mutation)."""
         shard, i = found_at
         if count:
             self.counters["update"] += 1
@@ -331,7 +374,7 @@ class TpuTextLoader:
             return
         one = np.array([i])
         for f in self.update_fields:
-            value = coerce_update_value(f, row.get(f))
+            value = coerced.get(f)
             if value is None:
                 continue
             if f in JSONB_COLUMNS:
@@ -362,7 +405,7 @@ class TpuTextLoader:
         for j, entry in enumerate(novel):
             found_at = meta_index.get(j)
             if found_at is not None:
-                self._apply_update(found_at, entry[1], alg_id, commit, count=False)
+                self._apply_update(found_at, entry[7], alg_id, commit, count=False)
 
 
 def _chunk_from_rows(novel: list, width: int) -> VcfChunk:

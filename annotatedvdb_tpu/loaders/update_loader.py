@@ -94,6 +94,8 @@ class TpuUpdateLoader:
         log=print,
         log_after: int | None = None,
         insert_loader: TpuVcfLoader | None = None,
+        quarantine=None,
+        max_errors: int = -1,
     ):
         self.store = store
         self.ledger = ledger
@@ -101,6 +103,14 @@ class TpuUpdateLoader:
         self.batch_size = batch_size
         self.chromosome_map = chromosome_map
         self.log = log
+        from annotatedvdb_tpu.utils.quarantine import ErrorBudget
+
+        # quarantine sink + --maxErrors budget (utils.quarantine)
+        self.quarantine = quarantine
+        self._budget = (
+            quarantine.budget if quarantine is not None
+            else ErrorBudget(max_errors)
+        )
         from annotatedvdb_tpu.utils.logging import ProgressCadence
         from annotatedvdb_tpu.utils.profiling import StageTimer
 
@@ -137,11 +147,22 @@ class TpuUpdateLoader:
             # membership caches where the link makes that a win (no-op on
             # slow links / CPU backends)
             self.store.pin_for_updates()
+        def _reject(line_no, raw, reason):
+            # counted BEFORE the budget check so an abort still reports the
+            # row that tripped it (this loader is single-threaded)
+            self.counters["rejected"] = self.counters.get("rejected", 0) + 1
+            if self.quarantine is not None:
+                self.quarantine.reject(line_no, raw, reason)
+            else:
+                self._budget.add(1, context=f"line {line_no}: {reason}")
+
         reader = VcfBatchReader(
             path, batch_size=self.batch_size, width=self.store.width,
             chromosome_map=self.chromosome_map,
             pack_alleles=False,  # update path never uploads allele matrices
+            on_reject=_reject,
         )
+        captured = reader.rejects_captured
         with self.timer.wall():
             chunks = iter(reader)
             while True:
@@ -150,10 +171,22 @@ class TpuUpdateLoader:
                 if chunk is None:
                     break
                 self.counters["line"] += chunk.counters.get("line", 0)
+                mal = chunk.counters.get("malformed", 0)
                 self.counters["malformed"] = (
-                    self.counters.get("malformed", 0)
-                    + chunk.counters.get("malformed", 0)
+                    self.counters.get("malformed", 0) + mal
                 )
+                if mal and not captured:
+                    # native tokenizer: counts only — budget-check here
+                    self.counters["rejected"] = (
+                        self.counters.get("rejected", 0) + mal
+                    )
+                    if self.quarantine is not None:
+                        self.quarantine.reject_uncaptured(
+                            mal, "malformed VCF line(s); re-run with "
+                            "AVDB_INGEST_ENGINE=python to quarantine them",
+                        )
+                    else:
+                        self._budget.add(mal, context="malformed VCF lines")
                 if chunk.batch.n == 0:  # trailing counters-only chunk
                     continue
                 # chunks fully covered by a previous committed checkpoint

@@ -136,6 +136,8 @@ class TpuVcfLoader:
         store_display_attributes: bool = False,
         log=print,
         log_after: int | None = None,
+        quarantine=None,
+        max_errors: int = -1,
     ):
         """``genome``: optional
         :class:`~annotatedvdb_tpu.genome.ReferenceGenome`; enables batched
@@ -214,9 +216,37 @@ class TpuVcfLoader:
         #: optional :class:`annotatedvdb_tpu.obs.metrics.LoadObserver`
         #: (chunk-granularity metrics; set by ``ObsSession.attach``)
         self.obs = None
+        # quarantine sink + error budget (utils.quarantine): malformed
+        # input lines are preserved replayably and counted against
+        # --maxErrors; the sink's budget is authoritative when present
+        from annotatedvdb_tpu.utils.quarantine import ErrorBudget
+
+        self.quarantine = quarantine
+        self._budget = (
+            quarantine.budget if quarantine is not None
+            else ErrorBudget(max_errors)
+        )
+        self._rejects_captured = False
 
     #: metric/run-ledger label for this loader family
     obs_name = "load-vcf"
+
+    def _reject(self, line_no, raw, reason) -> None:
+        """Quarantine one rejected input line (may run on the ingest
+        thread; the sink and budget are thread-safe).  Raises
+        ErrorBudgetExceeded past --maxErrors."""
+        if self.quarantine is not None:
+            self.quarantine.reject(line_no, raw, reason)
+        else:
+            self._budget.add(1, context=f"line {line_no}: {reason}")
+
+    def _reject_uncaptured(self, n: int, reason: str) -> None:
+        if n <= 0:
+            return
+        if self.quarantine is not None:
+            self.quarantine.reject_uncaptured(n, reason)
+        else:
+            self._budget.add(n, context=reason)
 
     def _stall_rec(self, name: str) -> dict:
         return self.queue_stalls.setdefault(name, {
@@ -311,7 +341,12 @@ class TpuVcfLoader:
                 # backends packing saves no transfer; skip the tokenizer's
                 # pack work in both cases
                 pack_alleles=self.mesh is None and transport_wanted(),
+                on_reject=self._reject,
             )
+            # content-capturing rejects reach _reject directly (python
+            # scanner); native-engine loads budget-count from the chunk
+            # malformed counters instead (_entry_from_chunk)
+            self._rejects_captured = reader.rejects_captured
             with self.timer.wall():
                 if overlapped:
                     self._run_overlapped(reader, ctx)
@@ -325,6 +360,10 @@ class TpuVcfLoader:
                 self.counters["line"], self.counters, self.timer.summary()
             )
         finally:
+            if self._budget.count:
+                # rejected-row total (captured + uncaptured) — recorded on
+                # success AND abort so the run ledger always witnesses it
+                self.counters["rejected"] = self._budget.count
             try:
                 # earlier chunks' queued commits land even when a later
                 # chunk raised (failAt semantics: everything before the
@@ -417,6 +456,18 @@ class TpuVcfLoader:
             # stall table (the close()s above settled both stage threads)
             self._merge_stage_stats("ingest", ingest.stats)
             self._merge_stage_stats("dispatch", dispatch.stats)
+            # a stage error whose envelope never reached this consumer
+            # (dropped by the close) is the abort's ROOT CAUSE — log it
+            # unless it is the very exception already propagating
+            import sys as _sys
+
+            propagating = _sys.exc_info()[1]
+            for _name, _st in (("ingest", ingest), ("dispatch", dispatch)):
+                if _st.error is not None and _st.error is not propagating:
+                    self.log(
+                        f"pipeline {_name} stage failed during teardown: "
+                        f"{_st.error!r}"
+                    )
 
     def _entry_from_chunk(self, chunk: VcfChunk, resume_line: int) -> tuple:
         """Ingest-side accounting for one chunk: the counter delta that
@@ -431,6 +482,15 @@ class TpuVcfLoader:
             ),
             "malformed": chunk.counters.get("malformed", 0),
         }
+        if delta["malformed"] and not self._rejects_captured:
+            # native tokenizer: malformed lines were counted without
+            # content — budget-check them here (raising past --maxErrors
+            # travels the pipeline to the consumer like any stage error)
+            self._reject_uncaptured(
+                delta["malformed"],
+                "malformed VCF line(s); native engine captured no content "
+                "— re-run with AVDB_INGEST_ENGINE=python to quarantine them",
+            )
         needs_dispatch = True
         if chunk.batch.n == 0:
             needs_dispatch = False  # trailing counters-only chunk
@@ -713,8 +773,6 @@ class TpuVcfLoader:
                 padded.ref, padded.alt, padded.ref_len, padded.alt_len
             )
             return {"ann_p": ann_p, "h_dev": h_dev}
-        import jax
-
         from annotatedvdb_tpu.ops.pack import (
             encode_alleles_nibble,
             inflate_alleles_jit,
@@ -774,14 +832,19 @@ class TpuVcfLoader:
             enc = None  # reader's scan already found exotic bytes
         else:
             enc = encode_alleles_nibble(*pad_alleles(width))
+        # uploads ride the bounded-retry wrapper: a transient tunnel/
+        # runtime blip on a remote-attached device re-sends the buffer
+        # instead of killing a multi-hour load (utils.retry)
+        from annotatedvdb_tpu.utils.retry import device_put as _dput
+
         if enc is not None:
             ref_dev, alt_dev = inflate_alleles_jit(
-                jax.device_put(enc[0]), jax.device_put(enc[1]), width,
+                _dput(enc[0]), _dput(enc[1]), width,
             )
             dev = (
-                jax.device_put(chrom_p), jax.device_put(pos_p),
+                _dput(chrom_p), _dput(pos_p),
                 ref_dev, alt_dev,
-                jax.device_put(rl_p), jax.device_put(al_p),
+                _dput(rl_p), _dput(al_p),
             )
         else:
             # width bucketing: annotate compute (and upload bytes) scale
@@ -801,9 +864,9 @@ class TpuVcfLoader:
                     w = wb
             ref_p, alt_p = pad_alleles(w)
             dev = (
-                jax.device_put(chrom_p), jax.device_put(pos_p),
-                jax.device_put(ref_p), jax.device_put(alt_p),
-                jax.device_put(rl_p), jax.device_put(al_p),
+                _dput(chrom_p), _dput(pos_p),
+                _dput(ref_p), _dput(alt_p),
+                _dput(rl_p), _dput(al_p),
             )
         ann_p = annotate_fn()(*dev)
         # the packed transport needs the device hash (folded into its

@@ -94,6 +94,8 @@ class TpuVepLoader:
         log=print,
         log_after: int | None = None,
         mesh=None,
+        quarantine=None,
+        max_errors: int = -1,
     ):
         """``mesh``: optional multi-device :class:`jax.sharding.Mesh`; the
         per-chunk identity resolution then runs as ONE sharded program
@@ -121,10 +123,32 @@ class TpuVepLoader:
         self.obs = None
         self._blob: bytes | None = None      # native rank-table serialization
         self._blob_version = -1
+        from annotatedvdb_tpu.utils.quarantine import ErrorBudget
+
+        # quarantine sink + --maxErrors budget: malformed JSON lines and
+        # structurally broken result docs are preserved replayably instead
+        # of killing the whole-batch decode (utils.quarantine)
+        self.quarantine = quarantine
+        self._budget = (
+            quarantine.budget if quarantine is not None
+            else ErrorBudget(max_errors)
+        )
         self.counters = {
             "line": 0, "variant": 0, "skipped": 0, "duplicates": 0,
             "update": 0, "not_found": 0,
         }
+
+    def _reject(self, raw, reason: str) -> None:
+        """Quarantine one rejected VEP result line (line numbers are not
+        tracked through the block reader; the raw line is what replay
+        needs).  Raises ErrorBudgetExceeded past --maxErrors."""
+        self.counters["rejected"] = self.counters.get("rejected", 0) + 1
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8", "replace")
+        if self.quarantine is not None:
+            self.quarantine.reject(None, raw, reason)
+        else:
+            self._budget.add(1, context=reason)
 
     def _ranking_blob(self) -> bytes:
         """Serialized rank table for the native transformer, refreshed when
@@ -217,17 +241,52 @@ class TpuVepLoader:
             # ONE json.loads over the whole flush (lines joined into a JSON
             # array) — the C decoder amortizes per-call setup and allocator
             # churn across the batch, ~2x a per-line loads loop
-            raw = json.loads(b"[" + b",".join(batch_lines) + b"]")
+            try:
+                raw = json.loads(b"[" + b",".join(batch_lines) + b"]")
+            except ValueError:
+                raw = None
+            if raw is not None and len(raw) == len(batch_lines):
+                pairs = list(zip(raw, batch_lines))
+            else:
+                # a malformed line poisons the whole-batch decode, and a
+                # line carrying several comma-joined docs desyncs the
+                # doc<->line pairing: fall back per line so only bad lines
+                # quarantine (under --maxErrors), every good doc still
+                # loads, and each doc is attributed to its OWN line
+                pairs = []
+                for ln in batch_lines:
+                    try:
+                        pairs.append((json.loads(ln), ln))
+                    except ValueError:
+                        try:
+                            docs_on_line = json.loads(b"[" + ln + b"]")
+                        except ValueError as err:
+                            self._reject(ln, f"invalid VEP JSON: {err}")
+                            continue
+                        pairs.extend((d, ln) for d in docs_on_line)
+            docs = []
+            for ann, ln in pairs:
+                if isinstance(ann, dict):
+                    docs.append((ann, ln))
+                else:
+                    self._reject(
+                        ln, "VEP result line is not a JSON object"
+                    )
             # batched combo->rank resolution through the compiled rank-table
             # snapshot first (device path for large batches); the per-row
             # parse below then hits the memo, and only novel combos take the
             # host ranker's learn-on-miss path
-            self.parser.prefetch_ranks(raw)
+            self.parser.prefetch_ranks([d for d, _ in docs])
             pending: list[tuple] = []
             extend = pending.extend
             parse = self._parse_result
-            for ann in raw:
-                extend(parse(ann))
+            for ann, ln in docs:
+                try:
+                    extend(parse(ann))
+                except (KeyError, ValueError, TypeError, IndexError,
+                        AttributeError) as err:
+                    # structurally broken doc (missing 'input', bad POS...)
+                    self._reject(ln, f"unparseable VEP result: {err!r}")
             if pending:
                 self._apply_batch(pending, alg_id, commit)
 

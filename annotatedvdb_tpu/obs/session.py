@@ -173,6 +173,14 @@ class ObsSession:
         self._t0 = time.perf_counter()
         self._loader = None
         self._closed = False
+        # baselines for the process-global fault/retry tallies: exports
+        # report THIS session's delta, so two loads in one process never
+        # double-attribute each other's counts
+        from annotatedvdb_tpu.utils import faults as _faults
+        from annotatedvdb_tpu.utils import retry as _retry
+
+        self._faults_base = _faults.fired()
+        self._retry_base = dict(_retry.stats)
 
     @classmethod
     def from_args(cls, script: str, args, params: dict) -> "ObsSession":
@@ -222,6 +230,36 @@ class ObsSession:
             export_counters(self.registry, counters, name)
             export_stages(self.registry, stages or {}, wall, name)
             export_queue_stalls(self.registry, stalls, name)
+            # robustness surface: injected-fault fires, bounded-retry
+            # attempts, quarantined-row totals (the 'rejected' counter is
+            # already folded in via export_counters).  All deltas against
+            # the session baseline — the underlying tallies are
+            # process-global
+            from annotatedvdb_tpu.utils import faults as _faults
+            from annotatedvdb_tpu.utils import retry as _retry
+
+            for point, count in _faults.fired().items():
+                count -= self._faults_base.get(point, 0)
+                if count > 0:
+                    self.registry.counter(
+                        "avdb_faults_fired_total",
+                        "injected faults fired (AVDB_FAULT harness)",
+                        {"point": point},
+                    ).inc(count)
+            retries = _retry.stats["retries"] - self._retry_base["retries"]
+            if retries > 0:
+                self.registry.counter(
+                    "avdb_io_retries_total",
+                    "transient-failure retries (I/O + device transfers)",
+                    {"loader": name},
+                ).inc(retries)
+            gave_up = _retry.stats["gave_up"] - self._retry_base["gave_up"]
+            if gave_up > 0:
+                self.registry.counter(
+                    "avdb_io_retries_exhausted_total",
+                    "operations that failed after exhausting retries",
+                    {"loader": name},
+                ).inc(gave_up)
             if store is not None:
                 export_store_stats(self.registry, store)
             if self.metrics_out:

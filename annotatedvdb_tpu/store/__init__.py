@@ -1,4 +1,12 @@
-from .variant_store import VariantStore, ChromosomeShard, JSONB_COLUMNS
+from .variant_store import (
+    VariantStore,
+    ChromosomeShard,
+    JSONB_COLUMNS,
+    StoreCorruptError,
+)
 from .ledger import AlgorithmLedger
 
-__all__ = ["VariantStore", "ChromosomeShard", "JSONB_COLUMNS", "AlgorithmLedger"]
+__all__ = [
+    "VariantStore", "ChromosomeShard", "JSONB_COLUMNS", "AlgorithmLedger",
+    "StoreCorruptError",
+]

@@ -15,40 +15,58 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+from annotatedvdb_tpu.utils import faults
 
 
 class AlgorithmLedger:
-    def __init__(self, path: str):
+    def __init__(self, path: str, log=None):
         self.path = path
         self._entries: list[dict] = []
         self._heal_before_append = False
+        #: lines the open-scan could not parse (torn appends, garbage) —
+        #: read paths skipped them; fsck reports the count
+        self.skipped_lines = 0
+        log = log or (lambda msg: print(msg, file=sys.stderr))
         if os.path.exists(path):
             with open(path) as f:
                 lines = [line for line in f if line.strip()]
             for k, line in enumerate(lines):
                 try:
-                    self._entries.append(json.loads(line))
-                except json.JSONDecodeError:
-                    if k == len(lines) - 1:
-                        # torn FINAL line: the writer died mid-append, so
-                        # that checkpoint never became durable — resume
-                        # proceeds from the previous one (the store may run
-                        # ahead of the cursor; replay is idempotent).
-                        # Heal lazily at our first append — NOT here:
-                        # rewriting on open would let a concurrent
-                        # read-only opener clobber a line the live writer
-                        # is completing.
-                        self._heal_before_append = True
-                        break
-                    raise
+                    entry = json.loads(line)
+                    if not isinstance(entry, dict):
+                        raise ValueError("ledger entry is not an object")
+                except ValueError:
+                    # torn line: the writer died mid-append, so that record
+                    # never became durable — resume proceeds from the
+                    # previous checkpoint (the store may run ahead of the
+                    # cursor; replay is idempotent).  A NON-final torn line
+                    # (a crashed append later concatenated with a fresh
+                    # record, or byte damage) is skipped the same way: one
+                    # bad line must never poison runs()/last_checkpoint()
+                    # for the whole store.  Heal lazily at our first append
+                    # — NOT here: rewriting on open would let a concurrent
+                    # read-only opener clobber a line the live writer is
+                    # completing.
+                    self.skipped_lines += 1
+                    self._heal_before_append = True
+                    where = "torn trailing" if k == len(lines) - 1 else "torn"
+                    log(
+                        f"ledger {path}: skipping {where} line {k + 1} "
+                        f"({line[:80]!r}...)"
+                    )
+                    continue
+                self._entries.append(entry)
 
     def _append(self, entry: dict) -> None:
         self._entries.append(entry)
         if self._heal_before_append:
-            # drop the torn tail detected at open, atomically, now that
+            # drop the torn lines detected at open, atomically, now that
             # this process IS the writer.  Dot-prefixed tmp name so
             # VariantStore.save's orphan cleanup reaps it after a crash.
+            faults.fire("ledger.append")
             d, base = os.path.split(self.path)
             tmp = os.path.join(d, f".{base}.tmp{os.getpid()}")
             with open(tmp, "w") as out:
@@ -60,7 +78,13 @@ class AlgorithmLedger:
             self._heal_before_append = False
             return
         with open(self.path, "a") as f:
-            f.write(json.dumps(entry) + "\n")
+            line = json.dumps(entry) + "\n"
+            # crash point, BEFORE the write: raise/kill model a death in
+            # which this record never landed; torn_write writes half the
+            # record itself then kills (the classic torn-tail case the
+            # tolerant open-scan above recovers from)
+            faults.fire("ledger.append", f, payload=line)
+            f.write(line)
             from annotatedvdb_tpu.store.variant_store import _fsync_wanted
 
             if _fsync_wanted():
@@ -120,10 +144,32 @@ class AlgorithmLedger:
         """All run records, oldest first (the ops/audit read path)."""
         return [e for e in self._entries if e.get("type") == "run"]
 
+    def undo_intent(self, alg_id: int) -> None:
+        """Record that an undo of ``alg_id`` is ABOUT to mutate the store.
+
+        Appended BEFORE ``store.save()`` on the undo path: a crash between
+        the save and the final ``undo`` record then leaves an intent with no
+        completion — fsck flags it as "undo may be partially applied,
+        re-run ``undo_load --algId N --commit``" (idempotent: the delete
+        masks on ``row_algorithm_id``) instead of the store silently
+        disagreeing with the ledger.  Resume/undo read paths ignore intents."""
+        self._append(
+            {"type": "undo_intent", "alg_id": alg_id, "ts": time.time()}
+        )
+
     def undo(self, alg_id: int, removed: int) -> None:
         self._append(
             {"type": "undo", "alg_id": alg_id, "removed": removed, "ts": time.time()}
         )
+
+    def pending_undo_intents(self) -> list[int]:
+        """Alg ids with an ``undo_intent`` but no completing ``undo`` record
+        — the fsck cross-check for crashes mid-undo."""
+        done = {e["alg_id"] for e in self._entries if e.get("type") == "undo"}
+        return sorted({
+            e["alg_id"] for e in self._entries
+            if e.get("type") == "undo_intent" and e["alg_id"] not in done
+        })
 
     def last_checkpoint(self, input_file: str) -> int:
         """Resume cursor for an input file: the line of its most recently
@@ -179,3 +225,7 @@ class AlgorithmLedger:
 
     def invocations(self) -> list[dict]:
         return [e for e in self._entries if e.get("type") == "invocation"]
+
+    def entries(self) -> list[dict]:
+        """Every parsed record, oldest first (fsck's cross-check surface)."""
+        return list(self._entries)

@@ -38,12 +38,29 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Iterable
 
 import numpy as np
 
 from annotatedvdb_tpu.types import chromosome_label, decode_allele
+from annotatedvdb_tpu.utils import faults
 from annotatedvdb_tpu.utils.strings import deep_update
+
+
+class StoreCorruptError(ValueError):
+    """The on-disk store is internally inconsistent (torn/missing/mismatched
+    segment files, unreadable manifest).  The message always names
+    ``tools/store_fsck.py`` — the diagnosis/repair entry point — so an
+    operator hitting this at 3am knows the next command to run."""
+
+
+def _fsck_hint(path: str) -> str:
+    return (
+        f"run `python -m annotatedvdb_tpu doctor --storeDir {path}` "
+        "(tools/store_fsck.py) to diagnose, and add --repair to prune "
+        "orphans / roll back to the last consistent state"
+    )
 
 # The ten JSONB annotation columns of AnnotatedVDB.Variant
 # (createVariant.sql:4-24).
@@ -128,6 +145,52 @@ def _fsync_wanted() -> bool:
     """AVDB_FSYNC opt-in: full power-loss durability for segment data and
     rename metadata (see ``VariantStore.save``).  '0'/'false' disable."""
     return os.environ.get("AVDB_FSYNC", "").lower() not in ("", "0", "false")
+
+
+def _verify_mode() -> str:
+    """AVDB_VERIFY load-time integrity checking: ``size`` (default) checks
+    byte counts against the manifest's integrity records — free, catches
+    truncation; ``deep`` additionally checksums every segment file —
+    catches bit rot, costs one crc32 pass per load; ``off`` disables both
+    (forensic loads of known-damaged stores via fsck)."""
+    mode = os.environ.get("AVDB_VERIFY", "size").lower()
+    return mode if mode in ("off", "size", "deep") else "size"
+
+
+def crc32_file(path: str) -> int:
+    """Chunked crc32 of a whole file — the read-side twin of the write-time
+    integrity records (shared by load-time deep verify and fsck)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(1 << 20)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc
+
+
+class _CrcWriter:
+    """File-object wrapper accumulating crc32 + byte count over every write
+    — the integrity record is computed on the bytes ALREADY IN HAND on the
+    way to disk (one C-speed crc pass), never by re-reading the file (the
+    npz-era per-member crc re-reads were ~45% of persist CPU and were
+    removed for throughput; this must not reintroduce them)."""
+
+    __slots__ = ("_f", "crc", "nbytes")
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.nbytes = 0
+
+    def write(self, b):
+        self.crc = zlib.crc32(b, self.crc)
+        self.nbytes += len(b)
+        return self._f.write(b)
+
+    def __getattr__(self, name):  # flush/tell/truncate/fileno passthrough
+        return getattr(self._f, name)
 
 
 def _device_lookup_mode() -> str:
@@ -522,12 +585,11 @@ class Segment:
         and can't match a real query)."""
         if self._device is not None:
             return
-        import jax
-
         from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
+        from annotatedvdb_tpu.utils.retry import device_put
 
         self._device = tuple(
-            jax.device_put(x) for x in (
+            device_put(x) for x in (
                 pad_pow2(self.cols["pos"], POS_SENTINEL),
                 pad_pow2(self.cols["h"], 0),
                 pad_pow2(self.ref, 0), pad_pow2(self.alt, 0),
@@ -1017,6 +1079,11 @@ class VariantStore:
         self.width = width
         self.shards: dict[int, ChromosomeShard] = {}
         self._next_seg_id = 1
+        # per-stem write-time integrity records ({stem: {npz: {bytes, crc32},
+        # jsonl: {...}}}), carried in the manifest so load/fsck can detect
+        # torn or bit-rotted segment files; populated by _write_segment and
+        # inherited from the manifest on load (clean segments keep theirs)
+        self._integrity: dict[str, dict] = {}
         # identity of THIS store's on-disk lineage: save() only trusts
         # pre-existing segment files in a directory whose manifest carries
         # this uid — a same-stem file left by a DIFFERENT store must be
@@ -1117,7 +1184,9 @@ class VariantStore:
                     sid = self._next_seg_id
                     self._next_seg_id += 1
                     stems = [f"chr{label}.{sid:06d}"]
-                    self._write_segment(path, stems[0], seg)
+                    self._integrity[stems[0]] = self._write_segment(
+                        path, stems[0], seg
+                    )
                     seg.backing = [sid]
                     seg.dirty = False
                 for stem in stems:
@@ -1125,6 +1194,18 @@ class VariantStore:
                 groups.append(list(seg.backing))
             manifest["shards"][label] = groups
         manifest["next_seg_id"] = self._next_seg_id
+        # write-time integrity records for every LIVE segment file (size +
+        # crc32 of the exact bytes handed to the OS).  Stems with no record
+        # (clean segments inherited from a pre-integrity manifest) are
+        # simply absent — load skips their checks, the next rewrite records
+        # them.  Sorted for the deterministic-manifest invariant.
+        live_stems = sorted({
+            f[: -len(".npz")] for f in live_files if f.endswith(".npz")
+        })
+        manifest["integrity"] = {
+            stem: self._integrity[stem]
+            for stem in live_stems if stem in self._integrity
+        }
         # residency stats for ops tooling (the obs layer exports these as
         # avdb_store_rows gauges without loading any segment data).
         # DETERMINISTIC on store content only — no timestamps/host data:
@@ -1158,6 +1239,10 @@ class VariantStore:
         # (UNLOGGED tables are truncated by Postgres crash recovery,
         # createVariant.sql:4).
         fsync_data = _fsync_wanted()
+        # crash point: every segment of this checkpoint is on disk, the
+        # commit (manifest swap) has not happened — a death here must leave
+        # the PREVIOUS manifest fully consistent (new files are orphans)
+        faults.fire("store.save.pre_manifest")
         mtmp = os.path.join(path, f".manifest.tmp{os.getpid()}")
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
@@ -1179,9 +1264,14 @@ class VariantStore:
                     # orphaned tmp files from crashed saves (any pid)
                     or (fname.startswith(".") and ".tmp" in fname)):
                 os.remove(os.path.join(path, fname))
+        # drop integrity records for files the cleanup just removed
+        self._integrity = {
+            stem: rec for stem, rec in self._integrity.items()
+            if stem + ".npz" in live_files
+        }
 
     @staticmethod
-    def _write_segment(path: str, stem: str, seg: Segment) -> None:
+    def _write_segment(path: str, stem: str, seg: Segment) -> dict:
         # uncompressed: segments are rewritten on every cascade merge, and
         # deflate CPU dominates the persist stage at load throughput (the
         # reference's Postgres heap is uncompressed for the same reason).
@@ -1219,19 +1309,30 @@ class VariantStore:
             "ref": ref, "alt": alt,
             **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
         }
-        with open(tmp, "wb", buffering=1 << 20) as f:
+        with open(tmp, "wb", buffering=1 << 20) as raw_f:
+            # integrity record accumulates on the bytes in hand (see
+            # _CrcWriter) — no post-hoc re-read pass
+            f = _CrcWriter(raw_f)
             f.write(
                 (json.dumps({"seg": 1, "names": list(arrays)}) + "\n")
                 .encode()
             )
+            first = True
             for arr in arrays.values():
                 np.lib.format.write_array(f, arr, allow_pickle=False)
+                if first:
+                    # crash point: the container body is part-written (the
+                    # tmp file tears, the manifested store must not notice)
+                    faults.fire("store.save.mid_segment", raw_f)
+                    first = False
             if fsync_data:
                 f.flush()
                 os.fsync(f.fileno())
+        npz_rec = {"bytes": f.nbytes, "crc32": f.crc}
         os.replace(tmp, os.path.join(path, stem + ".npz"))
         atmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.ann.jsonl")
-        with open(atmp, "w") as f:
+        with open(atmp, "wb") as raw_f:
+            f = _CrcWriter(raw_f)
             present = [(c, seg.obj[c]) for c in OBJECT_COLUMNS
                        if seg.obj[c] is not None]
             for i in range(seg.n) if present else ():
@@ -1250,16 +1351,34 @@ class VariantStore:
                         parts.append(f'"{c}":{json.dumps(v)}')
                 if parts:
                     parts.append(f'"i":{i}')
-                    f.write("{" + ",".join(parts) + "}\n")
+                    f.write(("{" + ",".join(parts) + "}\n").encode())
             if fsync_data:
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(atmp, os.path.join(path, stem + ".ann.jsonl"))
+        return {"npz": npz_rec, "jsonl": {"bytes": f.nbytes, "crc32": f.crc}}
 
     @classmethod
     def load(cls, path: str) -> "VariantStore":
-        with open(os.path.join(path, "manifest.json")) as f:
-            manifest = json.load(f)
+        mpath = os.path.join(path, "manifest.json")
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"{mpath}: no store manifest — {path!r} is not a variant "
+                "store directory, or its first save never completed; "
+                + _fsck_hint(path)
+            ) from None
+        except (ValueError, OSError) as err:
+            raise StoreCorruptError(
+                f"{mpath}: unreadable store manifest ({err}); "
+                + _fsck_hint(path)
+            ) from err
+        if not isinstance(manifest, dict):
+            raise StoreCorruptError(
+                f"{mpath}: manifest is not a JSON object; " + _fsck_hint(path)
+            )
         fmt = manifest.get("format")
         if fmt not in (2, 3):
             raise ValueError(
@@ -1275,6 +1394,8 @@ class VariantStore:
             # predating store_uid keep the fresh uid — the first save into
             # their directory rewrites segments once, then records the uid.
             store._uid = uid
+        store._integrity = dict(manifest.get("integrity") or {})
+        verify = _verify_mode()
         from annotatedvdb_tpu.types import chromosome_code
 
         for label, groups in manifest["shards"].items():
@@ -1283,7 +1404,13 @@ class VariantStore:
             shard = store.shard(chromosome_code(label))
             for group in groups:
                 parts = [
-                    cls._read_segment(path, label, sid, store.width)
+                    cls._read_segment(
+                        path, label, sid, store.width,
+                        integrity=store._integrity.get(
+                            f"chr{label}.{sid:06d}"
+                        ),
+                        verify=verify,
+                    )
                     for sid in group
                 ]
                 # multi-way (concat for the common ascending-disjoint
@@ -1307,25 +1434,75 @@ class VariantStore:
         return store
 
     @staticmethod
-    def _read_segment(path: str, label: str, seg_id: int,
-                      width: int) -> Segment:
+    def _check_file(fp: str, rec: dict | None, verify: str,
+                    store_path: str) -> None:
+        """Integrity gate for one segment file: size check whenever a record
+        exists (free — one stat), full crc32 under ``AVDB_VERIFY=deep``."""
+        if rec is None or verify == "off":
+            return
+        try:
+            actual = os.path.getsize(fp)
+        except OSError as err:
+            raise StoreCorruptError(
+                f"{fp}: unreadable segment file ({err}); "
+                + _fsck_hint(store_path)
+            ) from err
+        if actual != rec["bytes"]:
+            raise StoreCorruptError(
+                f"{fp}: segment file is {actual} bytes, manifest integrity "
+                f"record says {rec['bytes']} (torn or truncated write); "
+                + _fsck_hint(store_path)
+            )
+        if verify == "deep":
+            crc = crc32_file(fp)
+            if crc != rec["crc32"]:
+                raise StoreCorruptError(
+                    f"{fp}: crc32 mismatch (stored {rec['crc32']:#010x}, "
+                    f"computed {crc:#010x}) — bit rot or partial overwrite; "
+                    + _fsck_hint(store_path)
+                )
+
+    @classmethod
+    def _read_segment(cls, path: str, label: str, seg_id: int,
+                      width: int, integrity: dict | None = None,
+                      verify: str = "size") -> Segment:
         stem = f"chr{label}.{seg_id:06d}"
         fp = os.path.join(path, stem + ".npz")
-        with open(fp, "rb") as f:
-            head = f.read(1)
-            if head == b"{":
-                # flat container (see _write_segment): JSON name line +
-                # sequential raw .npy streams
-                f.seek(0)
-                names = json.loads(f.readline())["names"]
-                data = {
-                    name: np.lib.format.read_array(f, allow_pickle=False)
-                    for name in names
-                }
-            else:  # legacy zip-backed npz from older builds
-                f.seek(0)
-                with np.load(f) as z:
-                    data = {name: z[name] for name in z.files}
+        ap = os.path.join(path, stem + ".ann.jsonl")
+        for p, key in ((fp, "npz"), (ap, "jsonl")):
+            if not os.path.exists(p):
+                raise StoreCorruptError(
+                    f"{p}: segment file referenced by the manifest is "
+                    f"missing; " + _fsck_hint(path)
+                )
+            cls._check_file(
+                p, (integrity or {}).get(key), verify, path
+            )
+        try:
+            with open(fp, "rb") as f:
+                head = f.read(1)
+                if head == b"{":
+                    # flat container (see _write_segment): JSON name line +
+                    # sequential raw .npy streams
+                    f.seek(0)
+                    names = json.loads(f.readline())["names"]
+                    data = {
+                        name: np.lib.format.read_array(f, allow_pickle=False)
+                        for name in names
+                    }
+                else:  # legacy zip-backed npz from older builds
+                    f.seek(0)
+                    with np.load(f) as z:
+                        data = {name: z[name] for name in z.files}
+        except StoreCorruptError:
+            raise
+        except Exception as err:
+            # a torn file with no integrity record (pre-integrity store)
+            # still must not surface as a bare numpy/zip parse error
+            raise StoreCorruptError(
+                f"{fp}: segment container failed to parse ({err}); "
+                + _fsck_hint(path)
+            ) from err
         cols = {name: data[name] for name, _ in _NUMERIC_COLUMNS}
         n = data["ref"].shape[0]
         ref, alt = data["ref"], data["alt"]
@@ -1339,10 +1516,16 @@ class VariantStore:
             full[:, :alt.shape[1]] = alt
             alt = full
         obj: dict = {c: None for c in OBJECT_COLUMNS}
-        with open(os.path.join(path, stem + ".ann.jsonl")) as f:
-            for line in f:
-                row = json.loads(line)
-                i = row.pop("i")
+        with open(ap) as f:
+            for k, line in enumerate(f, start=1):
+                try:
+                    row = json.loads(line)
+                    i = row.pop("i")
+                except (ValueError, KeyError) as err:
+                    raise StoreCorruptError(
+                        f"{ap}:{k}: unparseable annotation row ({err}); "
+                        + _fsck_hint(path)
+                    ) from err
                 for c, v in row.items():
                     if obj[c] is None:
                         obj[c] = np.full((n,), None, object)

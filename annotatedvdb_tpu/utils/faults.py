@@ -1,0 +1,149 @@
+"""Deterministic fault injection for crash/recovery testing.
+
+``AVDB_FAULT=<point>:<nth>[:<action>]`` arms exactly one named injection
+point: the <nth> time (1-based) that point is reached in this process, the
+action fires.  Unarmed processes pay one module-global ``is None`` check per
+point, so the points stay compiled into production code paths — the failure
+model is tested against the real code, not a test double.
+
+Actions:
+
+- ``raise``      raise :class:`InjectedFault` (default) — the in-process
+                 abort path (exception ordering, ledger witnessing)
+- ``kill``       SIGKILL the process: no ``finally``/atexit runs, the OS
+                 state is exactly what was durably written — a true crash
+- ``torn_write`` flush the in-flight file, truncate the CURRENT write
+                 session to half its bytes, then SIGKILL — simulates a torn
+                 page write (power loss mid-append)
+- ``eio``        raise ``OSError(EIO)`` — the transient-I/O error the
+                 bounded-retry paths (``utils.retry``) must absorb
+
+Points wired in this repo (grep ``faults.fire(`` for the live list):
+
+======================== ====================================================
+``store.save.pre_manifest`` just before the manifest tmp write — every
+                            segment of the checkpoint is on disk, the commit
+                            point has not happened
+``store.save.mid_segment``  mid-way through a segment container body (the
+                            tmp file is torn, the manifest still references
+                            only intact files)
+``ledger.append``           around one ledger JSONL append (torn_write tears
+                            the appended line, the classic torn-tail case)
+``egress.flush``            per COPY-file write in ``io.pg_egress``
+``ingest.chunk``            per parsed chunk handed to a loader (fires on
+                            the ingest thread under the overlapped pipeline)
+======================== ====================================================
+
+``fired()`` exposes per-point fire counts for the observability exports.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+
+_ACTIONS = ("raise", "kill", "torn_write", "eio")
+
+
+class InjectedFault(RuntimeError):
+    """The exception the ``raise`` action throws (never caught by library
+    code — it must propagate to the abort path like any real error)."""
+
+
+#: (point, nth, action) or None — parsed once from AVDB_FAULT; tests re-arm
+#: via :func:`reset` after mutating the environment.
+_ARMED: tuple[str, int, str] | None = None
+_SEEN: dict[str, int] = {}
+_FIRED: dict[str, int] = {}
+
+
+def _parse(spec: str | None) -> tuple[str, int, str] | None:
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise ValueError(
+            f"AVDB_FAULT={spec!r}: expected <point>:<nth>[:<action>]"
+        )
+    point = parts[0]
+    try:
+        nth = int(parts[1])
+    except ValueError:
+        raise ValueError(f"AVDB_FAULT={spec!r}: nth must be an integer") from None
+    if nth < 1:
+        raise ValueError(f"AVDB_FAULT={spec!r}: nth is 1-based (got {nth})")
+    action = parts[2] if len(parts) > 2 else "raise"
+    if action not in _ACTIONS:
+        raise ValueError(
+            f"AVDB_FAULT={spec!r}: unknown action {action!r} "
+            f"(one of {', '.join(_ACTIONS)})"
+        )
+    return point, nth, action
+
+
+def reset(spec: str | None = None) -> None:
+    """Re-arm from ``spec`` (or the current environment) and zero the hit
+    counters — the test-suite entry point for in-process fault runs."""
+    global _ARMED
+    _ARMED = _parse(
+        spec if spec is not None else os.environ.get("AVDB_FAULT")
+    )
+    _SEEN.clear()
+    _FIRED.clear()
+
+
+def armed_point() -> str | None:
+    """Name of the armed injection point, or None."""
+    return _ARMED[0] if _ARMED is not None else None
+
+
+def fired() -> dict[str, int]:
+    """{point: times an action actually fired} — the obs export surface.
+    (``kill``/``torn_write`` never return to report, but the ``raise``/
+    ``eio`` counts matter for retry/abort accounting.)"""
+    return dict(_FIRED)
+
+
+def fire(point: str, fileobj=None, tear_base: int = 0,
+         payload=None) -> None:
+    """One pass through the named injection point.
+
+    Placed BEFORE the guarded write, so ``raise``/``kill``/``eio`` model a
+    death in which the write never happened.  ``torn_write`` instead
+    simulates the write landing HALFWAY: with ``payload`` (the bytes/str
+    about to be written) it writes the first half itself then SIGKILLs;
+    without a payload it truncates the current write session back to
+    ``tear_base + (written - tear_base) // 2``.  Points with no file fall
+    back to a plain kill.
+    """
+    armed = _ARMED
+    if armed is None or armed[0] != point:
+        return
+    n = _SEEN[point] = _SEEN.get(point, 0) + 1
+    if n != armed[1]:
+        return
+    action = armed[2]
+    _FIRED[point] = _FIRED.get(point, 0) + 1
+    if action == "raise":
+        raise InjectedFault(f"injected fault at {point} (hit {n})")
+    if action == "eio":
+        raise OSError(errno.EIO, f"injected EIO at {point} (hit {n})")
+    if action == "torn_write" and fileobj is not None:
+        try:
+            if payload is not None:
+                fileobj.write(payload[: max(len(payload) // 2, 1)])
+            fileobj.flush()
+            if payload is None:
+                end = fileobj.tell()
+                cut = tear_base + max((end - tear_base) // 2, 0)
+                fileobj.truncate(cut)
+            os.fsync(fileobj.fileno())
+        except OSError:
+            pass  # the kill below is the point; a failed tear still crashes
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+# arm from the environment at import: loader CLIs run as subprocesses whose
+# AVDB_FAULT is set at spawn time
+reset()

@@ -84,6 +84,11 @@ class BoundedStage:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._done = False
+        #: first exception raised on the stage thread, preserved even when
+        #: its _StageError envelope never reaches the consumer (dropped by a
+        #: concurrent close(), or the thread died while the stop flag was
+        #: set) — abort paths report the root cause, not a generic teardown
+        self.error: BaseException | None = None
         #: backpressure accounting (always on: two clock reads per CHUNK)
         self.stats = StageStats(name)
         self._thread = threading.Thread(
@@ -138,6 +143,11 @@ class BoundedStage:
                     return
             self._put(_END)
         except BaseException as exc:  # re-raised at the consumer
+            # record BEFORE the put: if close() races us (stop set, the put
+            # returns False and the envelope is dropped), the root cause
+            # still survives on self.error
+            if self.error is None:
+                self.error = exc
             self._put(_StageError(exc))
 
     def __iter__(self):
@@ -166,9 +176,13 @@ class BoundedStage:
                         item = self._q.get(timeout=0.05)
                     except queue.Empty:
                         if not self._thread.is_alive():
-                            # producer gone without _END (closed/aborted
-                            # upstream)
+                            # producer gone without _END: closed upstream —
+                            # or CRASHED with its error envelope dropped.
+                            # Silently stopping would truncate the stream
+                            # and report success; surface the root cause
                             self._done = True
+                            if self.error is not None:
+                                raise self.error
                             raise StopIteration
                         continue
                     break
@@ -195,9 +209,14 @@ class BoundedStage:
         while True:
             while True:  # unblock a producer waiting on a full queue
                 try:
-                    self._q.get_nowait()
+                    item = self._q.get_nowait()
                 except queue.Empty:
                     break
+                # a drained item may be the stage's error envelope — keep
+                # the FIRST one on self.error instead of discarding it with
+                # the data items (abort paths read it for the root cause)
+                if isinstance(item, _StageError) and self.error is None:
+                    self.error = item.exc
             self._thread.join(timeout=0.25)
             if not self._thread.is_alive():
                 return True

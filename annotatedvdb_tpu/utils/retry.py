@@ -1,0 +1,88 @@
+"""Bounded retry-with-backoff for transient I/O and device transfers.
+
+Two failure families get the retry treatment (and ONLY these — data errors,
+logic errors, and injected ``raise`` faults must propagate unchanged):
+
+- transient filesystem errors (``EIO``/``EAGAIN``/``EBUSY``/``EINTR``/
+  ``ESTALE``) on the Postgres-egress COPY writers — NFS blips and overloaded
+  disks on the multi-hour export paths;
+- transient accelerator-runtime errors on host->device uploads (the
+  remote-attached-TPU tunnel drops a transfer under load: jaxlib surfaces
+  ``UNAVAILABLE``/``DEADLINE_EXCEEDED``/connection-reset strings; HBM OOM
+  — ``RESOURCE_EXHAUSTED`` — is deterministic and is NOT retried).
+
+Retries are bounded (default 3 attempts) with exponential backoff and are
+counted in :data:`stats` for the observability exports — a load that only
+succeeded through retries should say so in its metrics.
+"""
+
+from __future__ import annotations
+
+import errno
+import time
+
+#: errno values worth a retry: transient by nature, not data-dependent.
+TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.EAGAIN, errno.EBUSY, errno.EINTR, errno.ESTALE,
+})
+
+#: substrings of accelerator-runtime errors that indicate a transient
+#: transfer failure (grpc/XLA status names embedded in the message).
+#: RESOURCE_EXHAUSTED is deliberately ABSENT: on a device_put it means
+#: HBM OOM, which is deterministic — retrying the identical buffer only
+#: delays the abort and mislabels a capacity failure as a transient one.
+_TRANSIENT_DEVICE_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED",
+    "connection reset", "Socket closed",
+)
+
+#: cumulative retry accounting, exported as avdb_io_retries_total
+stats = {"retries": 0, "gave_up": 0}
+
+
+def is_transient_io(exc: BaseException) -> bool:
+    return isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS
+
+
+def is_transient_device(exc: BaseException) -> bool:
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return False
+    msg = str(exc)
+    return any(m in msg for m in _TRANSIENT_DEVICE_MARKERS)
+
+
+def with_backoff(fn, *, attempts: int = 3, base_delay: float = 0.05,
+                 max_delay: float = 2.0, retryable=is_transient_io,
+                 log=None, what: str = "operation"):
+    """Run ``fn()``; on a retryable exception, back off and re-run, at most
+    ``attempts`` times total.  Non-retryable exceptions and the final
+    retryable failure propagate unchanged."""
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except BaseException as exc:
+            if attempt >= attempts or not retryable(exc):
+                if attempt > 1:
+                    stats["gave_up"] += 1
+                raise
+            stats["retries"] += 1
+            delay = min(base_delay * (2 ** (attempt - 1)), max_delay)
+            if log is not None:
+                log(
+                    f"transient failure in {what} "
+                    f"(attempt {attempt}/{attempts}): {exc}; "
+                    f"retrying in {delay:.2f}s"
+                )
+            time.sleep(delay)
+
+
+def device_put(x, *, attempts: int = 3):
+    """``jax.device_put`` with bounded retry on transient runtime errors —
+    the upload half of every dispatch on remote-attached devices."""
+    import jax
+
+    return with_backoff(
+        lambda: jax.device_put(x),
+        attempts=attempts, retryable=is_transient_device,
+        what="device transfer",
+    )

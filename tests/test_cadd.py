@@ -201,8 +201,8 @@ def test_test_mode_does_not_poison_unexamined_rows(tmp_path):
     orig = mod.CaddFileReader
 
     class SmallReader(orig):
-        def __init__(self, path, width, block_rows=4):
-            super().__init__(path, width, block_rows=4)
+        def __init__(self, path, width, block_rows=4, **kw):
+            super().__init__(path, width, block_rows=4, **kw)
 
     mod.CaddFileReader = SmallReader
     try:

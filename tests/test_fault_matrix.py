@@ -1,0 +1,212 @@
+"""Crash/recovery matrix: for every fault-injection kill point in the VCF
+load path, abort a committing load mid-flight, then require that
+
+1. the on-disk store loads cleanly, at most one checkpoint behind, OR is
+   restored by ``store_fsck --repair``; and
+2. ledger-driven resume completes the load to a store whose CONTENT is
+   identical to an uninterrupted run (provenance columns — seg ids,
+   ``row_algorithm_id`` — necessarily differ: they encode how many
+   invocations it took, which is the one thing a crash changes).
+
+The in-process matrix uses the ``raise`` action: an exception abandons the
+in-memory store exactly where a crash would, and the durable state is
+whatever the persist path had already renamed into place — the same
+atomic-swap guarantees a SIGKILL exercises, minus page-cache effects no
+in-tree test can simulate.  ``test_sigkill_*`` drives two points through a
+real subprocess SIGKILL for the no-finally-runs guarantee.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.config import StoreConfig
+from annotatedvdb_tpu.store import VariantStore
+from annotatedvdb_tpu.store.fsck import fsck
+from annotatedvdb_tpu.utils import faults
+
+N_ROWS = 2600
+BATCH = 512  # ~6 chunks => ~6 checkpoints per committed load
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset("")
+
+
+def _write_vcf(path, n=N_ROWS):
+    with open(path, "w") as f:
+        f.write("##fileformat=VCFv4.2\n"
+                "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        for i in range(n):
+            f.write(f"8\t{1000 + 3 * i}\trs{i}\tA\tG\t.\t.\tRS={i}\n")
+
+
+def _run_load(store_dir, vcf, fault=""):
+    """One committing CLI-shaped load (persist-before-checkpoint).  Returns
+    (counters, exception): with a fault armed, the in-memory store is
+    abandoned like a crashed process's heap and only disk state survives."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+
+    faults.reset(fault)
+    store, ledger = StoreConfig(store_dir).open()
+    loader = TpuVcfLoader(
+        store, ledger, batch_size=BATCH, log=lambda *a: None,
+    )
+    try:
+        counters = loader.load_file(
+            vcf, commit=True, resume=True,
+            persist=lambda: store.save(store_dir),
+        )
+        loader.close()
+        store.save(store_dir)
+        return counters, None
+    except BaseException as exc:
+        # a real crash stops every thread instantly: cancel the "dead"
+        # loader's queued writer jobs so it cannot keep committing into
+        # the directory while the recovery run is underway (an artifact
+        # only an in-process crash simulation has)
+        try:
+            if loader._writer_pool is not None:
+                loader._writer_pool.shutdown(wait=True, cancel_futures=True)
+            if loader._prefetch_pool is not None:
+                loader._prefetch_pool.shutdown(wait=False)
+        except Exception:
+            pass
+        return None, exc
+    finally:
+        faults.reset("")
+
+
+def _content(store_dir):
+    """Content signature: every column except provenance (alg ids)."""
+    store = VariantStore.load(store_dir)
+    shard = store.shard(8)
+    shard.compact()
+    cols = {
+        c: shard.cols[c]
+        for c in ("pos", "h", "ref_snp", "ref_len", "alt_len",
+                  "bin_level", "leaf_bin")
+    }
+    return cols, shard.ref.copy(), shard.alt.copy(), store.n
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """One uninterrupted load: the content every recovery must reproduce."""
+    d = tmp_path_factory.mktemp("ref")
+    vcf = str(d / "d.vcf")
+    _write_vcf(vcf)
+    ref_store = str(d / "store")
+    counters, exc = _run_load(ref_store, vcf)
+    assert exc is None, exc
+    assert counters["variant"] == N_ROWS
+    return vcf, _content(ref_store)
+
+
+# every kill point of the load path; nth chosen so at least one checkpoint
+# is durable before the fault lands (the "<= 1 checkpoint behind" clause)
+MATRIX = [
+    ("store.save.pre_manifest:2:raise", False),
+    ("store.save.pre_manifest:2:raise", True),   # + fsck --repair pass
+    ("store.save.mid_segment:3:raise", False),
+    ("ledger.append:4:raise", False),
+    ("ingest.chunk:4:raise", False),
+]
+
+
+@pytest.mark.parametrize("fault,run_fsck", MATRIX)
+def test_crash_matrix(tmp_path, reference, fault, run_fsck):
+    vcf, want = reference
+    store_dir = str(tmp_path / "crash")
+
+    counters, exc = _run_load(store_dir, vcf, fault=fault)
+    assert exc is not None, f"{fault}: fault never fired"
+
+    # 1. the durable store must load cleanly (possibly behind) ...
+    partial = VariantStore.load(store_dir)
+    assert partial.n <= N_ROWS
+    # ... at most one checkpoint behind the ledger cursor: resume replays
+    # idempotently, so the cursor may lag the store but never lead it
+    from annotatedvdb_tpu.store import AlgorithmLedger
+
+    cursor = AlgorithmLedger(
+        os.path.join(store_dir, "ledger.jsonl")
+    ).last_checkpoint(vcf)
+    committed_rows = partial.n
+    assert cursor <= 2 + committed_rows  # lines = header(2) + one per row
+
+    if run_fsck:  # repair between crash and resume must stay recoverable
+        report = fsck(store_dir, repair=True, log=lambda m: None)
+        assert report["exit_code"] in (0, 1), report
+        VariantStore.load(store_dir)
+
+    # 2. resume completes to reference content
+    counters, exc = _run_load(store_dir, vcf)
+    assert exc is None, f"{fault}: resume failed: {exc}"
+    got = _content(store_dir)
+    want_cols, want_ref, want_alt, want_n = want
+    got_cols, got_ref, got_alt, got_n = got
+    assert got_n == want_n == N_ROWS
+    for c, arr in want_cols.items():
+        np.testing.assert_array_equal(got_cols[c], arr, err_msg=f"{fault}:{c}")
+    np.testing.assert_array_equal(got_ref, want_ref)
+    np.testing.assert_array_equal(got_alt, want_alt)
+
+    # 3. post-recovery store passes fsck cleanly (orphans at worst)
+    report = fsck(store_dir, deep=True, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+
+
+def _cli(vcf, store, extra=()):
+    return [sys.executable, "-m", "annotatedvdb_tpu.cli.load_vcf",
+            "--fileName", vcf, "--storeDir", store,
+            "--commitAfter", str(BATCH), "--commit", *extra]
+
+
+@pytest.mark.parametrize("fault", [
+    "store.save.pre_manifest:2:kill",
+    "ledger.append:4:torn_write",
+])
+def test_sigkill_matrix(tmp_path, reference, fault):
+    """True process death (no finally/atexit) at the two juiciest points:
+    before a manifest swap, and tearing a ledger append in half."""
+    vcf, want = reference
+    store_dir = str(tmp_path / "crash")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AVDB_FAULT=fault,
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jaxcache"),
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0",
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+    )
+    p = subprocess.run(_cli(vcf, store_dir), env=env,
+                       capture_output=True, text=True, timeout=480)
+    assert p.returncode == -signal.SIGKILL, (
+        f"expected SIGKILL death, got rc={p.returncode}\n{p.stderr[-2000:]}"
+    )
+
+    # store loads (possibly behind); fsck prunes crash debris
+    partial = VariantStore.load(store_dir)
+    assert partial.n <= N_ROWS
+    report = fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+
+    # resume (no fault armed) completes to reference content
+    env.pop("AVDB_FAULT")
+    p = subprocess.run(_cli(vcf, store_dir), env=env,
+                       capture_output=True, text=True, timeout=480)
+    assert p.returncode == 0, p.stderr[-2000:]
+    got_cols, got_ref, got_alt, got_n = _content(store_dir)
+    want_cols, want_ref, want_alt, want_n = want
+    assert got_n == want_n
+    for c, arr in want_cols.items():
+        np.testing.assert_array_equal(got_cols[c], arr, err_msg=c)
+    np.testing.assert_array_equal(got_ref, want_ref)
+    np.testing.assert_array_equal(got_alt, want_alt)

@@ -1,0 +1,370 @@
+"""Quarantine sink + error budgets across the four parser families
+(VCF / VEP JSON / CADD TSV / annotation TSV): rejected lines are preserved
+replayably (reject -> fix -> replay round trip), and ``--maxErrors`` aborts
+deterministically."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.config import StoreConfig
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.utils.quarantine import (
+    ErrorBudget,
+    ErrorBudgetExceeded,
+    QuarantineSink,
+    read_rejects,
+    write_replay,
+)
+
+_SILENT = lambda *a, **k: None  # noqa: E731
+
+
+def _sink(store_dir, input_path, loader, max_errors=-1):
+    return QuarantineSink(
+        store_dir, input_path, loader, budget=ErrorBudget(max_errors)
+    )
+
+
+# ---------------------------------------------------------------------------
+# VCF
+
+
+def _write_vcf(path, rows, with_header=True):
+    with open(path, "w") as f:
+        if with_header:
+            f.write("##fileformat=VCFv4.2\n"
+                    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        for r in rows:
+            f.write(r + "\n")
+
+
+GOOD_VCF = [f"8\t{1000 + 3 * i}\trs{i}\tA\tG\t.\t.\t." for i in range(8)]
+BAD_VCF = ["8\tnot-a-position\trsX\tA\tG\t.\t.\t.",
+           "too\tfew"]
+
+
+def test_vcf_quarantine_roundtrip(tmp_path, monkeypatch):
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+
+    monkeypatch.setenv("AVDB_INGEST_ENGINE", "python")  # capture content
+    store_dir = str(tmp_path / "vdb")
+    vcf = str(tmp_path / "in.vcf")
+    # interleave bad rows mid-file
+    _write_vcf(vcf, GOOD_VCF[:4] + BAD_VCF + GOOD_VCF[4:])
+    sink = _sink(store_dir, vcf, "load-vcf")
+    store, ledger = StoreConfig(store_dir).open()
+    loader = TpuVcfLoader(store, ledger, batch_size=64, log=_SILENT,
+                          quarantine=sink)
+    counters = loader.load_file(vcf, commit=True,
+                                persist=lambda: store.save(store_dir))
+    loader.close()
+    store.save(store_dir)
+    assert counters["variant"] == 8
+    assert counters["rejected"] == 2
+    meta, records = read_rejects(sink.path)
+    assert meta["loader"] == "load-vcf"
+    assert [r["raw"] for r in records] == BAD_VCF
+    assert all(r["line"] for r in records)  # line numbers captured
+
+    # fix the quarantined lines in place, replay, and load the replay file
+    fixed = ["8\t50000\trsX\tA\tG\t.\t.\t.",
+             "8\t50003\trsY\tA\tG\t.\t.\t."]
+    with open(sink.path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    for rec, line in zip([r for r in recs if "meta" not in r], fixed):
+        rec["raw"] = line
+    with open(sink.path, "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    replay = str(tmp_path / "replay.vcf")
+    assert write_replay(sink.path, replay) == 2
+    counters = loader.load_file(replay, commit=True,
+                                persist=lambda: store.save(store_dir))
+    loader.close()
+    store.save(store_dir)
+    assert counters["variant"] == 8 + 2  # cumulative: the 2 fixed rows landed
+    assert VariantStore.load(store_dir).n == 10
+
+
+def test_vcf_error_budget_aborts(tmp_path, monkeypatch):
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+
+    monkeypatch.setenv("AVDB_INGEST_ENGINE", "python")
+    store_dir = str(tmp_path / "vdb")
+    vcf = str(tmp_path / "in.vcf")
+    _write_vcf(vcf, BAD_VCF + GOOD_VCF)
+    store, ledger = StoreConfig(store_dir).open()
+    loader = TpuVcfLoader(
+        store, ledger, batch_size=64, log=_SILENT,
+        quarantine=_sink(store_dir, vcf, "load-vcf", max_errors=0),
+    )
+    with pytest.raises(ErrorBudgetExceeded):
+        loader.load_file(vcf, commit=False)
+    loader.close()
+    # the aborting row itself was preserved before the abort
+    _meta, records = read_rejects(
+        os.path.join(store_dir, "quarantine",
+                     os.path.basename(vcf) + ".rejects.jsonl")
+    )
+    assert records and records[0]["raw"] == BAD_VCF[0]
+
+
+# ---------------------------------------------------------------------------
+# VEP JSON
+
+
+GOOD_VEP = json.dumps({"input": "1\t100\trs1\tA\tG", "id": "rs1"})
+BAD_VEP = '{"input": "1\\t100\\trs1\\tA\\tG", BROKEN'
+
+
+def _vep_loader(store_dir, quarantine=None, max_errors=-1):
+    from annotatedvdb_tpu.conseq import ConsequenceRanker
+    from annotatedvdb_tpu.loaders import TpuVepLoader
+
+    store, ledger = StoreConfig(store_dir).open()
+    return TpuVepLoader(
+        store, ledger, ConsequenceRanker(), log=_SILENT,
+        quarantine=quarantine, max_errors=max_errors,
+    )
+
+
+def test_vep_quarantine_roundtrip(tmp_path):
+    store_dir = str(tmp_path / "vdb")
+    vep = str(tmp_path / "r.json")
+    with open(vep, "w") as f:
+        f.write(GOOD_VEP + "\n" + BAD_VEP + "\n" + GOOD_VEP + "\n")
+    sink = _sink(store_dir, vep, "load-vep")
+    loader = _vep_loader(store_dir, quarantine=sink)
+    counters = loader.load_file(vep, commit=False)
+    assert counters["rejected"] == 1
+    assert counters["line"] == 3
+    _meta, records = read_rejects(sink.path)
+    assert records[0]["raw"] == BAD_VEP
+
+    # fix + replay: the repaired line loads with no rejects
+    with open(sink.path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    for rec in recs:
+        if "meta" not in rec:
+            rec["raw"] = GOOD_VEP
+    with open(sink.path, "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    replay = str(tmp_path / "replay.json")
+    assert write_replay(sink.path, replay) == 1
+    loader2 = _vep_loader(store_dir)
+    counters = loader2.load_file(replay, commit=False)
+    assert counters.get("rejected", 0) == 0
+    assert counters["line"] == 1
+
+
+def test_vep_multidoc_line_loads_every_doc(tmp_path, monkeypatch):
+    """One physical line carrying two comma-joined docs must load BOTH and
+    must not desync later docs' line attribution (the whole-batch decode
+    falls back to per-line pairing when counts mismatch).  Pins the Python
+    decode path — the native transformer's treatment of such malformed
+    lines (first doc wins) predates this code."""
+    monkeypatch.setenv("AVDB_NATIVE_VEP", "0")
+    store_dir = str(tmp_path / "vdb")
+    vep = str(tmp_path / "r.json")
+    with open(vep, "w") as f:
+        f.write(GOOD_VEP + "," + GOOD_VEP + "\n" + GOOD_VEP + "\n")
+    loader = _vep_loader(store_dir, quarantine=_sink(store_dir, vep,
+                                                     "load-vep"))
+    counters = loader.load_file(vep, commit=False)
+    assert counters.get("rejected", 0) == 0
+    assert counters["line"] == 2
+    assert counters["variant"] == 3  # all three docs parsed
+
+
+def test_vep_error_budget_aborts(tmp_path):
+    store_dir = str(tmp_path / "vdb")
+    vep = str(tmp_path / "r.json")
+    with open(vep, "w") as f:
+        f.write(BAD_VEP + "\n" + GOOD_VEP + "\n")
+    loader = _vep_loader(
+        store_dir, quarantine=_sink(store_dir, vep, "load-vep", max_errors=0)
+    )
+    with pytest.raises(ErrorBudgetExceeded):
+        loader.load_file(vep, commit=False)
+
+
+# ---------------------------------------------------------------------------
+# CADD TSV
+
+
+CADD_HEADER = "#Chrom\tPos\tRef\tAlt\tRawScore\tPHRED"
+GOOD_CADD = ["1\t10\tA\tC\t0.5\t10.1", "1\t11\tA\tG\t0.6\t11.0"]
+BAD_CADD = ["1\tnot-a-pos\tA\tC\t0.5\t10.1"]
+
+
+def _cadd_store(tmp_path):
+    store_dir = str(tmp_path / "vdb")
+    store, ledger = StoreConfig(store_dir).open()
+    w = store.width
+    store.shard(1).append(
+        {"pos": np.asarray([10], np.int32),
+         "h": np.asarray([7], np.uint32),
+         "ref_len": np.full(1, 1, np.int32),
+         "alt_len": np.full(1, 1, np.int32)},
+        np.full((1, w), 65, np.uint8), np.full((1, w), 67, np.uint8),
+    )
+    return store_dir, store, ledger
+
+
+def test_cadd_quarantine_and_budget(tmp_path, monkeypatch):
+    from annotatedvdb_tpu.loaders.cadd_loader import TpuCaddUpdater
+
+    monkeypatch.setenv("AVDB_NATIVE_CADD", "0")  # content capture
+    store_dir, store, ledger = _cadd_store(tmp_path)
+    dbdir = str(tmp_path / "cadd")
+    os.makedirs(dbdir)
+    snv = "snvs.tsv"
+    with open(os.path.join(dbdir, snv), "w") as f:
+        f.write("\n".join([CADD_HEADER] + GOOD_CADD[:1] + BAD_CADD
+                          + GOOD_CADD[1:]) + "\n")
+    sink = _sink(store_dir, os.path.join(dbdir, "cadd-scores"), "load-cadd")
+    updater = TpuCaddUpdater(store, ledger, dbdir, snv_file=snv,
+                             log=_SILENT, quarantine=sink)
+    counters = updater.update_all(commit=False)
+    assert counters["rejected"] == 1
+    _meta, records = read_rejects(sink.path)
+    assert records[0]["raw"] == BAD_CADD[0]
+    assert snv in records[0]["reason"]  # table attribution
+    assert records[0]["line"] == 3
+
+    # budget: zero tolerance aborts on the bad row
+    b = tmp_path / "b"
+    b.mkdir()
+    _store_dir2, store2, ledger2 = _cadd_store(b)
+    updater2 = TpuCaddUpdater(store2, ledger2, dbdir, snv_file=snv,
+                              log=_SILENT, max_errors=0)
+    with pytest.raises(ErrorBudgetExceeded):
+        updater2.update_all(commit=False)
+
+    # replay round trip at the reader level: fixed lines parse cleanly
+    with open(sink.path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    for rec in recs:
+        if "meta" not in rec:
+            rec["raw"] = "1\t12\tA\tT\t0.7\t12.0"
+    with open(sink.path, "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    replay = str(tmp_path / "replay.tsv")
+    assert write_replay(sink.path, replay) == 1
+    from annotatedvdb_tpu.io.cadd import CaddFileReader
+
+    rejects2 = []
+    blocks = list(CaddFileReader(
+        replay, width=8,
+        on_reject=lambda *a: rejects2.append(a),
+    ).blocks_all())
+    assert rejects2 == []
+    assert sum(b.n for _c, b in blocks) == 1
+
+
+# ---------------------------------------------------------------------------
+# annotation TSV
+
+
+TSV_HEADER = "variant\tother_annotation"
+GOOD_TSV = ['1:10:A:C\t{"source": "x"}']
+BAD_TSV = ['garbage-id\t{"source": "y"}',      # unparseable variant id
+           '1:20:A:G\t{not-json']              # bad JSON cell
+
+
+def test_tsv_quarantine_roundtrip_and_budget(tmp_path):
+    from annotatedvdb_tpu.loaders.txt_loader import TpuTextLoader
+
+    store_dir = str(tmp_path / "vdb")
+    tsv = str(tmp_path / "ann.tsv")
+    with open(tsv, "w") as f:
+        f.write("\n".join([TSV_HEADER] + GOOD_TSV + BAD_TSV) + "\n")
+    sink = _sink(store_dir, tsv, "update-variant-annotation")
+    store, ledger = StoreConfig(store_dir).open()
+    loader = TpuTextLoader(store, ledger, log=_SILENT, quarantine=sink)
+    counters = loader.load_file(tsv, commit=True,
+                                persist=lambda: store.save(store_dir))
+    store.save(store_dir)
+    assert counters["rejected"] == 2
+    assert counters["inserted"] == 1  # the good metaseq row inserted
+    meta, records = read_rejects(sink.path)
+    assert meta["header"] == TSV_HEADER  # replay restores the header
+    assert [r["raw"] for r in records] == BAD_TSV
+
+    # fix + replay: header is reconstructed, both rows land
+    with open(sink.path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    fixed = iter(['1:30:A:C\t{"source": "y"}', '1:20:A:G\t{"source": "z"}'])
+    for rec in recs:
+        if "meta" not in rec:
+            rec["raw"] = next(fixed)
+    with open(sink.path, "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    replay = str(tmp_path / "replay.tsv")
+    assert write_replay(sink.path, replay) == 2
+    assert open(replay).readline().rstrip("\n") == TSV_HEADER
+    loader2 = TpuTextLoader(store, ledger, log=_SILENT)
+    counters = loader2.load_file(replay, commit=True,
+                                 persist=lambda: store.save(store_dir))
+    store.save(store_dir)
+    assert counters.get("rejected", 0) == 0
+    assert counters["inserted"] == 2
+    assert VariantStore.load(store_dir).n == 3
+
+    # budget: zero tolerance aborts on the first bad row
+    store_dir2 = str(tmp_path / "vdb2")
+    store2, ledger2 = StoreConfig(store_dir2).open()
+    loader3 = TpuTextLoader(
+        store2, ledger2, log=_SILENT,
+        quarantine=_sink(store_dir2, tsv, "update-variant-annotation",
+                         max_errors=0),
+    )
+    with pytest.raises(ErrorBudgetExceeded):
+        loader3.load_file(tsv, commit=False)
+
+
+def test_sink_rotates_unreplayed_rejects(tmp_path):
+    """A second load sharing the input basename must not clobber the first
+    load's un-replayed rejects: one prior generation survives at <path>.1."""
+    store_dir = str(tmp_path / "vdb")
+    s1 = _sink(store_dir, "x.vcf", "load-vcf")
+    s1.reject(1, "first-gen line", "bad")
+    s1.close()
+    s2 = _sink(store_dir, "x.vcf", "load-vep")
+    s2.reject(9, "second-gen line", "bad")
+    s2.close()
+    _meta, records = read_rejects(s2.path)
+    assert records[0]["raw"] == "second-gen line"
+    _meta1, records1 = read_rejects(s2.path + ".1")
+    assert records1[0]["raw"] == "first-gen line"
+
+
+# ---------------------------------------------------------------------------
+# update loaders (VCF-driven) share the same reader hook
+
+
+def test_update_loader_budget_aborts(tmp_path, monkeypatch):
+    from annotatedvdb_tpu.loaders.qc_loader import TpuQcPvcfLoader
+
+    monkeypatch.setenv("AVDB_INGEST_ENGINE", "python")
+    store_dir = str(tmp_path / "vdb")
+    vcf = str(tmp_path / "qc.vcf")
+    _write_vcf(vcf, BAD_VCF + GOOD_VCF)
+    store, ledger = StoreConfig(store_dir).open()
+    loader = TpuQcPvcfLoader(
+        store, ledger, "r4", log=_SILENT,
+        quarantine=_sink(store_dir, vcf, "update-qc", max_errors=0),
+    )
+    with pytest.raises(ErrorBudgetExceeded):
+        loader.load_file(vcf, commit=False)
+    _meta, records = read_rejects(
+        os.path.join(store_dir, "quarantine",
+                     os.path.basename(vcf) + ".rejects.jsonl")
+    )
+    assert records[0]["raw"] == BAD_VCF[0]

@@ -281,15 +281,21 @@ def test_ledger_tolerates_torn_final_line(tmp_path):
     # healed: every line in the file parses again
     again = AlgorithmLedger(path)
     assert again.last_checkpoint("f.vcf") == 2000
-    # a torn line in the MIDDLE is real corruption and must still raise
+    # a torn line in the MIDDLE (crash mid-append interleaved with another
+    # writer, or byte damage) skips with a warning too: one bad line must
+    # never poison runs()/last_checkpoint() for the whole store — fsck
+    # reports the skipped count, the next append heals the file
     lines = open(path).read().splitlines()
     lines.insert(1, '{"type": "checkpoi')
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
-    import pytest as _pytest
-
-    with _pytest.raises(Exception):
-        AlgorithmLedger(path)
+    tolerant = AlgorithmLedger(path)
+    assert tolerant.skipped_lines == 1
+    assert tolerant.last_checkpoint("f.vcf") == 2000  # good lines intact
+    tolerant.checkpoint(2, "f.vcf", 3000, {})  # heal-on-append
+    healed = AlgorithmLedger(path)
+    assert healed.skipped_lines == 0
+    assert healed.last_checkpoint("f.vcf") == 3000
 
 
 def test_save_is_atomic_against_kill(tmp_path, monkeypatch):
@@ -495,6 +501,14 @@ def test_legacy_npz_segments_still_load(tmp_path):
             np.savez(f, **data)
         with open(fp, "rb") as f:
             assert f.read(1) == b"P"  # genuinely zip-backed now
+    # legacy manifests predate integrity records: drop them so the emulated
+    # store is faithful (otherwise the size check correctly flags the
+    # out-of-band rewrite as tampering)
+    mpath = os.path.join(d, "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest.pop("integrity", None)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
     loaded = VariantStore.load(d)
     assert loaded.n == 3
     s = loaded.shard(1)

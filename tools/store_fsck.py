@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Offline store integrity check + repair (thin wrapper over
+``annotatedvdb_tpu.store.fsck``; also reachable as
+``python -m annotatedvdb_tpu doctor``).
+
+Usage:
+    python tools/store_fsck.py --storeDir ./vdb [--deep] [--repair] [--json]
+
+Exit codes: 0 = clean, 1 = warnings / repaired, 2 = errors remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--deep", action="store_true",
+                    help="crc32-verify every segment file against the "
+                         "manifest's write-time integrity records")
+    ap.add_argument("--repair", action="store_true",
+                    help="prune orphans/tmp files, heal the ledger, roll "
+                         "damaged backing groups back out of the manifest")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    from annotatedvdb_tpu.store.fsck import fsck
+
+    report = fsck(
+        args.storeDir, deep=args.deep, repair=args.repair,
+        log=(lambda m: None) if args.json else
+            (lambda m: print(m, file=sys.stderr)),
+    )
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"store_fsck: {args.storeDir}: {report['status']} "
+              f"({len(report['findings'])} finding(s), "
+              f"{len(report['repairs'])} repair(s))", file=sys.stderr)
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
