@@ -1,0 +1,36 @@
+"""Project-native static analysis (``avdb-check``).
+
+Rule families (each with fixture-backed tests in
+``tests/test_avdb_check.py`` and a catalog entry in README "Static
+analysis & code health"):
+
+==========  ============================================================
+AVDB001     file does not parse (nothing else checked there)
+AVDB1xx     trace-safety: host side effects / data-dependent branches in
+            jit/pjit/shard_map code (``rules_trace``)
+AVDB2xx     lock-discipline: ``#: guarded by self._lock`` attributes
+            accessed outside their lock (``rules_locks``)
+AVDB3xx     registry-drift: fault points vs ``faults.POINTS``; metric
+            name/kind/label consistency; README refs (``rules_registry``)
+AVDB4xx     env-var drift: ``AVDB_*`` reads vs ``config.ENV_VARS`` vs
+            README (``rules_env``)
+AVDB5xx     CLI-contract: the six loader CLIs' shared flag set
+            (``rules_cli``)
+AVDB6xx     hygiene: bare except, silent Exception-pass, mutable default
+            args (``rules_hygiene``)
+==========  ============================================================
+
+Entry point: ``python tools/avdb_check.py [--json] [paths...]`` — exit
+codes 0 (clean) / 1 (findings) / 2 (usage or internal error), mirroring
+``tools/store_fsck.py``.  Suppress a finding with
+``# avdb: noqa[CODE] -- reason``.
+"""
+
+from annotatedvdb_tpu.analysis.core import (  # noqa: F401 (public API)
+    Finding,
+    LOADER_CLIS,
+    iter_python_files,
+    run_paths,
+)
+
+__all__ = ["Finding", "LOADER_CLIS", "iter_python_files", "run_paths"]

@@ -1,0 +1,91 @@
+"""AVDB6xx — hygiene: the failure-swallowing patterns this repo has banned.
+
+The robustness spine (PR 3) made "errors must surface with their root
+cause" a design rule — ``BoundedStage`` preserves the first in-flight stage
+error, the run ledger witnesses aborts.  A bare ``except:`` or an
+``except Exception: pass`` anywhere upstream silently defeats all of it,
+and a mutable default argument is shared state across calls in a codebase
+that runs loaders repeatedly in one process.
+
+Codes:
+
+- **AVDB601** — bare ``except:`` (catches SystemExit/KeyboardInterrupt);
+- **AVDB602** — ``except Exception``/``except BaseException`` whose body
+  is only ``pass``/``...`` (silent swallow; log-and-continue is fine);
+- **AVDB603** — mutable default argument (list/dict/set display or
+  constructor call).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from annotatedvdb_tpu.analysis.core import FileContext, Finding
+
+HINT_601 = ("catch a concrete exception type, or `except Exception` with "
+            "a log line; bare except swallows KeyboardInterrupt/SystemExit")
+HINT_602 = ("log the swallowed error (even at debug level) or narrow the "
+            "type; silent Exception-pass hides root causes the run ledger "
+            "exists to witness")
+HINT_603 = "default to None and create the list/dict/set inside the body"
+
+_BROAD = {"Exception", "BaseException"}
+_MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict"}
+
+
+def _is_swallow_body(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring or `...`
+        return False
+    return True
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in _MUTABLE_CALLS:
+        return True
+    return False
+
+
+def check(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                findings.append(Finding(
+                    "AVDB601", ctx.path, node.lineno,
+                    "bare `except:`",
+                    HINT_601,
+                ))
+            else:
+                names = []
+                t = node.type
+                elems = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elems:
+                    if isinstance(e, ast.Name):
+                        names.append(e.id)
+                if any(n in _BROAD for n in names) \
+                        and _is_swallow_body(node.body):
+                    findings.append(Finding(
+                        "AVDB602", ctx.path, node.lineno,
+                        f"`except {'/'.join(names)}` silently swallows "
+                        f"the error (body is pass/...)",
+                        HINT_602,
+                    ))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                if _is_mutable_default(d):
+                    findings.append(Finding(
+                        "AVDB603", ctx.path, d.lineno,
+                        f"mutable default argument in {node.name!r}",
+                        HINT_603,
+                    ))
+    return findings

@@ -1,0 +1,302 @@
+"""AVDB1xx — trace-safety: jitted/shard_map code must stay host-pure.
+
+The ≥1M variants/sec north star rests on every ``jax.jit``/``pjit``/
+``shard_map`` program being a pure device computation: a stray ``print``,
+metrics call, env read, or fault hook inside one either fires at TRACE time
+(once, silently, with a tracer value — almost never what the author meant)
+or forces a host sync.  Data-dependent Python ``if``/``while`` on a traced
+value is a ``ConcretizationTypeError`` at runtime — but only on the first
+call with a non-concrete input, which on this repo's CPU-tested/TPU-deployed
+split means it detonates in production.  Both are statically visible.
+
+Codes:
+
+- **AVDB101** — host side effect (print/open/logging/metrics/faults/env/
+  time/global) inside a traced function;
+- **AVDB102** — ``if``/``while``/``assert`` whose condition reads a traced
+  parameter directly (``.shape``/``.ndim``/``.dtype``/``.size``/``len()``
+  reads are static under tracing and exempt, as are ``static_argnums``/
+  ``static_argnames`` parameters).
+
+Traced functions are found three ways: jit-family decorators (including
+``partial(jax.jit, ...)``), wrap assignments at any scope depth
+(``f_jit = jax.jit(f)``, ``return jax.jit(step)``), and
+``shard_map(f, ...)`` / ``partial(shard_map, f, ...)`` references resolving
+to a function defined in an enclosing scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from annotatedvdb_tpu.analysis.core import FileContext, Finding
+
+HINT_101 = ("hoist the host call out of the traced function (do it at the "
+            "call site, per chunk) or gate it behind jax.debug.*")
+HINT_102 = ("branch with jnp.where/lax.cond, or declare the parameter "
+            "static via static_argnums/static_argnames")
+
+_JIT_NAMES = {"jit", "pjit"}
+_SHARD_NAMES = {"shard_map"}
+
+#: bare-name calls that are host side effects inside a trace
+_HOST_CALLS = {"print", "input", "breakpoint", "open", "exec", "eval"}
+
+#: attribute-chain roots that are host side effects inside a trace
+#: (jax.random is fine — its chain root is "jax"; stdlib random is not)
+_HOST_ROOTS = {"os", "logging", "faults", "random", "time", "socket",
+               "subprocess", "shutil"}
+
+#: method names that are metric/fault emissions regardless of the base
+#: object (``counter.inc``, ``hist.observe``, ``faults.maybe_fire``)
+_HOST_METHODS = {"maybe_fire", "inc", "dec", "observe"}
+
+#: attribute reads on a traced value that stay static under tracing
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "at"}
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _dotted(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` -> ["a", "b", "c"]; None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _ends_with(node: ast.AST, names: set[str]) -> bool:
+    chain = _dotted(node)
+    return bool(chain) and chain[-1] in names
+
+
+def _static_from_call(call: ast.Call, fn: ast.FunctionDef) -> set[str]:
+    """Parameter names declared static in a jit(...) call's kwargs."""
+    pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    for kw in call.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except ValueError:
+            continue
+        if kw.arg == "static_argnames":
+            static.update((val,) if isinstance(val, str) else tuple(val))
+        elif kw.arg == "static_argnums":
+            for i in ((val,) if isinstance(val, int) else tuple(val)):
+                if 0 <= i < len(pos_params):
+                    static.add(pos_params[i])
+    return static
+
+
+def _jit_call_of(node: ast.AST) -> ast.Call | None:
+    """The jit-like Call carrying static kwargs: ``jax.jit(...)`` itself or
+    ``partial(jax.jit, ...)``; None when ``node`` is neither."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _ends_with(node.func, _JIT_NAMES):
+        return node
+    if _ends_with(node.func, {"partial"}) and node.args \
+            and _ends_with(node.args[0], _JIT_NAMES):
+        return node
+    return None
+
+
+def _iter_scope_stmts(body):
+    """Statements lexically in this scope: descends into compound-statement
+    blocks but never into nested function/class bodies."""
+    for s in body:
+        yield s
+        if isinstance(s, _DEFS + (ast.ClassDef,)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            yield from _iter_scope_stmts(getattr(s, attr, None) or [])
+        for h in getattr(s, "handlers", None) or []:
+            yield from _iter_scope_stmts(h.body)
+
+
+def _iter_scope_exprs(body):
+    """All AST nodes in this scope's statements, stopping at nested
+    function/class bodies (their decorators ARE yielded)."""
+    for s in _iter_scope_stmts(body):
+        if isinstance(s, _DEFS + (ast.ClassDef,)):
+            for dec in s.decorator_list:
+                yield from ast.walk(dec)
+            continue
+        stack = [s]
+        while stack:
+            n = stack.pop()
+            yield n
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, _DEFS + (ast.ClassDef,)):
+                    for dec in c.decorator_list:
+                        yield from ast.walk(dec)
+                    continue
+                stack.append(c)
+
+
+def find_traced_functions(tree: ast.Module):
+    """[(FunctionDef, static_param_names)] for every function this module
+    traces via decorator, wrap assignment, or shard_map reference."""
+    traced: dict[ast.AST, set[str]] = {}
+
+    def resolve(name: str, env_stack) -> ast.AST | None:
+        for env in reversed(env_stack):
+            if name in env:
+                return env[name]
+        return None
+
+    def handle_decorators(fn) -> None:
+        for dec in fn.decorator_list:
+            if _ends_with(dec, _JIT_NAMES | _SHARD_NAMES):
+                traced.setdefault(fn, set())
+            elif isinstance(dec, ast.Call):
+                jc = _jit_call_of(dec)
+                if jc is not None:
+                    traced.setdefault(fn, set()).update(
+                        _static_from_call(jc, fn)
+                    )
+                elif _ends_with(dec.func, _SHARD_NAMES) or (
+                        _ends_with(dec.func, {"partial"}) and dec.args
+                        and _ends_with(dec.args[0], _SHARD_NAMES)):
+                    # @shard_map(...) / @partial(shard_map, mesh=..., ...)
+                    traced.setdefault(fn, set())
+
+    def handle_call(call: ast.Call, env_stack) -> None:
+        target_name = None
+        static_call = None
+        if _ends_with(call.func, _JIT_NAMES | _SHARD_NAMES):
+            # jax.jit(f, ...) / shard_map(f, ...)
+            if call.args and isinstance(call.args[0], ast.Name):
+                target_name = call.args[0].id
+                if _ends_with(call.func, _JIT_NAMES):
+                    static_call = call
+        elif _ends_with(call.func, {"partial"}) and call.args:
+            # partial(jax.jit, f?, ...) / partial(shard_map, f, ...)
+            if _ends_with(call.args[0], _JIT_NAMES | _SHARD_NAMES) \
+                    and len(call.args) > 1 \
+                    and isinstance(call.args[1], ast.Name):
+                target_name = call.args[1].id
+                if _ends_with(call.args[0], _JIT_NAMES):
+                    static_call = call
+        elif isinstance(call.func, ast.Call):
+            # partial(jax.jit, ...)(f)
+            if _jit_call_of(call.func) is not None and call.args \
+                    and isinstance(call.args[0], ast.Name):
+                target_name = call.args[0].id
+                static_call = _jit_call_of(call.func)
+        if target_name is None:
+            return
+        target = resolve(target_name, env_stack)
+        if isinstance(target, _DEFS):
+            entry = traced.setdefault(target, set())
+            if static_call is not None:
+                entry.update(_static_from_call(static_call, target))
+
+    def process_scope(body, env_stack) -> None:
+        env = {
+            s.name: s for s in _iter_scope_stmts(body)
+            if isinstance(s, _DEFS)
+        }
+        stack2 = env_stack + [env]
+        for node in _iter_scope_exprs(body):
+            if isinstance(node, ast.Call):
+                handle_call(node, stack2)
+        for s in _iter_scope_stmts(body):
+            if isinstance(s, _DEFS):
+                handle_decorators(s)
+                process_scope(s.body, stack2)
+            elif isinstance(s, ast.ClassDef):
+                process_scope(s.body, stack2)
+
+    process_scope(tree.body, [])
+    return [(fn, static) for fn, static in traced.items()
+            if isinstance(fn, _DEFS)]
+
+
+def _check_traced_body(ctx: FileContext, fn: ast.FunctionDef,
+                       static: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    params = {
+        a.arg
+        for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+    } - static - {"self"}
+
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def traced_names_in(test: ast.AST) -> list[str]:
+        hits = []
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in params):
+                continue
+            parent = parents.get(id(node))
+            if isinstance(parent, ast.Attribute) \
+                    and parent.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(parent, ast.Call) \
+                    and isinstance(parent.func, ast.Name) \
+                    and parent.func.id in {"len", "isinstance", "type"}:
+                continue
+            hits.append(node.id)
+        return hits
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            bad = None
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _HOST_CALLS:
+                bad = node.func.id
+            elif chain and chain[0] in _HOST_ROOTS:
+                bad = ".".join(chain)
+            elif chain and len(chain) >= 2 and chain[-1] in _HOST_METHODS:
+                bad = ".".join(chain)
+            elif chain and len(chain) >= 3 and chain[0] == "sys" \
+                    and chain[1] in {"stdout", "stderr"}:
+                bad = ".".join(chain)
+            if bad is not None:
+                findings.append(Finding(
+                    "AVDB101", ctx.path, node.lineno,
+                    f"host side effect {bad}() inside traced function "
+                    f"{fn.name!r}",
+                    HINT_101,
+                ))
+        elif isinstance(node, ast.Subscript):
+            chain = _dotted(node.value)
+            if chain and chain[-2:] == ["os", "environ"] or \
+                    (chain and chain == ["environ"]):
+                findings.append(Finding(
+                    "AVDB101", ctx.path, node.lineno,
+                    f"os.environ access inside traced function {fn.name!r}",
+                    HINT_101,
+                ))
+        elif isinstance(node, ast.Global):
+            findings.append(Finding(
+                "AVDB101", ctx.path, node.lineno,
+                f"global statement inside traced function {fn.name!r}",
+                HINT_101,
+            ))
+        elif isinstance(node, (ast.If, ast.While, ast.Assert)):
+            names = traced_names_in(node.test)
+            if names:
+                findings.append(Finding(
+                    "AVDB102", ctx.path, node.lineno,
+                    f"Python branch on traced value(s) "
+                    f"{', '.join(sorted(set(names)))} inside traced "
+                    f"function {fn.name!r}",
+                    HINT_102,
+                ))
+    return findings
+
+
+def check(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn, static in find_traced_functions(ctx.tree):
+        findings.extend(_check_traced_body(ctx, fn, static))
+    return findings

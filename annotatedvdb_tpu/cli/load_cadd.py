@@ -73,9 +73,6 @@ def main(argv=None) -> int:
     ap.add_argument("--fileName", help="restrict update to this VCF's variants")
     ap.add_argument("--chr", dest="chromosomes",
                     help="chromosome, comma list, or all/allNoM/autosome")
-    ap.add_argument("--commit", action="store_true")
-    ap.add_argument("--test", action="store_true",
-                    help="stop after one chromosome / first block")
     ap.add_argument("--updateExisting", action="store_true",
                     help="re-score variants that already have cadd_scores")
     ap.add_argument("--buildIndex", action="store_true",
@@ -86,12 +83,12 @@ def main(argv=None) -> int:
                     default=None,
                     help="join subsets via indexed seeks (default: auto when "
                          "--fileName is given and indexes exist)")
-    ap.add_argument("--logFilePath", default=None,
-                    help="log file (default: beside --fileName or the store)")
-    ap.add_argument("--maxErrors", type=int, default=-1, metavar="N",
-                    help="abort once more than N malformed score rows have "
-                         "been rejected (quarantined under the store); "
-                         "default -1 = tolerate all")
+    # shared lifecycle contract (--commit/--test/--logAfter/--logFilePath/
+    # --maxErrors) from the registrar — the CLI-contract rule (AVDB501/502)
+    # pins all six loader CLIs to this surface
+    from annotatedvdb_tpu.config import add_lifecycle_args
+
+    add_lifecycle_args(ap)
     from annotatedvdb_tpu.obs import ObsSession, add_obs_args
 
     add_obs_args(ap)
@@ -137,9 +134,13 @@ def main(argv=None) -> int:
     ledger = AlgorithmLedger(os.path.join(args.storeDir, "ledger.jsonl"))
     from annotatedvdb_tpu.config import quarantine_from_args
 
+    from annotatedvdb_tpu.config import effective_log_after
+
     updater = TpuCaddUpdater(
         store, ledger, args.databaseDir,
         skip_existing=not args.updateExisting, log=log, mesh=mesh,
+        # table rows scanned, not input lines: CADD's cadence unit
+        log_after=effective_log_after(args.logAfter, 1 << 22),
         # rejects come from the SCORE TABLES (not --fileName): one sink
         # named for them, both tables attributed via the reject reason
         quarantine=quarantine_from_args(

@@ -25,6 +25,63 @@ import os
 from dataclasses import dataclass
 
 
+#: Canonical registry of every ``AVDB_*`` environment variable the tree
+#: reads (name -> one-line doc).  The static analyzer enforces the contract
+#: both ways: an undeclared read is AVDB401, a declared-but-never-read
+#: entry is AVDB403, and a declared-but-undocumented entry (vs README's
+#: environment table) is AVDB402 — so this dict, README, and the code can
+#: never drift apart silently.
+ENV_VARS: dict = {
+    # runtime / platform pin
+    "AVDB_JAX_PLATFORM": "resolved backend pin (auto-set by pin_platform; "
+                         "export to force cpu/tpu outright)",
+    "AVDB_JAX_PLATFORM_SOURCE": "provenance of the pin (probe/env/flag) "
+                                "for doctor/bench diagnostics",
+    "AVDB_TPU_PROBE_TIMEOUT_S": "accelerator probe timeout in seconds "
+                                "(default 45)",
+    "AVDB_TPU_MARKER": "path of the cached tunnel-down probe marker "
+                       "(skip re-probing a known-dead TPU)",
+    "AVDB_TPU_MARKER_TTL_S": "marker freshness window in seconds "
+                             "(default 3600)",
+    # load pipeline
+    "AVDB_PIPELINE": "overlapped (default) | serial — staged executor vs "
+                     "single-thread double-buffered loop",
+    "AVDB_ASYNC_STORE": "0 folds the store writer back into the process "
+                        "thread (default 1: async writer stage)",
+    "AVDB_INGEST_ENGINE": "auto (default) | native | python — VCF tokenizer "
+                          "selection (python captures reject content)",
+    "AVDB_NATIVE_VEP": "0 disables the native VEP JSON transform",
+    "AVDB_NATIVE_CADD": "0 disables the native CADD table scanner",
+    "AVDB_PACK_TRANSPORT": "0 disables nibble-packed allele upload and "
+                           "packed output transport",
+    "AVDB_LOAD_GC": "0 keeps the collector enabled during bulk loads "
+                    "(default: gc paused, one collect per load)",
+    # multi-host
+    "AVDB_COORDINATOR": "host:port of the jax.distributed coordinator",
+    "AVDB_NUM_PROCESSES": "world size for multi-host init",
+    "AVDB_PROCESS_ID": "this process's rank for multi-host init",
+    # store / robustness
+    "AVDB_FSYNC": "1 extends durability to power loss (fsync segment data "
+                  "and directories, not just manifest renames)",
+    "AVDB_VERIFY": "load-time integrity level: size (default) | deep "
+                   "(full checksums) | off",
+    "AVDB_DEVICE_LOOKUP": "1 keeps membership-probe segments resident in "
+                          "HBM (device lookup cache)",
+    "AVDB_FAULT": "<point>:<nth>[:<action>] deterministic fault injection "
+                  "(see utils/faults.py; unknown points fail the arm)",
+    # bench / test gates
+    "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
+    "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "
+                           "(default 3)",
+    "AVDB_BENCH_RETRY_REASON": "internal: set by bench.py when it re-execs "
+                               "itself after a platform-pin retry",
+    "AVDB_PROFILE": "directory for a jax.profiler device trace of the "
+                    "bench run",
+    "AVDB_SCALE_TEST": "1 enables the 10M-row scaling test tier",
+    "AVDB_CRASH_TEST": "1 enables the subprocess crash/recovery matrix",
+}
+
+
 @dataclass(frozen=True)
 class RuntimeConfig:
     """Execution environment: platform + parallel fan-out."""

@@ -170,8 +170,18 @@ def export_store(store: VariantStore, out_dir: str,
                 f.flush()
             os.replace(tmp, target)
 
-        with_backoff(attempt, retryable=is_transient_io,
-                     what=f"egress write of {fname}")
+        try:
+            with_backoff(attempt, retryable=is_transient_io,
+                         what=f"egress write of {fname}")
+        except BaseException:
+            # an aborted export must not strand its half-written tmp: the
+            # export dir is not a store, so nothing else ever reaps it
+            # (test_fault_matrix pins this via the egress.flush raise case)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     counts: dict[str, int] = {}
     copy_files = []

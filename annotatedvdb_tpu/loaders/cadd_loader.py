@@ -88,6 +88,7 @@ class TpuCaddUpdater:
         mesh=None,
         quarantine=None,
         max_errors: int = -1,
+        log_after: int | None = None,
     ):
         """``mesh``: optional multi-device :class:`jax.sharding.Mesh`; the
         sequential table pass then resolves score rows against the store
@@ -109,6 +110,13 @@ class TpuCaddUpdater:
         self.timer = StageTimer()
         #: chunk-granularity metrics hook (ObsSession.attach)
         self.obs = None
+        # --logAfter cadence over score-table rows scanned (the CADD
+        # analog of the VCF loaders' input-line cadence)
+        from annotatedvdb_tpu.utils.logging import ProgressCadence
+
+        self._cadence = ProgressCadence(self.log, log_after,
+                                        unit="table rows")
+        self._rows_scanned = 0
         self.counters = {"snv": 0, "indel": 0, "not_matched": 0,
                          "skipped": 0, "update": 0}
         from annotatedvdb_tpu.utils.quarantine import ErrorBudget
@@ -261,6 +269,11 @@ class TpuCaddUpdater:
                                 )
                         if self.obs is not None:
                             self.obs.chunk(n_rows)
+                        self._rows_scanned += n_rows
+                        self._cadence.maybe_log(
+                            self._rows_scanned, self.counters,
+                            self.timer.summary(),
+                        )
                         if test:
                             stop = True
                             break
@@ -268,6 +281,10 @@ class TpuCaddUpdater:
                     self._flush_mesh(states, mesh_ctx)
                 with self.timer.stage("finalize"):
                     self._finalize(states, kind, commit, complete=not stop)
+        # terminal counter line: passes ending between cadences still log
+        self._cadence.finish(
+            self._rows_scanned, self.counters, self.timer.summary()
+        )
         self.ledger.finish(alg_id, dict(self.counters))
         self.counters["alg_id"] = alg_id
         return dict(self.counters)

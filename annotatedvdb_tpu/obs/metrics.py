@@ -65,6 +65,7 @@ class Counter:
     def __init__(self, name: str, help: str = "", labels: dict | None = None):
         self.name, self.help, self.labels = name, help, dict(labels or {})
         self._lock = threading.Lock()
+        #: guarded by self._lock
         self._value = 0.0
 
     def inc(self, n: float = 1.0) -> None:
@@ -93,6 +94,7 @@ class Gauge:
     def __init__(self, name: str, help: str = "", labels: dict | None = None):
         self.name, self.help, self.labels = name, help, dict(labels or {})
         self._lock = threading.Lock()
+        #: guarded by self._lock
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -141,8 +143,11 @@ class Histogram:
         self.name, self.help, self.labels = name, help, dict(labels or {})
         self.edges = edges
         self._lock = threading.Lock()
+        #: guarded by self._lock
         self._counts = [0] * (len(edges) + 1)  # +1: the +Inf tail
+        #: guarded by self._lock
         self._sum = 0.0
+        #: guarded by self._lock
         self._count = 0
 
     def observe(self, v: float) -> None:
@@ -188,6 +193,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        #: guarded by self._lock
         self._metrics: dict[tuple, object] = {}
 
     def _get(self, cls, name: str, help: str, labels: dict | None, **kw):

@@ -38,7 +38,9 @@ class Tracer:
     def __init__(self, process_name: str = "avdb-load"):
         self._t0 = time.perf_counter_ns()
         self._lock = threading.Lock()
+        #: guarded by self._lock
         self._events: list[dict] = []
+        #: guarded by self._lock
         self._threads_seen: set[int] = set()
         self.pid = os.getpid()
         with self._lock:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 from annotatedvdb_tpu.utils import faults
@@ -24,7 +25,13 @@ from annotatedvdb_tpu.utils import faults
 class AlgorithmLedger:
     def __init__(self, path: str, log=None):
         self.path = path
+        # the async store writer checkpoints from its own thread while the
+        # main thread may append run/finish records — re-entrant so `begin`
+        # can compute the next alg_id and append under one acquisition
+        self._lock = threading.RLock()
+        #: guarded by self._lock
         self._entries: list[dict] = []
+        #: guarded by self._lock
         self._heal_before_append = False
         #: lines the open-scan could not parse (torn appends, garbage) —
         #: read paths skipped them; fsck reports the count
@@ -61,45 +68,56 @@ class AlgorithmLedger:
                 self._entries.append(entry)
 
     def _append(self, entry: dict) -> None:
-        self._entries.append(entry)
-        if self._heal_before_append:
-            # drop the torn lines detected at open, atomically, now that
-            # this process IS the writer.  Dot-prefixed tmp name so
-            # VariantStore.save's orphan cleanup reaps it after a crash.
-            faults.fire("ledger.append")
-            d, base = os.path.split(self.path)
-            tmp = os.path.join(d, f".{base}.tmp{os.getpid()}")
-            with open(tmp, "w") as out:
-                for e in self._entries:
-                    out.write(json.dumps(e) + "\n")
-                out.flush()
-                os.fsync(out.fileno())
-            os.replace(tmp, self.path)
-            self._heal_before_append = False
-            return
-        with open(self.path, "a") as f:
-            line = json.dumps(entry) + "\n"
-            # crash point, BEFORE the write: raise/kill model a death in
-            # which this record never landed; torn_write writes half the
-            # record itself then kills (the classic torn-tail case the
-            # tolerant open-scan above recovers from)
-            faults.fire("ledger.append", f, payload=line)
-            f.write(line)
-            from annotatedvdb_tpu.store.variant_store import _fsync_wanted
+        # serialized: the async store writer checkpoints concurrently with
+        # main-thread run/finish appends — interleaved list mutation or
+        # interleaved file writes would tear the JSONL (a torn line the
+        # open-scan would then skip as crash damage)
+        with self._lock:
+            self._entries.append(entry)
+            if self._heal_before_append:
+                # drop the torn lines detected at open, atomically, now that
+                # this process IS the writer.  Dot-prefixed tmp name so
+                # VariantStore.save's orphan cleanup reaps it after a crash.
+                faults.fire("ledger.append")
+                d, base = os.path.split(self.path)
+                tmp = os.path.join(d, f".{base}.tmp{os.getpid()}")
+                with open(tmp, "w") as out:
+                    for e in self._entries:
+                        out.write(json.dumps(e) + "\n")
+                    out.flush()
+                    os.fsync(out.fileno())
+                os.replace(tmp, self.path)
+                self._heal_before_append = False
+                return
+            with open(self.path, "a") as f:
+                line = json.dumps(entry) + "\n"
+                # crash point, BEFORE the write: raise/kill model a death in
+                # which this record never landed; torn_write writes half the
+                # record itself then kills (the classic torn-tail case the
+                # tolerant open-scan above recovers from)
+                faults.fire("ledger.append", f, payload=line)
+                f.write(line)
+                from annotatedvdb_tpu.store.variant_store import _fsync_wanted
 
-            if _fsync_wanted():
-                # power-loss opt-in: make the cursor promptly durable.
-                # (Safety never depends on this — the store's fsync'd
-                # renames complete BEFORE this append is written, so the
-                # cursor can lag the store but never lead it.)
-                f.flush()
-                os.fsync(f.fileno())
+                if _fsync_wanted():
+                    # power-loss opt-in: make the cursor promptly durable.
+                    # (Safety never depends on this — the store's fsync'd
+                    # renames complete BEFORE this append is written, so the
+                    # cursor can lag the store but never lead it.)
+                    f.flush()
+                    os.fsync(f.fileno())
 
     def begin(self, script: str, params: dict, commit: bool) -> int:
         """Register a load; returns the new algorithm-invocation id (serial)."""
-        alg_id = 1 + max(
-            (e["alg_id"] for e in self._entries if "alg_id" in e), default=0
-        )
+        with self._lock:
+            alg_id = 1 + max(
+                (e["alg_id"] for e in self._entries if "alg_id" in e),
+                default=0,
+            )
+            self._append_begin(script, params, commit, alg_id)
+        return alg_id
+
+    def _append_begin(self, script, params, commit, alg_id) -> None:
         self._append(
             {
                 "type": "invocation",
@@ -110,7 +128,6 @@ class AlgorithmLedger:
                 "ts": time.time(),
             }
         )
-        return alg_id
 
     def checkpoint(self, alg_id: int, input_file: str, line: int,
                    counters: dict | None = None) -> None:
@@ -142,7 +159,8 @@ class AlgorithmLedger:
 
     def runs(self) -> list[dict]:
         """All run records, oldest first (the ops/audit read path)."""
-        return [e for e in self._entries if e.get("type") == "run"]
+        with self._lock:
+            return [e for e in self._entries if e.get("type") == "run"]
 
     def undo_intent(self, alg_id: int) -> None:
         """Record that an undo of ``alg_id`` is ABOUT to mutate the store.
@@ -165,11 +183,14 @@ class AlgorithmLedger:
     def pending_undo_intents(self) -> list[int]:
         """Alg ids with an ``undo_intent`` but no completing ``undo`` record
         — the fsck cross-check for crashes mid-undo."""
-        done = {e["alg_id"] for e in self._entries if e.get("type") == "undo"}
-        return sorted({
-            e["alg_id"] for e in self._entries
-            if e.get("type") == "undo_intent" and e["alg_id"] not in done
-        })
+        with self._lock:
+            done = {
+                e["alg_id"] for e in self._entries if e.get("type") == "undo"
+            }
+            return sorted({
+                e["alg_id"] for e in self._entries
+                if e.get("type") == "undo_intent" and e["alg_id"] not in done
+            })
 
     def last_checkpoint(self, input_file: str) -> int:
         """Resume cursor for an input file: the line of its most recently
@@ -179,14 +200,16 @@ class AlgorithmLedger:
         invocation completes the file, so re-submitting a finished file is a
         fresh load (the loader's own skip/duplicate policy governs its rows),
         not a crash recovery."""
+        with self._lock:
+            entries = list(self._entries)
         finished = {
-            e["alg_id"] for e in self._entries if e.get("type") == "finish"
+            e["alg_id"] for e in entries if e.get("type") == "finish"
         }
         undone = {
-            e["alg_id"] for e in self._entries if e.get("type") == "undo"
+            e["alg_id"] for e in entries if e.get("type") == "undo"
         }
         invocations = {
-            e["alg_id"]: e for e in self._entries if e.get("type") == "invocation"
+            e["alg_id"]: e for e in entries if e.get("type") == "invocation"
         }
 
         def is_partial(alg_id: int) -> bool:
@@ -196,8 +219,8 @@ class AlgorithmLedger:
             inv = invocations.get(alg_id)
             return bool(inv and inv.get("params", {}).get("test"))
 
-        for pos in range(len(self._entries) - 1, -1, -1):
-            e = self._entries[pos]
+        for pos in range(len(entries) - 1, -1, -1):
+            e = entries[pos]
             if e.get("type") != "checkpoint" or e.get("file") != input_file:
                 continue
             if e["alg_id"] in undone:
@@ -218,14 +241,18 @@ class AlgorithmLedger:
                 and not inv.get("params", {}).get("test")
                 and inv["alg_id"] in finished
                 and inv["alg_id"] not in undone  # an undone run covers nothing
-                for inv in self._entries[pos + 1:]
+                for inv in entries[pos + 1:]
             )
             return 0 if later_finished else e["line"]
         return 0
 
     def invocations(self) -> list[dict]:
-        return [e for e in self._entries if e.get("type") == "invocation"]
+        with self._lock:
+            return [
+                e for e in self._entries if e.get("type") == "invocation"
+            ]
 
     def entries(self) -> list[dict]:
         """Every parsed record, oldest first (fsck's cross-check surface)."""
-        return list(self._entries)
+        with self._lock:
+            return list(self._entries)

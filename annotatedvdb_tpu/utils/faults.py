@@ -18,7 +18,8 @@ Actions:
 - ``eio``        raise ``OSError(EIO)`` — the transient-I/O error the
                  bounded-retry paths (``utils.retry``) must absorb
 
-Points wired in this repo (grep ``faults.fire(`` for the live list):
+Points wired in this repo (the canonical registry is :data:`POINTS`;
+arming any other name is a ``ValueError`` at parse time):
 
 ======================== ====================================================
 ``store.save.pre_manifest`` just before the manifest tmp write — every
@@ -45,6 +46,20 @@ import signal
 
 _ACTIONS = ("raise", "kill", "torn_write", "eio")
 
+#: canonical registry of every injection point compiled into the tree.
+#: ``_parse`` rejects unknown points at ARM time (a typo'd AVDB_FAULT used
+#: to arm silently and never fire — the crash test then "passed" without
+#: crashing anything); the static analyzer (AVDB301) rejects unregistered
+#: ``faults.fire("<point>")`` literals at the call site, and AVDB302
+#: requires every entry here to appear in tests/test_fault_matrix.py.
+POINTS = frozenset({
+    "store.save.pre_manifest",
+    "store.save.mid_segment",
+    "ledger.append",
+    "egress.flush",
+    "ingest.chunk",
+})
+
 
 class InjectedFault(RuntimeError):
     """The exception the ``raise`` action throws (never caught by library
@@ -67,6 +82,11 @@ def _parse(spec: str | None) -> tuple[str, int, str] | None:
             f"AVDB_FAULT={spec!r}: expected <point>:<nth>[:<action>]"
         )
     point = parts[0]
+    if point not in POINTS:
+        raise ValueError(
+            f"AVDB_FAULT={spec!r}: unknown injection point {point!r} "
+            f"(known points: {', '.join(sorted(POINTS))})"
+        )
     try:
         nth = int(parts[1])
     except ValueError:

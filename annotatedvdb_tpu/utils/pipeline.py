@@ -84,10 +84,18 @@ class BoundedStage:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._done = False
+        # protects the first-error-wins update below: the stage thread and
+        # a concurrent close() can both discover the error (the thread as
+        # it raises, close() as it drains the envelope) — without the lock,
+        # two check-then-set writers could both pass the `is None` check
+        self._lock = threading.Lock()
         #: first exception raised on the stage thread, preserved even when
         #: its _StageError envelope never reaches the consumer (dropped by a
         #: concurrent close(), or the thread died while the stop flag was
-        #: set) — abort paths report the root cause, not a generic teardown
+        #: set) — abort paths report the root cause, not a generic teardown.
+        #: External post-close reads (the loader's teardown log) see a
+        #: settled value.
+        #: guarded by self._lock
         self.error: BaseException | None = None
         #: backpressure accounting (always on: two clock reads per CHUNK)
         self.stats = StageStats(name)
@@ -146,8 +154,9 @@ class BoundedStage:
             # record BEFORE the put: if close() races us (stop set, the put
             # returns False and the envelope is dropped), the root cause
             # still survives on self.error
-            if self.error is None:
-                self.error = exc
+            with self._lock:
+                if self.error is None:
+                    self.error = exc
             self._put(_StageError(exc))
 
     def __iter__(self):
@@ -181,8 +190,10 @@ class BoundedStage:
                             # Silently stopping would truncate the stream
                             # and report success; surface the root cause
                             self._done = True
-                            if self.error is not None:
-                                raise self.error
+                            with self._lock:
+                                err = self.error
+                            if err is not None:
+                                raise err
                             raise StopIteration
                         continue
                     break
@@ -215,8 +226,10 @@ class BoundedStage:
                 # a drained item may be the stage's error envelope — keep
                 # the FIRST one on self.error instead of discarding it with
                 # the data items (abort paths read it for the root cause)
-                if isinstance(item, _StageError) and self.error is None:
-                    self.error = item.exc
+                if isinstance(item, _StageError):
+                    with self._lock:
+                        if self.error is None:
+                            self.error = item.exc
             self._thread.join(timeout=0.25)
             if not self._thread.is_alive():
                 return True

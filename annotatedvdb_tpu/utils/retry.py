@@ -62,7 +62,11 @@ def with_backoff(fn, *, attempts: int = 3, base_delay: float = 0.05,
             return fn()
         except BaseException as exc:
             if attempt >= attempts or not retryable(exc):
-                if attempt > 1:
+                # gave_up counts RETRY EXHAUSTION only: a non-retryable
+                # error after an earlier transient blip is a data/logic
+                # failure, not an exhausted retry (the distinction the
+                # avdb_io_retries_exhausted_total metric exists to draw)
+                if attempt > 1 and retryable(exc):
                     stats["gave_up"] += 1
                 raise
             stats["retries"] += 1

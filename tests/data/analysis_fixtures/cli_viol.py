@@ -1,0 +1,19 @@
+"""Fixture: CLI-contract violations (AVDB501/AVDB502).
+
+tests/test_avdb_check.py runs the analyzer with ``loader_clis`` overridden
+to point at THIS file, which hand-rolls its parser: two shared flags are
+missing entirely and one is re-defined with a drifted default.
+"""
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)  # EXPECT: AVDB501, AVDB501
+    ap.add_argument("--fileName", required=True)
+    ap.add_argument("--commit", action="store_true")
+    ap.add_argument("--test", action="store_true")
+    ap.add_argument("--logAfter", type=int, default=None)
+    ap.add_argument("--logFilePath", default=None)
+    ap.add_argument("--maxErrors", type=int, default=0)  # EXPECT: AVDB502
+    # --metricsOut / --traceOut are MISSING -> the two AVDB501s above
+    return ap.parse_args(argv)

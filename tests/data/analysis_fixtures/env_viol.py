@@ -1,0 +1,18 @@
+"""Fixture: env-var drift violations (AVDB401).
+
+``# EXPECT: <CODE>`` markers pin the expected findings.  Resolution is
+against the REAL ``config.ENV_VARS`` registry; writes are never flagged.
+"""
+import os
+
+
+def read_vars():
+    a = os.environ.get("AVDB_PIPELINE")  # declared: clean
+    b = os.getenv("AVDB_TOTALLY_UNDECLARED")  # EXPECT: AVDB401
+    c = os.environ["AVDB_ALSO_UNDECLARED"]    # EXPECT: AVDB401
+    return a, b, c
+
+
+def write_vars():
+    # writes arm fixtures/tests — the variable's job, never a finding
+    os.environ["AVDB_SOME_WRITE_ONLY"] = "1"

@@ -1,0 +1,45 @@
+"""Fixture: hygiene violations (AVDB601/AVDB602/AVDB603).
+
+``# EXPECT: <CODE>`` markers pin the expected findings.
+"""
+
+
+def swallow_everything():
+    try:
+        return 1 / 0
+    except:                                   # EXPECT: AVDB601
+        pass
+
+
+def swallow_exception():
+    try:
+        return 1 / 0
+    except Exception:                         # EXPECT: AVDB602
+        pass
+
+
+def swallow_with_log_ok(log=print):
+    try:
+        return 1 / 0
+    except Exception as err:  # fine: the error is surfaced
+        log(f"failed: {err}")
+        return None
+
+
+def narrow_pass_ok():
+    try:
+        return 1 / 0
+    except ZeroDivisionError:  # fine: narrow type
+        pass
+
+
+def mutable_default(items=[]):                # EXPECT: AVDB603
+    return items
+
+
+def mutable_default_kw(*, mapping={}):        # EXPECT: AVDB603
+    return mapping
+
+
+def none_default_ok(items=None):
+    return items or []

@@ -1,0 +1,78 @@
+"""Fixture: lock-discipline violations (AVDB201/AVDB202).
+
+``# EXPECT: <CODE>`` markers pin the expected findings; see
+tests/test_avdb_check.py.
+"""
+import threading
+
+
+class GuardedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: guarded by self._lock
+        self._value = 0
+        self._value = 0  # re-assignment in __init__ is exempt
+
+    def inc(self):
+        with self._lock:
+            self._value += 1  # correctly guarded
+
+    def racy_read(self):
+        return self._value                    # EXPECT: AVDB201
+
+    def racy_write(self):
+        self._value = 0                       # EXPECT: AVDB201
+
+    def suppressed_read(self):
+        # lexical rule escape hatch: caller holds the lock
+        return self._value  # avdb: noqa[AVDB201] -- caller holds _lock
+
+    def guarded_then_not(self):
+        with self._lock:
+            v = self._value  # guarded
+        self._value = v + 1                   # EXPECT: AVDB201
+
+
+class StaleAnnotation:
+    def __init__(self):
+        #: guarded by self._lokc  # EXPECT: AVDB202
+        self._events = []
+
+    def read(self):
+        return self._events                   # EXPECT: AVDB201
+
+
+class AugAssignBinding:
+    """The annotation binds to augmented assignments too."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            #: guarded by self._lock
+            self.count += 1  # the annotation binds HERE (augassign)
+
+    def racy_bump(self):
+        self.count += 1                       # EXPECT: AVDB201
+
+
+class FloatingAnnotation:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: guarded by self._lock  # EXPECT: AVDB202
+        # (binds to nothing: no self.X assignment within 3 lines —
+        #  a silently dropped annotation would disable the rule)
+        x = 1
+        del x
+
+
+class Unannotated:
+    """No guard annotations: nothing here is checked."""
+
+    def __init__(self):
+        self.value = 0
+
+    def racy_but_unclaimed(self):
+        self.value += 1  # fine: no annotation claims a lock

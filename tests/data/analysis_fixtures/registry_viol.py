@@ -1,0 +1,25 @@
+"""Fixture: registry-drift violations (AVDB301/AVDB303/AVDB304).
+
+``# EXPECT: <CODE>`` markers pin the expected findings.  The fault-point
+check resolves against the REAL ``faults.POINTS`` registry (the fixture
+lives inside the repo), so ``ingest.chunk`` passes and a typo fails.
+"""
+from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+from annotatedvdb_tpu.utils import faults
+
+reg = MetricsRegistry()
+
+
+def fire_points():
+    faults.fire("ingest.chunk")  # registered: clean
+    faults.fire("ingest.chunkz")              # EXPECT: AVDB301
+
+
+def register_metrics():
+    reg.counter("avdb_fixture_rows_total", "rows", {"loader": "x"})
+    reg.gauge("avdb_fixture_rows_total", "rows")  # EXPECT: AVDB303
+    reg.counter("avdb_fixture_chunks_total", "c", {"loader": "x"})
+    reg.counter("avdb_fixture_chunks_total", "c", {"stage": "y"})  # EXPECT: AVDB304
+    # non-literal labels are skipped, not guessed: no finding
+    labels = {"loader": "z"}
+    reg.counter("avdb_fixture_chunks_total", "c", labels)

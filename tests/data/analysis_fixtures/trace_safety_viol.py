@@ -1,0 +1,68 @@
+"""Fixture: trace-safety violations (AVDB101/AVDB102).
+
+Violation lines carry a trailing ``# EXPECT: <CODE>`` marker;
+tests/test_avdb_check.py asserts the analyzer reports exactly those
+(code, line) pairs for this file.  This file is never imported — the
+analyzer is purely static.
+"""
+import functools
+import os
+
+import jax
+from annotatedvdb_tpu.utils import faults
+
+
+@jax.jit
+def decorated_kernel(x, y):
+    print("tracing", x)                       # EXPECT: AVDB101
+    faults.fire("ingest.chunk")               # EXPECT: AVDB101
+    flag = os.environ.get("AVDB_PIPELINE")    # EXPECT: AVDB101
+    del flag
+    if x > 0:                                 # EXPECT: AVDB102
+        return x + y
+    return x - y
+
+
+def wrapped_kernel(x):
+    counter.inc(1)                            # EXPECT: AVDB101
+    return x * 2
+
+
+wrapped_kernel_jit = jax.jit(wrapped_kernel)
+
+
+def sharded_step(block):
+    if block:                                 # EXPECT: AVDB102
+        return block
+    return block * 0
+
+
+sharded = jax.shard_map(sharded_step)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_ok(x, mode):
+    if mode:          # static param: allowed
+        return x + 1
+    if x:                                     # EXPECT: AVDB102
+        return x
+    return x - 1
+
+
+def shape_read_ok(x):
+    if x.shape[0] > 8:  # static under tracing: allowed
+        return x
+    return x * 2
+
+
+shape_read_ok_jit = jax.jit(shape_read_ok)
+
+
+def host_helper(x):   # NOT traced: none of this is flagged
+    print("fine here")
+    if x:
+        return os.environ.get("AVDB_PIPELINE")
+    return None
+
+
+counter = None

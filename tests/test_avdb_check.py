@@ -1,0 +1,311 @@
+"""Analyzer unit tests: every rule family pinned by checked-in fixture
+files with expected (code, line) pairs, plus suppression semantics, the
+project-audit codes (driven through synthetic registries), the --json
+schema, and a self-hosting smoke test.
+
+Fixture convention (``tests/data/analysis_fixtures/``): a violation line
+carries a trailing ``# EXPECT: <CODE>[, <CODE>...]`` marker; the test
+asserts the analyzer reports EXACTLY those (line, code) pairs for the
+file — so a rule that stops firing (or starts over-firing) fails here
+before it silently stops guarding the tree.  A new rule family lands with
+a fixture file the same way a new fault point lands with a matrix case.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from annotatedvdb_tpu.analysis import run_paths
+from annotatedvdb_tpu.analysis.core import (
+    FileContext,
+    Project,
+    ProjectFacts,
+    find_repo_root,
+)
+
+REPO = find_repo_root(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "analysis_fixtures")
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9,\s]+)")
+
+
+def expected_pairs(path):
+    """{(line, code)} parsed from the fixture's EXPECT markers."""
+    out = set()
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            for code in m.group(1).split(","):
+                code = code.strip()
+                if code:
+                    out.add((i, code))
+    return out
+
+
+def found_pairs(path, **kwargs):
+    findings, n_files = run_paths([path], **kwargs)
+    assert n_files == 1
+    return {(f.line, f.code) for f in findings}, findings
+
+
+FIXTURE_FILES = [
+    "trace_safety_viol.py",
+    "lock_viol.py",
+    "registry_viol.py",
+    "env_viol.py",
+    "hygiene_viol.py",
+]
+
+
+@pytest.mark.parametrize("name", FIXTURE_FILES)
+def test_fixture_findings_match_markers_exactly(name):
+    path = os.path.join(FIXTURES, name)
+    want = expected_pairs(path)
+    assert want, f"{name}: fixture has no EXPECT markers"
+    got, findings = found_pairs(path)
+    assert got == want, (
+        f"{name}: findings != markers\n  extra: {sorted(got - want)}\n"
+        f"  missing: {sorted(want - got)}\n  raw: "
+        + "\n  ".join(f.render() for f in findings)
+    )
+
+
+def test_cli_contract_fixture():
+    """AVDB501/502 need the loader-CLI list pointed at the fixture."""
+    path = os.path.join(FIXTURES, "cli_viol.py")
+    want = expected_pairs(path)
+    got, findings = found_pairs(
+        path, loader_clis=("tests/data/analysis_fixtures/cli_viol.py",)
+    )
+    assert got == want, (got, want)
+
+
+def test_fixtures_fail_via_cli_entrypoint():
+    """Acceptance: the CLI exits non-zero on each checked-in fixture."""
+    for name in FIXTURE_FILES + ["cli_viol.py"]:
+        cmd = [sys.executable, os.path.join(REPO, "tools", "avdb_check.py"),
+               os.path.join(FIXTURES, name)]
+        if name == "cli_viol.py":
+            cmd += ["--loaderCli", "tests/data/analysis_fixtures/cli_viol.py"]
+        p = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+        assert p.returncode == 1, (name, p.returncode, p.stdout, p.stderr)
+
+
+def test_every_rule_family_covered_by_fixtures():
+    """One fixture-backed assertion per family, by construction."""
+    families = set()
+    for name in FIXTURE_FILES + ["cli_viol.py"]:
+        for _line, code in expected_pairs(os.path.join(FIXTURES, name)):
+            families.add(code[:5])  # AVDB1..AVDB6
+    assert families == {"AVDB1", "AVDB2", "AVDB3", "AVDB4", "AVDB5",
+                        "AVDB6"}
+
+
+# ---------------------------------------------------------------------------
+# suppression semantics
+
+
+def test_noqa_parsing_forms(tmp_path):
+    src = (
+        "x = 1  # avdb: noqa[AVDB601]\n"
+        "y = 2  # avdb: noqa[AVDB101, AVDB102] -- reason here\n"
+        "z = 3  # avdb: noqa\n"
+        "w = 4\n"
+    )
+    ctx = FileContext(str(tmp_path / "f.py"), src)
+    assert ctx.suppressed(1, "AVDB601")
+    assert not ctx.suppressed(1, "AVDB602")
+    assert ctx.suppressed(2, "AVDB101") and ctx.suppressed(2, "AVDB102")
+    assert ctx.suppressed(3, "AVDB999")  # blanket
+    assert not ctx.suppressed(4, "AVDB601")
+
+
+def test_noqa_honored_identically_for_relative_and_absolute_scans(tmp_path,
+                                                                  monkeypatch):
+    """Suppression is keyed by absolute path on both sides: a noqa must
+    work the same under `avdb_check .` and `avdb_check /abs/tree` (it was
+    once silently ignored for absolute scans of project-level findings)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(x=[]):  # avdb: noqa[AVDB603] -- fixture\n    return x\n"
+    )
+    abs_findings, _ = run_paths([str(bad)])
+    monkeypatch.chdir(tmp_path)
+    rel_findings, _ = run_paths(["bad.py"])
+    assert abs_findings == [] and rel_findings == []
+
+
+def test_fixture_data_skipped_only_under_tests(tmp_path):
+    """Only tests/data is exempt from scanning — a package dir that merely
+    happens to be NAMED `data` must still be analyzed."""
+    from annotatedvdb_tpu.analysis import iter_python_files
+
+    (tmp_path / "tests" / "data").mkdir(parents=True)
+    (tmp_path / "tests" / "data" / "fixture.py").write_text("x = 1\n")
+    (tmp_path / "pkg" / "data").mkdir(parents=True)
+    (tmp_path / "pkg" / "data" / "module.py").write_text("x = 1\n")
+    files = [os.path.relpath(f, tmp_path)
+             for f in iter_python_files([str(tmp_path)])]
+    assert os.path.join("pkg", "data", "module.py") in files
+    assert os.path.join("tests", "data", "fixture.py") not in files
+
+
+def test_noqa_suppresses_finding_end_to_end(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    pass\n"
+        "except Exception:  # avdb: noqa[AVDB602] -- fixture\n    pass\n"
+    )
+    findings, _ = run_paths([str(bad)])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# project-audit codes (AVDB302/305/402/403) — driven through synthetic
+# registries so the shipped tree (which is clean) still proves they fire
+
+
+def _project(**over):
+    base = dict(
+        root=REPO, readme="", fault_points=frozenset(),
+        fault_matrix_src="", env_declared={}, loader_clis=(),
+        flag_registrars={},
+    )
+    base.update(over)
+    return Project(**base)
+
+
+def _audit_facts():
+    facts = ProjectFacts()
+    facts.full_registry_scan = True
+    facts.tree_scan = True
+    return facts
+
+
+def test_avdb302_uncovered_fault_point():
+    from annotatedvdb_tpu.analysis import rules_registry
+
+    project = _project(
+        fault_points=frozenset({"a.b", "c.d"}),
+        fault_matrix_src="only a.b is exercised here",
+    )
+    findings = rules_registry.finalize(_audit_facts(), project)
+    assert [f.code for f in findings] == ["AVDB302"]
+    assert "c.d" in findings[0].message
+
+
+def test_avdb305_readme_metric_reference():
+    from annotatedvdb_tpu.analysis import rules_registry
+    from annotatedvdb_tpu.analysis.rules_registry import MetricReg
+
+    facts = _audit_facts()
+    facts.metric_regs = {
+        "avdb_real_rows_total": [MetricReg(
+            "avdb_real_rows_total", False, "counter", (), "m.py", 1
+        )],
+    }
+    project = _project(
+        readme="`avdb_real_rows_total` exists; `avdb_ghost_total` not; "
+               "`avdb_check` is a tool, not a metric",
+    )
+    findings = rules_registry.finalize(facts, project)
+    assert [f.code for f in findings] == ["AVDB305"]
+    assert "avdb_ghost_total" in findings[0].message
+
+
+def test_avdb402_403_env_audit():
+    from annotatedvdb_tpu.analysis import rules_env
+
+    facts = _audit_facts()
+    facts.env_reads = [("x.py", 1, "AVDB_USED")]
+    project = _project(
+        env_declared={
+            "AVDB_USED": "doc", "AVDB_UNDOCUMENTED": "doc",
+            "AVDB_STALE": "doc",
+        },
+        readme="AVDB_USED and AVDB_STALE are in the readme",
+    )
+    findings = rules_env.finalize(facts, project)
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f.message)
+    assert sorted(by_code) == ["AVDB402", "AVDB403"]
+    assert any("AVDB_UNDOCUMENTED" in m for m in by_code["AVDB402"])
+    # AVDB_STALE: documented but never read; AVDB_UNDOCUMENTED is also
+    # unread (bench.py supplements reads, neither appears there)
+    assert any("AVDB_STALE" in m for m in by_code["AVDB403"])
+
+
+def test_audit_codes_gated_off_on_partial_scans():
+    """Scanning a fixture subtree must not audit the whole project."""
+    from annotatedvdb_tpu.analysis import rules_env, rules_registry
+
+    facts = ProjectFacts()  # full_registry_scan stays False
+    project = _project(
+        fault_points=frozenset({"never.tested"}),
+        fault_matrix_src="no coverage here",
+        env_declared={"AVDB_NEVER_READ": "doc"},
+        readme="nothing",
+    )
+    codes = [f.code for f in rules_registry.finalize(facts, project)]
+    codes += [f.code for f in rules_env.finalize(facts, project)]
+    assert "AVDB302" not in codes
+    assert "AVDB402" not in codes and "AVDB403" not in codes
+
+
+# ---------------------------------------------------------------------------
+# --json schema (alongside tools/check_bench_schema.py conventions)
+
+
+def test_json_output_schema():
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "avdb_check.py"),
+         "--json", os.path.join(FIXTURES, "hygiene_viol.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 1
+    report = json.loads(p.stdout)
+    assert report["version"] == 1
+    assert report["exit_code"] == 1
+    assert isinstance(report["files_scanned"], int)
+    assert report["files_scanned"] == 1
+    assert isinstance(report["findings"], list) and report["findings"]
+    for f in report["findings"]:
+        assert set(f) == {"code", "path", "line", "message", "hint"}
+        assert re.fullmatch(r"AVDB\d{3}", f["code"])
+        assert isinstance(f["line"], int) and f["line"] >= 1
+        assert f["message"] and f["hint"]
+
+
+def test_json_clean_tree_shape():
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "avdb_check.py"),
+         "--json", os.path.join(REPO, "annotatedvdb_tpu", "analysis")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    report = json.loads(p.stdout)
+    assert report["findings"] == [] and report["exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# self-hosting smoke: the analyzer over the package is clean via the API
+# (the full-tree CLI gate lives in tests/test_static_checks.py)
+
+
+def test_self_hosting_package_clean():
+    findings, n_files = run_paths([os.path.join(REPO, "annotatedvdb_tpu")])
+    assert n_files > 50
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings, _ = run_paths([str(bad)])
+    assert [f.code for f in findings] == ["AVDB001"]

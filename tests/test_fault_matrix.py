@@ -76,7 +76,7 @@ def _run_load(store_dir, vcf, fault=""):
                 loader._writer_pool.shutdown(wait=True, cancel_futures=True)
             if loader._prefetch_pool is not None:
                 loader._prefetch_pool.shutdown(wait=False)
-        except Exception:
+        except Exception:  # avdb: noqa[AVDB602] -- best-effort teardown of a simulated-dead loader; the armed fault is the exception under test
             pass
         return None, exc
     finally:
@@ -210,3 +210,38 @@ def test_sigkill_matrix(tmp_path, reference, fault):
         np.testing.assert_array_equal(got_cols[c], arr, err_msg=c)
     np.testing.assert_array_equal(got_ref, want_ref)
     np.testing.assert_array_equal(got_alt, want_alt)
+
+
+# ---------------------------------------------------------------------------
+# egress.flush — the export leg's injection point.  Not part of the VCF
+# load matrix above (egress runs offline), but every faults.POINTS entry
+# must be crash-tested here (static rule AVDB302): a raise mid-export must
+# abort without leaving a torn COPY tmp, and a rerun must complete.
+
+
+def test_egress_flush_raise_aborts_clean_and_rerun_completes(tmp_path):
+    from annotatedvdb_tpu.io.pg_egress import export_store
+    from annotatedvdb_tpu.utils.faults import InjectedFault
+
+    store = VariantStore(width=8)
+    store.shard(3).append(
+        {"pos": np.asarray([10, 20, 30], np.int32),
+         "h": np.asarray([7, 8, 9], np.uint32),
+         "ref_len": np.full(3, 1, np.int32),
+         "alt_len": np.full(3, 1, np.int32)},
+        np.full((3, 8), 65, np.uint8), np.full((3, 8), 67, np.uint8),
+    )
+    out = str(tmp_path / "export")
+    faults.reset("egress.flush:1:raise")
+    with pytest.raises(InjectedFault):
+        export_store(store, out)
+    # the aborted export left no torn half-written COPY tmp behind
+    data_dir = os.path.join(out, "data")
+    if os.path.isdir(data_dir):
+        assert [f for f in os.listdir(data_dir) if ".tmp" in f] == []
+    # rerun unarmed completes to full content
+    faults.reset("")
+    counts = export_store(store, out)
+    assert counts == {"3": 3}
+    data = open(os.path.join(data_dir, "variant_chr3.copy")).read()
+    assert data.count("\n") == 3

@@ -34,28 +34,45 @@ def test_unarmed_fire_is_noop():
 
 
 def test_nth_hit_fires_exactly_once():
-    faults.reset("p.x:3:raise")
-    faults.fire("p.x")
-    faults.fire("p.other")  # different point: not counted
-    faults.fire("p.x")
+    faults.reset("ingest.chunk:3:raise")
+    faults.fire("ingest.chunk")
+    faults.fire("ledger.append")  # different point: not counted
+    faults.fire("ingest.chunk")
     with pytest.raises(InjectedFault):
-        faults.fire("p.x")
-    faults.fire("p.x")  # past nth: no-op again
-    assert faults.fired() == {"p.x": 1}
+        faults.fire("ingest.chunk")
+    faults.fire("ingest.chunk")  # past nth: no-op again
+    assert faults.fired() == {"ingest.chunk": 1}
 
 
 def test_eio_action_raises_oserror():
-    faults.reset("p.io:1:eio")
+    faults.reset("egress.flush:1:eio")
     with pytest.raises(OSError) as exc:
-        faults.fire("p.io")
+        faults.fire("egress.flush")
     assert exc.value.errno == errno.EIO
 
 
 def test_bad_specs_rejected():
-    for spec in ("nope", "p:x", "p:0", "p:1:explode"):
+    for spec in ("nope", "ingest.chunk:x", "ingest.chunk:0",
+                 "ingest.chunk:1:explode"):
         with pytest.raises(ValueError):
             faults.reset(spec)
         faults.reset("")
+
+
+def test_unknown_point_rejected_at_arm_time():
+    """A typo'd point must fail the arm, not arm silently and never fire —
+    and the error must name the known points so the fix is obvious."""
+    with pytest.raises(ValueError) as exc:
+        faults.reset("store.save.pre_manifst:1:kill")  # typo'd
+    msg = str(exc.value)
+    assert "unknown injection point" in msg
+    for point in sorted(faults.POINTS):
+        assert point in msg
+    faults.reset("")
+    # every registered point arms cleanly
+    for point in faults.POINTS:
+        faults.reset(f"{point}:1:raise")
+    faults.reset("")
 
 
 # ---------------------------------------------------------------------------
@@ -205,3 +222,71 @@ def test_egress_flush_eio_is_retried(tmp_path):
     leftovers = [f for f in os.listdir(os.path.join(out, "data"))
                  if ".tmp" in f]
     assert leftovers == []
+
+
+# ---------------------------------------------------------------------------
+# ledger thread-safety (lock-discipline rule AVDB201 surfaced this): the
+# async store writer checkpoints from its own thread while the main thread
+# appends run/finish records
+
+
+def test_ledger_concurrent_appends_are_serialized(tmp_path):
+    import json
+    import threading
+
+    path = str(tmp_path / "ledger.jsonl")
+    ledger = AlgorithmLedger(path)
+    alg = ledger.begin("load", {"file": "f.vcf"}, commit=True)
+    N = 200
+
+    def checkpoints():
+        for i in range(N):
+            ledger.checkpoint(alg, "f.vcf", i + 1, {})
+
+    def runs():
+        for i in range(N):
+            ledger.run({"script": "t", "i": i})
+
+    threads = [threading.Thread(target=checkpoints),
+               threading.Thread(target=runs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # every line parses (no interleaved/torn writes), every record landed
+    with open(path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert len(lines) == 1 + 2 * N
+    assert len(ledger.runs()) == N
+    reopened = AlgorithmLedger(path)
+    assert reopened.skipped_lines == 0
+    assert reopened.last_checkpoint("f.vcf") == N
+
+
+def test_gave_up_counts_retry_exhaustion_only():
+    """A non-retryable error after an earlier transient blip is a data
+    failure, not an exhausted retry — it must not inflate
+    avdb_io_retries_exhausted_total."""
+    calls = {"n": 0}
+
+    def transient_then_data_error():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(errno.EIO, "blip")
+        raise ValueError("data error")
+
+    before = dict(retry_mod.stats)
+    with pytest.raises(ValueError):
+        with_backoff(transient_then_data_error, attempts=5,
+                     base_delay=0.001)
+    assert retry_mod.stats["retries"] - before["retries"] == 1
+    assert retry_mod.stats["gave_up"] == before["gave_up"]
+
+    def always_transient():
+        raise OSError(errno.EIO, "persistent")
+
+    before = dict(retry_mod.stats)
+    with pytest.raises(OSError):
+        with_backoff(always_transient, attempts=2, base_delay=0.001)
+    assert retry_mod.stats["gave_up"] - before["gave_up"] == 1

@@ -33,9 +33,10 @@ def test_counter_and_gauge_basics():
     assert g.value == 2
     # get-or-create: same (name, labels) returns the same object
     assert reg.counter("avdb_test_total") is c
-    # same name as a different type is a bug
+    # same name as a different type is a bug (the kind conflict is the
+    # behavior under test here, mirroring static rule AVDB303)
     with pytest.raises(TypeError):
-        reg.gauge("avdb_test_total")
+        reg.gauge("avdb_test_total")  # avdb: noqa[AVDB303] -- deliberate kind conflict asserting the registry raises
 
 
 def test_histogram_fixed_bucket_edges():

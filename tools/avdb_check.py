@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Project-native static analysis driver (``annotatedvdb_tpu.analysis``).
+
+Runs the six AVDB rule families (trace-safety, lock-discipline,
+registry-drift, env-var drift, CLI-contract, hygiene) over the tree.  See
+README "Static analysis & code health" for the rule catalog and the
+suppression policy (``# avdb: noqa[CODE] -- reason``).
+
+Usage:
+    python tools/avdb_check.py [--json] [paths...]
+
+Default paths: ``annotatedvdb_tpu tools tests bench.py`` relative to the
+repo root.  Exit codes mirror ``tools/store_fsck.py``: 0 = clean,
+1 = findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PATHS = ("annotatedvdb_tpu", "tools", "tests", "bench.py")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report on stdout")
+    ap.add_argument("--loaderCli", action="append", default=None,
+                    metavar="PATH",
+                    help="override the CLI-contract file list (repeatable; "
+                         "fixture tests point this at synthetic CLIs)")
+    args = ap.parse_args(argv)
+
+    from annotatedvdb_tpu.analysis import run_paths
+    from annotatedvdb_tpu.analysis.core import find_repo_root
+
+    root = find_repo_root(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [
+        os.path.join(root, p) for p in DEFAULT_PATHS
+        if os.path.exists(os.path.join(root, p))
+    ]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"avdb_check: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    try:
+        findings, n_files = run_paths(
+            paths,
+            loader_clis=(
+                tuple(args.loaderCli) if args.loaderCli else None
+            ),
+        )
+    except Exception as err:  # internal analyzer error, not a finding
+        print(f"avdb_check: internal error: {err!r}", file=sys.stderr)
+        return 2
+    exit_code = 1 if findings else 0
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "files_scanned": n_files,
+            "findings": [f.as_dict() for f in findings],
+            "exit_code": exit_code,
+        }, indent=1, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"avdb_check: {n_files} file(s), {len(findings)} finding(s)",
+            file=sys.stderr,
+        )
+    return exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# One entry point for every static check this repo carries; tier-1
+# (tests/test_static_checks.py) shells this script so the whole suite
+# gates every PR without separate CI infrastructure.
+#
+#   1. avdb_check  — project-native rules (trace-safety, lock-discipline,
+#                    registry-drift, env-drift, CLI-contract, hygiene)
+#   2. ruff        — generic pyflakes-class lint (pyproject.toml subset);
+#                    SKIPPED with a notice when ruff is not installed
+#                    (the container image does not ship it)
+#   3. check_bench_schema — committed BENCH_*.json records stay loadable
+#
+# Exit: 0 all clean, 1 any check found problems.
+
+set -u
+root="$(cd "$(dirname "$0")/.." && pwd)"
+rc=0
+
+echo "== avdb_check ==" >&2
+python "$root/tools/avdb_check.py" \
+    "$root/annotatedvdb_tpu" "$root/tools" "$root/tests" "$root/bench.py" \
+    || rc=1
+
+echo "== ruff ==" >&2
+if command -v ruff >/dev/null 2>&1; then
+    (cd "$root" && ruff check .) || rc=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    (cd "$root" && python -m ruff check .) || rc=1
+else
+    echo "ruff not installed: skipped (pyproject.toml carries the config)" >&2
+fi
+
+echo "== bench schema ==" >&2
+python "$root/tools/check_bench_schema.py" || rc=1
+
+if [ "$rc" -eq 0 ]; then
+    echo "run_checks: all checks clean" >&2
+else
+    echo "run_checks: FAILURES above" >&2
+fi
+exit "$rc"
