@@ -1,8 +1,9 @@
 """Umbrella CLI: ``python -m annotatedvdb_tpu <command> [flags]``.
 
-One entry point over the twelve task drivers (the reference scatters them
-across ``Load/bin``, ``Util/bin`` and ``BinIndex/bin``); each command
-delegates to its module's ``main(argv)`` so both invocation styles work.
+One entry point over the task drivers (the reference scatters them across
+``Load/bin``, ``Util/bin`` and ``BinIndex/bin``) plus the serving front
+end (``serve``); each command delegates to its module's ``main(argv)`` so
+both invocation styles work.
 """
 
 from __future__ import annotations
@@ -19,6 +20,8 @@ COMMANDS = {
     "update-annotation": ("annotatedvdb_tpu.cli.update_variant_annotation",
                           "TSV-driven column updates"),
     "undo": ("annotatedvdb_tpu.cli.undo_load", "undo a load by invocation id"),
+    "serve": ("annotatedvdb_tpu.cli.serve",
+              "HTTP query API over a store (point/bulk/region reads)"),
     "doctor": ("annotatedvdb_tpu.cli.doctor",
                "store fsck/repair + quarantine replay"),
     "export-vcf": ("annotatedvdb_tpu.cli.export_variant2vcf",

@@ -1,0 +1,106 @@
+"""CLI: serve point/bulk/region queries over a loaded variant store.
+
+The read-side entry point the reference never shipped as a program (its
+query surface is raw SQL against ``AnnotatedVDB.Variant``): a stdlib JSON
+API over the store directory, with request coalescing, bounded admission,
+and snapshot isolation against concurrent loader commits.
+
+Usage::
+
+    python -m annotatedvdb_tpu serve --storeDir ./vdb --port 8080
+    curl localhost:8080/variant/8:1000:A:G
+    curl 'localhost:8080/region/8:1000-250000?minCadd=20'
+
+``--port 0`` binds an ephemeral port (printed on startup) — the smoke/test
+mode.  Batching/admission knobs default from ``AVDB_SERVE_*`` (see README
+"Configuration"); flags override the environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="HTTP query API over a TPU-native variant store"
+    )
+    parser.add_argument("--storeDir", required=True,
+                        help="variant store directory (opened read-only)")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address (default 127.0.0.1)")
+    parser.add_argument("--port", type=int, default=8080,
+                        help="bind port (0 = ephemeral, printed on startup)")
+    parser.add_argument("--maxBatch", type=int, default=None,
+                        help="max point queries per coalesced microbatch "
+                             "(default: AVDB_SERVE_BATCH_MAX or 256)")
+    parser.add_argument("--batchWaitMs", type=float, default=None,
+                        help="batcher drain deadline in ms "
+                             "(default: AVDB_SERVE_BATCH_WAIT_MS or 2)")
+    parser.add_argument("--maxQueue", type=int, default=None,
+                        help="admission bound: pending queries beyond this "
+                             "are rejected 429 "
+                             "(default: AVDB_SERVE_MAX_QUEUE or 1024)")
+    parser.add_argument("--regionCache", type=int, default=None,
+                        help="rendered hot-region LRU capacity "
+                             "(default: AVDB_SERVE_REGION_CACHE or 64)")
+    parser.add_argument("--metricsOut", default=None, metavar="FILE",
+                        help="write serving metrics on shutdown: Prometheus "
+                             "textfile at FILE plus JSON at FILE.json "
+                             "(live scrape: GET /metrics)")
+    parser.add_argument("--traceOut", default=None, metavar="FILE",
+                        help="write a Chrome trace of batcher drain spans "
+                             "on shutdown")
+    args = parser.parse_args(argv)
+
+    from annotatedvdb_tpu.obs.trace import Tracer
+    from annotatedvdb_tpu.serve.http import build_server
+
+    def log(msg):
+        print(f"serve: {msg}", file=sys.stderr)
+
+    tracer = Tracer(process_name="avdb-serve") if args.traceOut else None
+    try:
+        httpd = build_server(
+            store_dir=args.storeDir, host=args.host, port=args.port,
+            max_batch=args.maxBatch,
+            max_wait_s=(
+                args.batchWaitMs / 1000.0
+                if args.batchWaitMs is not None else None
+            ),
+            max_queue=args.maxQueue, region_cache_size=args.regionCache,
+            tracer=tracer, log=log,
+        )
+    except (OSError, ValueError) as err:
+        print(f"serve: cannot start: {err}", file=sys.stderr)
+        return 1
+    ctx = httpd.ctx
+    snap = ctx.manager.current()
+    host, port = httpd.server_address[:2]
+    print(f"serving {args.storeDir} (generation {snap.generation}, "
+          f"{snap.store.n} rows) on http://{host}:{port}", flush=True)
+    try:
+        httpd.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        print("serve: shutting down", file=sys.stderr)
+    finally:
+        httpd.server_close()
+        ctx.batcher.close()
+        if args.metricsOut:
+            try:
+                ctx.registry.write_textfile(args.metricsOut)
+                ctx.registry.write_json(args.metricsOut + ".json")
+            except OSError as err:
+                print(f"serve: metrics export failed ({err})",
+                      file=sys.stderr)
+        if tracer is not None and args.traceOut:
+            try:
+                tracer.save(args.traceOut)
+            except OSError as err:
+                print(f"serve: trace export failed ({err})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

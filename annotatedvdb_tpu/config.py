@@ -69,6 +69,17 @@ ENV_VARS: dict = {
                           "HBM (device lookup cache)",
     "AVDB_FAULT": "<point>:<nth>[:<action>] deterministic fault injection "
                   "(see utils/faults.py; unknown points fail the arm)",
+    # query & serving (serve/)
+    "AVDB_SERVE_BATCH_MAX": "max point queries coalesced into one device "
+                            "microbatch (default 256)",
+    "AVDB_SERVE_BATCH_WAIT_MS": "batcher drain deadline in ms: how long the "
+                                "first query of a batch waits for company "
+                                "(default 2)",
+    "AVDB_SERVE_MAX_QUEUE": "admission bound: pending queries beyond this "
+                            "are rejected with HTTP 429 (default 1024)",
+    "AVDB_SERVE_REGION_CACHE": "LRU capacity of the rendered hot-region "
+                               "cache, keyed by store generation "
+                               "(default 64; 0 disables)",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "
@@ -143,14 +154,18 @@ class StoreConfig:
     store_dir: str
     width: int = DEFAULT_ALLELE_WIDTH  # fixed per store at creation
 
-    def open(self, create: bool = True):
-        """(store, ledger) — loading the existing store when present."""
+    def open(self, create: bool = True, readonly: bool = False):
+        """(store, ledger) — loading the existing store when present.
+
+        ``readonly=True`` is the serving/read-path mode: the store must
+        already exist (never created), ``save`` is forbidden, and missing
+        shards are never materialized by lookups."""
         from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
 
         manifest = os.path.join(self.store_dir, "manifest.json")
         if os.path.exists(manifest):
-            store = VariantStore.load(self.store_dir)
-        elif create:
+            store = VariantStore.load(self.store_dir, readonly=readonly)
+        elif create and not readonly:
             os.makedirs(self.store_dir, exist_ok=True)
             store = VariantStore(width=self.width)
         else:

@@ -5,6 +5,11 @@ against the store: device FNV hash over the width-bounded alleles, host
 re-hash from the original strings for over-width rows (their device arrays
 are truncated, so the device hash would collide on shared prefixes), then a
 per-chromosome sorted-merge lookup against the shard.
+
+The serving read path (``serve/engine.py``) resolves client-supplied
+``chr:pos:ref:alt`` ids through :func:`identity_hashes` — the numpy twin of
+the same rule — so a query hashes byte-identically to the load that wrote
+the row.
 """
 
 from __future__ import annotations
@@ -12,8 +17,26 @@ from __future__ import annotations
 import numpy as np
 
 from annotatedvdb_tpu.io.vcf import VcfChunk
-from annotatedvdb_tpu.ops.hashing import allele_hash_jit
+from annotatedvdb_tpu.ops.hashing import allele_hash_jit, allele_hash_np
 from annotatedvdb_tpu.store import VariantStore
+
+
+def identity_hashes(width: int, ref: np.ndarray, alt: np.ndarray,
+                    ref_len: np.ndarray, alt_len: np.ndarray,
+                    refs=None, alts=None) -> np.ndarray:
+    """[N] uint32 identity hashes, host path: numpy FNV over the
+    width-bounded allele arrays, with the over-width host-string override
+    when the original strings are supplied.  Must stay bit-identical to the
+    loader's device hashing (``chunk_hashes``) — store membership compares
+    these against load-time hashes."""
+    from annotatedvdb_tpu.loaders.vcf_loader import _fnv32_str
+
+    h = allele_hash_np(ref, alt, ref_len, alt_len)
+    if refs is not None:
+        for i in np.where((np.asarray(ref_len) > width)
+                          | (np.asarray(alt_len) > width))[0]:
+            h[i] = _fnv32_str(refs[i], alts[i])
+    return h
 
 
 def chunk_hashes(store: VariantStore, chunk: VcfChunk) -> np.ndarray:
@@ -38,7 +61,9 @@ def chunk_lookup(store: VariantStore, chunk: VcfChunk, h: np.ndarray | None = No
     """Yield (code, shard, sel, found, idx) per chromosome present in the
     chunk.  ``shard`` is None (with found all-False) for chromosomes the
     store does not hold — callers must not create shards as a side effect of
-    a lookup (empty shards would be persisted by the next save)."""
+    a lookup (empty shards would be persisted by the next save; read paths
+    can make that structurally impossible by opening with
+    ``VariantStore.load(..., readonly=True)``)."""
     batch = chunk.batch
     if h is None:
         h = chunk_hashes(store, chunk)

@@ -1,0 +1,35 @@
+"""avdb-serve: TPU-resident query & serving subsystem.
+
+The read path over a loaded :class:`~annotatedvdb_tpu.store.VariantStore`:
+
+- :mod:`~annotatedvdb_tpu.serve.engine`   — point / bulk / region queries;
+- :mod:`~annotatedvdb_tpu.serve.batcher`  — continuous batching of
+  concurrent point queries into device microbatches;
+- :mod:`~annotatedvdb_tpu.serve.snapshot` — generation pinning so loader
+  commits never tear in-flight reads;
+- :mod:`~annotatedvdb_tpu.serve.http`     — stdlib JSON API front end
+  (imported lazily by the CLI; not re-exported here to keep engine-only
+  consumers free of ``http.server``).
+
+Entry point: ``python -m annotatedvdb_tpu serve --storeDir <dir>``.
+"""
+
+from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
+from annotatedvdb_tpu.serve.engine import (
+    QueryEngine,
+    QueryError,
+    parse_region,
+    parse_variant_id,
+    render_variant,
+)
+from annotatedvdb_tpu.serve.snapshot import (
+    SnapshotManager,
+    StaticSnapshots,
+    StoreSnapshot,
+)
+
+__all__ = [
+    "QueryBatcher", "QueueFull", "QueryEngine", "QueryError",
+    "SnapshotManager", "StaticSnapshots", "StoreSnapshot",
+    "parse_region", "parse_variant_id", "render_variant",
+]

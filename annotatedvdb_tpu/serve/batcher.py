@@ -1,0 +1,237 @@
+"""Request coalescing: continuous batching for concurrent point lookups.
+
+Concurrent HTTP handler threads each carry ONE query; probing the store one
+row at a time would waste everything the vectorized membership path is good
+at.  The batcher is the continuous-batching shape inference stacks use
+(annbatch makes the same argument for sharded scientific stores): callers
+enqueue single queries and block; one drain thread pulls the first pending
+query, waits up to a deadline for company, executes the whole microbatch
+through ``QueryEngine.lookup_many`` (one vectorized probe per chromosome
+group — large batches ride the device probe path), and hands each caller
+its own slice back.
+
+Knobs (env defaults, overridable per instance):
+
+- ``AVDB_SERVE_BATCH_MAX``      — max queries per microbatch (default 256);
+- ``AVDB_SERVE_BATCH_WAIT_MS``  — how long the first query of a batch waits
+  for company (default 2ms: under load batches fill and the wait never
+  triggers; idle, a lone query pays at most the deadline);
+- ``AVDB_SERVE_MAX_QUEUE``      — admission bound; ``submit`` beyond this
+  depth raises :class:`QueueFull` (the HTTP layer's 429).
+
+Queries are grammar-validated at ``submit`` so a malformed id fails ONLY
+its own caller — co-batched strangers never share a client's parse error.
+A real engine failure mid-drain fails that one batch (every waiter gets the
+root cause) and the drain thread keeps serving; the ``serve.batch`` fault
+point fires before each drain so the matrix pins exactly that behavior.
+
+Accounting reuses the pipeline's :class:`~annotatedvdb_tpu.utils.pipeline.
+StageStats` (items / consumer_wait_s / max_depth on the admission queue)
+plus batch-fill metrics when a registry is attached.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import queue
+import threading
+import time
+
+from annotatedvdb_tpu.serve.engine import parse_variant_id
+from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils.pipeline import StageStats
+
+#: batch-fill histogram edges (fraction of max_batch actually used)
+BATCH_FILL_EDGES = (0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+
+class QueueFull(RuntimeError):
+    """Admission rejection: the pending-query queue is at capacity.  The
+    HTTP front end maps this to 429 + Retry-After."""
+
+
+class _Pending:
+    """One caller's query in flight: the drain thread fills ``result`` or
+    ``error`` then sets ``done`` (the Event publishes the write)."""
+
+    __slots__ = ("qid", "result", "error", "done")
+
+    def __init__(self, qid: str):
+        self.qid = qid
+        self.result = None
+        self.error: BaseException | None = None
+        self.done = threading.Event()
+
+
+class QueryBatcher:
+    """Drains concurrent single-query submissions into padded microbatches."""
+
+    def __init__(self, engine, max_batch: int | None = None,
+                 max_wait_s: float | None = None,
+                 max_queue: int | None = None,
+                 tracer=None, registry=None, timeout_s: float = 30.0):
+        if max_batch is None:
+            max_batch = int(os.environ.get("AVDB_SERVE_BATCH_MAX", "") or 256)
+        if max_wait_s is None:
+            max_wait_s = int(
+                os.environ.get("AVDB_SERVE_BATCH_WAIT_MS", "") or 2
+            ) / 1000.0
+        if max_queue is None:
+            max_queue = int(
+                os.environ.get("AVDB_SERVE_MAX_QUEUE", "") or 1024
+            )
+        self.engine = engine
+        self.max_batch = max(int(max_batch), 1)
+        self.max_wait_s = max(float(max_wait_s), 0.0)
+        self.max_queue = max(int(max_queue), 0)
+        self.timeout_s = timeout_s
+        self.tracer = tracer
+        #: admission-queue accounting (items per drain, idle wait, depth
+        #: high-water) — same shape the pipeline boundaries report
+        self.stats = StageStats("serve.batch")
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        #: guarded by self._lock
+        self._batches = 0
+        #: guarded by self._lock
+        self._queries = 0
+        if registry is not None:
+            self._m_batches = registry.counter(
+                "avdb_serve_batches_total", "batcher drains executed"
+            )
+            self._m_fill = registry.histogram(
+                "avdb_serve_batch_fill", BATCH_FILL_EDGES,
+                "fraction of max_batch used per drain",
+            )
+            self._m_depth = registry.gauge(
+                "avdb_serve_queue_depth", "pending queries awaiting a drain"
+            )
+        else:
+            self._m_batches = self._m_fill = self._m_depth = None
+        self._thread = threading.Thread(
+            target=self._run, name="avdb-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- caller side --------------------------------------------------------
+
+    def depth(self) -> int:
+        """Pending (undrained) queries — the admission gauge."""
+        return self._q.qsize()
+
+    def submit(self, variant_id: str):
+        """Enqueue one point query and block for its result (JSON text or
+        None).  Raises :class:`QueueFull` at the admission bound,
+        :class:`~annotatedvdb_tpu.serve.engine.QueryError` on bad grammar
+        (validated HERE, before the queue), or the drain's root cause."""
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        parse_variant_id(variant_id)  # grammar errors stay with this caller
+        if self._q.qsize() >= self.max_queue:
+            raise QueueFull(
+                f"serve queue full ({self.max_queue} pending queries)"
+            )
+        pending = _Pending(variant_id)
+        self._q.put(pending)
+        if self._m_depth is not None:
+            self._m_depth.set(self._q.qsize())
+        if not pending.done.wait(self.timeout_s):
+            raise TimeoutError(
+                f"query {variant_id!r} timed out after {self.timeout_s}s "
+                "in the serve batcher"
+            )
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def drain_stats(self) -> dict:
+        """Lifetime coalescing summary (the bench's batch-fill source)."""
+        with self._lock:
+            batches, queries = self._batches, self._queries
+        return {
+            "batches": batches,
+            "queries": queries,
+            "batch_fill": round(
+                queries / (batches * self.max_batch), 4
+            ) if batches else 0.0,
+            "queue": self.stats.as_dict(),
+        }
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the drain thread; queued-but-undrained queries fail with a
+        closed error rather than hang their callers."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        self._fail_queued(RuntimeError("serve batcher closed"))
+
+    # -- drain thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        q, stats = self._q, self.stats
+        while True:
+            t0 = time.perf_counter()
+            try:
+                first = q.get(timeout=0.05)
+            except queue.Empty:
+                stats.consumer_wait_s += time.perf_counter() - t0
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [first]
+            deadline = time.perf_counter() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            depth = q.qsize()
+            if depth > stats.max_depth:
+                stats.max_depth = depth
+            self._drain(batch)
+            if self._stop.is_set():
+                self._fail_queued(RuntimeError("serve batcher closed"))
+                return
+
+    def _drain(self, batch: list) -> None:
+        stats = self.stats
+        stats.items += len(batch)
+        try:
+            # crash point: the microbatch is assembled, nothing executed —
+            # a failure here must fail exactly this batch's callers and
+            # leave the drain thread serving
+            faults.fire("serve.batch")
+            span = (
+                self.tracer.span("serve.batch", n=len(batch))
+                if self.tracer is not None else contextlib.nullcontext()
+            )
+            with span:
+                results = self.engine.lookup_many([p.qid for p in batch])
+        except Exception as exc:
+            for pending in batch:
+                pending.error = exc
+                pending.done.set()
+            return
+        for pending, result in zip(batch, results):
+            pending.result = result
+            pending.done.set()
+        with self._lock:
+            self._batches += 1
+            self._queries += len(batch)
+        if self._m_batches is not None:
+            self._m_batches.inc()
+            self._m_fill.observe(len(batch) / self.max_batch)
+            self._m_depth.set(self._q.qsize())
+
+    def _fail_queued(self, error: BaseException) -> None:
+        while True:
+            try:
+                pending = self._q.get_nowait()
+            except queue.Empty:
+                return
+            pending.error = error
+            pending.done.set()

@@ -1,0 +1,447 @@
+"""Query engine: point, bulk, and region reads over a pinned store snapshot.
+
+The read-side twin of the loaders.  The reference serves these queries from
+Postgres — point lookups by ``record_primary_key``, range scans through the
+hierarchical bin index (``find_bin_index`` + the ``bin_index`` ltree column)
+— and this engine answers the same three shapes against the TPU-native
+columnar store:
+
+- **point**: ``chr:pos:ref:alt`` resolves through the SAME identity rule
+  the loaders use (``loaders.lookup.identity_hashes``: FNV over the
+  width-bounded allele bytes, host-string override for over-width rows),
+  then one sorted-merge probe per shard (``ChromosomeShard.lookup``);
+- **bulk**: many thousands of ids per call, grouped per chromosome and
+  probed as ONE vectorized batch — which rides the existing device probe
+  path (HBM segment cache + ``ops/dedup.lookup_in_sorted``) exactly where
+  a loader's membership check would;
+- **region**: ``chr:start-end`` computes the enclosing hierarchical bin via
+  the closed-form device kernel (``ops.binindex.bin_index_kernel``), then
+  slices each sorted segment by position (rows sort by ``(pos, hash)``, so
+  ``pos`` is directly ``searchsorted``-able per segment) — the BITS-style
+  vectorized interval intersection, no tree walk, no per-row compare.
+  Results dedup first-wins across segments (the store's duplicate policy)
+  and support the two annotation filters clients actually page on:
+  minimum CADD phred and ADSP consequence-rank cutoff.
+
+Records render as JSON **text** through the same codec the egress path uses
+(``store.variant_store.jsonb_dumps``): a ``RawJson`` annotation splices its
+stored text verbatim — zero parse/re-serialize on the hot read path — and
+rendering never mutates the snapshot (unlike ``get_ann``, which
+materializes parsed trees back into the column).
+
+Rendered region responses sit in a small LRU keyed by store generation
+(``AVDB_SERVE_REGION_CACHE``), so a hot region costs one dict probe until
+the next loader commit swaps the generation and naturally invalidates it.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from annotatedvdb_tpu.loaders.lookup import identity_hashes
+from annotatedvdb_tpu.oracle.binindex import closed_form_path
+from annotatedvdb_tpu.store.variant_store import (
+    _DIGEST_PK,
+    _LONG_ALLELES,
+    JSONB_COLUMNS,
+    jsonb_dumps,
+)
+from annotatedvdb_tpu.types import (
+    chromosome_code,
+    chromosome_label,
+    decode_allele,
+    encode_allele_array,
+)
+
+
+class QueryError(ValueError):
+    """Malformed query (grammar / unknown chromosome / bad range) — the
+    client's fault; HTTP maps it to 400, never 500."""
+
+
+_ALLELE_RE = re.compile(r"^[ACGTUNacgtun]+$")
+
+#: region span cap: one level-0 bin side (64Mb) covers any chromosome arm;
+#: anything wider is a scan, not a region query, and must page.
+MAX_REGION_SPAN = 64_000_000
+
+
+def parse_variant_id(spec: str) -> tuple[int, int, str, str]:
+    """``chr:pos:ref:alt`` -> (chrom code, pos, REF, ALT).
+
+    Accepts a ``chr`` prefix and tolerates a trailing ``:rs<N>`` field (the
+    store's own primary keys round-trip as queries).  Alleles are uppercased
+    — the store encodes uppercase bytes."""
+    parts = spec.split(":")
+    if len(parts) == 5 and parts[4].startswith("rs"):
+        parts = parts[:4]
+    if len(parts) != 4:
+        raise QueryError(
+            f"bad variant id {spec!r}: expected chr:pos:ref:alt"
+        )
+    code = chromosome_code(parts[0])
+    if code == 0:
+        raise QueryError(f"bad variant id {spec!r}: unknown chromosome")
+    try:
+        pos = int(parts[1])
+    except ValueError:
+        raise QueryError(
+            f"bad variant id {spec!r}: position is not an integer"
+        ) from None
+    if pos < 1:
+        raise QueryError(f"bad variant id {spec!r}: position is 1-based")
+    ref, alt = parts[2].upper(), parts[3].upper()
+    if not _ALLELE_RE.match(ref) or not _ALLELE_RE.match(alt):
+        raise QueryError(f"bad variant id {spec!r}: non-nucleotide allele")
+    return code, pos, ref, alt
+
+
+def parse_region(spec: str) -> tuple[int, int, int]:
+    """``chr:start-end`` -> (chrom code, start, end), 1-based inclusive."""
+    chrom, sep, rng = spec.partition(":")
+    start_s, dash, end_s = rng.partition("-")
+    if not sep or not dash:
+        raise QueryError(f"bad region {spec!r}: expected chr:start-end")
+    code = chromosome_code(chrom)
+    if code == 0:
+        raise QueryError(f"bad region {spec!r}: unknown chromosome")
+    try:
+        start, end = int(start_s), int(end_s)
+    except ValueError:
+        raise QueryError(f"bad region {spec!r}: bounds must be integers") \
+            from None
+    if start < 1 or end < start:
+        raise QueryError(
+            f"bad region {spec!r}: need 1 <= start <= end"
+        )
+    if end - start + 1 > MAX_REGION_SPAN:
+        raise QueryError(
+            f"bad region {spec!r}: span exceeds {MAX_REGION_SPAN} bp — "
+            "page the query"
+        )
+    return code, start, end
+
+
+@functools.lru_cache(maxsize=4096)
+def _region_bin(start: int, end: int) -> tuple[int, int]:
+    """(level, leaf_bin) of the deepest bin enclosing [start, end] — the
+    closed-form device kernel, batched [1] and memoized (hot regions skip
+    the dispatch; the LRU also absorbs the one-time trace cost).  The test
+    suite cross-checks this answer against the scalar host oracle
+    (``oracle.binindex.closed_form_bin``) per region query."""
+    from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
+
+    level, leaf = bin_index_kernel_jit(
+        np.asarray([start], np.int32), np.asarray([end], np.int32)
+    )
+    return int(level[0]), int(leaf[0])
+
+
+@functools.lru_cache(maxsize=8192)
+def _bin_path(label: str, level: int, leaf: int) -> str:
+    """Memoized ltree path: rows cluster into few (level, leaf) pairs —
+    a 20kb region spans ~2 leaves — so path assembly amortizes away."""
+    return closed_form_path(label, level, leaf)
+
+
+def render_variant(shard, code: int, gid: int) -> str:
+    """One store row (by global id) as JSON text."""
+    seg, j = shard.locate_row(gid)
+    return _render_row(seg, j, chromosome_label(code), shard.width)
+
+
+def _render_row(seg, j: int, label: str, width: int) -> str:
+    """One segment row as JSON text (fixed field order; annotation values
+    splice through ``jsonb_dumps`` — raw-text columns copy verbatim).
+    Identity strings are assembled without ``json.dumps``: alleles, labels,
+    and PKs are [A-Za-z0-9:._-] by construction, nothing to escape."""
+    # alleles: retained original strings for the over-width tail, decoded
+    # device bytes otherwise (the scalar definition shard.alleles pins)
+    la = seg.obj[_LONG_ALLELES]
+    if la is not None and la[j] is not None:
+        ref, alt = la[j]
+    else:
+        ref_len = int(seg.cols["ref_len"][j])
+        alt_len = int(seg.cols["alt_len"][j])
+        if ref_len > width or alt_len > width:
+            raise ValueError(
+                f"allele length {max(ref_len, alt_len)} exceeds store "
+                f"width {width} with no retained strings (store predates "
+                "long-allele retention; reload from source)"
+            )
+        ref = decode_allele(seg.ref[j], ref_len)
+        alt = decode_allele(seg.alt[j], alt_len)
+    pos = int(seg.cols["pos"][j])
+    rs = int(seg.cols["ref_snp"][j])
+    adsp = int(seg.cols["is_adsp_variant"][j])
+    rs_suffix = f":rs{rs}" if rs >= 0 else ""
+    # record PK: retained digest for the long-allele tail, else the literal
+    # (primary_key_generator.py:99-122 semantics, same as shard.primary_key)
+    dp = seg.obj[_DIGEST_PK]
+    if dp is not None and dp[j] is not None:
+        pk = dp[j]
+    else:
+        pk = f"{label}:{pos}:{ref}:{alt}{rs_suffix}"
+    bin_path = _bin_path(
+        label, int(seg.cols["bin_level"][j]), int(seg.cols["leaf_bin"][j])
+    )
+    parts = [
+        f'"primary_key":"{pk}"',
+        f'"metaseq_id":"{label}:{pos}:{ref}:{alt}"',
+        f'"chromosome":"{label}"',
+        f'"position":{pos}',
+        f'"ref":"{ref}"',
+        f'"alt":"{alt}"',
+        '"ref_snp":' + (f'"rs{rs}"' if rs >= 0 else "null"),
+        '"is_multi_allelic":'
+        + ("true" if seg.cols["is_multi_allelic"][j] else "false"),
+        '"is_adsp_variant":'
+        + ("null" if adsp < 0 else ("true" if adsp else "false")),
+        f'"bin_index":{json.dumps(bin_path)}',
+    ]
+    ann = []
+    for c in JSONB_COLUMNS:
+        col = seg.obj[c]
+        if col is None:
+            continue
+        v = col[j]
+        if v is not None:
+            ann.append(f'"{c}":{jsonb_dumps(v)}')
+    parts.append('"annotations":{' + ",".join(ann) + "}")
+    return "{" + ",".join(parts) + "}"
+
+
+def _ann_number(seg, j: int, column: str, field: str):
+    """Numeric ``field`` of row j's ``column`` annotation, or None.  Reads
+    the object column without materializing (RawJson stays raw for every
+    OTHER consumer; its cached parse is row-local and never written back)."""
+    col = seg.obj[column]
+    if col is None:
+        return None
+    v = col[j]
+    if v is None or not hasattr(v, "get"):
+        return None
+    out = v.get(field)
+    return out if isinstance(out, (int, float)) \
+        and not isinstance(out, bool) else None
+
+
+class QueryEngine:
+    """Point/bulk/region queries over a snapshot provider
+    (:class:`~annotatedvdb_tpu.serve.snapshot.SnapshotManager` in a server,
+    :class:`~annotatedvdb_tpu.serve.snapshot.StaticSnapshots` in tests)."""
+
+    def __init__(self, snapshots, registry=None,
+                 region_cache_size: int | None = None):
+        self.snapshots = snapshots
+        if region_cache_size is None:
+            region_cache_size = int(
+                os.environ.get("AVDB_SERVE_REGION_CACHE", "") or 64
+            )
+        self.region_cache_size = max(int(region_cache_size), 0)
+        self._cache_lock = threading.Lock()
+        #: guarded by self._cache_lock
+        self._region_cache: OrderedDict = OrderedDict()
+        if registry is not None:
+            self._cache_hits = registry.counter(
+                "avdb_query_cache_hits_total",
+                "region queries served from the rendered LRU",
+            )
+            self._cache_misses = registry.counter(
+                "avdb_query_cache_misses_total",
+                "region queries that rendered fresh",
+            )
+        else:
+            self._cache_hits = self._cache_misses = None
+
+    # -- point / bulk -------------------------------------------------------
+
+    def lookup(self, variant_id: str) -> str | None:
+        """JSON text of the record, or None when absent."""
+        return self.lookup_many([variant_id])[0]
+
+    def lookup_many(self, ids: list) -> list:
+        """[JSON text | None] per id, order-preserving.  Ids are parsed up
+        front (one bad id fails the CALL with :class:`QueryError` — the
+        batcher pre-validates at submit so co-batched strangers never share
+        a client's grammar error), then probed per chromosome as one
+        vectorized batch through the loader's membership path."""
+        out: list = [None] * len(ids)
+        if not ids:
+            return out
+        parsed = [parse_variant_id(s) for s in ids]
+        snap = self.snapshots.current()
+        store = snap.store
+        width = store.width
+        by_code: dict[int, list] = {}
+        for i, (code, _pos, _ref, _alt) in enumerate(parsed):
+            by_code.setdefault(code, []).append(i)
+        for code, idxs in by_code.items():
+            shard = store.shards.get(code)
+            if shard is None:
+                continue  # chromosome not loaded: every id misses
+            refs = [parsed[i][2] for i in idxs]
+            alts = [parsed[i][3] for i in idxs]
+            ref, ref_len = encode_allele_array(refs, width)
+            alt, alt_len = encode_allele_array(alts, width)
+            pos = np.fromiter(
+                (parsed[i][1] for i in idxs), np.int32, count=len(idxs)
+            )
+            h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+            found, gid = shard.lookup(pos, h, ref, alt, ref_len, alt_len)
+            for k, i in enumerate(idxs):
+                if found[k]:
+                    out[i] = render_variant(shard, code, int(gid[k]))
+        return out
+
+    # -- region -------------------------------------------------------------
+
+    def region(self, spec: str, min_cadd=None, max_conseq_rank=None,
+               limit: int | None = None) -> str:
+        """JSON text answering ``chr:start-end`` (with optional filters):
+        ``{"region", "bin_level", "bin_index", "count", "returned",
+        "generation", "variants": [...]}``.  ``count`` is the post-filter
+        match total; ``variants`` carries the first ``limit`` of them."""
+        code, start, end = parse_region(spec)
+        snap = self.snapshots.current()
+        key = (snap.generation, code, start, end,
+               min_cadd, max_conseq_rank, limit)
+        text = self._cache_get(key)
+        if text is None:
+            text = self._region_render(
+                snap, code, start, end, min_cadd, max_conseq_rank, limit
+            )
+            self._cache_put(key, text)
+        return text
+
+    def _region_render(self, snap, code, start, end,
+                       min_cadd, max_conseq_rank, limit) -> str:
+        label = chromosome_label(code)
+        level, leaf = _region_bin(start, end)
+        shard = snap.store.shards.get(code)
+        kept: list[tuple[int, int]] = []  # (segment index, local row)
+        if shard is not None and shard.n:
+            kept = self._region_rows(shard, start, end)
+        if min_cadd is not None or max_conseq_rank is not None:
+            kept = [
+                (si, j) for si, j in kept
+                if self._passes(shard.segments[si], j,
+                                min_cadd, max_conseq_rank)
+            ]
+        shown = kept if limit is None else kept[: max(int(limit), 0)]
+        rendered = [
+            _render_row(shard.segments[si], j, label, shard.width)
+            for si, j in shown
+        ]
+        region = f"{label}:{start}-{end}"
+        bin_path = closed_form_path(label, level, leaf)
+        return (
+            f'{{"region":{json.dumps(region)}'
+            f',"bin_level":{level}'
+            f',"bin_index":{json.dumps(bin_path)}'
+            f',"count":{len(kept)}'
+            f',"returned":{len(rendered)}'
+            f',"generation":{snap.generation}'
+            ',"variants":[' + ",".join(rendered) + "]}"
+        )
+
+    @staticmethod
+    def _region_rows(shard, start: int, end: int) -> list:
+        """(segment index, local row) of every region row, position-sorted,
+        duplicates resolved oldest-segment-first (the store's lookup
+        policy).  Per segment this is two ``searchsorted`` calls — rows are
+        (pos, hash)-sorted, so the position column is directly sliceable —
+        then one global lexsort over only the in-region rows."""
+        pos_parts, h_parts, si_parts, j_parts = [], [], [], []
+        for si, seg in enumerate(shard.segments):
+            if seg.n == 0:
+                continue
+            p = seg.cols["pos"]
+            lo = int(np.searchsorted(p, start, side="left"))
+            hi = int(np.searchsorted(p, end, side="right"))
+            if hi <= lo:
+                continue
+            pos_parts.append(p[lo:hi])
+            h_parts.append(seg.cols["h"][lo:hi])
+            si_parts.append(np.full(hi - lo, si, np.int32))
+            j_parts.append(np.arange(lo, hi, dtype=np.int64))
+        if not pos_parts:
+            return []
+        pos = np.concatenate(pos_parts)
+        h = np.concatenate(h_parts)
+        si = np.concatenate(si_parts)
+        jj = np.concatenate(j_parts)
+        order = np.lexsort((si, h, pos))
+        # fast path: no adjacent (pos, hash) collision in sorted order means
+        # no duplicates are POSSIBLE — skip the per-row identity compare
+        # (the dominant serving case: loader-deduplicated stores)
+        ps, hs = pos[order], h[order]
+        if not bool(np.any((ps[1:] == ps[:-1]) & (hs[1:] == hs[:-1]))):
+            return [(int(si[t]), int(jj[t])) for t in order]
+        kept: list[tuple[int, int]] = []
+        run_key = None
+        run_seen: list = []  # identities emitted for the current (pos, h)
+        for t in order:
+            key = (int(pos[t]), int(h[t]))
+            if key != run_key:
+                run_key, run_seen = key, []
+            seg = shard.segments[int(si[t])]
+            j = int(jj[t])
+            ident = (
+                int(seg.cols["ref_len"][j]), int(seg.cols["alt_len"][j]),
+                seg.ref[j].tobytes(), seg.alt[j].tobytes(),
+            )
+            if ident in run_seen:  # shadowed duplicate in a newer segment
+                continue
+            run_seen.append(ident)
+            kept.append((int(si[t]), j))
+        return kept
+
+    @staticmethod
+    def _passes(seg, j: int, min_cadd, max_conseq_rank) -> bool:
+        """Annotation filters: rows lacking the filtered annotation drop
+        (matching the reference's ``WHERE (col->>'x')::numeric`` SQL, where
+        a NULL column never satisfies the predicate)."""
+        if min_cadd is not None:
+            phred = _ann_number(seg, j, "cadd_scores", "CADD_phred")
+            if phred is None or phred < min_cadd:
+                return False
+        if max_conseq_rank is not None:
+            rank = _ann_number(
+                seg, j, "adsp_most_severe_consequence", "rank"
+            )
+            if rank is None or rank > max_conseq_rank:
+                return False
+        return True
+
+    # -- region LRU ---------------------------------------------------------
+
+    def _cache_get(self, key):
+        if not self.region_cache_size:
+            return None
+        with self._cache_lock:
+            text = self._region_cache.get(key)
+            if text is not None:
+                self._region_cache.move_to_end(key)
+        counter = self._cache_hits if text is not None else self._cache_misses
+        if counter is not None:
+            counter.inc()
+        return text
+
+    def _cache_put(self, key, text: str) -> None:
+        if not self.region_cache_size:
+            return
+        with self._cache_lock:
+            self._region_cache[key] = text
+            self._region_cache.move_to_end(key)
+            # stale-generation entries age out with everything else — the
+            # cap bounds them, and their keys can never be probed again
+            while len(self._region_cache) > self.region_cache_size:
+                self._region_cache.popitem(last=False)

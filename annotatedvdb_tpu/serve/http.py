@@ -1,0 +1,355 @@
+"""Stdlib JSON API over the query engine: the serving front end.
+
+``ThreadingHTTPServer`` (one thread per connection — the point queries those
+threads carry coalesce in the batcher, so concurrency here is cheap) with a
+deliberately small route surface:
+
+====================================  =====================================
+``GET /healthz``                      liveness + pinned generation + rows
+``GET /metrics``                      Prometheus exposition of the registry
+``GET /stats``                        batcher/coalescing + snapshot summary
+``GET /variant/<chr:pos:ref:alt>``    point lookup (through the batcher);
+                                      404 when absent
+``POST /variants``                    bulk: body ``{"ids": [...]}`` →
+                                      ``{"results": [rec|null, ...]}``
+``GET /region/<chr:start-end>``       region query; ``?minCadd=``,
+                                      ``maxConseqRank=``, ``limit=``
+====================================  =====================================
+
+Admission is bounded everywhere: point queries reject with **429** when the
+batcher queue is at ``AVDB_SERVE_MAX_QUEUE``; bulk/region requests count
+against an in-flight cap (same bound) and 429 the overflow — so a traffic
+spike degrades to fast rejections, never an unbounded thread/memory pile
+(the serving twin of the pipeline's bounded-queue backpressure, and the
+depth numbers ride the same ``StageStats`` shape).
+
+Every data route refreshes the snapshot pin first (one ``stat`` on the
+manifest), so a loader commit becomes visible within one request with no
+background poller; client errors map to 400, admission to 429, absence to
+404, engine faults to 500 — and the error body is always JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+#: pulls "returned":N out of the region envelope prefix (fixed field order)
+_RETURNED_RE = re.compile(r'"returned":(\d+)')
+
+from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
+from annotatedvdb_tpu.serve.engine import QueryEngine, QueryError
+from annotatedvdb_tpu.serve.snapshot import SnapshotManager
+
+#: per-request latency histogram edges (seconds; sub-ms to 2.5s)
+QUERY_SECONDS_EDGES = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5,
+)
+
+#: default row cap for region responses (explicit ``?limit=`` overrides)
+DEFAULT_REGION_LIMIT = 10_000
+
+
+class ServeContext:
+    """Everything a handler thread needs, shared across requests."""
+
+    def __init__(self, manager, engine: QueryEngine, batcher: QueryBatcher,
+                 registry: MetricsRegistry, max_inflight: int | None = None,
+                 log=None):
+        self.manager = manager
+        self.engine = engine
+        self.batcher = batcher
+        self.registry = registry
+        self.max_inflight = (
+            max_inflight if max_inflight is not None else batcher.max_queue
+        )
+        self.log = log if log is not None else (lambda msg: None)
+        self._lock = threading.Lock()
+        #: guarded by self._lock
+        self._inflight = 0
+        self._m_inflight = registry.gauge(
+            "avdb_serve_inflight", "bulk/region requests being executed"
+        )
+        self._m_swaps = registry.counter(
+            "avdb_serve_snapshot_swaps_total",
+            "store generation swaps observed by the server",
+        )
+
+    # -- per-kind metrics (kind in {point, bulk, region}) -------------------
+
+    def observe(self, kind: str, seconds: float, rows: int = 0) -> None:
+        labels = {"kind": kind}
+        self.registry.counter(
+            "avdb_query_requests_total", "queries served", labels
+        ).inc()
+        self.registry.histogram(
+            "avdb_query_seconds", QUERY_SECONDS_EDGES,
+            "request latency by query kind", labels,
+        ).observe(seconds)
+        if rows:
+            self.registry.counter(
+                "avdb_query_rows_total", "result rows returned", labels
+            ).inc(rows)
+
+    def rejected(self, kind: str) -> None:
+        self.registry.counter(
+            "avdb_query_rejected_total",
+            "queries rejected at the admission bound (HTTP 429)",
+            {"kind": kind},
+        ).inc()
+
+    def errored(self, kind: str) -> None:
+        self.registry.counter(
+            "avdb_query_errors_total",
+            "queries that failed (HTTP 4xx grammar / 5xx engine)",
+            {"kind": kind},
+        ).inc()
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self) -> bool:
+        """Reserve one bulk/region execution slot; False = reject (429)."""
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            depth = self._inflight
+        self._m_inflight.set(depth)
+        return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+            depth = self._inflight
+        self._m_inflight.set(depth)
+
+    def refresh_snapshot(self) -> None:
+        """Pick up a loader commit if one landed; a refresh failure keeps
+        serving the pinned generation (and must never fail the request)."""
+        try:
+            if self.manager.refresh():
+                self._m_swaps.inc()
+        except Exception as err:
+            self.log(f"snapshot refresh errored: {err}")
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    """Routes one request; all state lives on ``self.server.ctx``."""
+
+    server_version = "avdb-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, format, *args):  # stdlib signature
+        self.server.ctx.log(f"{self.address_string()} {format % args}")
+
+    def _reply(self, status: int, body: str,
+               content_type: str = "application/json") -> None:
+        payload = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        if status == 429:
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        try:
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up mid-response; already accounted
+
+    def _error(self, status: int, message: str) -> None:
+        self._reply(status, json.dumps({"error": message}))
+
+    # -- routes -------------------------------------------------------------
+
+    def do_GET(self):
+        ctx = self.server.ctx
+        url = urlparse(self.path)
+        path = unquote(url.path)
+        if path == "/healthz":
+            ctx.refresh_snapshot()
+            snap = ctx.manager.current()
+            self._reply(200, json.dumps({
+                "status": "ok",
+                "generation": snap.generation,
+                "rows": snap.store.n,
+                "shards": len(snap.store.shards),
+                "queue_depth": ctx.batcher.depth(),
+            }))
+            return
+        if path == "/metrics":
+            self._reply(200, ctx.registry.render_prometheus(),
+                        content_type="text/plain; version=0.0.4")
+            return
+        if path == "/stats":
+            snap = ctx.manager.current()
+            self._reply(200, json.dumps({
+                "generation": snap.generation,
+                "rows": snap.store.n,
+                "snapshot_swaps": ctx.manager.swaps,
+                "batcher": ctx.batcher.drain_stats(),
+            }))
+            return
+        if path.startswith("/variant/"):
+            self._point(ctx, path[len("/variant/"):])
+            return
+        if path.startswith("/region/"):
+            self._region(ctx, path[len("/region/"):], url.query)
+            return
+        self._error(404, f"no such route: {path}")
+
+    def do_POST(self):
+        ctx = self.server.ctx
+        path = unquote(urlparse(self.path).path)
+        if path == "/variants":
+            self._bulk(ctx)
+            return
+        self._error(404, f"no such route: {path}")
+
+    # -- query kinds --------------------------------------------------------
+
+    def _point(self, ctx: ServeContext, variant_id: str) -> None:
+        t0 = time.perf_counter()
+        ctx.refresh_snapshot()
+        try:
+            record = ctx.batcher.submit(variant_id)
+        except QueueFull as err:
+            ctx.rejected("point")
+            self._error(429, str(err))
+            return
+        except QueryError as err:
+            ctx.errored("point")
+            self._error(400, str(err))
+            return
+        except Exception as err:
+            ctx.errored("point")
+            self._error(500, f"{type(err).__name__}: {err}")
+            return
+        if record is None:
+            ctx.observe("point", time.perf_counter() - t0)
+            self._error(404, f"variant {variant_id!r} not in store")
+            return
+        ctx.observe("point", time.perf_counter() - t0, rows=1)
+        self._reply(200, record)
+
+    def _bulk(self, ctx: ServeContext) -> None:
+        t0 = time.perf_counter()
+        if not ctx.admit():
+            ctx.rejected("bulk")
+            self._error(429, "server at capacity (bulk admission bound)")
+            return
+        try:
+            ctx.refresh_snapshot()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                ids = body["ids"]
+                if not isinstance(ids, list) \
+                        or not all(isinstance(i, str) for i in ids):
+                    raise KeyError("ids")
+            except (ValueError, KeyError, TypeError):
+                ctx.errored("bulk")
+                self._error(400, 'bulk body must be {"ids": ["chr:pos:ref:alt", ...]}')
+                return
+            try:
+                results = ctx.engine.lookup_many(ids)
+            except QueryError as err:
+                ctx.errored("bulk")
+                self._error(400, str(err))
+                return
+            except Exception as err:
+                ctx.errored("bulk")
+                self._error(500, f"{type(err).__name__}: {err}")
+                return
+            found = sum(1 for r in results if r is not None)
+            ctx.observe("bulk", time.perf_counter() - t0, rows=found)
+            self._reply(200, (
+                f'{{"n":{len(results)},"found":{found},"results":['
+                + ",".join(r if r is not None else "null" for r in results)
+                + "]}"
+            ))
+        finally:
+            ctx.release()
+
+    def _region(self, ctx: ServeContext, spec: str, query: str) -> None:
+        t0 = time.perf_counter()
+        if not ctx.admit():
+            ctx.rejected("region")
+            self._error(429, "server at capacity (region admission bound)")
+            return
+        try:
+            ctx.refresh_snapshot()
+            params = parse_qs(query)
+
+            def num(name, cast):
+                vals = params.get(name)
+                if not vals:
+                    return None
+                try:
+                    return cast(vals[0])
+                except ValueError:
+                    raise QueryError(
+                        f"bad query parameter {name}={vals[0]!r}"
+                    ) from None
+
+            try:
+                limit = num("limit", int)  # explicit 0 = count-only query
+                text = ctx.engine.region(
+                    spec,
+                    min_cadd=num("minCadd", float),
+                    max_conseq_rank=num("maxConseqRank", int),
+                    limit=DEFAULT_REGION_LIMIT if limit is None else limit,
+                )
+            except QueryError as err:
+                ctx.errored("region")
+                self._error(400, str(err))
+                return
+            except Exception as err:
+                ctx.errored("region")
+                self._error(500, f"{type(err).__name__}: {err}")
+                return
+            # the row count sits in the fixed-format envelope prefix —
+            # never re-parse the (up to 10k-record) response body for it
+            m = _RETURNED_RE.search(text[:256])
+            returned = int(m.group(1)) if m else 0
+            ctx.observe("region", time.perf_counter() - t0, rows=returned)
+            self._reply(200, text)
+        finally:
+            ctx.release()
+
+
+def build_server(store_dir: str | None = None, manager=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int | None = None,
+                 max_wait_s: float | None = None,
+                 max_queue: int | None = None,
+                 region_cache_size: int | None = None,
+                 registry: MetricsRegistry | None = None,
+                 tracer=None, log=None) -> ThreadingHTTPServer:
+    """Wire manager → engine → batcher → HTTP server (not yet serving; call
+    ``serve_forever`` or run it on a thread).  The server carries its
+    :class:`ServeContext` as ``httpd.ctx``; callers own shutdown order:
+    ``httpd.shutdown()`` then ``httpd.ctx.batcher.close()``."""
+    if manager is None:
+        if store_dir is None:
+            raise ValueError("build_server needs store_dir or manager")
+        manager = SnapshotManager(store_dir, log=log)
+    registry = registry if registry is not None else MetricsRegistry()
+    engine = QueryEngine(
+        manager, registry=registry, region_cache_size=region_cache_size
+    )
+    batcher = QueryBatcher(
+        engine, max_batch=max_batch, max_wait_s=max_wait_s,
+        max_queue=max_queue, tracer=tracer, registry=registry,
+    )
+    httpd = ThreadingHTTPServer((host, port), ServeHandler)
+    httpd.daemon_threads = True
+    httpd.ctx = ServeContext(manager, engine, batcher, registry, log=log)
+    return httpd

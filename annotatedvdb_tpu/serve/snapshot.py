@@ -1,0 +1,142 @@
+"""Snapshot isolation for the serving read path.
+
+A loader commit ends in ``VariantStore.save``'s atomic ``manifest.json``
+swap; the files a manifest references are never mutated in place.  That
+gives the serving process a clean generation boundary: loading the store
+pins ONE manifest's segment set fully into memory, so an in-flight query
+that captured a :class:`StoreSnapshot` keeps reading exactly that
+generation no matter what a concurrent loader renames, rewrites, or prunes
+on disk — the reader-side half of the store's crash-consistency contract
+(MVCC by whole-store generation, the closest columnar analog of the
+reference's Postgres snapshot isolation).
+
+:class:`SnapshotManager` owns the pinned generation:
+
+- ``current()`` hands out the snapshot (queries hold it for their whole
+  execution — the swap can never tear one mid-read);
+- ``refresh()`` fingerprints ``manifest.json`` (one ``stat``, cheap enough
+  per request), loads the new generation OFF-lock when it changed, then
+  swaps the pin atomically.  The ``snapshot.swap`` fault point fires
+  between load and swap: a failure there must leave the old generation
+  serving, which the fault matrix pins.
+
+Stores are opened ``readonly=True``: the serving process can never create
+directories, persist empty shards, or otherwise write through a read path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from annotatedvdb_tpu.store import VariantStore
+from annotatedvdb_tpu.utils import faults
+
+
+class StoreSnapshot:
+    """One immutable pinned generation of a store.
+
+    ``generation`` increments per swap (1-based); ``fingerprint`` is the
+    manifest identity the generation was loaded from (None for in-memory
+    stores pinned by :class:`StaticSnapshots`)."""
+
+    __slots__ = ("store", "generation", "fingerprint")
+
+    def __init__(self, store: VariantStore, generation: int, fingerprint):
+        self.store = store
+        self.generation = generation
+        self.fingerprint = fingerprint
+
+
+def _manifest_fingerprint(store_dir: str) -> tuple:
+    """Identity of the on-disk manifest: (mtime_ns, size, inode).  The save
+    path replaces the manifest via rename, so any commit changes the inode
+    — mtime granularity can never mask a swap."""
+    st = os.stat(os.path.join(store_dir, "manifest.json"))
+    return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+
+class SnapshotManager:
+    """Pins the serving store generation; swaps are atomic under a lock."""
+
+    def __init__(self, store_dir: str, log=None):
+        self.store_dir = store_dir
+        self.log = log if log is not None else (lambda msg: None)
+        self._lock = threading.Lock()
+        fingerprint = _manifest_fingerprint(store_dir)
+        store = VariantStore.load(store_dir, readonly=True)
+        #: guarded by self._lock
+        self._snap = StoreSnapshot(store, 1, fingerprint)
+        #: guarded by self._lock
+        self._swaps = 0
+
+    def current(self) -> StoreSnapshot:
+        """The pinned generation.  Callers keep the returned snapshot for
+        their whole query — a concurrent swap replaces the PIN, never the
+        snapshot object they hold."""
+        with self._lock:
+            return self._snap
+
+    @property
+    def swaps(self) -> int:
+        with self._lock:
+            return self._swaps
+
+    def refresh(self) -> bool:
+        """Swap to the on-disk generation if it changed; returns True on a
+        swap.  The expensive load runs OFF-lock (readers keep being served
+        from the old pin); load failures — a commit racing the stat, a torn
+        directory mid-repair — keep the old generation and report False,
+        because a serving process must degrade to stale before it degrades
+        to down."""
+        with self._lock:
+            pinned = self._snap
+        try:
+            fingerprint = _manifest_fingerprint(self.store_dir)
+        except OSError:
+            return False  # manifest mid-rename: keep serving the pin
+        if fingerprint == pinned.fingerprint:
+            return False
+        try:
+            store = VariantStore.load(self.store_dir, readonly=True)
+        except (OSError, ValueError) as err:  # StoreCorruptError is a ValueError
+            self.log(f"snapshot refresh failed, keeping generation "
+                     f"{pinned.generation}: {err}")
+            return False
+        # crash point: the new generation is fully loaded, the pin has not
+        # moved — a failure here must leave the old generation serving
+        faults.fire("snapshot.swap")
+        with self._lock:
+            if self._snap.fingerprint == fingerprint:
+                return False  # a concurrent refresh won the race
+            if self._snap is not pinned:
+                # the pin moved while THIS load ran (a concurrent refresh
+                # installed a different — by now newer — manifest): never
+                # swap content backwards; the next request re-stats
+                return False
+            self._snap = StoreSnapshot(
+                store, self._snap.generation + 1, fingerprint
+            )
+            self._swaps += 1
+            generation = self._snap.generation
+        self.log(f"snapshot swapped to generation {generation} "
+                 f"({store.n} rows)")
+        return True
+
+
+class StaticSnapshots:
+    """Snapshot provider over an in-memory store (tests, bench) — one fixed
+    generation, ``refresh`` is a no-op."""
+
+    def __init__(self, store: VariantStore, generation: int = 1):
+        self._snap = StoreSnapshot(store, generation, None)
+
+    def current(self) -> StoreSnapshot:
+        return self._snap
+
+    def refresh(self) -> bool:
+        return False
+
+    @property
+    def swaps(self) -> int:
+        return 0

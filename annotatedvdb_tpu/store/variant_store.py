@@ -750,6 +750,17 @@ class ChromosomeShard:
 
     # -- per-row access by global id ----------------------------------------
 
+    def locate_row(self, gid: int) -> tuple[Segment, int]:
+        """(segment, local offset) for one global row id — the per-row read
+        accessor the serving path renders records through (no compaction, no
+        mutation; valid until the shard is appended/merged/deleted).  Scalar
+        fast path: one searchsorted, no temporaries (the vectorized
+        ``_locate`` costs ~4x per single row)."""
+        gid = int(gid)
+        starts = self._starts()
+        si = int(starts.searchsorted(gid, side="right")) - 1
+        return self.segments[si], gid - int(starts[si])
+
     def get_col(self, name: str, ids):
         seg, off = self._locate(ids)
         out = np.empty(seg.shape, dtype=dict(_NUMERIC_COLUMNS)[name])
@@ -1077,6 +1088,11 @@ class VariantStore:
 
     def __init__(self, width: int):
         self.width = width
+        #: read-only stores (``load(..., readonly=True)``) refuse ``save``
+        #: and never materialize shards on access — the serving read path
+        #: must not create directories or persist empty shards as a side
+        #: effect of a lookup (the foot-gun ``loaders/lookup.py`` documents)
+        self.readonly = False
         self.shards: dict[int, ChromosomeShard] = {}
         self._next_seg_id = 1
         # per-stem write-time integrity records ({stem: {npz: {bytes, crc32},
@@ -1097,6 +1113,11 @@ class VariantStore:
     def shard(self, chrom_code: int) -> ChromosomeShard:
         code = int(chrom_code)
         if code not in self.shards:
+            if self.readonly:
+                raise RuntimeError(
+                    f"readonly store: shard {code} does not exist and must "
+                    "not be created by a read path (use store.shards.get)"
+                )
             self.shards[code] = ChromosomeShard(code, self.width)
         return self.shards[code]
 
@@ -1152,6 +1173,11 @@ class VariantStore:
         return uid is not None and uid == self._uid
 
     def save(self, path: str) -> None:
+        if self.readonly:
+            raise RuntimeError(
+                "readonly store: save() is forbidden (opened with "
+                "readonly=True — reload without it to mutate)"
+            )
         os.makedirs(path, exist_ok=True)
         trusted = self._dir_trusted(path)
         live_files = {"manifest.json"}
@@ -1359,7 +1385,12 @@ class VariantStore:
         return {"npz": npz_rec, "jsonl": {"bytes": f.nbytes, "crc32": f.crc}}
 
     @classmethod
-    def load(cls, path: str) -> "VariantStore":
+    def load(cls, path: str, readonly: bool = False) -> "VariantStore":
+        """Load a persisted store.  ``readonly=True`` marks the result as a
+        pure read replica: ``save`` raises, and ``shard()`` refuses to
+        materialize missing shards — a query for an unloaded chromosome can
+        never create directories or persist empty shards as a side effect
+        (the serving path's open mode; see ``serve/snapshot.py``)."""
         mpath = os.path.join(path, "manifest.json")
         try:
             with open(mpath) as f:
@@ -1431,6 +1462,8 @@ class VariantStore:
                     )
                 shard.segments.append(seg)
             shard._starts_cache = None
+        # flip LAST: the loop above materializes shards via store.shard()
+        store.readonly = bool(readonly)
         return store
 
     @staticmethod

@@ -33,6 +33,13 @@ arming any other name is a ``ValueError`` at parse time):
 ``egress.flush``            per COPY-file write in ``io.pg_egress``
 ``ingest.chunk``            per parsed chunk handed to a loader (fires on
                             the ingest thread under the overlapped pipeline)
+``serve.batch``             per batcher drain in ``serve.batcher`` — just
+                            before the coalesced microbatch executes (fires
+                            on the batcher thread; every caller of the batch
+                            observes the failure)
+``snapshot.swap``           in ``serve.snapshot`` after the new generation
+                            loaded but before the atomic swap — a failure
+                            must leave the old pinned generation serving
 ======================== ====================================================
 
 ``fired()`` exposes per-point fire counts for the observability exports.
@@ -58,6 +65,8 @@ POINTS = frozenset({
     "ledger.append",
     "egress.flush",
     "ingest.chunk",
+    "serve.batch",
+    "snapshot.swap",
 })
 
 

@@ -35,6 +35,7 @@ import random
 import shutil
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -61,6 +62,7 @@ WARMUP_STEPS = 3
 MEASURE_STEPS = 10
 KERNEL_TARGET = 1_000_000.0          # variants/sec/chip north star
 END_TO_END_TARGET = 90_000_000 / 600.0  # gnomAD chr1 in <10 min
+SERVE_QPS_TARGET = 10_000.0          # sustained concurrent point queries/sec
 
 E2E_ROWS = int(os.environ.get("AVDB_BENCH_ROWS", 1 << 21))
 _BASES = "ACGT"
@@ -396,6 +398,110 @@ def bench_qc_update(n_rows: int = 100_000):
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_serve(n_rows: int = 50_000, clients: int = 16,
+                requests_per_client: int = 250):
+    """Sustained concurrent-client serving bench (``serve/``): load a synth
+    store, then hammer it with ``clients`` threads of point queries through
+    the coalescing batcher — the continuous-batching read path.  Reports
+    QPS, p50/p99 per-request latency, and the batch-fill ratio (how full
+    the device microbatches ran), plus a single-threaded region-scan rate.
+    Host-side by design: the store is far below the device-probe threshold,
+    so this measures the serving machinery, not the accelerator."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.serve import QueryBatcher, QueryEngine, SnapshotManager
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+    from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
+
+    work = tempfile.mkdtemp(prefix="avdb_serve_")
+    batcher = None
+    try:
+        vcf = os.path.join(work, "base.vcf")
+        write_synth_vcf(vcf, n_rows)
+        store_dir = os.path.join(work, "store")
+        store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
+        ledger = AlgorithmLedger(os.path.join(work, "l.jsonl"))
+        TpuVcfLoader(store, ledger, batch_size=1 << 16,
+                     log=lambda *a: None).load_file(vcf, commit=True)
+        store.save(store_dir)
+        ids = []
+        with open(vcf) as fh:
+            for line in fh:
+                if line.startswith("#"):
+                    continue
+                chrom, pos, _vid, ref, alt = line.split("\t")[:5]
+                ids.append(f"{chrom}:{pos}:{ref}:{alt.split(',')[0]}")
+        manager = SnapshotManager(store_dir)  # serving generation pin
+        engine = QueryEngine(manager, region_cache_size=64)
+        batcher = QueryBatcher(engine, max_batch=256, max_wait_s=0.002,
+                               max_queue=1 << 20)
+        latencies = [[] for _ in range(clients)]
+        errors: list = []
+        barrier = threading.Barrier(clients + 1)
+
+        def client(ci):
+            rng = random.Random(7100 + ci)
+            mine = latencies[ci]
+            try:
+                barrier.wait(timeout=60)
+                for _ in range(requests_per_client):
+                    qid = ids[rng.randrange(len(ids))]
+                    t0 = time.perf_counter()
+                    if batcher.submit(qid) is None:
+                        errors.append(qid)
+                    mine.append(time.perf_counter() - t0)
+            except Exception as exc:
+                errors.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        settle()
+        barrier.wait(timeout=60)
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=300)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        lat_ms = np.concatenate(
+            [np.asarray(m) for m in latencies if m] or [np.zeros(1)]
+        ) * 1000.0
+        stats = batcher.drain_stats()
+        n_req = int(lat_ms.size)
+
+        # region-scan leg: distinct 20kb windows over the loaded span at a
+        # realistic page size (limit=250), single-threaded (regions don't
+        # coalesce; the LRU is defeated by distinct windows, so this is the
+        # uncached slice+render rate)
+        n_regions = 200
+        t1 = time.perf_counter()
+        for k in range(n_regions):
+            start = 10_000 + (k * 631) % 140_000
+            engine.region(f"1:{start}-{start + 20_000}", limit=250)
+        region_dt = max(time.perf_counter() - t1, 1e-9)
+
+        return {
+            "qps": round(n_req / dt, 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "requests": n_req,
+            "clients": clients,
+            "errors": len(errors),
+            "batch_fill": stats["batch_fill"],
+            "batches": stats["batches"],
+            "seconds": round(dt, 2),
+            "store_rows": n_rows,
+            "region": {
+                "qps": round(n_regions / region_dt, 1),
+                "requests": n_regions,
+                "seconds": round(region_dt, 3),
+            },
+        }
+    finally:
+        if batcher is not None:
+            batcher.close()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_multichip_virtual(n_devices: int = 8):
     """Mesh insert-step timing on a VIRTUAL n-device CPU mesh — a labeled
     scaling datapoint (reshard + annotate + dedup + membership as one mesh
@@ -540,9 +646,35 @@ def tpu_only():
     print(json.dumps(out))
 
 
+def serve_only():
+    """One-command serving bench (``python bench.py --serve``): the
+    concurrent-client read-path record alone, pinned to CPU (the serving
+    machinery is host-side at bench scale), printed as one schema-valid
+    JSON line with the ``serving`` block."""
+    os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+    from annotatedvdb_tpu.utils import runtime
+
+    platform = runtime.pin_platform("cpu")
+    import jax
+
+    serving = bench_serve()
+    print(json.dumps({
+        "metric": "serve_point_qps",
+        "value": serving["qps"],
+        "unit": "queries/sec",
+        "vs_baseline": round(serving["qps"] / SERVE_QPS_TARGET, 3),
+        "backend": jax.default_backend(),
+        "platform_pin": platform,
+        "serving": serving,
+    }))
+
+
 def main():
     if "--tpu-only" in sys.argv[1:]:
         tpu_only()
+        return
+    if "--serve" in sys.argv[1:]:
+        serve_only()
         return
     # Pin the platform BEFORE any backend touch: round 1's bench died with
     # rc=1 because the TPU tunnel errored during jax.default_backend(), and
@@ -615,6 +747,10 @@ def main():
         multichip = bench_multichip_virtual()
     except Exception as exc:  # a failed CPU-side projection leg never
         multichip = {"error": f"{type(exc).__name__}: {exc}"[:300]}  # aborts the record
+    try:
+        serving = bench_serve()
+    except Exception as exc:  # serving leg is host-side too: record, not abort
+        serving = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     print(
         json.dumps(
@@ -640,6 +776,7 @@ def main():
                 "cadd_join": cadd,
                 "qc_update": qc,
                 "multichip_virtual": multichip,
+                "serving": serving,
             }
         )
     )

@@ -52,6 +52,12 @@ GOOD = {
                   "variants": 100000, "seconds": 0.43},
     "qc_update": {"rows_per_sec": 120000.0, "updated": 100000,
                   "seconds": 0.82},
+    "serving": {
+        "qps": 3200.0, "p50_ms": 4.9, "p99_ms": 6.3, "requests": 4000,
+        "clients": 16, "errors": 0, "batch_fill": 0.06, "batches": 250,
+        "seconds": 1.2, "store_rows": 50000,
+        "region": {"qps": 110.0, "requests": 200, "seconds": 1.8},
+    },
 }
 
 
@@ -79,6 +85,21 @@ def test_bad_stage_shape_fails():
     bad["end_to_end"]["stages"]["ingest"] = {"items": 0}  # no seconds
     errors = validate_record(bad)
     assert any("ingest" in e and "seconds" in e for e in errors)
+
+
+def test_serving_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["p99_ms"]
+    assert any("p99_ms" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["batch_fill"] = 1.5  # a ratio, not a count
+    assert any("batch_fill" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["p99_ms"] = 1.0  # below p50: impossible percentiles
+    assert any("p99_ms below p50_ms" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["region"] = {"requests": 200}  # qps/seconds required
+    assert any("region" in e for e in validate_record(bad))
 
 
 def test_queue_stalls_block_is_validated_strictly():

@@ -219,18 +219,30 @@ def test_sigkill_matrix(tmp_path, reference, fault):
 # abort without leaving a torn COPY tmp, and a rerun must complete.
 
 
+def _tiny_store(width=8):
+    """Three chr3 A->C SNVs with REAL identity hashes (the serve legs probe
+    them back by ``chr:pos:ref:alt``, so the stored hash must match what
+    the engine computes)."""
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    store = VariantStore(width=width)
+    ref, ref_len = encode_allele_array(["A"] * 3, width)
+    alt, alt_len = encode_allele_array(["C"] * 3, width)
+    store.shard(3).append(
+        {"pos": np.asarray([10, 20, 30], np.int32),
+         "h": identity_hashes(width, ref, alt, ref_len, alt_len),
+         "ref_len": ref_len, "alt_len": alt_len},
+        ref, alt,
+    )
+    return store
+
+
 def test_egress_flush_raise_aborts_clean_and_rerun_completes(tmp_path):
     from annotatedvdb_tpu.io.pg_egress import export_store
     from annotatedvdb_tpu.utils.faults import InjectedFault
 
-    store = VariantStore(width=8)
-    store.shard(3).append(
-        {"pos": np.asarray([10, 20, 30], np.int32),
-         "h": np.asarray([7, 8, 9], np.uint32),
-         "ref_len": np.full(3, 1, np.int32),
-         "alt_len": np.full(3, 1, np.int32)},
-        np.full((3, 8), 65, np.uint8), np.full((3, 8), 67, np.uint8),
-    )
+    store = _tiny_store()
     out = str(tmp_path / "export")
     faults.reset("egress.flush:1:raise")
     with pytest.raises(InjectedFault):
@@ -245,3 +257,69 @@ def test_egress_flush_raise_aborts_clean_and_rerun_completes(tmp_path):
     assert counts == {"3": 3}
     data = open(os.path.join(data_dir, "variant_chr3.copy")).read()
     assert data.count("\n") == 3
+
+
+# ---------------------------------------------------------------------------
+# serve.batch / snapshot.swap — the serving subsystem's injection points
+# (AVDB302: every faults.POINTS entry must be crash-tested in this file).
+# Both use the raise action: serving is in-memory, so the contract is
+# fail-the-unit-of-work-and-keep-running, not crash-and-recover-from-disk.
+
+
+def test_serve_batch_raise_fails_only_that_batch_and_recovers():
+    """An injected fault mid-drain (serve.batch:1:raise) must surface the
+    root cause to every caller of THAT microbatch and leave the drain
+    thread serving the next one."""
+    from annotatedvdb_tpu.serve import QueryBatcher, QueryEngine, StaticSnapshots
+    from annotatedvdb_tpu.utils.faults import InjectedFault
+
+    engine = QueryEngine(StaticSnapshots(_tiny_store()), region_cache_size=0)
+    batcher = QueryBatcher(engine, max_batch=4, max_wait_s=0.001)
+    try:
+        faults.reset("serve.batch:1:raise")
+        with pytest.raises(InjectedFault):
+            batcher.submit("3:10:A:C")
+        faults.reset("")
+        # the batcher survived its failed drain: same query now answers
+        assert batcher.submit("3:10:A:C") is not None
+        stats = batcher.drain_stats()
+        assert stats["batches"] == 1  # only the clean drain counted
+    finally:
+        faults.reset("")
+        batcher.close()
+
+
+def test_snapshot_swap_raise_keeps_old_generation_serving(tmp_path):
+    """A fault between loading the new generation and swapping the pin
+    (snapshot.swap:1:raise) must leave the OLD generation serving; an
+    unarmed retry completes the swap."""
+    from annotatedvdb_tpu.serve import SnapshotManager
+    from annotatedvdb_tpu.utils.faults import InjectedFault
+
+    store_dir = str(tmp_path / "store")
+    _tiny_store().save(store_dir)
+    manager = SnapshotManager(store_dir)
+    rows_v1 = manager.current().store.n
+
+    # a loader commit lands a second generation on disk
+    store = VariantStore.load(store_dir)
+    store.shard(3).append(
+        {"pos": np.asarray([40], np.int32),
+         "h": np.asarray([11], np.uint32),
+         "ref_len": np.full(1, 1, np.int32),
+         "alt_len": np.full(1, 1, np.int32)},
+        np.full((1, 8), 65, np.uint8), np.full((1, 8), 71, np.uint8),
+    )
+    store.save(store_dir)
+
+    faults.reset("snapshot.swap:1:raise")
+    with pytest.raises(InjectedFault):
+        manager.refresh()
+    # the pin never moved: generation 1, old row count
+    snap = manager.current()
+    assert snap.generation == 1 and snap.store.n == rows_v1
+
+    faults.reset("")
+    assert manager.refresh() is True
+    snap = manager.current()
+    assert snap.generation == 2 and snap.store.n == rows_v1 + 1

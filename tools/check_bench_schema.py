@@ -145,6 +145,41 @@ def _check_end_to_end(e2e, where: str, errors: list) -> None:
             )
 
 
+def _check_serving(sv, where: str, errors: list) -> None:
+    """The avdb-serve bench block: concurrent-client QPS + latency
+    percentiles + batch-fill, with an optional region sub-leg."""
+    if not isinstance(sv, dict):
+        errors.append(f"{where}: serving must be an object")
+        return
+    w = f"{where}.serving"
+    _check_fields(
+        sv,
+        {
+            "qps": _is_num, "p50_ms": _is_num, "p99_ms": _is_num,
+            "requests": _is_int, "clients": _is_int, "errors": _is_int,
+            "batch_fill": _is_num, "batches": _is_int, "seconds": _is_num,
+            "store_rows": _is_int,
+        },
+        w, errors,
+        required=("qps", "p50_ms", "p99_ms", "requests", "batch_fill",
+                  "seconds"),
+    )
+    if _is_num(sv.get("batch_fill")) and not 0 <= sv["batch_fill"] <= 1:
+        errors.append(f"{w}.batch_fill: must be a ratio in [0, 1]")
+    if _is_num(sv.get("p50_ms")) and _is_num(sv.get("p99_ms")) \
+            and sv["p99_ms"] < sv["p50_ms"]:
+        errors.append(f"{w}: p99_ms below p50_ms")
+    if "region" in sv:
+        if not isinstance(sv["region"], dict):
+            errors.append(f"{w}.region: must be an object")
+        else:
+            _check_fields(
+                sv["region"],
+                {"qps": _is_num, "requests": _is_int, "seconds": _is_num},
+                f"{w}.region", errors, required=("qps", "seconds"),
+            )
+
+
 def validate_record(rec: dict, where: str = "record") -> list[str]:
     """Validate one RAW bench record; returns a list of error strings."""
     errors: list[str] = []
@@ -192,6 +227,9 @@ def validate_record(rec: dict, where: str = "record") -> list[str]:
             f"{where}.qc_update", errors,
             required=("rows_per_sec", "seconds"),
         )
+    if "serving" in rec and isinstance(rec["serving"], dict) \
+            and "error" not in rec["serving"]:
+        _check_serving(rec["serving"], where, errors)
     return errors
 
 

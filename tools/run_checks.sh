@@ -9,6 +9,8 @@
 #                    SKIPPED with a notice when ruff is not installed
 #                    (the container image does not ship it)
 #   3. check_bench_schema — committed BENCH_*.json records stay loadable
+#   4. serve_smoke — the HTTP query API answers point/region/metrics
+#                    against a tiny store on an ephemeral loopback port
 #
 # Exit: 0 all clean, 1 any check found problems.
 
@@ -32,6 +34,9 @@ fi
 
 echo "== bench schema ==" >&2
 python "$root/tools/check_bench_schema.py" || rc=1
+
+echo "== serve smoke ==" >&2
+python "$root/tools/serve_smoke.py" || rc=1
 
 if [ "$rc" -eq 0 ]; then
     echo "run_checks: all checks clean" >&2

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Serve smoke-check: build a tiny store, stand up the HTTP API on an
+ephemeral loopback port, and drive one request of every kind through it.
+
+Part of ``tools/run_checks.sh`` (tier-1 shells that script), so a PR that
+breaks the serving wiring — routes, batcher, snapshot pinning, metrics —
+fails the suite in seconds without the full pytest battery.
+
+Exit codes mirror the other tools: 0 clean, 1 smoke failure, 2 internal
+error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+# pin CPU before anything imports jax: the smoke must never hang on an
+# accelerator probe (same discipline as tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _build_store(store_dir: str) -> int:
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    width = 8
+    store = VariantStore(width=width)
+    n = 64
+    refs = ["A", "C", "G", "T"] * (n // 4)
+    alts = ["G", "T", "A", "C"] * (n // 4)
+    ref, ref_len = encode_allele_array(refs, width)
+    alt, alt_len = encode_allele_array(alts, width)
+    h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+    store.shard(8).append(
+        {"pos": np.arange(1000, 1000 + 97 * n, 97, dtype=np.int32)[:n],
+         "h": h, "ref_len": ref_len, "alt_len": alt_len},
+        ref, alt,
+        annotations={"cadd_scores": [
+            {"CADD_phred": float(i)} if i % 2 else None for i in range(n)
+        ]},
+    )
+    store.save(store_dir)
+    return n
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=20
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def main() -> int:
+    from annotatedvdb_tpu.serve.http import build_server
+
+    work = tempfile.mkdtemp(prefix="avdb_serve_smoke_")
+    store_dir = os.path.join(work, "store")
+    n = _build_store(store_dir)
+    httpd = build_server(store_dir=store_dir, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    failures: list[str] = []
+
+    def check(label: str, ok: bool, detail: str = "") -> None:
+        if not ok:
+            failures.append(f"{label}: {detail}"[:300])
+
+    try:
+        port = httpd.server_address[1]
+        status, body = _get(port, "/healthz")
+        check("healthz", status == 200
+              and json.loads(body)["rows"] == n, body)
+        status, body = _get(port, "/variant/8:1000:A:G")
+        check("point hit", status == 200
+              and json.loads(body)["position"] == 1000, body)
+        status, body = _get(port, "/variant/8:999:A:G")
+        check("point miss", status == 404, body)
+        status, body = _get(port, "/variant/junk")
+        check("point 400", status == 400, body)
+        status, body = _get(port, "/region/8:1-100000?minCadd=1&limit=5")
+        rec = json.loads(body) if status == 200 else {}
+        check("region", status == 200
+              and rec.get("returned") == 5
+              and rec.get("count", 0) > 5, body[:200])
+        status, body = _get(port, "/metrics")
+        check("metrics", status == 200
+              and "avdb_query_requests_total" in body, body[:200])
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.ctx.batcher.close()
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"serve_smoke FAIL {f}", file=sys.stderr)
+        return 1
+    print(f"serve_smoke: ok ({n} rows; point/region/metrics answered)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
