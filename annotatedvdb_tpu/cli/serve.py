@@ -3,26 +3,37 @@
 The read-side entry point the reference never shipped as a program (its
 query surface is raw SQL against ``AnnotatedVDB.Variant``): a stdlib JSON
 API over the store directory, with request coalescing, bounded admission,
-and snapshot isolation against concurrent loader commits.
+weighted per-client fairness, snapshot isolation against concurrent
+loader commits, and (optionally) an HBM residency budget.
 
 Usage::
 
     python -m annotatedvdb_tpu serve --storeDir ./vdb --port 8080
+    python -m annotatedvdb_tpu serve --storeDir ./vdb --port 8080 \\
+        --workers 4 --hbmBudget 2g          # multi-process fleet
     curl localhost:8080/variant/8:1000:A:G
     curl 'localhost:8080/region/8:1000-250000?minCadd=20'
 
 ``--port 0`` binds an ephemeral port (printed on startup) — the smoke/test
-mode.  Batching/admission knobs default from ``AVDB_SERVE_*`` (see README
-"Configuration"); flags override the environment.
+mode.  ``--workers N`` (default ``AVDB_SERVE_WORKERS`` or 1) runs the
+multi-process fleet: N worker processes share the port (SO_REUSEPORT
+where available, parent accept handoff otherwise) and one readonly store
+generation; the supervisor restarts dead workers and drains on SIGTERM.
+The default front end is the asyncio event loop (``serve/aio.py``);
+``--frontend threaded`` keeps the PR-5 thread-per-connection server.
+Knobs default from ``AVDB_SERVE_*`` (see README "Configuration"); flags
+override the environment.  ``--_workerIndex``/``--_listenFd`` are the
+fleet's internal worker handshake, not a user surface.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
-def main(argv=None):
+def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         description="HTTP query API over a TPU-native variant store"
     )
@@ -32,6 +43,14 @@ def main(argv=None):
                         help="bind address (default 127.0.0.1)")
     parser.add_argument("--port", type=int, default=8080,
                         help="bind port (0 = ephemeral, printed on startup)")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="serve fleet size: N>1 runs N worker processes "
+                             "sharing the port and one readonly store "
+                             "generation (default: AVDB_SERVE_WORKERS or 1)")
+    parser.add_argument("--frontend", choices=("aio", "threaded"),
+                        default="aio",
+                        help="event-loop front end (default) or the "
+                             "thread-per-connection reference server")
     parser.add_argument("--maxBatch", type=int, default=None,
                         help="max point queries per coalesced microbatch "
                              "(default: AVDB_SERVE_BATCH_MAX or 256)")
@@ -45,6 +64,24 @@ def main(argv=None):
     parser.add_argument("--regionCache", type=int, default=None,
                         help="rendered hot-region LRU capacity "
                              "(default: AVDB_SERVE_REGION_CACHE or 64)")
+    parser.add_argument("--clientRate", type=float, default=None,
+                        help="weighted per-client admission: requests/sec "
+                             "per weight unit, 0 disables "
+                             "(default: AVDB_SERVE_CLIENT_RATE or 0)")
+    parser.add_argument("--streamThreshold", type=int, default=None,
+                        help="region row count above which responses "
+                             "stream chunked instead of buffering "
+                             "(default: AVDB_SERVE_STREAM_THRESHOLD or 2048)")
+    parser.add_argument("--hbmBudget", default=None, metavar="BYTES",
+                        help="HBM residency budget for probe segment "
+                             "caches, e.g. 512m / 2g; unset = unmanaged "
+                             "(default: AVDB_SERVE_HBM_BUDGET). In fleet "
+                             "mode this is the WHOLE-fleet budget, split "
+                             "equally across workers — the device is "
+                             "shared, the budget must be too")
+    parser.add_argument("--snapshotTtlMs", type=float, default=None,
+                        help="coalesced manifest freshness window in ms "
+                             "(default: AVDB_SERVE_SNAPSHOT_TTL_MS or 250)")
     parser.add_argument("--metricsOut", default=None, metavar="FILE",
                         help="write serving metrics on shutdown: Prometheus "
                              "textfile at FILE plus JSON at FILE.json "
@@ -52,25 +89,270 @@ def main(argv=None):
     parser.add_argument("--traceOut", default=None, metavar="FILE",
                         help="write a Chrome trace of batcher drain spans "
                              "on shutdown")
-    args = parser.parse_args(argv)
+    parser.add_argument("--_workerIndex", type=int, default=None,
+                        help=argparse.SUPPRESS)  # fleet-internal
+    parser.add_argument("--_listenFd", type=int, default=None,
+                        help=argparse.SUPPRESS)  # fleet-internal
+    parser.add_argument("--_forceHandoff", action="store_true",
+                        help=argparse.SUPPRESS)  # tests: no-SO_REUSEPORT path
+    return parser
 
-    from annotatedvdb_tpu.obs.trace import Tracer
-    from annotatedvdb_tpu.serve.http import build_server
+
+def _effective_workers(args) -> int:
+    if args.workers is not None:
+        return max(int(args.workers), 1)
+    return max(int(os.environ.get("AVDB_SERVE_WORKERS", "") or 1), 1)
+
+
+def _resolve_budget(args):
+    """The effective HBM budget in bytes (flag wins over env), or None
+    when unmanaged — the ONE resolution both the fleet supervisor and the
+    single-process/worker path share."""
+    from annotatedvdb_tpu.serve.residency import budget_from_env, parse_bytes
+
+    return (
+        parse_bytes(args.hbmBudget) if args.hbmBudget is not None
+        else budget_from_env()
+    )
+
+
+def _knob_args(args, workers: int) -> list[str]:
+    """Knob flags forwarded to every fleet worker (per-process exports
+    like --metricsOut/--traceOut stay supervisor-only: N workers cannot
+    share one output file).  The HBM budget is the exception to verbatim
+    forwarding: it caps ONE shared device, so each worker gets an equal
+    share — N workers each enforcing the full budget could pin N x budget
+    of probe caches (an explicit flag also overrides the inherited
+    AVDB_SERVE_HBM_BUDGET, which would have the same problem)."""
+    out: list[str] = ["--frontend", args.frontend]
+    for flag, val in (
+        ("--maxBatch", args.maxBatch),
+        ("--batchWaitMs", args.batchWaitMs),
+        ("--maxQueue", args.maxQueue),
+        ("--regionCache", args.regionCache),
+        ("--clientRate", args.clientRate),
+        ("--streamThreshold", args.streamThreshold),
+        ("--snapshotTtlMs", args.snapshotTtlMs),
+    ):
+        if val is not None:
+            out += [flag, str(val)]
+    budget = _resolve_budget(args)
+    if budget is not None:
+        out += ["--hbmBudget", str(budget // workers)]
+    return out
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
 
     def log(msg):
         print(f"serve: {msg}", file=sys.stderr)
 
+    try:
+        workers = _effective_workers(args)
+    except ValueError as err:
+        print(f"serve: cannot start: bad AVDB_SERVE_WORKERS ({err})",
+              file=sys.stderr)
+        return 1
+    if args.frontend == "threaded":
+        dead = [flag for flag, val, env in (
+            ("--clientRate", args.clientRate, "AVDB_SERVE_CLIENT_RATE"),
+            ("--streamThreshold", args.streamThreshold,
+             "AVDB_SERVE_STREAM_THRESHOLD"),
+        ) if val is not None or os.environ.get(env)]
+        if dead:
+            # the PR-5 reference server has no governor or streaming
+            # wiring; starting silently would let an operator believe
+            # hogs are throttled while nothing limits them
+            print(f"serve: {', '.join(dead)} only apply to the aio front "
+                  "end and are ignored with --frontend threaded",
+                  file=sys.stderr)
+    if args._workerIndex is None and workers > 1:
+        if args.frontend == "threaded":
+            # the threaded server binds its own port and cannot join a
+            # shared-socket fleet — refusing beats a worker crash loop
+            print("serve: --workers > 1 requires the aio front end "
+                  "(--frontend threaded is single-process only)",
+                  file=sys.stderr)
+            return 2
+        if args.metricsOut or args.traceOut:
+            print("serve: --metricsOut/--traceOut are per-process exports "
+                  "and are not collected in fleet mode; scrape GET "
+                  "/metrics instead", file=sys.stderr)
+        from annotatedvdb_tpu.serve.fleet import ServeFleet
+
+        try:
+            fleet = ServeFleet(
+                args.storeDir, host=args.host, port=args.port,
+                workers=workers, worker_args=_knob_args(args, workers),
+                log=log,
+                reuseport=False if args._forceHandoff else None,
+            )
+        except (OSError, ValueError) as err:
+            print(f"serve: cannot start fleet: {err}", file=sys.stderr)
+            return 1
+        print(f"serving {args.storeDir} on http://{args.host}:{fleet.port} "
+              f"with {workers} workers", flush=True)
+        return fleet.run()
+    return _run_single(args, log)
+
+
+def _run_single(args, log) -> int:
+    """One serving process: the default single-process mode AND the fleet
+    worker mode (``--_workerIndex`` set)."""
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.obs.trace import Tracer
+    from annotatedvdb_tpu.serve.residency import ResidencyManager
+    from annotatedvdb_tpu.serve.snapshot import SnapshotManager
+    from annotatedvdb_tpu.utils import faults
+
     tracer = Tracer(process_name="avdb-serve") if args.traceOut else None
+    registry = MetricsRegistry()
+    try:
+        budget = _resolve_budget(args)
+        # None = unmanaged (the store's own ski-rental rule); an EXPLICIT
+        # 0 is the managed degenerate case — nothing may be resident,
+        # which is the opposite of unmanaged on a memory-pressured device
+        residency = (
+            ResidencyManager(budget, registry=registry, log=log)
+            if budget is not None else None
+        )
+        manager = SnapshotManager(
+            args.storeDir, log=log,
+            ttl_s=(args.snapshotTtlMs / 1000.0
+                   if args.snapshotTtlMs is not None else None),
+        )
+    except (OSError, ValueError) as err:
+        print(f"serve: cannot start: {err}", file=sys.stderr)
+        return 1
+
+    max_wait_s = (
+        args.batchWaitMs / 1000.0 if args.batchWaitMs is not None else None
+    )
+    sock = None
+    if args._workerIndex is not None:
+        try:
+            sock = _worker_socket(args)
+        except OSError as err:
+            print(f"serve: worker cannot bind: {err}", file=sys.stderr)
+            return 1
+
+    if args.frontend == "threaded":
+        return _run_threaded(args, manager, registry, residency, tracer,
+                             max_wait_s, log)
+
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+
+    try:
+        server = build_aio_server(
+            manager=manager, host=args.host, port=args.port, sock=sock,
+            max_batch=args.maxBatch, max_wait_s=max_wait_s,
+            max_queue=args.maxQueue, region_cache_size=args.regionCache,
+            registry=registry, residency=residency,
+            client_rate=args.clientRate,
+            stream_threshold=args.streamThreshold,
+            tracer=tracer, log=log,
+        )
+    except (OSError, ValueError) as err:
+        # unparseable AVDB_SERVE_* knob or unbindable address: same clean
+        # exit as every other startup failure (a fleet worker dying with a
+        # traceback here would respawn into a crash loop)
+        print(f"serve: cannot start: {err}", file=sys.stderr)
+        return 1
+    ctx = server.ctx
+    snap = manager.current()
+
+    # GC hygiene for a latency-sensitive process: the loaded store is
+    # millions of long-lived objects — freeze them out of the collector
+    # so a mid-request gen2 pass never walks the whole store (those walks
+    # are tens of milliseconds, straight into p99), and widen gen0 so
+    # request-rate allocation (futures, pendings, rendered strings)
+    # doesn't trigger collections thousands of times per second
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(50_000, 25, 25)
+    # a 5ms GIL slice (the interpreter default) stacks whole-slice stalls
+    # onto request tails whenever the batcher drain or an executor thread
+    # runs hot; 1ms trades a little switching overhead for p99
+    sys.setswitchinterval(0.001)
+
+    import signal
+    import threading
+
+    try:
+        if args._workerIndex is not None:
+            # fleet worker: the event loop owns the main thread (and its
+            # SIGTERM graceful drain); a watcher fires the worker fault
+            # point and prints readiness once the socket is accepting
+            def ready():
+                server._started.wait()
+                try:
+                    # crash point: this worker is accepting; a failure
+                    # here is a worker death the SUPERVISOR must absorb
+                    # and restart
+                    faults.fire("serve.worker")
+                except Exception as err:
+                    print(f"serve: worker fault injected: {err}",
+                          file=sys.stderr)
+                    os._exit(1)
+                host, port = server.server_address[:2]
+                print(f"worker {args._workerIndex} serving {args.storeDir} "
+                      f"(generation {snap.generation}, {snap.store.n} rows)"
+                      f" on http://{host}:{port}", flush=True)
+
+            threading.Thread(target=ready, daemon=True).start()
+            server.serve_forever()
+        else:
+            # single process: bind on a helper thread first so the
+            # concrete (possibly ephemeral) address prints before we block
+            server.start_background()
+            host, port = server.server_address[:2]
+            print(f"serving {args.storeDir} (generation {snap.generation}, "
+                  f"{snap.store.n} rows) on http://{host}:{port}",
+                  flush=True)
+            stop = threading.Event()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, lambda *_a: stop.set())
+            stop.wait()
+            log("shutting down")
+    except OSError as err:
+        # bind failure: same clean exit as the threaded front end
+        print(f"serve: cannot start: {err}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        log("shutting down")
+    finally:
+        server.shutdown()
+        ctx.batcher.close()
+        _export(args, ctx.registry, tracer, log)
+    return 0
+
+
+def _worker_socket(args):
+    """The worker's listening socket: inherit the supervisor's fd (accept
+    handoff) or bind our own SO_REUSEPORT socket on the fleet port."""
+    import socket as socket_mod
+
+    from annotatedvdb_tpu.serve.fleet import bind_reuseport
+
+    if args._listenFd is not None:
+        return socket_mod.socket(fileno=args._listenFd)
+    return bind_reuseport(args.host, args.port)
+
+
+def _run_threaded(args, manager, registry, residency, tracer,
+                  max_wait_s, log) -> int:
+    """The PR-5 thread-per-connection server (byte-parity reference)."""
+    from annotatedvdb_tpu.serve.http import build_server
+
     try:
         httpd = build_server(
-            store_dir=args.storeDir, host=args.host, port=args.port,
-            max_batch=args.maxBatch,
-            max_wait_s=(
-                args.batchWaitMs / 1000.0
-                if args.batchWaitMs is not None else None
-            ),
+            manager=manager, host=args.host, port=args.port,
+            max_batch=args.maxBatch, max_wait_s=max_wait_s,
             max_queue=args.maxQueue, region_cache_size=args.regionCache,
-            tracer=tracer, log=log,
+            registry=registry, residency=residency, tracer=tracer, log=log,
         )
     except (OSError, ValueError) as err:
         print(f"serve: cannot start: {err}", file=sys.stderr)
@@ -83,23 +365,26 @@ def main(argv=None):
     try:
         httpd.serve_forever(poll_interval=0.2)
     except KeyboardInterrupt:
-        print("serve: shutting down", file=sys.stderr)
+        log("shutting down")
     finally:
         httpd.server_close()
         ctx.batcher.close()
-        if args.metricsOut:
-            try:
-                ctx.registry.write_textfile(args.metricsOut)
-                ctx.registry.write_json(args.metricsOut + ".json")
-            except OSError as err:
-                print(f"serve: metrics export failed ({err})",
-                      file=sys.stderr)
-        if tracer is not None and args.traceOut:
-            try:
-                tracer.save(args.traceOut)
-            except OSError as err:
-                print(f"serve: trace export failed ({err})", file=sys.stderr)
+        _export(args, ctx.registry, tracer, log)
     return 0
+
+
+def _export(args, registry, tracer, log) -> None:
+    if args.metricsOut:
+        try:
+            registry.write_textfile(args.metricsOut)
+            registry.write_json(args.metricsOut + ".json")
+        except OSError as err:
+            log(f"metrics export failed ({err})")
+    if tracer is not None and args.traceOut:
+        try:
+            tracer.save(args.traceOut)
+        except OSError as err:
+            log(f"trace export failed ({err})")
 
 
 if __name__ == "__main__":

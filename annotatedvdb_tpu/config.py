@@ -80,6 +80,21 @@ ENV_VARS: dict = {
     "AVDB_SERVE_REGION_CACHE": "LRU capacity of the rendered hot-region "
                                "cache, keyed by store generation "
                                "(default 64; 0 disables)",
+    "AVDB_SERVE_WORKERS": "serve fleet size: N>1 runs N worker processes "
+                          "sharing the port and one readonly store "
+                          "generation (default 1)",
+    "AVDB_SERVE_HBM_BUDGET": "byte budget for HBM-resident probe segment "
+                             "caches, e.g. 512m / 2g (unset = unmanaged: "
+                             "the store's own ski-rental rule)",
+    "AVDB_SERVE_SNAPSHOT_TTL_MS": "coalesced manifest freshness window: "
+                                  "one stat per window across all request "
+                                  "threads (default 250)",
+    "AVDB_SERVE_CLIENT_RATE": "weighted per-client admission: requests/sec "
+                              "per weight unit, rejected 429 beyond the "
+                              "bucket (default 0 = disabled)",
+    "AVDB_SERVE_STREAM_THRESHOLD": "region row count above which responses "
+                                   "stream chunked instead of buffering "
+                                   "the body (default 2048)",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "

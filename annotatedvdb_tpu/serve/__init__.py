@@ -2,14 +2,23 @@
 
 The read path over a loaded :class:`~annotatedvdb_tpu.store.VariantStore`:
 
-- :mod:`~annotatedvdb_tpu.serve.engine`   — point / bulk / region queries;
-- :mod:`~annotatedvdb_tpu.serve.batcher`  — continuous batching of
+- :mod:`~annotatedvdb_tpu.serve.engine`    — point / bulk / region queries;
+- :mod:`~annotatedvdb_tpu.serve.batcher`   — continuous batching of
   concurrent point queries into device microbatches;
-- :mod:`~annotatedvdb_tpu.serve.snapshot` — generation pinning so loader
-  commits never tear in-flight reads;
-- :mod:`~annotatedvdb_tpu.serve.http`     — stdlib JSON API front end
-  (imported lazily by the CLI; not re-exported here to keep engine-only
-  consumers free of ``http.server``).
+- :mod:`~annotatedvdb_tpu.serve.snapshot`  — generation pinning so loader
+  commits never tear in-flight reads (freshness checks coalesce to one
+  manifest ``stat`` per ``AVDB_SERVE_SNAPSHOT_TTL_MS`` window);
+- :mod:`~annotatedvdb_tpu.serve.residency` — HBM hot-set residency under
+  an ``AVDB_SERVE_HBM_BUDGET`` byte budget (hot segments device-resident,
+  cold ones serve from host);
+- :mod:`~annotatedvdb_tpu.serve.aio`       — asyncio event-loop front end
+  (the throughput path: per-client weighted admission, chunked region
+  streaming; imported lazily by the CLI);
+- :mod:`~annotatedvdb_tpu.serve.fleet`     — multi-process serve fleet
+  (N workers on one port via SO_REUSEPORT or parent accept handoff, a
+  supervisor that restarts dead workers and drains on SIGTERM);
+- :mod:`~annotatedvdb_tpu.serve.http`      — stdlib threaded JSON API
+  front end (the PR-5 reference implementation; byte-parity twin of aio).
 
 Entry point: ``python -m annotatedvdb_tpu serve --storeDir <dir>``.
 """
@@ -18,10 +27,12 @@ from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
 from annotatedvdb_tpu.serve.engine import (
     QueryEngine,
     QueryError,
+    RegionPage,
     parse_region,
     parse_variant_id,
     render_variant,
 )
+from annotatedvdb_tpu.serve.residency import ResidencyManager
 from annotatedvdb_tpu.serve.snapshot import (
     SnapshotManager,
     StaticSnapshots,
@@ -29,7 +40,7 @@ from annotatedvdb_tpu.serve.snapshot import (
 )
 
 __all__ = [
-    "QueryBatcher", "QueueFull", "QueryEngine", "QueryError",
-    "SnapshotManager", "StaticSnapshots", "StoreSnapshot",
-    "parse_region", "parse_variant_id", "render_variant",
+    "QueryBatcher", "QueueFull", "QueryEngine", "QueryError", "RegionPage",
+    "ResidencyManager", "SnapshotManager", "StaticSnapshots",
+    "StoreSnapshot", "parse_region", "parse_variant_id", "render_variant",
 ]

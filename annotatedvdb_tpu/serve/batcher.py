@@ -51,17 +51,52 @@ class QueueFull(RuntimeError):
     HTTP front end maps this to 429 + Retry-After."""
 
 
+def resolve_batch_knobs(max_batch, max_wait_s, max_queue):
+    """Fill ``None`` knobs from ``AVDB_SERVE_BATCH_MAX`` /
+    ``_BATCH_WAIT_MS`` / ``_MAX_QUEUE`` and clamp — the ONE place the env
+    defaults live, so both batchers (and therefore both front ends)
+    resolve identically."""
+    if max_batch is None:
+        max_batch = int(os.environ.get("AVDB_SERVE_BATCH_MAX", "") or 256)
+    if max_wait_s is None:
+        max_wait_s = int(
+            os.environ.get("AVDB_SERVE_BATCH_WAIT_MS", "") or 2
+        ) / 1000.0
+    if max_queue is None:
+        max_queue = int(os.environ.get("AVDB_SERVE_MAX_QUEUE", "") or 1024)
+    return (max(int(max_batch), 1), max(float(max_wait_s), 0.0),
+            max(int(max_queue), 0))
+
+
 class _Pending:
     """One caller's query in flight: the drain thread fills ``result`` or
-    ``error`` then sets ``done`` (the Event publishes the write)."""
+    ``error`` then sets ``done`` (the Event publishes the write).  An
+    optional ``callback`` is invoked (on the drain thread) after ``done``
+    is set — the asyncio front end's completion hook, so an event loop
+    never parks a thread on the Event."""
 
-    __slots__ = ("qid", "result", "error", "done")
+    __slots__ = ("qid", "parsed", "result", "error", "done", "callback")
 
-    def __init__(self, qid: str):
+    def __init__(self, qid: str, parsed=None, callback=None,
+                 want_event: bool = True):
         self.qid = qid
+        self.parsed = parsed  # submit-time parse, reused by the drain
         self.result = None
         self.error: BaseException | None = None
-        self.done = threading.Event()
+        # callback-style waiters (the asyncio front end) never wait on the
+        # Event — skip allocating one on that hot path
+        self.done = threading.Event() if want_event else None
+        self.callback = callback
+
+    def finish(self) -> None:
+        """Publish the filled result/error to the waiter."""
+        if self.done is not None:
+            self.done.set()
+        if self.callback is not None:
+            try:
+                self.callback(self)
+            except Exception:  # avdb: noqa[AVDB602] -- a waiter's completion hook must never take down the shared drain thread
+                pass
 
 
 class QueryBatcher:
@@ -71,20 +106,9 @@ class QueryBatcher:
                  max_wait_s: float | None = None,
                  max_queue: int | None = None,
                  tracer=None, registry=None, timeout_s: float = 30.0):
-        if max_batch is None:
-            max_batch = int(os.environ.get("AVDB_SERVE_BATCH_MAX", "") or 256)
-        if max_wait_s is None:
-            max_wait_s = int(
-                os.environ.get("AVDB_SERVE_BATCH_WAIT_MS", "") or 2
-            ) / 1000.0
-        if max_queue is None:
-            max_queue = int(
-                os.environ.get("AVDB_SERVE_MAX_QUEUE", "") or 1024
-            )
         self.engine = engine
-        self.max_batch = max(int(max_batch), 1)
-        self.max_wait_s = max(float(max_wait_s), 0.0)
-        self.max_queue = max(int(max_queue), 0)
+        self.max_batch, self.max_wait_s, self.max_queue = \
+            resolve_batch_knobs(max_batch, max_wait_s, max_queue)
         self.timeout_s = timeout_s
         self.tracer = tracer
         #: admission-queue accounting (items per drain, idle wait, depth
@@ -126,17 +150,7 @@ class QueryBatcher:
         None).  Raises :class:`QueueFull` at the admission bound,
         :class:`~annotatedvdb_tpu.serve.engine.QueryError` on bad grammar
         (validated HERE, before the queue), or the drain's root cause."""
-        if self._stop.is_set():
-            raise RuntimeError("batcher is closed")
-        parse_variant_id(variant_id)  # grammar errors stay with this caller
-        if self._q.qsize() >= self.max_queue:
-            raise QueueFull(
-                f"serve queue full ({self.max_queue} pending queries)"
-            )
-        pending = _Pending(variant_id)
-        self._q.put(pending)
-        if self._m_depth is not None:
-            self._m_depth.set(self._q.qsize())
+        pending = self.submit_nowait(variant_id)
         if not pending.done.wait(self.timeout_s):
             raise TimeoutError(
                 f"query {variant_id!r} timed out after {self.timeout_s}s "
@@ -145,6 +159,31 @@ class QueryBatcher:
         if pending.error is not None:
             raise pending.error
         return pending.result
+
+    def submit_nowait(self, variant_id: str, callback=None,
+                      want_event: bool = True) -> _Pending:
+        """Enqueue one point query WITHOUT blocking for the result: the
+        admission/grammar contract of :meth:`submit` applies synchronously
+        (``QueueFull`` / ``QueryError`` raise here, in the caller), then
+        the returned pending completes on the drain thread — ``callback``
+        (if given) runs there after the result publishes.  The asyncio
+        front end's submission path: thousands of in-flight queries cost
+        futures, not parked threads (it passes ``want_event=False`` —
+        nothing ever waits on the Event).  The queue-depth gauge updates
+        per drain, not per submit (a submit-side ``qsize`` pair is
+        measurable at serving QPS)."""
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        # grammar errors stay with this caller; the parse is kept for the
+        # drain so the engine never re-parses a microbatch
+        parsed = parse_variant_id(variant_id)
+        if self._q.qsize() >= self.max_queue:
+            raise QueueFull(
+                f"serve queue full ({self.max_queue} pending queries)"
+            )
+        pending = _Pending(variant_id, parsed, callback, want_event)
+        self._q.put(pending)
+        return pending
 
     def drain_stats(self) -> dict:
         """Lifetime coalescing summary (the bench's batch-fill source)."""
@@ -210,15 +249,18 @@ class QueryBatcher:
                 if self.tracer is not None else contextlib.nullcontext()
             )
             with span:
-                results = self.engine.lookup_many([p.qid for p in batch])
+                results = self.engine.lookup_many(
+                    [p.qid for p in batch],
+                    parsed=[p.parsed for p in batch],
+                )
         except Exception as exc:
             for pending in batch:
                 pending.error = exc
-                pending.done.set()
+                pending.finish()
             return
         for pending, result in zip(batch, results):
             pending.result = result
-            pending.done.set()
+            pending.finish()
         with self._lock:
             self._batches += 1
             self._queries += len(batch)
@@ -234,4 +276,4 @@ class QueryBatcher:
             except queue.Empty:
                 return
             pending.error = error
-            pending.done.set()
+            pending.finish()

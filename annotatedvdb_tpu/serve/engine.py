@@ -36,6 +36,7 @@ the next loader commit swaps the generation and naturally invalidates it.
 
 from __future__ import annotations
 
+import base64
 import functools
 import json
 import os
@@ -51,6 +52,7 @@ from annotatedvdb_tpu.store.variant_store import (
     _DIGEST_PK,
     _LONG_ALLELES,
     JSONB_COLUMNS,
+    combined_key,
     jsonb_dumps,
 )
 from annotatedvdb_tpu.types import (
@@ -71,6 +73,52 @@ _ALLELE_RE = re.compile(r"^[ACGTUNacgtun]+$")
 #: region span cap: one level-0 bin side (64Mb) covers any chromosome arm;
 #: anything wider is a scan, not a region query, and must page.
 MAX_REGION_SPAN = 64_000_000
+
+
+def _cursor_key(code, start, end, min_cadd, max_conseq_rank) -> int:
+    """FNV-1a fingerprint binding a continuation token to ONE query shape —
+    a token replayed against different bounds/filters is a client error,
+    not a silent wrong page."""
+    h = 2166136261
+    for ch in f"{code}:{start}:{end}:{min_cadd}:{max_conseq_rank}".encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def encode_cursor(generation: int, offset: int, key: int) -> str:
+    """Opaque continuation token: urlsafe base64 of a compact JSON triple
+    (generation, row offset, query fingerprint).  Opaque by contract —
+    clients must round-trip it verbatim."""
+    raw = json.dumps(
+        {"g": generation, "o": offset, "k": key}, separators=(",", ":")
+    ).encode()
+    return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+def decode_cursor(token: str, key: int) -> int:
+    """Token -> row offset.  ``""``/``"0"`` start the first page; anything
+    else must be a token this query shape minted.  A token from an OLDER
+    generation stays valid: the offset re-applies against the current
+    generation's match list (best-effort continuation across commits, the
+    same contract a Postgres keyset page would give)."""
+    if token in ("", "0"):
+        return 0
+    try:
+        raw = base64.urlsafe_b64decode(token + "=" * (-len(token) % 4))
+        obj = json.loads(raw)
+        offset = int(obj["o"])
+        k = int(obj["k"])
+        int(obj["g"])  # well-formedness only: ANY generation is accepted
+    except (ValueError, KeyError, TypeError):
+        raise QueryError(f"bad continuation cursor {token!r}") from None
+    if k != key:
+        raise QueryError(
+            "continuation cursor does not belong to this region query "
+            "(region or filters changed mid-page)"
+        )
+    if offset < 0:
+        raise QueryError(f"bad continuation cursor {token!r}")
+    return offset
 
 
 def parse_variant_id(spec: str) -> tuple[int, int, str, str]:
@@ -233,14 +281,92 @@ def _ann_number(seg, j: int, column: str, field: str):
         and not isinstance(out, bool) else None
 
 
+class RegionPage:
+    """One prepared region answer, renderable without buffering: the fixed
+    envelope (``prefix``/``suffix``) plus a row generator (``rows``) —
+    what the streaming front end writes chunk by chunk, and what
+    :meth:`QueryEngine.region` joins into the PR-5 byte-identical body.
+
+    Unpaged pages (``cursor=None`` at prepare time) close with exactly
+    ``]}`` — byte-identical to the pre-paging envelope; paged ones append
+    a ``"next"`` field carrying the continuation token (null on the last
+    page)."""
+
+    __slots__ = ("shard", "label", "level", "bin_path", "count",
+                 "generation", "shown", "region_str", "next_token", "paged")
+
+    def __init__(self, shard, label, level, bin_path, count, generation,
+                 shown, region_str, next_token, paged):
+        self.shard = shard
+        self.label = label
+        self.level = level
+        self.bin_path = bin_path
+        self.count = count
+        self.generation = generation
+        self.shown = shown
+        self.region_str = region_str
+        self.next_token = next_token
+        self.paged = paged
+
+    @property
+    def returned(self) -> int:
+        return len(self.shown)
+
+    def prefix(self) -> str:
+        return (
+            f'{{"region":{json.dumps(self.region_str)}'
+            f',"bin_level":{self.level}'
+            f',"bin_index":{json.dumps(self.bin_path)}'
+            f',"count":{self.count}'
+            f',"returned":{len(self.shown)}'
+            f',"generation":{self.generation}'
+            ',"variants":['
+        )
+
+    def rows(self):
+        """Rendered JSON text per row, in response order — a generator, so
+        a streaming writer holds one row (not the whole body) at a time."""
+        shard = self.shard
+        for si, j in self.shown:
+            yield _render_row(shard.segments[si], j, self.label, shard.width)
+
+    def suffix(self) -> str:
+        if not self.paged:
+            return "]}"
+        nxt = json.dumps(self.next_token) if self.next_token else "null"
+        return f'],"next":{nxt}}}'
+
+    def assemble(self) -> str:
+        return self.prefix() + ",".join(self.rows()) + self.suffix()
+
+
 class QueryEngine:
     """Point/bulk/region queries over a snapshot provider
     (:class:`~annotatedvdb_tpu.serve.snapshot.SnapshotManager` in a server,
-    :class:`~annotatedvdb_tpu.serve.snapshot.StaticSnapshots` in tests)."""
+    :class:`~annotatedvdb_tpu.serve.snapshot.StaticSnapshots` in tests).
+    An optional :class:`~annotatedvdb_tpu.serve.residency.ResidencyManager`
+    governs which probed segments stay HBM-resident."""
+
+    #: rendered point-record LRU capacity (entries).  Keyed by
+    #: (generation, chromosome, global id): a serving generation's rows
+    #: are immutable, so a hot variant renders once per generation and
+    #: costs a dict probe afterwards — rendering is the dominant term of
+    #: a point drain (~half the microbatch budget).
+    POINT_RENDER_CACHE = 1 << 16
+    #: and a byte ceiling on the cached text: records carrying large
+    #: spliced RawJson annotation blobs (tens of KB each) must not pin
+    #: entries x record-size of RSS in a long-lived gc.freeze'd process
+    POINT_RENDER_CACHE_BYTES = 64 << 20
 
     def __init__(self, snapshots, registry=None,
-                 region_cache_size: int | None = None):
+                 region_cache_size: int | None = None, residency=None):
         self.snapshots = snapshots
+        self.residency = residency
+        self._render_lock = threading.Lock()
+        #: guarded by self._render_lock
+        self._render_cache: OrderedDict = OrderedDict()
+        #: guarded by self._render_lock
+        self._render_cache_bytes = 0
         if region_cache_size is None:
             region_cache_size = int(
                 os.environ.get("AVDB_SERVE_REGION_CACHE", "") or 64
@@ -249,6 +375,10 @@ class QueryEngine:
         self._cache_lock = threading.Lock()
         #: guarded by self._cache_lock
         self._region_cache: OrderedDict = OrderedDict()
+        #: guarded by self._cache_lock; (generation, region, filters) ->
+        #: (si, j) int64 arrays of the walk's post-filter matches, so an
+        #: N-page cursor walk scans the region once, not once per page
+        self._walk_cache: OrderedDict = OrderedDict()
         if registry is not None:
             self._cache_hits = registry.counter(
                 "avdb_query_cache_hits_total",
@@ -267,17 +397,22 @@ class QueryEngine:
         """JSON text of the record, or None when absent."""
         return self.lookup_many([variant_id])[0]
 
-    def lookup_many(self, ids: list) -> list:
+    def lookup_many(self, ids: list, parsed: list | None = None) -> list:
         """[JSON text | None] per id, order-preserving.  Ids are parsed up
         front (one bad id fails the CALL with :class:`QueryError` — the
         batcher pre-validates at submit so co-batched strangers never share
         a client's grammar error), then probed per chromosome as one
-        vectorized batch through the loader's membership path."""
+        vectorized batch through the loader's membership path.  The
+        batcher passes the tuples it already parsed at submit via
+        ``parsed`` — re-parsing a microbatch is measurable at QPS."""
         out: list = [None] * len(ids)
         if not ids:
             return out
-        parsed = [parse_variant_id(s) for s in ids]
+        if parsed is None:
+            parsed = [parse_variant_id(s) for s in ids]
         snap = self.snapshots.current()
+        if self.residency is not None:
+            self.residency.govern(snap)
         store = snap.store
         width = store.width
         by_code: dict[int, list] = {}
@@ -295,61 +430,161 @@ class QueryEngine:
                 (parsed[i][1] for i in idxs), np.int32, count=len(idxs)
             )
             h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+            if self.residency is not None:
+                qkey = combined_key(pos, h)
+                self.residency.touch_window(
+                    shard, qkey.min(), qkey.max(), len(idxs)
+                )
             found, gid = shard.lookup(pos, h, ref, alt, ref_len, alt_len)
+            generation = snap.generation
             for k, i in enumerate(idxs):
                 if found[k]:
-                    out[i] = render_variant(shard, code, int(gid[k]))
+                    out[i] = self._render_cached(
+                        shard, code, int(gid[k]), generation
+                    )
         return out
+
+    def _render_cached(self, shard, code: int, gid: int,
+                       generation: int) -> str:
+        """Point-record render through the generation-keyed LRU (stale
+        generations age out with everything else; their keys can never be
+        probed again)."""
+        key = (generation, code, gid)
+        with self._render_lock:
+            text = self._render_cache.get(key)
+            if text is not None:
+                self._render_cache.move_to_end(key)
+                return text
+        text = render_variant(shard, code, gid)
+        with self._render_lock:
+            # two threads can race the same miss: replace, don't
+            # double-count
+            old = self._render_cache.pop(key, None)
+            if old is not None:
+                self._render_cache_bytes -= len(old)
+            self._render_cache[key] = text
+            self._render_cache_bytes += len(text)
+            while self._render_cache and (
+                len(self._render_cache) > self.POINT_RENDER_CACHE
+                or self._render_cache_bytes > self.POINT_RENDER_CACHE_BYTES
+            ):
+                _, old = self._render_cache.popitem(last=False)
+                self._render_cache_bytes -= len(old)
+        return text
 
     # -- region -------------------------------------------------------------
 
     def region(self, spec: str, min_cadd=None, max_conseq_rank=None,
-               limit: int | None = None) -> str:
+               limit: int | None = None, cursor: str | None = None) -> str:
         """JSON text answering ``chr:start-end`` (with optional filters):
         ``{"region", "bin_level", "bin_index", "count", "returned",
         "generation", "variants": [...]}``.  ``count`` is the post-filter
-        match total; ``variants`` carries the first ``limit`` of them."""
+        match total; ``variants`` carries the first ``limit`` of them.
+        With ``cursor`` (``""`` starts a paged walk, a returned token
+        continues it) the envelope additionally carries ``"next"``."""
+        kind, payload = self.region_serve(
+            spec, min_cadd=min_cadd, max_conseq_rank=max_conseq_rank,
+            limit=limit, cursor=cursor, stream_threshold=None,
+        )
+        return payload if kind == "text" else payload.assemble()
+
+    def region_serve(self, spec: str, min_cadd=None, max_conseq_rank=None,
+                     limit: int | None = None, cursor: str | None = None,
+                     stream_threshold: int | None = None):
+        """The front ends' region entry point: ``("text", str)`` for
+        responses small enough to buffer (cache-eligible when unpaged), or
+        ``("page", RegionPage)`` when the row count exceeds
+        ``stream_threshold`` — the caller streams prefix/rows/suffix
+        without ever materializing the body (large gene-panel regions stop
+        holding peak RSS)."""
         code, start, end = parse_region(spec)
         snap = self.snapshots.current()
-        key = (snap.generation, code, start, end,
-               min_cadd, max_conseq_rank, limit)
-        text = self._cache_get(key)
-        if text is None:
-            text = self._region_render(
-                snap, code, start, end, min_cadd, max_conseq_rank, limit
-            )
-            self._cache_put(key, text)
-        return text
+        if self.residency is not None:
+            self.residency.govern(snap)
+        cache_key = None
+        if cursor is None:
+            cache_key = (snap.generation, code, start, end,
+                         min_cadd, max_conseq_rank, limit)
+            text = self._cache_get(cache_key)
+            if text is not None:
+                return "text", text
+        page = self._region_page(
+            snap, code, start, end, min_cadd, max_conseq_rank, limit, cursor
+        )
+        if stream_threshold is not None and page.returned > stream_threshold:
+            return "page", page
+        text = page.assemble()
+        if cache_key is not None:
+            self._cache_put(cache_key, text)
+        return "text", text
 
-    def _region_render(self, snap, code, start, end,
-                       min_cadd, max_conseq_rank, limit) -> str:
+    #: distinct in-flight cursor walks whose match lists stay cached
+    #: (two compact int64 arrays per walk, LRU; stale generations age out)
+    WALK_CACHE = 8
+
+    def _region_page(self, snap, code, start, end,
+                     min_cadd, max_conseq_rank, limit,
+                     cursor: str | None) -> RegionPage:
         label = chromosome_label(code)
         level, leaf = _region_bin(start, end)
         shard = snap.store.shards.get(code)
-        kept: list[tuple[int, int]] = []  # (segment index, local row)
-        if shard is not None and shard.n:
-            kept = self._region_rows(shard, start, end)
-        if min_cadd is not None or max_conseq_rank is not None:
-            kept = [
-                (si, j) for si, j in kept
-                if self._passes(shard.segments[si], j,
-                                min_cadd, max_conseq_rank)
-            ]
-        shown = kept if limit is None else kept[: max(int(limit), 0)]
-        rendered = [
-            _render_row(shard.segments[si], j, label, shard.width)
-            for si, j in shown
-        ]
-        region = f"{label}:{start}-{end}"
-        bin_path = closed_form_path(label, level, leaf)
-        return (
-            f'{{"region":{json.dumps(region)}'
-            f',"bin_level":{level}'
-            f',"bin_index":{json.dumps(bin_path)}'
-            f',"count":{len(kept)}'
-            f',"returned":{len(rendered)}'
-            f',"generation":{snap.generation}'
-            ',"variants":[' + ",".join(rendered) + "]}"
+        paged = cursor is not None
+        wkey = hit = None
+        if paged:
+            wkey = (snap.generation, code, start, end,
+                    min_cadd, max_conseq_rank)
+            with self._cache_lock:
+                hit = self._walk_cache.get(wkey)
+                if hit is not None:
+                    self._walk_cache.move_to_end(wkey)
+        if hit is None:
+            kept: list[tuple[int, int]] = []  # (segment index, local row)
+            if shard is not None and shard.n:
+                kept = self._region_rows(shard, start, end)
+            if min_cadd is not None or max_conseq_rank is not None:
+                kept = [
+                    (si, j) for si, j in kept
+                    if self._passes(shard.segments[si], j,
+                                    min_cadd, max_conseq_rank)
+                ]
+            if paged:
+                # without this an N-page walk re-runs the full region
+                # scan + filter pass per page (O(N x region) for what the
+                # client sees as keyset pagination)
+                hit = (
+                    np.fromiter((t[0] for t in kept), np.int64, len(kept)),
+                    np.fromiter((t[1] for t in kept), np.int64, len(kept)),
+                )
+                with self._cache_lock:
+                    self._walk_cache[wkey] = hit
+                    while len(self._walk_cache) > self.WALK_CACHE:
+                        self._walk_cache.popitem(last=False)
+        if paged:
+            total = int(hit[0].shape[0])
+            ckey = _cursor_key(code, start, end, min_cadd, max_conseq_rank)
+            offset = decode_cursor(cursor, ckey)
+            stop = total if limit is None \
+                else min(offset + max(int(limit), 0), total)
+            shown = list(zip(hit[0][offset:stop].tolist(),
+                             hit[1][offset:stop].tolist()))
+            next_token = None
+            # a page must ADVANCE to mint a continuation (limit=0
+            # count-only pages would otherwise hand back a
+            # self-referential token and loop a cursor-following client
+            # forever)
+            if stop < total and stop > offset:
+                next_token = encode_cursor(snap.generation, stop, ckey)
+            return RegionPage(
+                shard, label, level, closed_form_path(label, level, leaf),
+                total, snap.generation, shown, f"{label}:{start}-{end}",
+                next_token, paged=True,
+            )
+        stop = len(kept) if limit is None \
+            else min(max(int(limit), 0), len(kept))
+        return RegionPage(
+            shard, label, level, closed_form_path(label, level, leaf),
+            len(kept), snap.generation, kept[:stop],
+            f"{label}:{start}-{end}", None, paged=False,
         )
 
     @staticmethod

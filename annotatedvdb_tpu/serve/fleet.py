@@ -1,0 +1,233 @@
+"""Multi-process serve fleet: N workers, one port, one readonly store.
+
+A single Python serving process is GIL-bound: the event-loop front end
+(``serve/aio.py``) removes thread overhead but still executes on one
+core.  The fleet runs N worker **processes**, each a full snapshot-pinned
+serving stack over the SAME store directory — workers share one readonly
+store generation through the existing ``snapshot.py`` atomic manifest
+swaps (a loader commit becomes visible to every worker within one TTL
+window), so there is no cross-process coordination on the data path at
+all.
+
+Port sharing, in preference order:
+
+- **SO_REUSEPORT** (Linux, modern BSDs): every worker binds its own
+  listening socket on the shared port and the kernel load-balances
+  accepts across them — no parent involvement, no thundering herd.  The
+  supervisor holds a bound (never listening) reservation socket so the
+  port cannot be stolen between worker restarts.
+- **parent-managed accept handoff** (everywhere else): the supervisor
+  binds + listens once and passes the listening fd to every worker
+  (``--_listenFd``); workers accept from the shared queue.
+
+The supervisor is a plain restart-and-drain loop: a worker that dies
+unexpectedly is respawned (with backoff after rapid deaths); SIGTERM or
+SIGINT drains the fleet — workers get SIGTERM (their event loop finishes
+in-flight responses), stragglers are killed after a timeout.  The
+``serve.worker`` fault point fires in each worker right after its server
+comes up, so the matrix can kill a fresh worker deterministically; on
+respawn after an ARMED worker death the supervisor strips ``AVDB_FAULT``
+for serve-side points from the child environment — the injection tests
+the restart path, and re-arming every replacement would make the fleet
+unrecoverable by construction (a crash loop, not a crash test).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def reuseport_available() -> bool:
+    """Whether SO_REUSEPORT exists and the kernel accepts it."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return False
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        return True
+    except OSError:
+        return False
+
+
+def bind_reuseport(host: str, port: int) -> socket.socket:
+    """A bound+listening SO_REUSEPORT socket (worker side)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    sock.bind((host, port))
+    sock.listen(1024)
+    return sock
+
+
+class ServeFleet:
+    """Supervisor for N serve worker processes on one port.
+
+    ``worker_args`` is the tail of CLI flags forwarded verbatim to every
+    worker (batching/admission/residency knobs); the supervisor itself
+    never opens the store."""
+
+    def __init__(self, store_dir: str, host: str = "127.0.0.1",
+                 port: int = 0, workers: int = 2, worker_args=(),
+                 log=None, restart_backoff_s: float = 0.5,
+                 drain_s: float = 10.0, reuseport: bool | None = None):
+        self.store_dir = store_dir
+        self.host = host
+        self.workers = max(int(workers), 1)
+        self.worker_args = list(worker_args)
+        self.log = log if log is not None else (lambda msg: None)
+        self.restart_backoff_s = restart_backoff_s
+        self.drain_s = drain_s
+        # reuseport=False forces the parent accept-handoff path (the
+        # portability fallback) — how tests exercise it on Linux too
+        self.reuseport = (
+            reuseport_available() if reuseport is None else bool(reuseport)
+        )
+        # resolve the concrete port up front (--port 0 must advertise one
+        # address for the whole fleet)
+        self._reserve = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        if self.reuseport:
+            self._reserve.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+            )
+            self._reserve.bind((host, port))
+            # bound, NEVER listening: reserves the port without joining
+            # the kernel's accept distribution group
+        else:
+            self._reserve.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
+            self._reserve.bind((host, port))
+            self._reserve.listen(1024)
+        self.port = self._reserve.getsockname()[1]
+        self._procs: dict[int, subprocess.Popen] = {}  # worker idx -> proc
+        self._respawns: dict[int, int] = {}
+        self._spawn_time: dict[int, float] = {}
+        self._stopping = False
+
+    #: a worker that survived this long resets its rapid-death streak —
+    #: backoff punishes crash LOOPS, not a long-lived worker's occasional
+    #: death
+    HEALTHY_RUN_S = 30.0
+
+    #: consecutive rapid deaths after which the fleet gives up on the
+    #: worker and exits non-zero: a worker that can never start (bad
+    #: inherited env knob, wedged store) must surface as a startup
+    #: failure, not an indefinite respawn loop
+    MAX_RAPID_DEATHS = 5
+
+    # -- worker lifecycle ---------------------------------------------------
+
+    def _worker_cmd(self, index: int) -> list[str]:
+        cmd = [
+            sys.executable, "-m", "annotatedvdb_tpu", "serve",
+            "--storeDir", self.store_dir,
+            "--host", self.host, "--port", str(self.port),
+            "--_workerIndex", str(index),
+        ]
+        if not self.reuseport:
+            cmd += ["--_listenFd", str(self._reserve.fileno())]
+        return cmd + self.worker_args
+
+    def _spawn(self, index: int, respawn: bool = False) -> None:
+        env = dict(os.environ)
+        if respawn and env.get("AVDB_FAULT", "").startswith("serve."):
+            # an injected serve-side fault killed the previous incarnation;
+            # the replacement must come up clean (see module docstring)
+            self.log(f"worker {index}: respawning with AVDB_FAULT cleared")
+            env.pop("AVDB_FAULT")
+        proc = subprocess.Popen(
+            self._worker_cmd(index),
+            env=env,
+            pass_fds=() if self.reuseport else (self._reserve.fileno(),),
+        )
+        self._procs[index] = proc
+        self._spawn_time[index] = time.monotonic()
+        self.log(f"worker {index}: pid {proc.pid} "
+                 f"({'SO_REUSEPORT' if self.reuseport else 'shared fd'})")
+
+    def run(self) -> int:
+        """Spawn the fleet and supervise until SIGTERM/SIGINT; returns the
+        exit code (0 on a clean drain)."""
+        def _request_stop(signum, frame):
+            self._stopping = True
+
+        old_term = signal.signal(signal.SIGTERM, _request_stop)
+        old_int = signal.signal(signal.SIGINT, _request_stop)
+        try:
+            for i in range(self.workers):
+                self._spawn(i)
+            self.log(
+                f"fleet: serving {self.store_dir} on "
+                f"http://{self.host}:{self.port} with {self.workers} "
+                f"workers"
+            )
+            failed = False
+            while not self._stopping:
+                time.sleep(0.1)
+                for i, proc in list(self._procs.items()):
+                    rc = proc.poll()
+                    if rc is None or self._stopping:
+                        continue
+                    lived = time.monotonic() - self._spawn_time.get(i, 0.0)
+                    if lived >= self.HEALTHY_RUN_S:
+                        self._respawns[i] = 0  # streak broken: healthy run
+                    n = self._respawns[i] = self._respawns.get(i, 0) + 1
+                    if n >= self.MAX_RAPID_DEATHS:
+                        self.log(
+                            f"worker {i}: died {n} consecutive times "
+                            f"within {self.HEALTHY_RUN_S:.0f}s of spawn "
+                            f"(last rc={rc}); fleet cannot start — "
+                            f"giving up"
+                        )
+                        failed = True
+                        self._stopping = True
+                        break
+                    self.log(f"worker {i}: died rc={rc} after "
+                             f"{lived:.1f}s; restart #{n}")
+                    # backoff grows with CONSECUTIVE rapid deaths so a
+                    # wedged store cannot melt the host with spawn storms;
+                    # the wait stays responsive to SIGTERM and never
+                    # blocks other workers' restarts past its budget
+                    deadline = time.monotonic() + min(
+                        self.restart_backoff_s * (n - 1), 5.0
+                    )
+                    while time.monotonic() < deadline \
+                            and not self._stopping:
+                        time.sleep(0.1)
+                    if not self._stopping:
+                        self._spawn(i, respawn=True)
+            rc = self._drain()
+            return 1 if failed else rc
+        finally:
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
+            self._reserve.close()
+
+    def _drain(self) -> int:
+        """Graceful stop: SIGTERM every worker, wait out the drain budget,
+        SIGKILL stragglers."""
+        self.log("fleet: draining")
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                # the worker may vanish between poll and signal
+                with contextlib.suppress(OSError):
+                    proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + self.drain_s
+        clean = True
+        for i, proc in self._procs.items():
+            timeout = max(deadline - time.monotonic(), 0.1)
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.log(f"worker {i}: did not drain; killing")
+                with contextlib.suppress(OSError):
+                    proc.kill()
+                proc.wait(timeout=5)
+                clean = False
+        self.log("fleet: stopped")
+        return 0 if clean else 1

@@ -56,6 +56,65 @@ QUERY_SECONDS_EDGES = (
 DEFAULT_REGION_LIMIT = 10_000
 
 
+def healthz_payload(ctx) -> str:
+    """The ``/healthz`` body — ONE builder shared by both front ends, so
+    the route surface cannot silently fork (same reason
+    :func:`parse_region_params` lives here)."""
+    snap = ctx.manager.current()
+    return json.dumps({
+        "status": "ok",
+        "generation": snap.generation,
+        "rows": snap.store.n,
+        "shards": len(snap.store.shards),
+        "queue_depth": ctx.batcher.depth(),
+    })
+
+
+def stats_payload(ctx) -> str:
+    """The ``/stats`` body — shared like :func:`healthz_payload`."""
+    snap = ctx.manager.current()
+    stats = {
+        "generation": snap.generation,
+        "rows": snap.store.n,
+        "snapshot_swaps": ctx.manager.swaps,
+        "batcher": ctx.batcher.drain_stats(),
+    }
+    if ctx.engine.residency is not None:
+        stats["residency"] = ctx.engine.residency.stats()
+    return json.dumps(stats)
+
+
+def parse_region_params(query: str):
+    """``(min_cadd, max_conseq_rank, limit, cursor)`` from a region query
+    string — the ONE parsing contract both front ends share (the parity
+    suite pins their responses byte-identical, so the parameter grammar
+    must not fork).  Raises :class:`QueryError` on a bad value;
+    ``keep_blank_values`` so ``?cursor=`` (start a paged walk) survives."""
+    params = parse_qs(query, keep_blank_values=True)
+
+    def num(name, cast):
+        vals = params.get(name)
+        # a blank value ("?minCadd=&...", an unfilled client template) is
+        # an absent filter, exactly as before keep_blank_values (which
+        # only exists so a blank ?cursor= survives)
+        if not vals or vals[0] == "":
+            return None
+        try:
+            return cast(vals[0])
+        except ValueError:
+            raise QueryError(
+                f"bad query parameter {name}={vals[0]!r}"
+            ) from None
+
+    limit = num("limit", int)  # explicit 0 = count-only query
+    return (
+        num("minCadd", float),
+        num("maxConseqRank", int),
+        DEFAULT_REGION_LIMIT if limit is None else limit,
+        params.get("cursor", [None])[0],  # "" starts paging
+    )
+
+
 class ServeContext:
     """Everything a handler thread needs, shared across requests."""
 
@@ -80,36 +139,49 @@ class ServeContext:
             "avdb_serve_snapshot_swaps_total",
             "store generation swaps observed by the server",
         )
+        # per-kind series resolved ONCE: the registry probe (lock + label
+        # key assembly) is measurable at serving QPS, so the hot path
+        # indexes a dict instead of re-registering per request
+        self._kind = {}
+        for kind in ("point", "bulk", "region"):
+            labels = {"kind": kind}
+            self._kind[kind] = (
+                registry.counter(
+                    "avdb_query_requests_total", "queries served", labels
+                ),
+                registry.histogram(
+                    "avdb_query_seconds", QUERY_SECONDS_EDGES,
+                    "request latency by query kind", labels,
+                ),
+                registry.counter(
+                    "avdb_query_rows_total", "result rows returned", labels
+                ),
+                registry.counter(
+                    "avdb_query_rejected_total",
+                    "queries rejected at the admission bound (HTTP 429)",
+                    labels,
+                ),
+                registry.counter(
+                    "avdb_query_errors_total",
+                    "queries that failed (HTTP 4xx grammar / 5xx engine)",
+                    labels,
+                ),
+            )
 
     # -- per-kind metrics (kind in {point, bulk, region}) -------------------
 
     def observe(self, kind: str, seconds: float, rows: int = 0) -> None:
-        labels = {"kind": kind}
-        self.registry.counter(
-            "avdb_query_requests_total", "queries served", labels
-        ).inc()
-        self.registry.histogram(
-            "avdb_query_seconds", QUERY_SECONDS_EDGES,
-            "request latency by query kind", labels,
-        ).observe(seconds)
+        requests, seconds_h, rows_c, _rej, _err = self._kind[kind]
+        requests.inc()
+        seconds_h.observe(seconds)
         if rows:
-            self.registry.counter(
-                "avdb_query_rows_total", "result rows returned", labels
-            ).inc(rows)
+            rows_c.inc(rows)
 
     def rejected(self, kind: str) -> None:
-        self.registry.counter(
-            "avdb_query_rejected_total",
-            "queries rejected at the admission bound (HTTP 429)",
-            {"kind": kind},
-        ).inc()
+        self._kind[kind][3].inc()
 
     def errored(self, kind: str) -> None:
-        self.registry.counter(
-            "avdb_query_errors_total",
-            "queries that failed (HTTP 4xx grammar / 5xx engine)",
-            {"kind": kind},
-        ).inc()
+        self._kind[kind][4].inc()
 
     # -- admission ----------------------------------------------------------
 
@@ -130,10 +202,13 @@ class ServeContext:
         self._m_inflight.set(depth)
 
     def refresh_snapshot(self) -> None:
-        """Pick up a loader commit if one landed; a refresh failure keeps
-        serving the pinned generation (and must never fail the request)."""
+        """Pick up a loader commit if one landed — coalesced: at most one
+        manifest ``stat`` per ``AVDB_SERVE_SNAPSHOT_TTL_MS`` window across
+        every request thread (``SnapshotManager.maybe_refresh``).  A
+        refresh failure keeps serving the pinned generation (and must
+        never fail the request)."""
         try:
-            if self.manager.refresh():
+            if self.manager.maybe_refresh():
                 self._m_swaps.inc()
         except Exception as err:
             self.log(f"snapshot refresh errored: {err}")
@@ -175,27 +250,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         path = unquote(url.path)
         if path == "/healthz":
             ctx.refresh_snapshot()
-            snap = ctx.manager.current()
-            self._reply(200, json.dumps({
-                "status": "ok",
-                "generation": snap.generation,
-                "rows": snap.store.n,
-                "shards": len(snap.store.shards),
-                "queue_depth": ctx.batcher.depth(),
-            }))
+            self._reply(200, healthz_payload(ctx))
             return
         if path == "/metrics":
             self._reply(200, ctx.registry.render_prometheus(),
                         content_type="text/plain; version=0.0.4")
             return
         if path == "/stats":
-            snap = ctx.manager.current()
-            self._reply(200, json.dumps({
-                "generation": snap.generation,
-                "rows": snap.store.n,
-                "snapshot_swaps": ctx.manager.swaps,
-                "batcher": ctx.batcher.drain_stats(),
-            }))
+            self._reply(200, stats_payload(ctx))
             return
         if path.startswith("/variant/"):
             self._point(ctx, path[len("/variant/"):])
@@ -286,26 +348,15 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         try:
             ctx.refresh_snapshot()
-            params = parse_qs(query)
-
-            def num(name, cast):
-                vals = params.get(name)
-                if not vals:
-                    return None
-                try:
-                    return cast(vals[0])
-                except ValueError:
-                    raise QueryError(
-                        f"bad query parameter {name}={vals[0]!r}"
-                    ) from None
-
             try:
-                limit = num("limit", int)  # explicit 0 = count-only query
+                min_cadd, max_rank, limit, cursor = \
+                    parse_region_params(query)
                 text = ctx.engine.region(
                     spec,
-                    min_cadd=num("minCadd", float),
-                    max_conseq_rank=num("maxConseqRank", int),
-                    limit=DEFAULT_REGION_LIMIT if limit is None else limit,
+                    min_cadd=min_cadd,
+                    max_conseq_rank=max_rank,
+                    limit=limit,
+                    cursor=cursor,
                 )
             except QueryError as err:
                 ctx.errored("region")
@@ -332,6 +383,7 @@ def build_server(store_dir: str | None = None, manager=None,
                  max_queue: int | None = None,
                  region_cache_size: int | None = None,
                  registry: MetricsRegistry | None = None,
+                 residency=None,
                  tracer=None, log=None) -> ThreadingHTTPServer:
     """Wire manager → engine → batcher → HTTP server (not yet serving; call
     ``serve_forever`` or run it on a thread).  The server carries its
@@ -343,7 +395,8 @@ def build_server(store_dir: str | None = None, manager=None,
         manager = SnapshotManager(store_dir, log=log)
     registry = registry if registry is not None else MetricsRegistry()
     engine = QueryEngine(
-        manager, registry=registry, region_cache_size=region_cache_size
+        manager, registry=registry, region_cache_size=region_cache_size,
+        residency=residency,
     )
     batcher = QueryBatcher(
         engine, max_batch=max_batch, max_wait_s=max_wait_s,

@@ -14,11 +14,16 @@ reference's Postgres snapshot isolation).
 
 - ``current()`` hands out the snapshot (queries hold it for their whole
   execution — the swap can never tear one mid-read);
-- ``refresh()`` fingerprints ``manifest.json`` (one ``stat``, cheap enough
-  per request), loads the new generation OFF-lock when it changed, then
-  swaps the pin atomically.  The ``snapshot.swap`` fault point fires
-  between load and swap: a failure there must leave the old generation
-  serving, which the fault matrix pins.
+- ``refresh()`` fingerprints ``manifest.json`` (one ``stat``), loads the
+  new generation OFF-lock when it changed, then swaps the pin atomically.
+  The ``snapshot.swap`` fault point fires between load and swap: a failure
+  there must leave the old generation serving, which the fault matrix pins.
+- ``maybe_refresh()`` is the front ends' coalesced entry point: at serving
+  QPS a per-request ``stat`` is real syscall pressure, so freshness checks
+  collapse to one ``stat`` per ``AVDB_SERVE_SNAPSHOT_TTL_MS`` window
+  (default 250ms — a commit becomes visible within a quarter second, not
+  within one request).  ``refresh()`` keeps its always-stat semantics for
+  callers that need immediacy (tests, admin paths).
 
 Stores are opened ``readonly=True``: the serving process can never create
 directories, persist empty shards, or otherwise write through a read path.
@@ -28,9 +33,17 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from annotatedvdb_tpu.store import VariantStore
 from annotatedvdb_tpu.utils import faults
+
+
+def _ttl_from_env() -> float:
+    """``AVDB_SERVE_SNAPSHOT_TTL_MS`` (default 250) as seconds."""
+    return max(
+        float(os.environ.get("AVDB_SERVE_SNAPSHOT_TTL_MS", "") or 250), 0.0
+    ) / 1000.0
 
 
 class StoreSnapshot:
@@ -59,9 +72,10 @@ def _manifest_fingerprint(store_dir: str) -> tuple:
 class SnapshotManager:
     """Pins the serving store generation; swaps are atomic under a lock."""
 
-    def __init__(self, store_dir: str, log=None):
+    def __init__(self, store_dir: str, log=None, ttl_s: float | None = None):
         self.store_dir = store_dir
         self.log = log if log is not None else (lambda msg: None)
+        self.ttl_s = _ttl_from_env() if ttl_s is None else max(float(ttl_s), 0.0)
         self._lock = threading.Lock()
         fingerprint = _manifest_fingerprint(store_dir)
         store = VariantStore.load(store_dir, readonly=True)
@@ -69,6 +83,8 @@ class SnapshotManager:
         self._snap = StoreSnapshot(store, 1, fingerprint)
         #: guarded by self._lock
         self._swaps = 0
+        #: guarded by self._lock
+        self._next_check = 0.0  # monotonic deadline of the next free stat
 
     def current(self) -> StoreSnapshot:
         """The pinned generation.  Callers keep the returned snapshot for
@@ -81,6 +97,25 @@ class SnapshotManager:
     def swaps(self) -> int:
         with self._lock:
             return self._swaps
+
+    def refresh_due(self) -> bool:
+        """Whether the TTL window has lapsed (no stat, no side effects) —
+        the event-loop front end's cheap in-line check before it schedules
+        the real refresh off-loop."""
+        with self._lock:
+            return time.monotonic() >= self._next_check
+
+    def maybe_refresh(self) -> bool:
+        """Coalesced freshness check: at most one manifest ``stat`` per
+        TTL window across ALL request threads; within the window the
+        pinned generation is served as-is.  Returns True only when this
+        call performed the swap."""
+        now = time.monotonic()
+        with self._lock:
+            if now < self._next_check:
+                return False
+            self._next_check = now + self.ttl_s
+        return self.refresh()
 
     def refresh(self) -> bool:
         """Swap to the on-disk generation if it changed; returns True on a
@@ -135,6 +170,9 @@ class StaticSnapshots:
         return self._snap
 
     def refresh(self) -> bool:
+        return False
+
+    def maybe_refresh(self) -> bool:
         return False
 
     @property

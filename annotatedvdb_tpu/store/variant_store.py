@@ -345,7 +345,7 @@ class Segment:
     a merged segment's rows, it just references the constituent files)."""
 
     __slots__ = ("n", "cols", "ref", "alt", "obj", "backing", "dirty",
-                 "_key", "_device", "_numpy_query_volume")
+                 "_key", "_device", "_numpy_query_volume", "residency")
 
     def __init__(self, cols, ref, alt, obj, backing=None):
         self.n = int(ref.shape[0])
@@ -358,6 +358,11 @@ class Segment:
         self._key = None
         self._device = None
         self._numpy_query_volume = 0  # ski-rental accumulator (see probe)
+        # None = the segment decides its own HBM cache (ski-rental below);
+        # "managed" = an external residency manager (serve/residency.py)
+        # owns upload/evict under a byte budget — probe never auto-uploads,
+        # it uses whatever cache the manager installed
+        self.residency: str | None = None
 
     @property
     def key(self) -> np.ndarray:
@@ -535,18 +540,30 @@ class Segment:
         # an existing HBM cache is sunk cost — use it at any size; otherwise
         # upload once the ski-rental accumulator says the transfer has paid
         # for itself in forgone device work (see DEVICE_UPLOAD_AMORTIZE)
+        # capture the cache tuple ONCE: a residency manager may evict
+        # (`_device = None`) from another thread between this gate and
+        # the device call — the captured tuple stays valid (the arrays
+        # live as long as the reference), and a managed segment whose
+        # cache vanished falls back to numpy instead of re-uploading
+        dev = self._device
         if (_device_lookup_enabled()
-                and (_device_lookup_mode() == "always"
-                     # an existing cache (auto-built or pinned) is sunk
-                     # cost — honor it regardless of link speed
-                     or self._device is not None
-                     or (_transfer_fast()
-                         and self.n >= DEVICE_SEGMENT_MIN
-                         and nq >= DEVICE_QUERY_MIN
-                         and (self._numpy_query_volume + nq)
-                         * DEVICE_UPLOAD_AMORTIZE >= self.n))):
+                and (
+                     # an existing cache (auto-built, pinned, or installed
+                     # by a residency manager) is sunk cost — honor it
+                     # regardless of link speed
+                     dev is not None
+                     # auto-upload decisions belong to the segment only
+                     # when no residency manager governs it
+                     or (self.residency is None
+                         and (_device_lookup_mode() == "always"
+                              or (_transfer_fast()
+                                  and self.n >= DEVICE_SEGMENT_MIN
+                                  and nq >= DEVICE_QUERY_MIN
+                                  and (self._numpy_query_volume + nq)
+                                  * DEVICE_UPLOAD_AMORTIZE >= self.n))))):
             try:
-                return self._probe_device(pos, h, ref, alt, ref_len, alt_len)
+                return self._probe_device(pos, h, ref, alt, ref_len,
+                                          alt_len, dev=dev)
             except Exception:
                 # device unusable (no backend / OOM): numpy is always
                 # correct; latch so the hot path doesn't retry per lookup
@@ -598,18 +615,22 @@ class Segment:
             )
         )
 
-    def _probe_device(self, pos, h, ref, alt, ref_len, alt_len):
+    def _probe_device(self, pos, h, ref, alt, ref_len, alt_len, dev=None):
         """Large-batch membership on device (``ops/dedup.lookup_in_sorted``),
-        against an HBM-resident cache of this segment's identity columns.
-        Query arrays are padded to a power of two (sentinel positions can't
+        against an HBM-resident cache of this segment's identity columns
+        (``dev``: the caller-captured tuple — eviction-race-safe; None
+        builds the cache, which managed segments never request).  Query
+        arrays are padded to a power of two (sentinel positions can't
         match) so compile count stays logarithmic in batch size."""
         from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_jit
         from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
 
-        self._ensure_device_cache()
+        if dev is None:
+            self._ensure_device_cache()
+            dev = self._device
         nq = pos.shape[0]
         found, index = lookup_in_sorted_jit(
-            *self._device,
+            *dev,
             pad_pow2(pos, POS_SENTINEL), pad_pow2(h, 0),
             pad_pow2(ref, 0), pad_pow2(alt, 0),
             pad_pow2(ref_len, 0), pad_pow2(alt_len, 0),

@@ -40,6 +40,16 @@ arming any other name is a ``ValueError`` at parse time):
 ``snapshot.swap``           in ``serve.snapshot`` after the new generation
                             loaded but before the atomic swap — a failure
                             must leave the old pinned generation serving
+``serve.accept``            per accepted connection in the asyncio front
+                            end (``serve.aio``), before anything parses —
+                            ``raise`` must cost exactly that connection;
+                            ``kill`` is a worker death mid-accept
+``serve.worker``            in a fleet worker (``cli.serve --_workerIndex``)
+                            right after its server starts accepting — the
+                            supervisor must restart it and the fleet keeps
+                            serving (respawned workers come up with
+                            serve-side AVDB_FAULT stripped: the injection
+                            tests the restart path, not a crash loop)
 ======================== ====================================================
 
 ``fired()`` exposes per-point fire counts for the observability exports.
@@ -66,6 +76,8 @@ POINTS = frozenset({
     "egress.flush",
     "ingest.chunk",
     "serve.batch",
+    "serve.accept",
+    "serve.worker",
     "snapshot.swap",
 })
 

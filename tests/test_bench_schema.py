@@ -57,6 +57,24 @@ GOOD = {
         "clients": 16, "errors": 0, "batch_fill": 0.06, "batches": 250,
         "seconds": 1.2, "store_rows": 50000,
         "region": {"qps": 110.0, "requests": 200, "seconds": 1.8},
+        "open_loop": {
+            "slo_p99_ms": 25.0, "conns": 8, "duration_s": 2.5,
+            "max_sustainable_qps": 11800.0,
+            "fleets": [
+                {"workers": 1, "max_sustainable_qps": 9900.0,
+                 "steps": [
+                     {"offered_qps": 8000.0, "achieved_qps": 7950.0,
+                      "p50_ms": 12.0, "p99_ms": 21.5, "errors": 0,
+                      "requests": 20000, "seconds": 2.5},
+                 ]},
+                {"workers": 2, "max_sustainable_qps": 11800.0,
+                 "steps": [
+                     {"offered_qps": 12000.0, "achieved_qps": 11800.0,
+                      "p50_ms": 14.0, "p99_ms": 24.0, "errors": 0,
+                      "requests": 30000, "seconds": 2.5},
+                 ]},
+            ],
+        },
     },
 }
 
@@ -100,6 +118,30 @@ def test_serving_block_is_validated_strictly():
     bad = copy.deepcopy(GOOD)
     bad["serving"]["region"] = {"requests": 200}  # qps/seconds required
     assert any("region" in e for e in validate_record(bad))
+
+
+def test_open_loop_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["open_loop"]["max_sustainable_qps"]
+    assert any("max_sustainable_qps" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["open_loop"]["fleets"] = []  # at least one fleet size
+    assert any("fleets" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["open_loop"]["fleets"][0]["workers"]
+    assert any("workers" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    step = bad["serving"]["open_loop"]["fleets"][0]["steps"][0]
+    del step["achieved_qps"]
+    assert any("achieved_qps" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    step = bad["serving"]["open_loop"]["fleets"][1]["steps"][0]
+    step["p99_ms"] = 1.0  # below p50: impossible percentiles
+    assert any("p99_ms below p50_ms" in e for e in validate_record(bad))
+    # a serving block WITHOUT open_loop stays valid (r05-era records)
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["open_loop"]
+    assert validate_record(old) == []
 
 
 def test_queue_stalls_block_is_validated_strictly():

@@ -323,3 +323,107 @@ def test_snapshot_swap_raise_keeps_old_generation_serving(tmp_path):
     assert manager.refresh() is True
     snap = manager.current()
     assert snap.generation == 2 and snap.store.n == rows_v1 + 1
+
+
+# ---------------------------------------------------------------------------
+# serve.accept / serve.worker — the fleet's injection points.  An accept
+# fault must cost exactly one connection (raise) while the server keeps
+# serving; a killed worker must be restarted by the supervisor with the
+# fleet serving cleanly after the restart window.
+
+
+def test_serve_accept_raise_fails_only_that_connection():
+    import urllib.error
+    import urllib.request
+
+    from annotatedvdb_tpu.serve import StaticSnapshots
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+
+    server = build_aio_server(
+        manager=StaticSnapshots(_tiny_store()), port=0
+    )
+    server.start_background()
+    try:
+        port = server.server_address[1]
+
+        def get():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/variant/3:10:A:C", timeout=30
+            ) as r:
+                return r.status
+
+        assert get() == 200
+        # arm: the NEXT accepted connection dies before parsing anything
+        # (the client sees a reset/empty response, never a served reply)
+        faults.reset("serve.accept:1:raise")
+        with pytest.raises((urllib.error.URLError, ConnectionResetError)):
+            get()
+        # exactly that connection failed; the server keeps serving
+        faults.reset("")
+        assert get() == 200
+    finally:
+        faults.reset("")
+        server.shutdown()
+        server.ctx.batcher.close()
+
+
+def test_serve_worker_kill_fleet_restarts_and_keeps_serving(tmp_path):
+    """SIGKILLed workers (serve.worker:1:kill fires in each initial worker
+    right after it starts accepting) are restarted by the supervisor —
+    with the serve-side fault stripped from the respawn env — and after
+    the restart window the fleet serves with zero failed responses."""
+    import re
+    import subprocess
+    import time
+    import urllib.request
+
+    store_dir = str(tmp_path / "fleet_store")
+    _tiny_store().save(store_dir)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AVDB_FAULT="serve.worker:1:kill",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", store_dir, "--port", "0", "--workers", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"http://([\d.]+):(\d+)", line)
+        assert m, f"no fleet address line: {line!r}"
+        host, port = m.group(1), int(m.group(2))
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=5
+            ) as r:
+                return r.status
+
+        # both initial workers die at the fire point; the supervisor
+        # respawns them clean — wait out the restart window
+        deadline = time.monotonic() + 120
+        up = False
+        while time.monotonic() < deadline:
+            try:
+                if get("/healthz") == 200:
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.3)
+        assert up, "fleet never recovered from the injected worker kills"
+        # zero failed responses after the restart window
+        failures = 0
+        for _ in range(30):
+            try:
+                if get("/variant/3:10:A:C") != 200:
+                    failures += 1
+            except OSError:
+                failures += 1
+        assert failures == 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    assert rc == 0, proc.stdout.read()[-2000:]

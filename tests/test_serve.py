@@ -316,6 +316,25 @@ def test_overwidth_long_allele_point(served):
     assert rec["ref"] == long_row["ref"]  # true string, not the truncation
 
 
+def test_point_render_cache_byte_bounded(served):
+    """The render LRU is bounded in BYTES as well as entries: records
+    carrying large annotation blobs must not pin entries x record-size
+    of RSS in a long-lived serving process.  The byte ledger stays exact
+    under eviction."""
+    store_dir, truth, _manager, _engine = served
+    eng = QueryEngine(SnapshotManager(store_dir))
+    rows = [r for r in truth if r["chrom"] == 8][:20]
+    one = len(eng.lookup(_vid(rows[0])))
+    eng.POINT_RENDER_CACHE_BYTES = int(one * 2.5)  # room for ~2 records
+    for r in rows:
+        assert eng.lookup(_vid(r)) is not None
+    assert eng._render_cache_bytes <= eng.POINT_RENDER_CACHE_BYTES
+    assert eng._render_cache_bytes == sum(
+        len(v) for v in eng._render_cache.values()
+    )
+    assert len(eng._render_cache) >= 1  # the bound evicts, not disables
+
+
 def test_bulk_parity_thousands(served):
     _dir, truth, _manager, engine = served
     ids = [_vid(r) for r in truth]

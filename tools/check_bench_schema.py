@@ -178,6 +178,54 @@ def _check_serving(sv, where: str, errors: list) -> None:
                 {"qps": _is_num, "requests": _is_int, "seconds": _is_num},
                 f"{w}.region", errors, required=("qps", "seconds"),
             )
+    if "open_loop" in sv:
+        _check_open_loop(sv["open_loop"], w, errors)
+
+
+def _check_open_loop(ol, where: str, errors: list) -> None:
+    """The PR-6 open-loop sweep: per-fleet stepped offered load with a
+    p99 SLO and the max sustainable QPS each fleet size delivered."""
+    w = f"{where}.open_loop"
+    if not isinstance(ol, dict):
+        errors.append(f"{w}: must be an object")
+        return
+    _check_fields(
+        ol,
+        {"slo_p99_ms": _is_num, "conns": _is_int, "duration_s": _is_num,
+         "max_sustainable_qps": _is_num,
+         "fleets": lambda v: isinstance(v, list) and len(v) > 0},
+        w, errors,
+        required=("slo_p99_ms", "max_sustainable_qps", "fleets"),
+    )
+    if not isinstance(ol.get("fleets"), list):
+        return
+    for i, fleet in enumerate(ol["fleets"]):
+        fw = f"{w}.fleets[{i}]"
+        if not isinstance(fleet, dict):
+            errors.append(f"{fw}: must be an object")
+            continue
+        _check_fields(
+            fleet,
+            {"workers": _is_int, "max_sustainable_qps": _is_num,
+             "steps": lambda v: isinstance(v, list)},
+            fw, errors, required=("workers", "max_sustainable_qps"),
+        )
+        for j, step in enumerate(fleet.get("steps") or []):
+            sw = f"{fw}.steps[{j}]"
+            if not isinstance(step, dict):
+                errors.append(f"{sw}: must be an object")
+                continue
+            _check_fields(
+                step,
+                {"offered_qps": _is_num, "achieved_qps": _is_num,
+                 "p50_ms": _is_num, "p99_ms": _is_num, "errors": _is_int,
+                 "requests": _is_int, "seconds": _is_num},
+                sw, errors,
+                required=("offered_qps", "achieved_qps", "p99_ms"),
+            )
+            if _is_num(step.get("p50_ms")) and _is_num(step.get("p99_ms")) \
+                    and step["p99_ms"] < step["p50_ms"]:
+                errors.append(f"{sw}: p99_ms below p50_ms")
 
 
 def validate_record(rec: dict, where: str = "record") -> list[str]:

@@ -1,6 +1,9 @@
 #!/usr/bin/env python
-"""Serve smoke-check: build a tiny store, stand up the HTTP API on an
-ephemeral loopback port, and drive one request of every kind through it.
+"""Serve smoke-check: build a tiny store, stand up BOTH serving front
+ends (the threaded reference server and the asyncio event-loop server)
+on ephemeral loopback ports, and drive one request of every kind through
+each — plus the aio-only surfaces: chunked region streaming, cursor
+paging, and byte-parity between the two front ends.
 
 Part of ``tools/run_checks.sh`` (tier-1 shells that script), so a PR that
 breaks the serving wiring — routes, batcher, snapshot pinning, metrics —
@@ -67,45 +70,83 @@ def _get(port: int, path: str):
         return err.code, err.read().decode()
 
 
+def _drive_routes(port: int, n: int, check) -> str:
+    """The shared route battery; returns the region body for parity."""
+    status, body = _get(port, "/healthz")
+    check("healthz", status == 200
+          and json.loads(body)["rows"] == n, body)
+    status, body = _get(port, "/variant/8:1000:A:G")
+    check("point hit", status == 200
+          and json.loads(body)["position"] == 1000, body)
+    status, body = _get(port, "/variant/8:999:A:G")
+    check("point miss", status == 404, body)
+    status, body = _get(port, "/variant/junk")
+    check("point 400", status == 400, body)
+    status, region_body = _get(port, "/region/8:1-100000?minCadd=1&limit=5")
+    rec = json.loads(region_body) if status == 200 else {}
+    check("region", status == 200
+          and rec.get("returned") == 5
+          and rec.get("count", 0) > 5, region_body[:200])
+    status, body = _get(port, "/metrics")
+    check("metrics", status == 200
+          and "avdb_query_requests_total" in body, body[:200])
+    return region_body
+
+
 def main() -> int:
+    from annotatedvdb_tpu.serve.aio import build_aio_server
     from annotatedvdb_tpu.serve.http import build_server
 
     work = tempfile.mkdtemp(prefix="avdb_serve_smoke_")
     store_dir = os.path.join(work, "store")
-    n = _build_store(store_dir)
-    httpd = build_server(store_dir=store_dir, port=0)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
+    httpd = aio = None
     failures: list[str] = []
 
     def check(label: str, ok: bool, detail: str = "") -> None:
         if not ok:
             failures.append(f"{label}: {detail}"[:300])
 
+    # everything that can fail to start lives inside the try: an aio
+    # startup timeout must still shut the threaded server down, remove
+    # the temp store, and report through the FAIL path — not a traceback
     try:
+        n = _build_store(store_dir)
+        httpd = build_server(store_dir=store_dir, port=0)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        aio = build_aio_server(
+            store_dir=store_dir, port=0, stream_threshold=4
+        )
+        aio.start_background()
         port = httpd.server_address[1]
-        status, body = _get(port, "/healthz")
-        check("healthz", status == 200
-              and json.loads(body)["rows"] == n, body)
-        status, body = _get(port, "/variant/8:1000:A:G")
-        check("point hit", status == 200
-              and json.loads(body)["position"] == 1000, body)
-        status, body = _get(port, "/variant/8:999:A:G")
-        check("point miss", status == 404, body)
-        status, body = _get(port, "/variant/junk")
-        check("point 400", status == 400, body)
-        status, body = _get(port, "/region/8:1-100000?minCadd=1&limit=5")
+        threaded_region = _drive_routes(port, n, check)
+
+        aport = aio.server_address[1]
+        aio_region = _drive_routes(
+            aport, n, lambda label, ok, detail="":
+            check(f"aio {label}", ok, detail)
+        )
+        check("aio parity", aio_region == threaded_region,
+              "region bodies differ between front ends")
+        # aio-only surfaces: chunked streaming (threshold 4 forces it)
+        # and cursor paging
+        status, body = _get(aport, "/region/8:1-100000?limit=20")
         rec = json.loads(body) if status == 200 else {}
-        check("region", status == 200
-              and rec.get("returned") == 5
-              and rec.get("count", 0) > 5, body[:200])
-        status, body = _get(port, "/metrics")
-        check("metrics", status == 200
-              and "avdb_query_requests_total" in body, body[:200])
+        check("aio stream", status == 200 and rec.get("returned") == 20,
+              body[:200])
+        status, body = _get(aport, "/region/8:1-100000?limit=5&cursor=")
+        rec = json.loads(body) if status == 200 else {}
+        check("aio page", status == 200 and rec.get("next"), body[:200])
+    except Exception as exc:
+        check("startup", False, repr(exc))
     finally:
-        httpd.shutdown()
-        httpd.server_close()
-        httpd.ctx.batcher.close()
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+            httpd.ctx.batcher.close()
+        if aio is not None:
+            aio.shutdown()
+            aio.ctx.batcher.close()
         import shutil
 
         shutil.rmtree(work, ignore_errors=True)
@@ -113,8 +154,8 @@ def main() -> int:
         for f in failures:
             print(f"serve_smoke FAIL {f}", file=sys.stderr)
         return 1
-    print(f"serve_smoke: ok ({n} rows; point/region/metrics answered)",
-          file=sys.stderr)
+    print(f"serve_smoke: ok ({n} rows; threaded + aio front ends, "
+          "streaming and paging answered)", file=sys.stderr)
     return 0
 
 
