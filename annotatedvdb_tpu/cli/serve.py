@@ -93,6 +93,8 @@ def _build_parser() -> argparse.ArgumentParser:
                         help=argparse.SUPPRESS)  # fleet-internal
     parser.add_argument("--_listenFd", type=int, default=None,
                         help=argparse.SUPPRESS)  # fleet-internal
+    parser.add_argument("--_heartbeatFile", default=None,
+                        help=argparse.SUPPRESS)  # fleet-internal (watchdog)
     parser.add_argument("--_forceHandoff", action="store_true",
                         help=argparse.SUPPRESS)  # tests: no-SO_REUSEPORT path
     return parser
@@ -251,6 +253,8 @@ def _run_single(args, log) -> int:
             registry=registry, residency=residency,
             client_rate=args.clientRate,
             stream_threshold=args.streamThreshold,
+            heartbeat_file=args._heartbeatFile,
+            heartbeat_index=args._workerIndex or 0,
             tracer=tracer, log=log,
         )
     except (OSError, ValueError) as err:

@@ -67,8 +67,11 @@ ENV_VARS: dict = {
                    "(full checksums) | off",
     "AVDB_DEVICE_LOOKUP": "1 keeps membership-probe segments resident in "
                           "HBM (device lookup cache)",
-    "AVDB_FAULT": "<point>:<nth>[:<action>] deterministic fault injection "
-                  "(see utils/faults.py; unknown points fail the arm)",
+    "AVDB_FAULT": "<point>:<nth|prob:<p>>[:<action>[:<ms>]] deterministic "
+                  "fault injection (see utils/faults.py; unknown points "
+                  "fail the arm)",
+    "AVDB_FAULT_SEED": "integer seed for the prob:<p> fault-arming coin "
+                       "(default 0xA5DB) — chaos runs replay exactly",
     # query & serving (serve/)
     "AVDB_SERVE_BATCH_MAX": "max point queries coalesced into one device "
                             "microbatch (default 256)",
@@ -95,6 +98,20 @@ ENV_VARS: dict = {
     "AVDB_SERVE_STREAM_THRESHOLD": "region row count above which responses "
                                    "stream chunked instead of buffering "
                                    "the body (default 2048)",
+    "AVDB_SERVE_DEFAULT_DEADLINE_MS": "default per-request deadline budget "
+                                      "in ms (X-Deadline-Ms overrides; "
+                                      "0 = requests carry no deadline)",
+    "AVDB_SERVE_BROWNOUT_P99_MS": "brownout ladder latency target: when "
+                                  ">~5% of recent requests exceed it the "
+                                  "ladder escalates (default 250; 0 "
+                                  "disables the latency trigger)",
+    "AVDB_SERVE_WEDGE_TIMEOUT_S": "fleet watchdog: SIGKILL+respawn a live "
+                                  "worker whose event-loop heartbeat is "
+                                  "staler than this (default 10; 0 "
+                                  "disables)",
+    "AVDB_SERVE_CHAOS": "1 enables the POST /_chaos runtime fault-arming "
+                        "route on the aio front end (chaos harness only; "
+                        "never set in production)",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "

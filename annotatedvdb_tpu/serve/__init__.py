@@ -33,6 +33,12 @@ from annotatedvdb_tpu.serve.engine import (
     render_variant,
 )
 from annotatedvdb_tpu.serve.residency import ResidencyManager
+from annotatedvdb_tpu.serve.resilience import (
+    DeadlineExceeded,
+    DeviceBreaker,
+    OverloadGovernor,
+    PointCache,
+)
 from annotatedvdb_tpu.serve.snapshot import (
     SnapshotManager,
     StaticSnapshots,
@@ -40,6 +46,7 @@ from annotatedvdb_tpu.serve.snapshot import (
 )
 
 __all__ = [
+    "DeadlineExceeded", "DeviceBreaker", "OverloadGovernor", "PointCache",
     "QueryBatcher", "QueueFull", "QueryEngine", "QueryError", "RegionPage",
     "ResidencyManager", "SnapshotManager", "StaticSnapshots",
     "StoreSnapshot", "parse_region", "parse_variant_id", "render_variant",

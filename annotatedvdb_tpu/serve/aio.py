@@ -54,7 +54,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import mmap
 import os
+import struct
 import threading
 import time
 from collections import OrderedDict
@@ -73,8 +75,10 @@ from annotatedvdb_tpu.serve.http import (
     ServeContext,
     healthz_payload,
     parse_region_params,
+    readyz_payload,
     stats_payload,
 )
+from annotatedvdb_tpu.serve.resilience import DeadlineExceeded, DeviceBreaker
 from annotatedvdb_tpu.serve.snapshot import SnapshotManager
 from annotatedvdb_tpu.utils import faults
 
@@ -98,6 +102,8 @@ _STATUS = {
     431: b"HTTP/1.1 431 Request Header Fields Too Large\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
     501: b"HTTP/1.1 501 Not Implemented\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
 }
 
 _CT_JSON = b"Content-Type: application/json\r\nContent-Length: "
@@ -134,7 +140,7 @@ def _resp(status: int, body: str, retry_after: int | None = None,
     head = _STATUS[status] + content_type + str(len(payload)).encode()
     if retry_after is not None:
         head += b"\r\nRetry-After: " + str(retry_after).encode()
-    elif status == 429:
+    elif status in (429, 503):
         head += b"\r\nRetry-After: 1"
     return head + b"\r\n\r\n" + payload
 
@@ -194,18 +200,28 @@ class LoopBatcher:
             self._m_depth = registry.gauge(
                 "avdb_serve_queue_depth", "pending queries awaiting a drain"
             )
+            self._m_deadline_shed = registry.counter(
+                "avdb_deadline_shed_total",
+                "requests shed because their deadline budget ran out",
+                {"stage": "batcher"},
+            )
         else:
             self._m_batches = self._m_fill = self._m_depth = None
+            self._m_deadline_shed = None
 
     # -- caller side (event loop only) --------------------------------------
 
     def depth(self) -> int:
         return len(self._pending)
 
-    def submit_future(self, variant_id: str) -> asyncio.Future:
+    def submit_future(self, variant_id: str,
+                      deadline_t: float | None = None) -> asyncio.Future:
         """Enqueue one point query; returns the future of its JSON text
         (or None).  Admission/grammar contract of ``QueryBatcher``:
-        ``QueueFull`` / ``QueryError`` raise synchronously."""
+        ``QueueFull`` / ``QueryError`` raise synchronously.  A pending
+        whose ``deadline_t`` (absolute monotonic) lapses before its drain
+        fails with ``DeadlineExceeded`` instead of occupying device
+        work."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         parsed = parse_variant_id(variant_id)
@@ -216,7 +232,7 @@ class LoopBatcher:
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        self._pending.append((fut, variant_id, parsed))
+        self._pending.append((fut, variant_id, parsed, deadline_t))
         depth = len(self._pending)
         if depth > self._max_depth:
             self._max_depth = depth
@@ -249,6 +265,27 @@ class LoopBatcher:
         if self._pending:  # backlog: keep draining without a fresh wait
             self._drain_soon = True
             self._loop.call_soon(self._drain)
+        # shed already-dead pendings BEFORE device work: their clients
+        # stopped waiting, so probing for them only delays live requests
+        now = time.monotonic()
+        live = []
+        shed = 0
+        for item in batch:
+            fut, qid, _p, deadline_t = item
+            if deadline_t is not None and now >= deadline_t:
+                if not fut.done():
+                    fut.set_exception(DeadlineExceeded(
+                        f"query {qid!r} exceeded its deadline in the "
+                        "serve queue"
+                    ))
+                shed += 1
+            else:
+                live.append(item)
+        if shed and self._m_deadline_shed is not None:
+            self._m_deadline_shed.inc(shed)
+        batch = live
+        if not batch:
+            return
         try:
             # crash point: the microbatch is assembled, nothing executed —
             # a failure here must fail exactly this batch's callers and
@@ -260,15 +297,15 @@ class LoopBatcher:
             )
             with span:
                 results = self.engine.lookup_many(
-                    [q for _f, q, _p in batch],
-                    parsed=[p for _f, _q, p in batch],
+                    [q for _f, q, _p, _d in batch],
+                    parsed=[p for _f, _q, p, _d in batch],
                 )
         except Exception as exc:
-            for fut, _q, _p in batch:
+            for fut, _q, _p, _d in batch:
                 if not fut.done():
                     fut.set_exception(exc)
             return
-        for (fut, _q, _p), result in zip(batch, results):
+        for (fut, _q, _p, _d), result in zip(batch, results):
             if not fut.done():
                 fut.set_result(result)
         self._batches += 1
@@ -294,7 +331,7 @@ class LoopBatcher:
         loop has stopped (the futures' waiters are gone with it)."""
         self._closed = True
         pending, self._pending = self._pending, []
-        for fut, _q, _p in pending:
+        for fut, _q, _p, _d in pending:
             try:
                 if not fut.done():
                     fut.cancel()
@@ -483,15 +520,35 @@ class AioServer:
     Shutdown order mirrors the threaded server: stop the server, then
     ``ctx.batcher.close()`` (the caller owns the batcher)."""
 
+    #: loop maintenance-tick cadence: heartbeat write + brownout-ladder
+    #: evaluation + the serve.wedge fault point, all on the LOOP — a
+    #: parked loop stops ticking, which is exactly what the fleet
+    #: watchdog detects
+    TICK_S = 0.25
+
     def __init__(self, ctx: ServeContext, host: str = "127.0.0.1",
                  port: int = 0, sock=None,
                  client_rate: float | None = None,
                  stream_threshold: int | None = None,
-                 drain_s: float = 5.0):
+                 drain_s: float = 5.0,
+                 heartbeat_file: str | None = None,
+                 heartbeat_index: int = 0):
         self.ctx = ctx
         self.host = host
         self.port = port
         self.sock = sock  # pre-bound listening socket (fleet workers)
+        #: fleet watchdog handshake: this worker's slot in the shared
+        #: mmap'd heartbeat file (None outside a fleet)
+        self.heartbeat_file = heartbeat_file
+        self.heartbeat_index = int(heartbeat_index)
+        self._hb_mm = None
+        #: runtime fault arming (POST /_chaos) for the chaos harness —
+        #: gated hard on the environment so the route does not exist on
+        #: a production server (404, byte-identical to any unknown route)
+        self._chaos_enabled = os.environ.get("AVDB_SERVE_CHAOS", "") == "1"
+        #: arming generation: each /_chaos arm bumps it so a stale ttl
+        #: timer can never disarm a NEWER arming's fault
+        self._chaos_seq = 0
         if client_rate is None:
             client_rate = _client_rate_from_env()
         self.governor = (
@@ -596,9 +653,14 @@ class AioServer:
             return
         self.server_address = server.sockets[0].getsockname()[:2]
         self._started.set()
+        self._start_tick()
         try:
             await self._stop.wait()
         finally:
+            if self._hb_mm is not None:
+                with contextlib.suppress(OSError, ValueError):
+                    self._hb_mm.close()
+                self._hb_mm = None
             server.close()
             await server.wait_closed()
             # graceful drain: in-flight connections finish their current
@@ -610,6 +672,53 @@ class AioServer:
                 )
                 for t in still:
                     t.cancel()
+
+    # -- loop maintenance tick ----------------------------------------------
+
+    def _start_tick(self) -> None:
+        if self.heartbeat_file is not None:
+            try:
+                with open(self.heartbeat_file, "r+b") as f:
+                    self._hb_mm = mmap.mmap(f.fileno(), 0)
+            except (OSError, ValueError) as err:
+                self.ctx.log(f"heartbeat file unusable ({err}); "
+                             "watchdog will not see this worker")
+                self._hb_mm = None
+        self._loop.call_soon(self._tick)
+
+    def _tick(self) -> None:
+        """One maintenance pass ON the event loop: the wedge fault point
+        first (a long ``delay`` here parks the loop — requests stall AND
+        heartbeats stop, the alive-but-stuck worker), then one heartbeat
+        slot write and a brownout-ladder evaluation.  Everything that
+        proves this loop is making progress runs here, so a wedged loop
+        cannot keep looking healthy from a helper thread."""
+        if self._stop is not None and self._stop.is_set():
+            return
+        try:
+            try:
+                # crash point: fires per maintenance tick; delay = a
+                # wedged loop the fleet watchdog must SIGKILL, kill = a
+                # worker death
+                faults.fire("serve.wedge")
+            except Exception as err:
+                self.ctx.log(f"wedge fault injected: {err}")
+            if self._hb_mm is not None:
+                # struct.error on a mis-sized/mis-indexed slot file
+                # included: losing one beat is survivable, losing the
+                # TICK CHAIN gets a healthy worker watchdog-killed in a
+                # loop
+                with contextlib.suppress(OSError, ValueError, struct.error):
+                    struct.pack_into(
+                        "<d", self._hb_mm, self.heartbeat_index * 8,
+                        time.time(),
+                    )
+            with contextlib.suppress(Exception):
+                self.ctx.governor.maybe_step()
+        finally:
+            # the next tick is unconditional: whatever one pass hit, the
+            # heartbeat/brownout machinery must keep running
+            self._loop.call_later(self.TICK_S, self._tick)
 
     # -- connection handling ------------------------------------------------
 
@@ -751,8 +860,8 @@ class AioServer:
             return
         kind = item[0]
         if kind == "point":
-            _k, fut, t0, vid = item
-            out += await self._finish_point(fut, t0, vid)
+            _k, fut, t0, vid, generation = item
+            out += await self._finish_point(fut, t0, vid, generation)
             return
         # ("exec", future, kind, t0): buffered bytes or a stream marker
         _k, fut, qkind, t0 = item
@@ -779,7 +888,11 @@ class AioServer:
             self.ctx.release()
 
     async def _settle(self, item) -> None:
-        """Account for an item that will never reach the wire."""
+        """Account for an item that will never reach the wire (the client
+        connection died first): release whatever it holds, and make the
+        abandonment visible — a chaos run's killed connections should
+        show up in a counter, not vanish."""
+        self.ctx.abandoned()
         if isinstance(item, bytes):
             return
         fut = item[1]
@@ -803,16 +916,22 @@ class AioServer:
                     self.ctx.release()
         fut.add_done_callback(settle)
 
-    async def _finish_point(self, fut, t0, vid: str) -> bytes:
+    async def _finish_point(self, fut, t0, vid: str,
+                            generation: int) -> bytes:
         ctx = self.ctx
         try:
             # no wait_for wrapper (it costs a Task + timer per request):
             # every submitted pending is GUARANTEED to finish — the drain
-            # thread completes it, fails it, or close() fails the queue
+            # thread completes it, fails it, sheds it past its deadline,
+            # or close() fails the queue
             record = await fut
+        except DeadlineExceeded as err:
+            # the batcher shed it (and counted stage="batcher")
+            return _error(504, str(err))
         except Exception as err:
             ctx.errored("point")
             return _error(500, f"{type(err).__name__}: {err}")
+        ctx.remember_point(generation, vid, record)
         if record is None:
             ctx.observe("point", time.perf_counter() - t0)
             return _error(404, f"variant {vid!r} not in store")
@@ -855,16 +974,21 @@ class AioServer:
         if self.governor is None and head.startswith(b"GET /variant/"):
             eol = head.find(b"\r\n")
             line = head[:eol]
+            hlow = head.lower()
             # any Connection header (rare on this hot path; the token is
             # case-insensitive per RFC 9112) routes to the full parser —
-            # a substring guess here would misread "Connection: Close"
+            # a substring guess here would misread "Connection: Close";
+            # a client-sent deadline header likewise needs the real parse
             if line.endswith(b" HTTP/1.1") and b"?" not in line \
-                    and b"connection:" not in head.lower():
+                    and b"connection:" not in hlow \
+                    and b"x-deadline-ms:" not in hlow:
                 vid = line[13:-9].decode("latin-1")
                 if "%" in vid:
                     vid = unquote(vid)
                 self._maybe_refresh_snapshot()
-                return self._point_item(vid), True
+                return self._point_item(
+                    vid, self._default_deadline()
+                ), True
         try:
             method, target, keep, http11, headers = self._parse_head(head)
         except ValueError as err:
@@ -872,6 +996,7 @@ class AioServer:
         url = urlparse(target)
         path = unquote(url.path)
         self._maybe_refresh_snapshot()
+        deadline_t = ctx.request_deadline(headers.get("x-deadline-ms"))
         if method == "GET":
             if path.startswith("/variant/"):
                 retry = self._admit_client(headers, writer)
@@ -881,8 +1006,14 @@ class AioServer:
                         429, "client over rate (point admission)",
                         retry_after=max(int(retry + 0.999), 1),
                     ), keep
-                return self._point_item(path[len("/variant/"):]), keep
+                return self._point_item(
+                    path[len("/variant/"):], deadline_t
+                ), keep
             if path.startswith("/region/"):
+                if ctx.governor.shed_bulk():
+                    ctx.brownout_shed()
+                    return _error(503, "brownout: region reads shed "
+                                       "(point reads keep serving)"), keep
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("region")
@@ -891,9 +1022,12 @@ class AioServer:
                         retry_after=max(int(retry + 0.999), 1),
                     ), keep
                 return self._region_item(path[len("/region/"):],
-                                         url.query, http11), keep
+                                         url.query, http11, deadline_t), keep
             if path == "/healthz":
                 return _resp(200, healthz_payload(ctx)), keep
+            if path == "/readyz":
+                status, body = readyz_payload(ctx)
+                return _resp(status, body), keep
             if path == "/metrics":
                 return _resp(200, ctx.registry.render_prometheus(),
                              content_type=_CT_TEXT), keep
@@ -924,6 +1058,10 @@ class AioServer:
             except asyncio.IncompleteReadError:
                 return None, False
             if path == "/variants":
+                if ctx.governor.shed_bulk():
+                    ctx.brownout_shed()
+                    return _error(503, "brownout: bulk reads shed "
+                                       "(point reads keep serving)"), keep
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("bulk")
@@ -935,17 +1073,36 @@ class AioServer:
                 if self.governor is not None:
                     client, weight = self._client_key(headers, writer)
                     max_ids = self.governor.bulk_budget(weight)
-                return self._bulk_item(body, client, max_ids), keep
+                return self._bulk_item(body, client, max_ids, deadline_t), keep
+            if path == "/_chaos" and self._chaos_enabled:
+                return self._chaos_item(body), keep
             return _error(404, f"no such route: {path}"), keep
         return _error(501, f"method {method} not supported"), False
 
-    def _point_item(self, variant_id: str):
+    def _default_deadline(self) -> float | None:
+        """Absolute deadline from the configured default budget alone
+        (the fast path's case: no headers were parsed, and the fast path
+        already guaranteed no X-Deadline-Ms header is present)."""
+        d = self.ctx.default_deadline_s
+        return time.monotonic() + d if d > 0 else None
+
+    def _point_item(self, variant_id: str, deadline_t: float | None = None):
         ctx = self.ctx
         t0 = time.perf_counter()
+        action, payload = ctx.point_preflight(variant_id, deadline_t)
+        if action == "shed":
+            return _error(504, "deadline exhausted at admission")
+        if action == "cached":
+            if payload is None:
+                ctx.observe("point", time.perf_counter() - t0)
+                return _error(404, f"variant {variant_id!r} not in store")
+            ctx.observe("point", time.perf_counter() - t0, rows=1)
+            return _resp(200, payload)
+        generation = payload
         try:
             if self._loop_batcher:
                 # loop-native coalescing: no cross-thread handoffs
-                fut = ctx.batcher.submit_future(variant_id)
+                fut = ctx.batcher.submit_future(variant_id, deadline_t)
             else:
                 # thread-based batcher: completions cross back through
                 # the (drain-batched) bridge
@@ -956,7 +1113,8 @@ class AioServer:
                     bridge.complete(fut, pending)
 
                 ctx.batcher.submit_nowait(
-                    variant_id, on_done, want_event=False
+                    variant_id, on_done, want_event=False,
+                    deadline_t=deadline_t,
                 )
         except QueueFull as err:
             ctx.rejected("point")
@@ -967,28 +1125,73 @@ class AioServer:
         except Exception as err:
             ctx.errored("point")
             return _error(500, f"{type(err).__name__}: {err}")
-        return ("point", fut, t0, variant_id)
+        return ("point", fut, t0, variant_id, generation)
+
+    def _chaos_item(self, body: bytes) -> bytes:
+        """Runtime fault arming (``AVDB_SERVE_CHAOS=1`` only): the chaos
+        harness's worker-side lever — environment arming cannot reach a
+        running fleet, and respawned workers naturally come up clean
+        because this is in-process state.  ``ttl_s`` schedules an
+        automatic disarm so a probabilistic fault cannot outlive its
+        scheduled chaos window when the disarm request would land on a
+        different worker."""
+        try:
+            obj = json.loads(body or b"{}")
+            if not isinstance(obj, dict):
+                raise TypeError("chaos body must be a JSON object")
+            spec = obj.get("spec", "") or ""
+            ttl = obj.get("ttl_s")
+            # validate EVERYTHING before arming: a bad ttl must not leave
+            # the fault armed with the auto-disarm it promised missing
+            ttl_s = max(float(ttl), 0.0) if ttl is not None else None
+            faults.reset(spec)
+        except (ValueError, TypeError) as err:
+            return _error(400, f"bad chaos spec: {err}")
+        self._chaos_seq += 1
+        if ttl_s is not None and spec:
+            seq = self._chaos_seq
+
+            def expire():
+                # only disarm the arming this timer belongs to: a newer
+                # arm owns the (single) fault slot and its own ttl
+                if self._chaos_seq == seq:
+                    faults.reset("")
+
+            self._loop.call_later(ttl_s, expire)
+        return _resp(200, json.dumps(
+            {"armed": spec or None, "pid": os.getpid()}
+        ))
 
     def _bulk_item(self, body: bytes, client: str | None = None,
-                   max_ids: int | None = None):
+                   max_ids: int | None = None,
+                   deadline_t: float | None = None):
         ctx = self.ctx
         t0 = time.perf_counter()
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            return _error(504, "deadline exhausted at admission")
         if not ctx.admit():
             ctx.rejected("bulk")
             return _error(429, "server at capacity (bulk admission bound)",
                           retry_after=1)
         fut = self._loop.run_in_executor(
-            self._pool, self._bulk_work, body, t0, client, max_ids
+            self._pool, self._bulk_work, body, t0, client, max_ids,
+            deadline_t
         )
         return ("exec", fut, "bulk", t0)
 
     def _bulk_work(self, body: bytes, t0: float,
                    client: str | None = None,
-                   max_ids: int | None = None) -> bytes:
+                   max_ids: int | None = None,
+                   deadline_t: float | None = None) -> bytes:
         """Executor half of a bulk request (parse, probe, render, account);
         never raises — errors become response bytes."""
         ctx = self.ctx
         try:
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # executor-queue lag ate the budget: shed BEFORE the probe
+                ctx.deadline_shed("execute")
+                return _error(504, "deadline exhausted before execution")
             try:
                 parsed = json.loads(body or b"{}")
                 ids = parsed["ids"]
@@ -1035,20 +1238,26 @@ class AioServer:
         finally:
             ctx.release()
 
-    def _region_item(self, spec: str, query: str, http11: bool = True):
+    def _region_item(self, spec: str, query: str, http11: bool = True,
+                     deadline_t: float | None = None):
         ctx = self.ctx
         t0 = time.perf_counter()
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            return _error(504, "deadline exhausted at admission")
         if not ctx.admit():
             ctx.rejected("region")
             return _error(429, "server at capacity (region admission bound)",
                           retry_after=1)
         fut = self._loop.run_in_executor(
-            self._pool, self._region_work, spec, query, t0, http11
+            self._pool, self._region_work, spec, query, t0, http11,
+            deadline_t
         )
         return ("exec", fut, "region", t0)
 
     def _region_work(self, spec: str, query: str, t0: float,
-                     http11: bool = True):
+                     http11: bool = True,
+                     deadline_t: float | None = None):
         """Executor half of a region request.  Returns response bytes, or
         ``("stream", page)`` — the writer task then streams it chunked and
         releases the admission slot when the body is done.  A non-1.1
@@ -1058,9 +1267,16 @@ class AioServer:
         ctx = self.ctx
         stream_holds_slot = False
         try:
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                ctx.deadline_shed("execute")
+                return _error(504, "deadline exhausted before execution")
             try:
                 min_cadd, max_rank, limit, cursor = \
                     parse_region_params(query)
+                cap = ctx.governor.region_limit_cap()
+                if cap is not None:
+                    # brownout level >= 1: bound per-request render work
+                    limit = min(limit, cap)
                 kind, payload = ctx.engine.region_serve(
                     spec,
                     min_cadd=min_cadd,
@@ -1132,7 +1348,14 @@ class AioServer:
         """Chunked transfer of one RegionPage: prefix, rows in
         ``_STREAM_ROWS_PER_CHUNK`` batches (rendered lazily — RSS holds
         one batch, not the body), suffix.  De-chunked, the bytes are
-        exactly ``page.assemble()``."""
+        exactly ``page.assemble()``.
+
+        A SIGTERM drain (or the drain-budget cancellation) arriving
+        mid-stream must not tear the chunked framing: the stream CLEANLY
+        TRUNCATES — close the variants array at a row boundary, append a
+        ``"truncated": true`` trailer field, and emit the terminating
+        0-chunk — so the client holds valid JSON that SAYS it is partial
+        instead of a connection reset it must guess about."""
         writer.write(
             _STATUS[200]
             + b"Content-Type: application/json\r\n"
@@ -1141,17 +1364,34 @@ class AioServer:
         _write_chunk(writer, page.prefix().encode())
         buf: list[str] = []
         first = True
-        for row in page.rows():
-            buf.append(("" if first else ",") + row)
-            first = False
-            if len(buf) >= _STREAM_ROWS_PER_CHUNK:
-                _write_chunk(writer, "".join(buf).encode())
-                buf.clear()
-                await writer.drain()  # flow control + loop fairness
+        truncated = cancelled = False
+        try:
+            for row in page.rows():
+                if self._stop is not None and self._stop.is_set():
+                    # graceful drain: finish THIS response as truncated
+                    # within the budget instead of racing the cancel
+                    truncated = True
+                    break
+                buf.append(("" if first else ",") + row)
+                first = False
+                if len(buf) >= _STREAM_ROWS_PER_CHUNK:
+                    _write_chunk(writer, "".join(buf).encode())
+                    buf.clear()
+                    await writer.drain()  # flow control + loop fairness
+        except asyncio.CancelledError:
+            # the drain budget expired with this stream still writing:
+            # terminate the framing before the cancellation propagates
+            # (the writes below are synchronous buffer appends)
+            truncated = cancelled = True
         if buf:
             _write_chunk(writer, "".join(buf).encode())
-        _write_chunk(writer, page.suffix().encode())
+        if truncated:
+            _write_chunk(writer, b'],"truncated":true}')
+        else:
+            _write_chunk(writer, page.suffix().encode())
         writer.write(b"0\r\n\r\n")
+        if cancelled:
+            raise asyncio.CancelledError
         await writer.drain()
 
 
@@ -1179,6 +1419,8 @@ def build_aio_server(store_dir: str | None = None, manager=None,
                      registry: MetricsRegistry | None = None,
                      residency=None, client_rate: float | None = None,
                      stream_threshold: int | None = None,
+                     heartbeat_file: str | None = None,
+                     heartbeat_index: int = 0,
                      tracer=None, log=None) -> AioServer:
     """Wire manager -> engine -> batcher -> event-loop server (not yet
     serving; call ``serve_forever`` or ``start_background``).  The caller
@@ -1192,6 +1434,7 @@ def build_aio_server(store_dir: str | None = None, manager=None,
     engine = QueryEngine(
         manager, registry=registry, region_cache_size=region_cache_size,
         residency=residency,
+        breaker=DeviceBreaker(registry=registry, log=log),
     )
     batcher = LoopBatcher(
         engine, max_batch=max_batch, max_wait_s=max_wait_s,
@@ -1201,4 +1444,5 @@ def build_aio_server(store_dir: str | None = None, manager=None,
     return AioServer(
         ctx, host=host, port=port, sock=sock, client_rate=client_rate,
         stream_threshold=stream_threshold,
+        heartbeat_file=heartbeat_file, heartbeat_index=heartbeat_index,
     )

@@ -39,6 +39,7 @@ import threading
 import time
 
 from annotatedvdb_tpu.serve.engine import parse_variant_id
+from annotatedvdb_tpu.serve.resilience import DeadlineExceeded
 from annotatedvdb_tpu.utils import faults
 from annotatedvdb_tpu.utils.pipeline import StageStats
 
@@ -73,12 +74,15 @@ class _Pending:
     ``error`` then sets ``done`` (the Event publishes the write).  An
     optional ``callback`` is invoked (on the drain thread) after ``done``
     is set — the asyncio front end's completion hook, so an event loop
-    never parks a thread on the Event."""
+    never parks a thread on the Event.  ``deadline_t`` (absolute
+    ``time.monotonic`` seconds, or None) is the request's remaining-budget
+    bound: the drain sheds already-dead pendings before device work."""
 
-    __slots__ = ("qid", "parsed", "result", "error", "done", "callback")
+    __slots__ = ("qid", "parsed", "result", "error", "done", "callback",
+                 "deadline_t")
 
     def __init__(self, qid: str, parsed=None, callback=None,
-                 want_event: bool = True):
+                 want_event: bool = True, deadline_t: float | None = None):
         self.qid = qid
         self.parsed = parsed  # submit-time parse, reused by the drain
         self.result = None
@@ -87,6 +91,7 @@ class _Pending:
         # Event — skip allocating one on that hot path
         self.done = threading.Event() if want_event else None
         self.callback = callback
+        self.deadline_t = deadline_t
 
     def finish(self) -> None:
         """Publish the filled result/error to the waiter."""
@@ -132,8 +137,14 @@ class QueryBatcher:
             self._m_depth = registry.gauge(
                 "avdb_serve_queue_depth", "pending queries awaiting a drain"
             )
+            self._m_deadline_shed = registry.counter(
+                "avdb_deadline_shed_total",
+                "requests shed because their deadline budget ran out",
+                {"stage": "batcher"},
+            )
         else:
             self._m_batches = self._m_fill = self._m_depth = None
+            self._m_deadline_shed = None
         self._thread = threading.Thread(
             target=self._run, name="avdb-serve-batcher", daemon=True
         )
@@ -145,13 +156,27 @@ class QueryBatcher:
         """Pending (undrained) queries — the admission gauge."""
         return self._q.qsize()
 
-    def submit(self, variant_id: str):
+    def submit(self, variant_id: str, deadline_t: float | None = None):
         """Enqueue one point query and block for its result (JSON text or
         None).  Raises :class:`QueueFull` at the admission bound,
         :class:`~annotatedvdb_tpu.serve.engine.QueryError` on bad grammar
-        (validated HERE, before the queue), or the drain's root cause."""
-        pending = self.submit_nowait(variant_id)
-        if not pending.done.wait(self.timeout_s):
+        (validated HERE, before the queue),
+        :class:`~annotatedvdb_tpu.serve.resilience.DeadlineExceeded` once
+        the request's budget lapses (the drain sheds the queued pending —
+        its admission slot releases — and this caller stops waiting), or
+        the drain's root cause."""
+        pending = self.submit_nowait(variant_id, deadline_t=deadline_t)
+        wait_s = self.timeout_s
+        if deadline_t is not None:
+            wait_s = min(wait_s, max(deadline_t - time.monotonic(), 0.0))
+        if not pending.done.wait(wait_s):
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # the queued pending is now dead weight: the next drain
+                # sheds it (counted there), nobody waits on its Event
+                raise DeadlineExceeded(
+                    f"query {variant_id!r} exceeded its deadline in the "
+                    "serve queue"
+                )
             raise TimeoutError(
                 f"query {variant_id!r} timed out after {self.timeout_s}s "
                 "in the serve batcher"
@@ -161,7 +186,8 @@ class QueryBatcher:
         return pending.result
 
     def submit_nowait(self, variant_id: str, callback=None,
-                      want_event: bool = True) -> _Pending:
+                      want_event: bool = True,
+                      deadline_t: float | None = None) -> _Pending:
         """Enqueue one point query WITHOUT blocking for the result: the
         admission/grammar contract of :meth:`submit` applies synchronously
         (``QueueFull`` / ``QueryError`` raise here, in the caller), then
@@ -181,7 +207,8 @@ class QueryBatcher:
             raise QueueFull(
                 f"serve queue full ({self.max_queue} pending queries)"
             )
-        pending = _Pending(variant_id, parsed, callback, want_event)
+        pending = _Pending(variant_id, parsed, callback, want_event,
+                           deadline_t)
         self._q.put(pending)
         return pending
 
@@ -239,6 +266,9 @@ class QueryBatcher:
     def _drain(self, batch: list) -> None:
         stats = self.stats
         stats.items += len(batch)
+        batch = self._shed_expired(batch)
+        if not batch:
+            return
         try:
             # crash point: the microbatch is assembled, nothing executed —
             # a failure here must fail exactly this batch's callers and
@@ -268,6 +298,29 @@ class QueryBatcher:
             self._m_batches.inc()
             self._m_fill.observe(len(batch) / self.max_batch)
             self._m_depth.set(self._q.qsize())
+
+    def _shed_expired(self, batch: list) -> list:
+        """Drop already-dead pendings BEFORE device work: their callers
+        stopped waiting, so executing them only delays live requests.
+        Each shed pending fails with :class:`DeadlineExceeded` (a caller
+        still blocked in ``submit`` — clock skew between its wait and
+        this check — gets the honest 504 cause)."""
+        now = time.monotonic()
+        live = []
+        shed = 0
+        for pending in batch:
+            if pending.deadline_t is not None and now >= pending.deadline_t:
+                pending.error = DeadlineExceeded(
+                    f"query {pending.qid!r} exceeded its deadline in the "
+                    "serve queue"
+                )
+                pending.finish()
+                shed += 1
+            else:
+                live.append(pending)
+        if shed and self._m_deadline_shed is not None:
+            self._m_deadline_shed.inc(shed)
+        return live
 
     def _fail_queued(self, error: BaseException) -> None:
         while True:

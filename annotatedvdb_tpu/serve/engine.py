@@ -61,6 +61,7 @@ from annotatedvdb_tpu.types import (
     decode_allele,
     encode_allele_array,
 )
+from annotatedvdb_tpu.utils import faults
 
 
 class QueryError(ValueError):
@@ -359,9 +360,15 @@ class QueryEngine:
     POINT_RENDER_CACHE_BYTES = 64 << 20
 
     def __init__(self, snapshots, registry=None,
-                 region_cache_size: int | None = None, residency=None):
+                 region_cache_size: int | None = None, residency=None,
+                 breaker=None):
         self.snapshots = snapshots
         self.residency = residency
+        #: device-path circuit breaker (serve/resilience.DeviceBreaker) —
+        #: None keeps the store's legacy one-failure-latches-host behavior
+        self.breaker = breaker
+        if breaker is not None:
+            breaker.install()
         self._render_lock = threading.Lock()
         #: guarded by self._render_lock
         self._render_cache: OrderedDict = OrderedDict()
@@ -435,13 +442,51 @@ class QueryEngine:
                 self.residency.touch_window(
                     shard, qkey.min(), qkey.max(), len(idxs)
                 )
-            found, gid = shard.lookup(pos, h, ref, alt, ref_len, alt_len)
+            found, gid = self._probe_group(
+                shard, code, pos, h, ref, alt, ref_len, alt_len
+            )
             generation = snap.generation
             for k, i in enumerate(idxs):
                 if found[k]:
                     out[i] = self._render_cached(
                         shard, code, int(gid[k]), generation
                     )
+        return out
+
+    def _probe_group(self, shard, code: int, pos, h, ref, alt,
+                     ref_len, alt_len):
+        """One chromosome group's membership probe, routed through the
+        device circuit breaker when one is installed.
+
+        Closed/half-open groups take the normal path (the breaker's
+        half-open state admits exactly one trial); an open group pins the
+        probe to the byte-identical host path — no failing-device attempt
+        is paid per lookup while the device is sick.  Failures reach the
+        breaker two ways: REAL device errors surface through the store's
+        probe-fallback hook (``observing`` attributes them to this group),
+        and the ``engine.device_probe`` fault point injects them
+        deterministically for the matrix/chaos runs — either way the
+        caller gets correct bytes (host retry)."""
+        breaker = self.breaker
+        if breaker is None:
+            return shard.lookup(pos, h, ref, alt, ref_len, alt_len)
+        if not breaker.allow_device(code):
+            return shard.lookup(pos, h, ref, alt, ref_len, alt_len,
+                                host_only=True)
+        try:
+            with breaker.observing(code) as obs:
+                # crash point: models a device probe/upload failure
+                # surfacing from this group's membership probe — the
+                # breaker must absorb it on the host path, never wrong
+                # bytes
+                faults.fire("engine.device_probe")
+                out = shard.lookup(pos, h, ref, alt, ref_len, alt_len)
+        except Exception as exc:
+            breaker.record_failure(code, exc)
+            return shard.lookup(pos, h, ref, alt, ref_len, alt_len,
+                                host_only=True)
+        if not obs.failed:
+            breaker.record_success(code)
         return out
 
     def _render_cached(self, shard, code: int, gid: int,

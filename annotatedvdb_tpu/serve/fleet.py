@@ -23,7 +23,14 @@ Port sharing, in preference order:
 The supervisor is a plain restart-and-drain loop: a worker that dies
 unexpectedly is respawned (with backoff after rapid deaths); SIGTERM or
 SIGINT drains the fleet — workers get SIGTERM (their event loop finishes
-in-flight responses), stragglers are killed after a timeout.  The
+in-flight responses, open chunked region streams cleanly truncate with a
+``"truncated": true`` trailer), stragglers are killed after a timeout.
+A **wedged-worker watchdog** covers the alive-but-stuck case: every
+worker heartbeats through a shared mmap'd slot file from its EVENT LOOP
+(``--_heartbeatFile``; a parked loop — the ``serve.wedge`` fault point's
+``delay`` action — stops beating even though the process lives), and the
+supervisor SIGKILLs-and-respawns any worker whose beat goes stale past
+``AVDB_SERVE_WEDGE_TIMEOUT_S``.  The
 ``serve.worker`` fault point fires in each worker right after its server
 comes up, so the matrix can kill a fresh worker deterministically; on
 respawn after an ARMED worker death the supervisor strips ``AVDB_FAULT``
@@ -35,12 +42,24 @@ unrecoverable by construction (a crash loop, not a crash test).
 from __future__ import annotations
 
 import contextlib
+import mmap
 import os
 import signal
 import socket
+import struct
 import subprocess
 import sys
+import tempfile
 import time
+
+
+def wedge_timeout_from_env() -> float:
+    """``AVDB_SERVE_WEDGE_TIMEOUT_S`` (default 10; 0 disables the
+    watchdog) — how stale a worker's heartbeat may grow before the
+    supervisor declares it wedged and SIGKILLs it."""
+    return max(
+        float(os.environ.get("AVDB_SERVE_WEDGE_TIMEOUT_S", "") or 10.0), 0.0
+    )
 
 
 def reuseport_available() -> bool:
@@ -74,7 +93,8 @@ class ServeFleet:
     def __init__(self, store_dir: str, host: str = "127.0.0.1",
                  port: int = 0, workers: int = 2, worker_args=(),
                  log=None, restart_backoff_s: float = 0.5,
-                 drain_s: float = 10.0, reuseport: bool | None = None):
+                 drain_s: float = 10.0, reuseport: bool | None = None,
+                 wedge_timeout_s: float | None = None):
         self.store_dir = store_dir
         self.host = host
         self.workers = max(int(workers), 1)
@@ -82,6 +102,23 @@ class ServeFleet:
         self.log = log if log is not None else (lambda msg: None)
         self.restart_backoff_s = restart_backoff_s
         self.drain_s = drain_s
+        # wedged-worker watchdog: workers heartbeat through a shared
+        # mmap'd slot file (8 bytes of time.time() per worker, written on
+        # the worker's EVENT LOOP — a parked loop stops beating even when
+        # the process is alive); the supervisor SIGKILLs any live worker
+        # whose beat goes stale past the timeout and respawns it.  A slot
+        # still at 0.0 means the worker has not come up yet: startup
+        # (jax import + store load) is covered by the rapid-death logic,
+        # not the wedge timeout.
+        self.wedge_timeout_s = (
+            wedge_timeout_from_env() if wedge_timeout_s is None
+            else max(float(wedge_timeout_s), 0.0)
+        )
+        fd, self._hb_path = tempfile.mkstemp(prefix="avdb_serve_hb_")
+        os.write(fd, b"\x00" * (8 * self.workers))
+        os.close(fd)
+        with open(self._hb_path, "r+b") as f:
+            self._hb_mm = mmap.mmap(f.fileno(), 8 * self.workers)
         # reuseport=False forces the parent accept-handoff path (the
         # portability fallback) — how tests exercise it on Linux too
         self.reuseport = (
@@ -128,12 +165,16 @@ class ServeFleet:
             "--storeDir", self.store_dir,
             "--host", self.host, "--port", str(self.port),
             "--_workerIndex", str(index),
+            "--_heartbeatFile", self._hb_path,
         ]
         if not self.reuseport:
             cmd += ["--_listenFd", str(self._reserve.fileno())]
         return cmd + self.worker_args
 
     def _spawn(self, index: int, respawn: bool = False) -> None:
+        # zero the slot: a stale beat from the previous incarnation must
+        # not get the replacement killed before it comes up
+        struct.pack_into("<d", self._hb_mm, index * 8, 0.0)
         env = dict(os.environ)
         if respawn and env.get("AVDB_FAULT", "").startswith("serve."):
             # an injected serve-side fault killed the previous incarnation;
@@ -169,6 +210,7 @@ class ServeFleet:
             failed = False
             while not self._stopping:
                 time.sleep(0.1)
+                self._check_wedged()
                 for i, proc in list(self._procs.items()):
                     rc = proc.poll()
                     if rc is None or self._stopping:
@@ -207,6 +249,37 @@ class ServeFleet:
             signal.signal(signal.SIGTERM, old_term)
             signal.signal(signal.SIGINT, old_int)
             self._reserve.close()
+            with contextlib.suppress(OSError, ValueError):
+                self._hb_mm.close()
+            with contextlib.suppress(OSError):
+                os.unlink(self._hb_path)
+
+    def _check_wedged(self) -> None:
+        """SIGKILL workers that are alive but stuck: a worker whose
+        heartbeat slot went stale past the wedge timeout holds a parked
+        event loop — it still owns accepted connections that will never
+        answer, so the only useful move is kill-and-respawn (the restart
+        loop then treats it like any other death, backoff included).
+        A slot still at 0.0 is a worker that has not reached its first
+        tick (startup); the watchdog leaves those alone."""
+        if self.wedge_timeout_s <= 0 or self._stopping:
+            return
+        now = time.time()
+        for i, proc in self._procs.items():
+            if proc.poll() is not None:
+                continue  # already dead: the restart loop handles it
+            beat = struct.unpack_from("<d", self._hb_mm, i * 8)[0]
+            if beat <= 0.0:
+                continue
+            stale = now - beat
+            if stale > self.wedge_timeout_s:
+                self.log(
+                    f"worker {i}: wedged (alive, no heartbeat for "
+                    f"{stale:.1f}s > {self.wedge_timeout_s:.1f}s); killing"
+                )
+                struct.pack_into("<d", self._hb_mm, i * 8, 0.0)
+                with contextlib.suppress(OSError):
+                    proc.kill()
 
     def _drain(self) -> int:
         """Graceful stop: SIGTERM every worker, wait out the drain budget,

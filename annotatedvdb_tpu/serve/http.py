@@ -42,8 +42,15 @@ from urllib.parse import parse_qs, unquote, urlparse
 _RETURNED_RE = re.compile(r'"returned":(\d+)')
 
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+from annotatedvdb_tpu.serve import resilience
 from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
 from annotatedvdb_tpu.serve.engine import QueryEngine, QueryError
+from annotatedvdb_tpu.serve.resilience import (
+    DeadlineExceeded,
+    DeviceBreaker,
+    OverloadGovernor,
+    PointCache,
+)
 from annotatedvdb_tpu.serve.snapshot import SnapshotManager
 
 #: per-request latency histogram edges (seconds; sub-ms to 2.5s)
@@ -59,15 +66,34 @@ DEFAULT_REGION_LIMIT = 10_000
 def healthz_payload(ctx) -> str:
     """The ``/healthz`` body — ONE builder shared by both front ends, so
     the route surface cannot silently fork (same reason
-    :func:`parse_region_params` lives here)."""
+    :func:`parse_region_params` lives here).  ``/healthz`` is LIVENESS
+    (the process answers); the ``ready`` field mirrors ``/readyz``
+    (readiness: route traffic here or not)."""
     snap = ctx.manager.current()
+    ready, _reason = ctx.ready_state()
     return json.dumps({
         "status": "ok",
+        "ready": ready,
         "generation": snap.generation,
         "rows": snap.store.n,
         "shards": len(snap.store.shards),
         "queue_depth": ctx.batcher.depth(),
+        "brownout_level": ctx.governor.level,
+        "brownout": ctx.governor.level_name,
+        "breaker_open": len(
+            ctx.engine.breaker.open_groups()
+        ) if ctx.engine.breaker is not None else 0,
     })
+
+
+def readyz_payload(ctx) -> tuple[int, str]:
+    """(status, body) for ``/readyz`` — readiness is distinct from
+    liveness: a worker warming a snapshot swap or browned out past the
+    shed-bulk rung answers 503 so a fleet router drains traffic off it
+    while the supervisor leaves it alone (it is alive, just not ready)."""
+    ready, reason = ctx.ready_state()
+    body = json.dumps({"ready": ready, "reason": reason})
+    return (200 if ready else 503), body
 
 
 def stats_payload(ctx) -> str:
@@ -81,6 +107,11 @@ def stats_payload(ctx) -> str:
     }
     if ctx.engine.residency is not None:
         stats["residency"] = ctx.engine.residency.stats()
+    stats["brownout"] = {
+        "level": ctx.governor.level, "name": ctx.governor.level_name,
+    }
+    if ctx.engine.breaker is not None:
+        stats["breaker"] = ctx.engine.breaker.stats()
     return json.dumps(stats)
 
 
@@ -132,12 +163,44 @@ class ServeContext:
         self._lock = threading.Lock()
         #: guarded by self._lock
         self._inflight = 0
+        #: default per-request deadline budget (0 = none unless the client
+        #: sends X-Deadline-Ms)
+        self.default_deadline_s = resilience.default_deadline_s()
+        #: the brownout ladder: fed by observe(), stepped on the aio
+        #: maintenance tick AND (time-gated) on request completion so the
+        #: threaded front end needs no extra thread
+        self.governor = OverloadGovernor(
+            depth_fn=batcher.depth, max_queue=batcher.max_queue,
+            registry=registry,
+        )
+        #: generation-keyed id -> record cache (the cache_first rung)
+        self.point_cache = PointCache()
         self._m_inflight = registry.gauge(
             "avdb_serve_inflight", "bulk/region requests being executed"
         )
         self._m_swaps = registry.counter(
             "avdb_serve_snapshot_swaps_total",
             "store generation swaps observed by the server",
+        )
+        self._m_deadline_shed = {
+            stage: registry.counter(
+                "avdb_deadline_shed_total",
+                "requests shed because their deadline budget ran out",
+                {"stage": stage},
+            )
+            for stage in ("admission", "execute")
+        }
+        self._m_brownout_shed = registry.counter(
+            "avdb_serve_brownout_shed_total",
+            "bulk/region requests rejected by the brownout ladder",
+        )
+        self._m_point_cache_hits = registry.counter(
+            "avdb_serve_point_cache_hits_total",
+            "point reads served cache-first under brownout",
+        )
+        self._m_abandoned = registry.counter(
+            "avdb_serve_abandoned_responses_total",
+            "responses dropped because the client connection died first",
         )
         # per-kind series resolved ONCE: the registry probe (lock + label
         # key assembly) is measurable at serving QPS, so the hot path
@@ -176,12 +239,88 @@ class ServeContext:
         seconds_h.observe(seconds)
         if rows:
             rows_c.inc(rows)
+        # brownout signal: every completed request feeds the ladder; the
+        # evaluation itself is time-gated inside maybe_step (one lock +
+        # compare per request on the threaded front end; the aio front end
+        # also steps on its maintenance tick)
+        self.governor.note_latency(seconds)
+        self.governor.maybe_step()
 
     def rejected(self, kind: str) -> None:
         self._kind[kind][3].inc()
 
     def errored(self, kind: str) -> None:
         self._kind[kind][4].inc()
+
+    # -- resilience ---------------------------------------------------------
+
+    def request_deadline(self, header_value: str | None) -> float | None:
+        """Absolute monotonic deadline for a request arriving now."""
+        return resilience.deadline_at(header_value, self.default_deadline_s)
+
+    def deadline_shed(self, stage: str) -> None:
+        self._m_deadline_shed[stage].inc()
+
+    def brownout_shed(self) -> None:
+        self._m_brownout_shed.inc()
+
+    def point_cache_hit(self) -> None:
+        self._m_point_cache_hits.inc()
+
+    def abandoned(self) -> None:
+        self._m_abandoned.inc()
+
+    def cached_point(self, variant_id: str):
+        """(hit, record) from the id-level point cache for the CURRENT
+        generation — the brownout cache_first rung's read side."""
+        return self.point_cache.get(
+            self.manager.current().generation, variant_id
+        )
+
+    def point_preflight(self, variant_id: str, deadline_t: float | None):
+        """The point-read admission decision BOTH front ends share (the
+        parity convention: decision logic lives once, only rendering
+        forks).  Returns one of::
+
+            ("shed", None)        deadline dead at admission (counted)
+            ("cached", record)    cache-first answer (record may be None
+                                  = cached absence -> 404)
+            ("submit", generation)  proceed through the batcher; cache
+                                  the result under this generation —
+                                  captured BEFORE submit, so a swap
+                                  landing mid-flight writes the entry
+                                  under the retired generation's key,
+                                  which can never be probed again
+        """
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            self.deadline_shed("admission")
+            return "shed", None
+        if self.governor.cache_first():
+            hit, record = self.cached_point(variant_id)
+            if hit:
+                self.point_cache_hit()
+                return "cached", record
+        return "submit", self.manager.current().generation
+
+    def remember_point(self, generation: int, variant_id: str,
+                       record) -> None:
+        self.point_cache.put(generation, variant_id, record)
+
+    def ready_state(self) -> tuple[bool, str]:
+        """(ready, reason): readiness gates routing, not liveness.  Not
+        ready while a snapshot swap is loading (the warming-worker case)
+        or the brownout ladder reached shed_bulk.  Health polls step the
+        ladder too (time-gated): a shed_bulk worker a router has fully
+        DRAINED completes no requests, so on the threaded front end the
+        router's own readiness probes are what lets the now-idle ladder
+        de-escalate back to ready."""
+        self.governor.maybe_step()
+        if getattr(self.manager, "swapping", False):
+            return False, "snapshot swap in progress"
+        if self.governor.shed_bulk():
+            return False, f"brownout level {self.governor.level} " \
+                          f"({self.governor.level_name})"
+        return True, "ok"
 
     # -- admission ----------------------------------------------------------
 
@@ -231,7 +370,7 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
-        if status == 429:
+        if status in (429, 503):
             self.send_header("Retry-After", "1")
         self.end_headers()
         try:
@@ -251,6 +390,15 @@ class ServeHandler(BaseHTTPRequestHandler):
         if path == "/healthz":
             ctx.refresh_snapshot()
             self._reply(200, healthz_payload(ctx))
+            return
+        if path == "/readyz":
+            # readiness probes refresh too (TTL-coalesced): a DRAINED
+            # worker sees commits — and their swapping windows — only
+            # through its probes, and "ready" must not mean "about to
+            # block the first data request on a whole generation load"
+            ctx.refresh_snapshot()
+            status, body = readyz_payload(ctx)
+            self._reply(status, body)
             return
         if path == "/metrics":
             self._reply(200, ctx.registry.render_prometheus(),
@@ -280,11 +428,29 @@ class ServeHandler(BaseHTTPRequestHandler):
     def _point(self, ctx: ServeContext, variant_id: str) -> None:
         t0 = time.perf_counter()
         ctx.refresh_snapshot()
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        action, payload = ctx.point_preflight(variant_id, deadline_t)
+        if action == "shed":
+            self._error(504, "deadline exhausted at admission")
+            return
+        if action == "cached":
+            if payload is None:
+                ctx.observe("point", time.perf_counter() - t0)
+                self._error(404, f"variant {variant_id!r} not in store")
+            else:
+                ctx.observe("point", time.perf_counter() - t0, rows=1)
+                self._reply(200, payload)
+            return
+        generation = payload
         try:
-            record = ctx.batcher.submit(variant_id)
+            record = ctx.batcher.submit(variant_id, deadline_t=deadline_t)
         except QueueFull as err:
             ctx.rejected("point")
             self._error(429, str(err))
+            return
+        except DeadlineExceeded as err:
+            # the batcher shed it (and counted stage="batcher")
+            self._error(504, str(err))
             return
         except QueryError as err:
             ctx.errored("point")
@@ -294,6 +460,7 @@ class ServeHandler(BaseHTTPRequestHandler):
             ctx.errored("point")
             self._error(500, f"{type(err).__name__}: {err}")
             return
+        ctx.remember_point(generation, variant_id, record)
         if record is None:
             ctx.observe("point", time.perf_counter() - t0)
             self._error(404, f"variant {variant_id!r} not in store")
@@ -303,6 +470,16 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     def _bulk(self, ctx: ServeContext) -> None:
         t0 = time.perf_counter()
+        if ctx.governor.shed_bulk():
+            ctx.brownout_shed()
+            self._error(503, "brownout: bulk reads shed (point reads "
+                             "keep serving)")
+            return
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            self._error(504, "deadline exhausted at admission")
+            return
         if not ctx.admit():
             ctx.rejected("bulk")
             self._error(429, "server at capacity (bulk admission bound)")
@@ -319,6 +496,11 @@ class ServeHandler(BaseHTTPRequestHandler):
             except (ValueError, KeyError, TypeError):
                 ctx.errored("bulk")
                 self._error(400, 'bulk body must be {"ids": ["chr:pos:ref:alt", ...]}')
+                return
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # body read/queueing ate the budget: shed BEFORE the probe
+                ctx.deadline_shed("execute")
+                self._error(504, "deadline exhausted before execution")
                 return
             try:
                 results = ctx.engine.lookup_many(ids)
@@ -342,6 +524,16 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     def _region(self, ctx: ServeContext, spec: str, query: str) -> None:
         t0 = time.perf_counter()
+        if ctx.governor.shed_bulk():
+            ctx.brownout_shed()
+            self._error(503, "brownout: region reads shed (point reads "
+                             "keep serving)")
+            return
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            self._error(504, "deadline exhausted at admission")
+            return
         if not ctx.admit():
             ctx.rejected("region")
             self._error(429, "server at capacity (region admission bound)")
@@ -351,6 +543,10 @@ class ServeHandler(BaseHTTPRequestHandler):
             try:
                 min_cadd, max_rank, limit, cursor = \
                     parse_region_params(query)
+                cap = ctx.governor.region_limit_cap()
+                if cap is not None:
+                    # brownout level >= 1: bound per-request render work
+                    limit = min(limit, cap)
                 text = ctx.engine.region(
                     spec,
                     min_cadd=min_cadd,
@@ -397,6 +593,7 @@ def build_server(store_dir: str | None = None, manager=None,
     engine = QueryEngine(
         manager, registry=registry, region_cache_size=region_cache_size,
         residency=residency,
+        breaker=DeviceBreaker(registry=registry, log=log),
     )
     batcher = QueryBatcher(
         engine, max_batch=max_batch, max_wait_s=max_wait_s,

@@ -85,6 +85,12 @@ class SnapshotManager:
         self._swaps = 0
         #: guarded by self._lock
         self._next_check = 0.0  # monotonic deadline of the next free stat
+        #: True while a NEW generation is loading (between the changed
+        #: fingerprint and the pin swap) — the readiness probe reports
+        #: not-ready so a fleet router drains traffic off a warming
+        #: worker (plain bool: atomic to read, written by the one
+        #: refreshing thread)
+        self.swapping = False
 
     def current(self) -> StoreSnapshot:
         """The pinned generation.  Callers keep the returned snapshot for
@@ -132,15 +138,20 @@ class SnapshotManager:
             return False  # manifest mid-rename: keep serving the pin
         if fingerprint == pinned.fingerprint:
             return False
+        self.swapping = True
         try:
-            store = VariantStore.load(self.store_dir, readonly=True)
-        except (OSError, ValueError) as err:  # StoreCorruptError is a ValueError
-            self.log(f"snapshot refresh failed, keeping generation "
-                     f"{pinned.generation}: {err}")
-            return False
-        # crash point: the new generation is fully loaded, the pin has not
-        # moved — a failure here must leave the old generation serving
-        faults.fire("snapshot.swap")
+            try:
+                store = VariantStore.load(self.store_dir, readonly=True)
+            except (OSError, ValueError) as err:  # StoreCorruptError is a ValueError
+                self.log(f"snapshot refresh failed, keeping generation "
+                         f"{pinned.generation}: {err}")
+                return False
+            # crash point: the new generation is fully loaded, the pin has
+            # not moved — a failure here must leave the old generation
+            # serving (and readiness recover: the finally clears the flag)
+            faults.fire("snapshot.swap")
+        finally:
+            self.swapping = False
         with self._lock:
             if self._snap.fingerprint == fingerprint:
                 return False  # a concurrent refresh won the race

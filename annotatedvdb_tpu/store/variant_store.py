@@ -237,6 +237,20 @@ def _transfer_fast() -> bool:
 # process, not one per membership check.
 _DEVICE_LOOKUP_OK = None
 
+# Serve-side device-probe failure observer (serve/resilience.DeviceBreaker):
+# a device error inside Segment.probe falls back to numpy EITHER way; the
+# hook decides the recovery policy.  Returning True means the observer owns
+# it (per-group breaker state, half-open re-probes) and the process-wide
+# latch above stays untouched; None/False keeps the legacy latch — one
+# failure turns device lookups off for the process lifetime.
+_DEVICE_PROBE_FAILURE_HOOK = None
+
+
+def set_device_probe_failure_hook(hook) -> None:
+    """Install (or clear, with None) the device-probe failure observer."""
+    global _DEVICE_PROBE_FAILURE_HOOK
+    _DEVICE_PROBE_FAILURE_HOOK = hook
+
 
 def _device_lookup_enabled() -> bool:
     global _DEVICE_LOOKUP_OK
@@ -531,8 +545,13 @@ class Segment:
 
     # -- membership ---------------------------------------------------------
 
-    def probe(self, qkey, pos, h, ref, alt, ref_len, alt_len):
-        """(found [N] bool, local index [N] int32; -1 when absent)."""
+    def probe(self, qkey, pos, h, ref, alt, ref_len, alt_len,
+              host_only: bool = False):
+        """(found [N] bool, local index [N] int32; -1 when absent).
+
+        ``host_only=True`` skips the device branch outright — the serving
+        circuit breaker's open-state path (byte-identical answers, no
+        failing-device attempt paid per probe)."""
         global _DEVICE_LOOKUP_OK
         if self.n == 0:
             return np.zeros(pos.shape, np.bool_), np.full(pos.shape, -1, np.int32)
@@ -546,7 +565,8 @@ class Segment:
         # live as long as the reference), and a managed segment whose
         # cache vanished falls back to numpy instead of re-uploading
         dev = self._device
-        if (_device_lookup_enabled()
+        if (not host_only
+                and _device_lookup_enabled()
                 and (
                      # an existing cache (auto-built, pinned, or installed
                      # by a residency manager) is sunk cost — honor it
@@ -564,10 +584,15 @@ class Segment:
             try:
                 return self._probe_device(pos, h, ref, alt, ref_len,
                                           alt_len, dev=dev)
-            except Exception:
+            except Exception as exc:
                 # device unusable (no backend / OOM): numpy is always
-                # correct; latch so the hot path doesn't retry per lookup
-                _DEVICE_LOOKUP_OK = False
+                # correct.  An installed failure observer (the serving
+                # circuit breaker) owns the recovery policy — per-group
+                # trip + half-open re-probe; otherwise latch so the hot
+                # path doesn't retry per lookup
+                hook = _DEVICE_PROBE_FAILURE_HOOK
+                if hook is None or not hook(exc):
+                    _DEVICE_LOOKUP_OK = False
         self._numpy_query_volume += nq
         lo = np.searchsorted(self.key, qkey, side="left")
         found = np.zeros(nq, np.bool_)
@@ -885,12 +910,15 @@ class ChromosomeShard:
                     break
         return pinned
 
-    def lookup(self, pos, h, ref, alt, ref_len, alt_len):
+    def lookup(self, pos, h, ref, alt, ref_len, alt_len,
+               host_only: bool = False):
         """Vectorized membership: (found [N] bool, global id [N] int64).
 
         Oldest segment wins when an identity appears in several segments
         (first-wins duplicate policy).  Returned ids are invalidated by the
-        next ``append``/``compact``/``delete``."""
+        next ``append``/``compact``/``delete``.  ``host_only=True`` pins
+        every segment probe to the numpy path (circuit-breaker open
+        state — byte-identical answers)."""
         found = np.zeros(pos.shape, np.bool_)
         index = np.full(pos.shape, -1, np.int64)
         if not self.segments:
@@ -909,7 +937,8 @@ class ChromosomeShard:
                 continue
             if found.all():
                 break
-            f, idx = seg.probe(qkey, pos, h, ref, alt, ref_len, alt_len)
+            f, idx = seg.probe(qkey, pos, h, ref, alt, ref_len, alt_len,
+                               host_only=host_only)
             take = f & ~found
             index = np.where(take, idx.astype(np.int64) + starts[si], index)
             found |= f

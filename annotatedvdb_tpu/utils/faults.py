@@ -1,10 +1,21 @@
 """Deterministic fault injection for crash/recovery testing.
 
-``AVDB_FAULT=<point>:<nth>[:<action>]`` arms exactly one named injection
-point: the <nth> time (1-based) that point is reached in this process, the
-action fires.  Unarmed processes pay one module-global ``is None`` check per
-point, so the points stay compiled into production code paths — the failure
-model is tested against the real code, not a test double.
+``AVDB_FAULT=<point>:<when>[:<action>[:<ms>]]`` arms exactly one named
+injection point.  ``<when>`` selects WHICH passes fire:
+
+- ``<nth>``      the <nth> time (1-based) the point is reached in this
+                 process, the action fires ONCE;
+- ``prob:<p>``   every pass flips a coin: the action fires with
+                 probability ``p`` (0 < p <= 1) on EVERY matching pass —
+                 the sustained-degradation mode the chaos harness drives.
+                 The coin sequence is deterministic: seeded from
+                 ``AVDB_FAULT_SEED`` (default 0xA5DB), re-seeded by every
+                 :func:`reset`, so two identically-armed runs inject at
+                 identical passes.
+
+Unarmed processes pay one module-global ``is None`` check per point, so
+the points stay compiled into production code paths — the failure model
+is tested against the real code, not a test double.
 
 Actions:
 
@@ -17,6 +28,10 @@ Actions:
                  page write (power loss mid-append)
 - ``eio``        raise ``OSError(EIO)`` — the transient-I/O error the
                  bounded-retry paths (``utils.retry``) must absorb
+- ``delay:<ms>`` sleep ``ms`` milliseconds ON the firing thread, then
+                 continue normally — injected latency (with ``prob``) or a
+                 parked event loop (``serve.wedge`` with a long delay: the
+                 wedged-worker case the fleet watchdog must detect)
 
 Points wired in this repo (the canonical registry is :data:`POINTS`;
 arming any other name is a ``ValueError`` at parse time):
@@ -50,6 +65,17 @@ arming any other name is a ``ValueError`` at parse time):
                             serving (respawned workers come up with
                             serve-side AVDB_FAULT stripped: the injection
                             tests the restart path, not a crash loop)
+``serve.wedge``             per event-loop maintenance tick in the asyncio
+                            front end — a long ``delay`` here parks the
+                            LOOP (heartbeats stop, requests stall) while
+                            the process stays alive: the wedged worker the
+                            fleet watchdog must SIGKILL and respawn
+``engine.device_probe``     per device-eligible chromosome-group membership
+                            probe in ``serve.engine`` — ``eio``/``raise``
+                            models a device probe/upload failure; the
+                            serving circuit breaker must absorb it on the
+                            byte-identical host path and re-close via
+                            half-open probes
 ======================== ====================================================
 
 ``fired()`` exposes per-point fire counts for the observability exports.
@@ -59,9 +85,11 @@ from __future__ import annotations
 
 import errno
 import os
+import random
 import signal
+import time
 
-_ACTIONS = ("raise", "kill", "torn_write", "eio")
+_ACTIONS = ("raise", "kill", "torn_write", "eio", "delay")
 
 #: canonical registry of every injection point compiled into the tree.
 #: ``_parse`` rejects unknown points at ARM time (a typo'd AVDB_FAULT used
@@ -78,6 +106,8 @@ POINTS = frozenset({
     "serve.batch",
     "serve.accept",
     "serve.worker",
+    "serve.wedge",
+    "engine.device_probe",
     "snapshot.swap",
 })
 
@@ -87,20 +117,26 @@ class InjectedFault(RuntimeError):
     code — it must propagate to the abort path like any real error)."""
 
 
-#: (point, nth, action) or None — parsed once from AVDB_FAULT; tests re-arm
-#: via :func:`reset` after mutating the environment.
-_ARMED: tuple[str, int, str] | None = None
+#: (point, nth|None, prob|None, action, delay_ms) or None — parsed once
+#: from AVDB_FAULT; tests re-arm via :func:`reset` after mutating the
+#: environment.  Exactly one of nth/prob is set.
+_ARMED: tuple[str, int | None, float | None, str, int] | None = None
 _SEEN: dict[str, int] = {}
 _FIRED: dict[str, int] = {}
+_RNG = random.Random()
+
+#: default deterministic seed for ``prob`` mode (``AVDB_FAULT_SEED``
+#: overrides): chaos runs are replayable by construction
+_DEFAULT_SEED = 0xA5DB
 
 
-def _parse(spec: str | None) -> tuple[str, int, str] | None:
+def _parse(spec: str | None) -> tuple | None:
     if not spec:
         return None
     parts = spec.split(":")
     if len(parts) < 2:
         raise ValueError(
-            f"AVDB_FAULT={spec!r}: expected <point>:<nth>[:<action>]"
+            f"AVDB_FAULT={spec!r}: expected <point>:<when>[:<action>[:<ms>]]"
         )
     point = parts[0]
     if point not in POINTS:
@@ -108,30 +144,87 @@ def _parse(spec: str | None) -> tuple[str, int, str] | None:
             f"AVDB_FAULT={spec!r}: unknown injection point {point!r} "
             f"(known points: {', '.join(sorted(POINTS))})"
         )
-    try:
-        nth = int(parts[1])
-    except ValueError:
-        raise ValueError(f"AVDB_FAULT={spec!r}: nth must be an integer") from None
-    if nth < 1:
-        raise ValueError(f"AVDB_FAULT={spec!r}: nth is 1-based (got {nth})")
-    action = parts[2] if len(parts) > 2 else "raise"
+    nth: int | None = None
+    prob: float | None = None
+    if parts[1] == "prob":
+        if len(parts) < 3:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: prob mode needs a probability "
+                "(<point>:prob:<p>[:<action>[:<ms>]])"
+            )
+        try:
+            prob = float(parts[2])
+        except ValueError:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: probability must be a number"
+            ) from None
+        if not 0.0 < prob <= 1.0:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: probability must be in (0, 1] "
+                f"(got {prob})"
+            )
+        rest = parts[3:]
+    else:
+        try:
+            nth = int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: nth must be an integer "
+                "(or 'prob:<p>')"
+            ) from None
+        if nth < 1:
+            raise ValueError(f"AVDB_FAULT={spec!r}: nth is 1-based (got {nth})")
+        rest = parts[2:]
+    action = rest[0] if rest else "raise"
     if action not in _ACTIONS:
         raise ValueError(
             f"AVDB_FAULT={spec!r}: unknown action {action!r} "
             f"(one of {', '.join(_ACTIONS)})"
         )
-    return point, nth, action
+    delay_ms = 0
+    if action == "delay":
+        if len(rest) < 2:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: delay action needs milliseconds "
+                "(<point>:<when>:delay:<ms>)"
+            )
+        try:
+            delay_ms = int(rest[1])
+        except ValueError:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: delay milliseconds must be an integer"
+            ) from None
+        if delay_ms < 0:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: delay milliseconds must be >= 0"
+            )
+        extra = rest[2:]
+    else:
+        extra = rest[1:]
+    if extra:
+        raise ValueError(
+            f"AVDB_FAULT={spec!r}: unexpected trailing fields {extra!r}"
+        )
+    return point, nth, prob, action, delay_ms
 
 
 def reset(spec: str | None = None) -> None:
-    """Re-arm from ``spec`` (or the current environment) and zero the hit
-    counters — the test-suite entry point for in-process fault runs."""
+    """Re-arm from ``spec`` (or the current environment), zero the hit
+    counters, and re-seed the ``prob`` coin (``AVDB_FAULT_SEED``) — the
+    test-suite entry point for in-process fault runs."""
     global _ARMED
     _ARMED = _parse(
         spec if spec is not None else os.environ.get("AVDB_FAULT")
     )
     _SEEN.clear()
     _FIRED.clear()
+    try:
+        seed = int(os.environ.get("AVDB_FAULT_SEED", "") or _DEFAULT_SEED)
+    except ValueError:
+        raise ValueError(
+            "AVDB_FAULT_SEED must be an integer"
+        ) from None
+    _RNG.seed(seed)
 
 
 def armed_point() -> str | None:
@@ -142,7 +235,7 @@ def armed_point() -> str | None:
 def fired() -> dict[str, int]:
     """{point: times an action actually fired} — the obs export surface.
     (``kill``/``torn_write`` never return to report, but the ``raise``/
-    ``eio`` counts matter for retry/abort accounting.)"""
+    ``eio``/``delay`` counts matter for retry/abort/latency accounting.)"""
     return dict(_FIRED)
 
 
@@ -156,16 +249,24 @@ def fire(point: str, fileobj=None, tear_base: int = 0,
     about to be written) it writes the first half itself then SIGKILLs;
     without a payload it truncates the current write session back to
     ``tear_base + (written - tear_base) // 2``.  Points with no file fall
-    back to a plain kill.
+    back to a plain kill.  ``delay`` sleeps on the firing thread and
+    continues — injected latency, or a parked loop when the point sits on
+    an event loop's maintenance tick.
     """
     armed = _ARMED
     if armed is None or armed[0] != point:
         return
+    _point, nth, prob, action, delay_ms = armed
     n = _SEEN[point] = _SEEN.get(point, 0) + 1
-    if n != armed[1]:
+    if prob is not None:
+        if _RNG.random() >= prob:
+            return
+    elif n != nth:
         return
-    action = armed[2]
     _FIRED[point] = _FIRED.get(point, 0) + 1
+    if action == "delay":
+        time.sleep(delay_ms / 1000.0)
+        return
     if action == "raise":
         raise InjectedFault(f"injected fault at {point} (hit {n})")
     if action == "eio":

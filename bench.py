@@ -476,7 +476,15 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
     request in a burst shares its burst's arrival time): syscalls cost
     hundreds of microseconds in this sandboxed kernel, so per-request
     packets would make both client and server syscall-bound — a bursty
-    arrival process is also the harsher, more production-shaped load."""
+    arrival process is also the harsher, more production-shaped load.
+
+    Error classification: ``errors`` counts HTTP-level non-200 responses
+    (bucketed per status in ``status_counts``); ``transport_errors``
+    counts connect failures, resets, and requests a dead connection never
+    delivered.  Latency samples come ONLY from 200 responses — a refused
+    connection or a fast 429 during a worker restart used to land in the
+    latency array and skew p99 downward exactly when the server was at
+    its worst (chaos runs made the skew systematic)."""
     import selectors
     import socket
 
@@ -502,10 +510,13 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
         return {
             "offered_qps": float(offered_qps), "achieved_qps": 0.0,
             "p50_ms": 0.0, "p99_ms": 0.0,
-            "errors": conns * n_per_conn, "requests": 0, "seconds": 0.0,
+            "errors": 0, "transport_errors": conns * n_per_conn,
+            "status_counts": {}, "requests": 0, "seconds": 0.0,
         }
     lat: list = []
-    errors = 0
+    errors = 0            # HTTP-level non-200 responses
+    transport_errors = 0  # connect/reset/undelivered (no response at all)
+    status_counts: dict = {}
     total = conns * n_per_conn
     t0 = time.perf_counter()
     deadline = t0 + duration_s + timeout_s
@@ -536,7 +547,7 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
                 except BlockingIOError:
                     pass
                 except OSError:
-                    errors += n_per_conn - c.recvd
+                    transport_errors += n_per_conn - c.recvd
                     done += n_per_conn - c.recvd
                     c.recvd = n_per_conn
                     _retire_conn(sel, c)  # a dead readable fd busy-spins
@@ -561,7 +572,7 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
             except OSError:
                 chunk = b""
             if not chunk:
-                errors += n_per_conn - c.recvd
+                transport_errors += n_per_conn - c.recvd
                 done += n_per_conn - c.recvd
                 c.recvd = n_per_conn
                 _retire_conn(sel, c)
@@ -577,7 +588,7 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
                 # NOT always the last header (429s carry Retry-After)
                 cl = buf.find(b"Content-Length: ", start, he)
                 if cl < 0:
-                    errors += n_per_conn - c.recvd
+                    transport_errors += n_per_conn - c.recvd
                     done += n_per_conn - c.recvd
                     c.recvd = n_per_conn
                     _retire_conn(sel, c)
@@ -585,16 +596,22 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
                 blen = int(buf[cl + 16:buf.find(b"\r\n", cl, he + 2)])
                 if len(buf) < he + 4 + blen:
                     break
-                if not buf.startswith(b"HTTP/1.1 200", start):
+                status = buf[start + 9:start + 12].decode("latin-1")
+                status_counts[status] = status_counts.get(status, 0) + 1
+                if status == "200":
+                    # ONLY delivered successes are latency samples: a fast
+                    # reject (429 during a restart) or refused connection
+                    # must not improve p99
+                    lat.append(tr - c.scheds[c.recvd])
+                else:
                     errors += 1
                 start = he + 4 + blen
-                lat.append(tr - c.scheds[c.recvd])
                 c.recvd += 1
                 done += 1
             c.buf = buf[start:]
     dt = max(time.perf_counter() - t0, 1e-9)
     undelivered = total - sum(min(c.recvd, n_per_conn) for c in cs)
-    errors += max(undelivered, 0)
+    transport_errors += max(undelivered, 0)
     for c in cs:
         try:
             c.sock.close()
@@ -608,6 +625,8 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
         "errors": int(errors),
+        "transport_errors": int(transport_errors),
+        "status_counts": status_counts,
         "requests": int(len(lat)),
         "seconds": round(dt, 2),
     }
@@ -615,8 +634,10 @@ def _open_loop_step(host: str, port: int, blobs: list, offered_qps: float,
 
 def _step_sustains(step: dict, slo_p99_ms: float) -> bool:
     """A step counts as sustained when the fleet kept up with the offered
-    rate (>=92% delivered), met the latency SLO, and dropped nothing."""
+    rate (>=92% delivered), met the latency SLO, and dropped nothing —
+    neither HTTP errors nor transport-level failures."""
     return (step["errors"] == 0
+            and step.get("transport_errors", 0) == 0
             and step["achieved_qps"] >= 0.92 * step["offered_qps"]
             and step["p99_ms"] <= slo_p99_ms)
 
@@ -712,10 +733,38 @@ def bench_serve_open_loop(store_dir: str, ids: list,
     out["max_achieved_qps"] = max(
         (s["achieved_qps"]
          for f in out["fleets"] for s in f["steps"]
-         if s["errors"] == 0 and s["achieved_qps"] >= 0.92 * s["offered_qps"]),
+         if s["errors"] == 0 and s.get("transport_errors", 0) == 0
+         and s["achieved_qps"] >= 0.92 * s["offered_qps"]),
         default=0.0,
     )
     return out
+
+
+def bench_chaos() -> dict:
+    """The chaos/soak certification leg (``tools/chaos_soak.py``, full
+    schedule): a 2-worker fleet under open-loop load absorbs injected
+    drain latency, a device-EIO breaker trip, a snapshot-swap failure
+    against a real commit, a worker SIGKILL, and a wedged loop — the
+    record lands as the ``serving.chaos`` block (schema-checked).  The
+    harness runs as a subprocess (it builds its own fleet and store);
+    a failed run records the violations instead of aborting the bench."""
+    import subprocess
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "chaos_soak.py")
+    try:
+        p = subprocess.run(
+            [sys.executable, tool, "--json", "-"],
+            capture_output=True, text=True, timeout=600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "chaos soak timed out"}
+    try:
+        record = json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"chaos soak rc={p.returncode}, no JSON "
+                         f"({p.stderr[-300:]!r})"}
+    return record
 
 
 def bench_serve(n_rows: int = 50_000, clients: int = 16,
@@ -980,6 +1029,8 @@ def serve_only():
         serving["open_loop"] = bench_serve_open_loop(store_dir, ids)
     finally:
         shutil.rmtree(work, ignore_errors=True)
+    settle()
+    serving["chaos"] = bench_chaos()
     sustainable = serving["open_loop"]["max_sustainable_qps"]
     if sustainable > 0:
         metric, headline = "serve_open_loop_sustainable_qps", sustainable

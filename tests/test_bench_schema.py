@@ -65,6 +65,8 @@ GOOD = {
                  "steps": [
                      {"offered_qps": 8000.0, "achieved_qps": 7950.0,
                       "p50_ms": 12.0, "p99_ms": 21.5, "errors": 0,
+                      "transport_errors": 0,
+                      "status_counts": {"200": 19875, "429": 125},
                       "requests": 20000, "seconds": 2.5},
                  ]},
                 {"workers": 2, "max_sustainable_qps": 11800.0,
@@ -74,6 +76,21 @@ GOOD = {
                       "requests": 30000, "seconds": 2.5},
                  ]},
             ],
+        },
+        "chaos": {
+            "mode": "full", "workers": 2, "duration_s": 40.0,
+            "offered_qps": 600.0, "requests": 24734, "ok": 23359,
+            "errors": 0, "hard_errors": 0, "shed": 12,
+            "transport_errors": 1375,
+            "status_counts": {"200": 23359, "503": 12},
+            "wrong_bytes": 0, "p99_ms": 813.5, "p99_budget_ms": 2500.0,
+            "error_rate": 0.0, "error_budget": 0.05,
+            "transport_rate": 0.056, "transport_budget": 0.25,
+            "faults": ["serve.batch:prob:0.2:delay:20",
+                       "serve.wedge:1:delay:30000"],
+            "breaker_trips": 1,
+            "recovered": True, "recovered_s": 19.1,
+            "recovery_window_s": 30.0, "violations": [],
         },
     },
 }
@@ -142,6 +159,43 @@ def test_open_loop_block_is_validated_strictly():
     old = copy.deepcopy(GOOD)
     del old["serving"]["open_loop"]
     assert validate_record(old) == []
+
+
+def test_chaos_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["chaos"]["wrong_bytes"]
+    assert any("wrong_bytes" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["chaos"]["recovered"]
+    assert any("recovered" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["error_rate"] = 1.7  # a ratio, not a count
+    assert any("error_rate" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["faults"] = "serve.wedge"  # a list of specs
+    assert any("faults" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["recovered"] = "yes"  # bool, not string
+    assert any("recovered" in e for e in validate_record(bad))
+    # a serving block WITHOUT chaos stays valid (r05/r06-era records)
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["chaos"]
+    assert validate_record(old) == []
+    # a failed chaos leg records {"error": ...} and stays loadable
+    failed = copy.deepcopy(GOOD)
+    failed["serving"]["chaos"] = {"error": "chaos soak timed out"}
+    assert validate_record(failed) == []
+
+
+def test_open_loop_step_transport_errors_validated():
+    bad = copy.deepcopy(GOOD)
+    step = bad["serving"]["open_loop"]["fleets"][0]["steps"][0]
+    step["transport_errors"] = 1.5  # a count, not a ratio
+    assert any("transport_errors" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    step = bad["serving"]["open_loop"]["fleets"][0]["steps"][0]
+    step["status_counts"] = {"200": "many"}  # counts are integers
+    assert any("status_counts" in e for e in validate_record(bad))
 
 
 def test_queue_stalls_block_is_validated_strictly():

@@ -367,6 +367,129 @@ def test_serve_accept_raise_fails_only_that_connection():
         server.ctx.batcher.close()
 
 
+def test_engine_device_probe_eio_trips_breaker_then_half_open_recloses():
+    """engine.device_probe (eio): repeated injected device-probe failures
+    must (1) never change answer bytes — the breaker retries the
+    byte-identical host path — and (2) trip the per-group breaker after
+    the threshold, then re-close it through a half-open probe once the
+    cooldown lapses and the fault is gone."""
+    from annotatedvdb_tpu.serve import (
+        DeviceBreaker,
+        QueryEngine,
+        StaticSnapshots,
+    )
+
+    clock = {"t": 0.0}
+    breaker = DeviceBreaker(cooldown_s=5.0, clock=lambda: clock["t"])
+    engine = QueryEngine(
+        StaticSnapshots(_tiny_store()), region_cache_size=0,
+        breaker=breaker,
+    )
+    want = engine.lookup("3:10:A:C")
+    assert want is not None
+    faults.reset("engine.device_probe:prob:1.0:eio")
+    for _ in range(breaker.failure_threshold):
+        # every failing probe still answers, byte-identical (host retry)
+        assert engine.lookup("3:10:A:C") == want
+    assert breaker.state(3) == "open"
+    # while tripped the device path is never attempted: the armed fault
+    # cannot fire (host-only path), answers stay correct
+    fired_before = faults.fired().get("engine.device_probe", 0)
+    assert engine.lookup("3:10:A:C") == want
+    assert faults.fired().get("engine.device_probe", 0) == fired_before
+    # cooldown lapses, fault cleared: ONE half-open probe re-closes
+    faults.reset("")
+    clock["t"] = 10.0
+    assert engine.lookup("3:10:A:C") == want
+    assert breaker.state(3) == "closed"
+
+
+def test_serve_wedge_watchdog_kills_and_respawns(tmp_path):
+    """serve.wedge (delay): a long delay on the event-loop maintenance
+    tick parks the LOOP — the worker process stays alive but stops
+    heartbeating and serving.  The fleet watchdog must SIGKILL it
+    (logged as wedged) and the respawned workers (fault stripped) must
+    bring the fleet back to clean serving."""
+    import re
+    import subprocess
+    import threading
+    import time
+    import urllib.request
+
+    store_dir = str(tmp_path / "wedge_store")
+    _tiny_store().save(store_dir)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # 3rd tick (~0.5s after accept starts): both workers come up,
+        # serve briefly, then park their loops for 60s
+        AVDB_FAULT="serve.wedge:3:delay:60000",
+        AVDB_SERVE_WEDGE_TIMEOUT_S="2",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", store_dir, "--port", "0", "--workers", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    lines: list[str] = []
+    try:
+        first = proc.stdout.readline()
+        lines.append(first)
+        reader = threading.Thread(
+            target=lambda: lines.extend(proc.stdout), daemon=True
+        )
+        reader.start()
+        m = re.search(r"http://([\d.]+):(\d+)", first)
+        assert m, f"no fleet address line: {first!r}"
+        host, port = m.group(1), int(m.group(2))
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=5
+            ) as r:
+                return r.status
+
+        # the watchdog must detect the parked loops and the respawned
+        # (clean) workers must serve again
+        deadline = time.monotonic() + 120
+        recovered = False
+        while time.monotonic() < deadline:
+            if any("wedged" in ln for ln in lines):
+                try:
+                    if get("/variant/3:10:A:C") == 200:
+                        recovered = True
+                        break
+                except OSError:
+                    pass
+            time.sleep(0.3)
+        assert any("wedged" in ln for ln in lines), (
+            "watchdog never detected the wedged workers:\n"
+            + "".join(lines)[-2000:]
+        )
+        assert recovered, (
+            "fleet never recovered after the wedge kills:\n"
+            + "".join(lines)[-2000:]
+        )
+        # recovered means RELIABLY serving, not one lucky hit
+        failures = sum(
+            1 for _ in range(20)
+            if _get_status_or_none(get) != 200
+        )
+        assert failures == 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    assert rc == 0, "".join(lines)[-2000:]
+
+
+def _get_status_or_none(get):
+    try:
+        return get("/variant/3:10:A:C")
+    except OSError:
+        return None
+
+
 def test_serve_worker_kill_fleet_restarts_and_keeps_serving(tmp_path):
     """SIGKILLed workers (serve.worker:1:kill fires in each initial worker
     right after it starts accepting) are restarted by the supervisor —

@@ -53,10 +53,68 @@ def test_eio_action_raises_oserror():
 
 def test_bad_specs_rejected():
     for spec in ("nope", "ingest.chunk:x", "ingest.chunk:0",
-                 "ingest.chunk:1:explode"):
+                 "ingest.chunk:1:explode",
+                 # prob mode: missing/garbage/out-of-range probabilities
+                 "ingest.chunk:prob", "ingest.chunk:prob:x",
+                 "ingest.chunk:prob:0", "ingest.chunk:prob:1.5",
+                 # delay action: missing/garbage/negative milliseconds
+                 "ingest.chunk:1:delay", "ingest.chunk:1:delay:x",
+                 "ingest.chunk:1:delay:-5",
+                 # trailing junk after a complete spec
+                 "ingest.chunk:1:raise:junk"):
         with pytest.raises(ValueError):
             faults.reset(spec)
         faults.reset("")
+
+
+def test_delay_action_sleeps_and_continues():
+    """``delay:<ms>`` is injected latency, not an abort: the fire sleeps
+    on the calling thread, counts as fired, and execution continues."""
+    import time
+
+    faults.reset("ingest.chunk:2:delay:50")
+    t0 = time.perf_counter()
+    faults.fire("ingest.chunk")  # hit 1: no-op
+    assert time.perf_counter() - t0 < 0.04
+    t0 = time.perf_counter()
+    faults.fire("ingest.chunk")  # hit 2: the 50ms sleep, then continue
+    assert time.perf_counter() - t0 >= 0.045
+    assert faults.fired() == {"ingest.chunk": 1}
+    faults.fire("ingest.chunk")  # nth mode: past the hit, no-op again
+    assert faults.fired() == {"ingest.chunk": 1}
+
+
+def test_prob_mode_fires_repeatedly_and_deterministically(monkeypatch):
+    """``prob:<p>`` flips a seeded coin per pass: the same seed replays
+    the exact injection sequence; a different AVDB_FAULT_SEED moves it."""
+    def sequence():
+        faults.reset("ingest.chunk:prob:0.5:eio")
+        out = []
+        for _ in range(64):
+            try:
+                faults.fire("ingest.chunk")
+                out.append(0)
+            except OSError:
+                out.append(1)
+        return out
+
+    first = sequence()
+    assert 0 < sum(first) < 64  # fires repeatedly, not always
+    assert sequence() == first  # same seed => identical replay
+    monkeypatch.setenv("AVDB_FAULT_SEED", "12345")
+    moved = sequence()
+    assert moved != first
+    # replayable under the explicit seed too
+    assert sequence() == moved
+
+
+def test_prob_mode_with_delay_action():
+    """The chaos harness's injected-latency shape: probabilistic delays
+    keep counting per fire."""
+    faults.reset("serve.batch:prob:1.0:delay:1")
+    for _ in range(5):
+        faults.fire("serve.batch")
+    assert faults.fired() == {"serve.batch": 5}
 
 
 def test_unknown_point_rejected_at_arm_time():

@@ -1,0 +1,578 @@
+#!/usr/bin/env python
+"""Chaos/soak harness: prove the serve stack's failure behavior under load.
+
+Stands up a REAL serve fleet (subprocess CLI) over a synthetic store,
+drives sustained open-loop point load against it (the PR-6 bench client,
+with its transport-vs-HTTP error split), and walks a scripted chaos
+schedule that arms fault points in live workers through the
+``AVDB_SERVE_CHAOS``-gated ``POST /_chaos`` route (plus supervisor-level
+events the route cannot express: a process SIGKILL rides the
+``serve.accept:1:kill`` arming; a snapshot-swap failure pairs a
+``snapshot.swap`` arm with a real loader commit from this process).
+
+What it asserts — the resilience layer's contract, not vibes:
+
+1. **zero wrong bytes**: sampled point responses during AND after chaos
+   are byte-identical to the pre-chaos reference (shed with 429/503/504
+   is allowed; answering wrong is not);
+2. **bounded errors**: hard failures (HTTP 5xx that are not deadline/
+   brownout sheds, plus transport failures) stay within the declared
+   budgets;
+3. **bounded latency**: p99 of DELIVERED responses stays inside the
+   declared brownout contract;
+4. **clean recovery**: within a bounded window after the last fault the
+   fleet reports breaker closed, brownout level 0, and ready on every
+   poll — and the sampled ids verify byte-exact again.
+
+Modes:
+
+- ``--smoke``  (<=30 s, tier-1 via tools/run_checks.sh): 1 worker, 2
+  fault points — injected drain latency (``serve.batch:prob::delay``)
+  and a device-EIO breaker trip (``engine.device_probe:prob::eio``).
+  No process kills: the smoke must be fast and deterministic.
+- full (default; the BENCH record's ``chaos`` block): 2-worker fleet,
+  the whole schedule — injected latency, device EIO, snapshot-swap
+  failure against a real commit, a worker SIGKILL, and a wedged loop the
+  watchdog must catch.
+
+Exit codes: 0 contract held, 1 violated, 2 harness error.
+``--json PATH`` (or ``-`` for stdout) emits the machine-readable record
+(`serving.chaos` schema in tools/check_bench_schema.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+# pin CPU before anything imports jax: the harness must never hang on an
+# accelerator probe (same discipline as tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # the open-loop client (single selector thread)  # noqa: E402
+
+#: statuses that are CONTRACTUAL sheds under chaos — bounded degradation,
+#: not failure: 429 admission, 503 brownout, 504 deadline
+SHED_STATUSES = {"429", "503", "504"}
+
+
+def log(msg: str) -> None:
+    print(f"chaos_soak: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# store
+
+
+def build_store(store_dir: str, n: int = 4000):
+    """(ids, region_spec): one committed chr8 store with CADD annotations
+    (region-filter material) and REAL identity hashes — the fleet probes
+    these ids back through the same loader identity rule."""
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    width = 8
+    store = VariantStore(width=width)
+    refs = ["A", "C", "G", "T"] * (n // 4)
+    alts = ["G", "T", "A", "C"] * (n // 4)
+    ref, ref_len = encode_allele_array(refs, width)
+    alt, alt_len = encode_allele_array(alts, width)
+    h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+    pos = np.arange(1000, 1000 + 97 * n, 97, dtype=np.int32)[:n]
+    store.shard(8).append(
+        {"pos": pos, "h": h, "ref_len": ref_len, "alt_len": alt_len},
+        ref, alt,
+        annotations={"cadd_scores": [
+            {"CADD_phred": float(i % 40)} if i % 2 else None
+            for i in range(n)
+        ]},
+    )
+    store.save(store_dir)
+    ids = [f"8:{int(p)}:{r}:{a}" for p, r, a in zip(pos, refs, alts)]
+    return ids, f"8:{int(pos[0])}-{int(pos[min(n - 1, 400)])}"
+
+
+def commit_new_generation(store_dir: str) -> None:
+    """One real loader commit: append a row FAR from the sampled window
+    (sampled point/region references stay byte-stable) and save — the
+    workers' snapshot TTL picks it up within a quarter second."""
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    store = VariantStore.load(store_dir)
+    width = store.width
+    ref, ref_len = encode_allele_array(["A"], width)
+    alt, alt_len = encode_allele_array(["T"], width)
+    h = identity_hashes(width, ref, alt, ref_len, alt_len, ["A"], ["T"])
+    store.shard(8).append(
+        {"pos": np.asarray([9_000_001], np.int32), "h": h,
+         "ref_len": ref_len, "alt_len": alt_len},
+        ref, alt,
+    )
+    store.save(store_dir)
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers
+
+
+def get(host: str, port: int, path: str, timeout: float = 5.0):
+    """(status, body_text); transport failures raise OSError."""
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def arm(host: str, port: int, spec: str, ttl_s: float | None = None) -> dict:
+    """POST /_chaos: arm ``spec`` in whichever worker answers (kernel
+    balancing picks one — chaos does not care which).  Returns the
+    worker's ack (pid included for the log)."""
+    body = json.dumps(
+        {"spec": spec, **({"ttl_s": ttl_s} if ttl_s is not None else {})}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/_chaos", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        ack = json.loads(r.read().decode())
+    log(f"armed {spec!r} in pid {ack.get('pid')}"
+        + (f" (ttl {ttl_s}s)" if ttl_s else ""))
+    return ack
+
+
+# ---------------------------------------------------------------------------
+# background load + byte-verification
+
+
+class LoadDriver(threading.Thread):
+    """Sustained open-loop load in fixed-length steps: a connection killed
+    by chaos poisons at most ONE step's remainder (counted as transport
+    errors), and every step starts with fresh connections — the client a
+    retrying production caller actually resembles."""
+
+    def __init__(self, host: str, port: int, blobs: list, qps: float,
+                 total_s: float, conns: int, step_s: float = 4.0):
+        super().__init__(name="chaos-load", daemon=True)
+        self.host, self.port, self.blobs = host, port, blobs
+        self.qps, self.total_s, self.conns = qps, total_s, conns
+        self.step_s = step_s
+        self.steps: list[dict] = []
+
+    def run(self) -> None:
+        deadline = time.monotonic() + self.total_s
+        while time.monotonic() < deadline:
+            step_s = min(self.step_s, max(deadline - time.monotonic(), 1.0))
+            self.steps.append(bench._open_loop_step(
+                self.host, self.port, self.blobs, self.qps, step_s,
+                self.conns, timeout_s=8.0,
+            ))
+
+
+class Checker(threading.Thread):
+    """Byte-verification side channel: low-rate point GETs of the sampled
+    reference ids on FRESH connections; every 200 must match the
+    reference bytes exactly.  Sheds/transport failures count in their own
+    buckets (bounded behavior), mismatches are the one unforgivable
+    outcome."""
+
+    def __init__(self, host: str, port: int, reference: dict,
+                 interval_s: float = 0.1):
+        super().__init__(name="chaos-checker", daemon=True)
+        self.host, self.port = host, port
+        self.reference = reference
+        self.interval_s = interval_s
+        self.stop = threading.Event()
+        self.requests = 0
+        self.ok = 0
+        self.wrong_bytes = 0
+        self.transport_errors = 0
+        self.status_counts: dict[str, int] = {}
+        self.mismatches: list[str] = []
+
+    def run(self) -> None:
+        import random
+
+        rng = random.Random(0xC405)
+        ids = list(self.reference)
+        while not self.stop.is_set():
+            vid = ids[rng.randrange(len(ids))]
+            self.requests += 1
+            try:
+                status, body = get(self.host, self.port,
+                                   f"/variant/{vid}", timeout=3.0)
+            except OSError:
+                self.transport_errors += 1
+            else:
+                key = str(status)
+                self.status_counts[key] = self.status_counts.get(key, 0) + 1
+                if status == 200:
+                    if body == self.reference[vid]:
+                        self.ok += 1
+                    else:
+                        self.wrong_bytes += 1
+                        if len(self.mismatches) < 3:
+                            self.mismatches.append(
+                                f"{vid}: got {body[:120]!r}"
+                            )
+            self.stop.wait(self.interval_s)
+
+
+# ---------------------------------------------------------------------------
+# the run
+
+
+def wait_healthy(host: str, port: int, timeout_s: float = 90.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = get(host, port, "/healthz", timeout=2.0)
+            if status == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError("fleet never became healthy")
+
+
+def check_recovered(host: str, port: int, workers: int,
+                    reference: dict) -> str | None:
+    """One recovery probe: None when the fleet looks fully recovered
+    (every poll ready, brownout 0, breaker closed, sampled bytes exact),
+    else a reason string."""
+    for _ in range(3 * workers):
+        try:
+            status, body = get(host, port, "/healthz", timeout=3.0)
+        except OSError as err:
+            return f"healthz transport error: {err}"
+        if status != 200:
+            return f"healthz {status}"
+        h = json.loads(body)
+        if not h.get("ready"):
+            return "not ready"
+        if h.get("brownout_level"):
+            return f"brownout level {h['brownout_level']}"
+        if h.get("breaker_open"):
+            return f"breaker open on {h['breaker_open']} group(s)"
+        try:
+            status, _ = get(host, port, "/readyz", timeout=3.0)
+        except OSError as err:
+            return f"readyz transport error: {err}"
+        if status != 200:
+            return f"readyz {status}"
+    for vid, want in reference.items():
+        try:
+            status, body = get(host, port, f"/variant/{vid}", timeout=3.0)
+        except OSError as err:
+            return f"verify transport error: {err}"
+        if status != 200:
+            return f"verify {vid}: {status}"
+        if body != want:
+            return f"verify {vid}: WRONG BYTES"
+    return None
+
+
+def run(args) -> tuple[dict, list[str]]:
+    work = tempfile.mkdtemp(prefix="avdb_chaos_")
+    store_dir = os.path.join(work, "store")
+    mode = "smoke" if args.smoke else "full"
+    workers = 1 if args.smoke else 2
+    duration_s = args.duration or (8.0 if args.smoke else 40.0)
+    qps = 250.0 if args.smoke else 600.0
+    conns = 4 if args.smoke else 8
+    error_budget = 0.02 if args.smoke else 0.05
+    transport_budget = 0.05 if args.smoke else 0.25
+    p99_budget_ms = 1500.0 if args.smoke else 2500.0
+    recovery_window_s = 20.0 if args.smoke else 30.0
+
+    log(f"{mode}: building store")
+    ids, region_spec = build_store(store_dir)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AVDB_JAX_PLATFORM="cpu",
+        AVDB_SERVE_CHAOS="1",
+        AVDB_SERVE_WEDGE_TIMEOUT_S="2",
+        AVDB_SERVE_DEFAULT_DEADLINE_MS="2000",
+    )
+    env.pop("AVDB_FAULT", None)  # the schedule arms at runtime, not spawn
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", store_dir, "--port", "0",
+         "--workers", str(workers), "--maxQueue", "8192"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    stderr_lines: list[str] = []
+    stderr_reader = threading.Thread(
+        target=lambda: stderr_lines.extend(proc.stderr),
+        name="chaos-fleet-stderr", daemon=True,
+    )
+    stderr_reader.start()
+    violations: list[str] = []
+    faults_armed: list[str] = []
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"http://([\d.]+):(\d+)", line)
+        if not m:
+            raise RuntimeError(f"no fleet address line: {line!r}")
+        host, port = m.group(1), int(m.group(2))
+        wait_healthy(host, port)
+        log(f"{mode}: fleet of {workers} on {host}:{port}")
+
+        # reference sample: the bytes every later 200 must reproduce
+        reference: dict[str, str] = {}
+        for vid in ids[:: max(len(ids) // 16, 1)][:16]:
+            status, body = get(host, port, f"/variant/{vid}")
+            if status != 200:
+                raise RuntimeError(f"reference GET {vid} -> {status}")
+            reference[vid] = body
+        status, _ = get(host, port, f"/region/{region_spec}?limit=50")
+        if status != 200:
+            raise RuntimeError(f"reference region -> {status}")
+
+        blobs = [
+            (f"GET /variant/{i} HTTP/1.1\r\nHost: c\r\n\r\n").encode()
+            for i in ids
+        ]
+        load = LoadDriver(host, port, blobs, qps, duration_s, conns)
+        checker = Checker(host, port, reference)
+        t_start = time.monotonic()
+        load.start()
+        checker.start()
+
+        # -- the chaos schedule (times relative to load start) -------------
+        def at(t_rel: float) -> None:
+            delay = t_start + t_rel - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+        if args.smoke:
+            schedule_desc = ["serve.batch:prob:0.25:delay:15",
+                             "engine.device_probe:prob:1.0:eio"]
+            at(1.0)
+            arm(host, port, "serve.batch:prob:0.25:delay:15", ttl_s=3.0)
+            at(4.5)
+            arm(host, port, "engine.device_probe:prob:1.0:eio", ttl_s=2.0)
+            last_fault_rel = 6.5
+        else:
+            schedule_desc = [
+                "serve.batch:prob:0.2:delay:20",
+                "engine.device_probe:prob:1.0:eio",
+                "snapshot.swap:1:raise (+ real commit)",
+                "serve.accept:1:kill (worker SIGKILL)",
+                "serve.wedge:1:delay:30000 (watchdog SIGKILL)",
+            ]
+            at(2.0)
+            arm(host, port, "serve.batch:prob:0.2:delay:20", ttl_s=6.0)
+            at(8.0)
+            arm(host, port, "engine.device_probe:prob:1.0:eio", ttl_s=2.0)
+            at(12.0)
+            arm(host, port, "snapshot.swap:1:raise")
+            commit_new_generation(store_dir)
+            log("committed a new store generation under the armed swap")
+            at(16.0)
+            arm(host, port, "serve.accept:1:kill")
+            at(22.0)
+            arm(host, port, "serve.wedge:1:delay:30000")
+            last_fault_rel = 22.0
+        faults_armed = schedule_desc
+
+        load.join()
+        last_fault_t = t_start + last_fault_rel
+
+        # -- recovery: bounded window after the last fault ------------------
+        recovered = False
+        recovered_s = recovery_window_s
+        deadline = last_fault_t + recovery_window_s
+        reason = "never probed"
+        while time.monotonic() < deadline:
+            reason = check_recovered(host, port, workers, reference)
+            if reason is None:
+                recovered = True
+                recovered_s = round(
+                    max(time.monotonic() - last_fault_t, 0.0), 2
+                )
+                break
+            time.sleep(0.3)
+        checker.stop.set()
+        checker.join(timeout=5)
+        if not recovered:
+            violations.append(
+                f"no clean recovery within {recovery_window_s}s after the "
+                f"last fault (last reason: {reason})"
+            )
+        else:
+            log(f"recovered {recovered_s}s after the last fault")
+
+        # -- aggregate + judge ----------------------------------------------
+        status_counts: dict[str, int] = dict(checker.status_counts)
+        errors = transport = 0
+        p99_ms = 0.0
+        for step in load.steps:
+            errors += step["errors"]
+            transport += step["transport_errors"]
+            p99_ms = max(p99_ms, step["p99_ms"])
+            for k, v in step["status_counts"].items():
+                status_counts[k] = status_counts.get(k, 0) + v
+        transport += checker.transport_errors
+        requests = sum(status_counts.values()) + transport
+        shed = sum(status_counts.get(s, 0) for s in SHED_STATUSES)
+        hard = sum(
+            v for k, v in status_counts.items()
+            if k.startswith("5") and k not in SHED_STATUSES
+        )
+        hard_rate = hard / max(requests, 1)
+        transport_rate = transport / max(requests, 1)
+
+        if checker.wrong_bytes:
+            violations.append(
+                f"{checker.wrong_bytes} WRONG-BYTE responses: "
+                f"{checker.mismatches}"
+            )
+        if hard_rate > error_budget:
+            violations.append(
+                f"hard error rate {hard_rate:.4f} over budget "
+                f"{error_budget} ({hard} hard errors / {requests} requests; "
+                f"statuses {status_counts})"
+            )
+        if transport_rate > transport_budget:
+            violations.append(
+                f"transport error rate {transport_rate:.4f} over budget "
+                f"{transport_budget} ({transport}/{requests})"
+            )
+        if p99_ms > p99_budget_ms:
+            violations.append(
+                f"p99 {p99_ms}ms over the brownout contract "
+                f"{p99_budget_ms}ms"
+            )
+        breaker_trips = 0
+        try:
+            status, metrics = get(host, port, "/metrics", timeout=3.0)
+            if status == 200:
+                m_trips = re.search(
+                    r"avdb_serve_breaker_trips_total (\d+)", metrics
+                )
+                breaker_trips = int(m_trips.group(1)) if m_trips else 0
+        except OSError:
+            pass
+        if args.smoke and breaker_trips < 1:
+            # single worker => deterministic: the eio burst MUST have
+            # tripped the breaker (and recovery already proved it
+            # re-closed) — a schedule that never bit proves nothing
+            violations.append(
+                "device-EIO phase never tripped the circuit breaker"
+            )
+        if not args.smoke:
+            joined = "".join(stderr_lines)
+            if "restart #" not in joined:
+                violations.append(
+                    "supervisor never restarted a worker (kill/wedge "
+                    "phases did not bite)"
+                )
+            if "wedged" not in joined:
+                violations.append(
+                    "watchdog never detected the wedged worker"
+                )
+
+        record = {
+            "mode": mode,
+            "workers": workers,
+            "duration_s": round(duration_s, 1),
+            "offered_qps": qps,
+            "requests": int(requests),
+            "ok": int(status_counts.get("200", 0)),
+            "errors": int(errors),
+            "hard_errors": int(hard),
+            "shed": int(shed),
+            "transport_errors": int(transport),
+            "status_counts": status_counts,
+            "wrong_bytes": int(checker.wrong_bytes),
+            "p99_ms": round(p99_ms, 3),
+            "p99_budget_ms": p99_budget_ms,
+            "error_rate": round(hard_rate, 5),
+            "error_budget": error_budget,
+            "transport_rate": round(transport_rate, 5),
+            "transport_budget": transport_budget,
+            "faults": faults_armed,
+            "breaker_trips": int(breaker_trips),
+            "recovered": recovered,
+            "recovered_s": recovered_s,
+            "recovery_window_s": recovery_window_s,
+            "violations": violations,
+        }
+        return record, violations
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="chaos/soak certification for the serve stack"
+    )
+    parser.add_argument("--smoke", action="store_true",
+                        help="<=30s tier-1 smoke: 1 worker, 2 fault "
+                             "points, no process kills")
+    parser.add_argument("--duration", type=float, default=None,
+                        help="load duration in seconds (default: 8 smoke, "
+                             "40 full)")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="write the chaos record as JSON to PATH "
+                             "('-' = stdout)")
+    args = parser.parse_args(argv)
+    try:
+        record, violations = run(args)
+    except Exception as exc:
+        log(f"HARNESS ERROR: {type(exc).__name__}: {exc}")
+        return 2
+    if args.json:
+        text = json.dumps(record, indent=None)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+    for v in violations:
+        log(f"VIOLATION: {v}")
+    if not violations:
+        log(f"{record['mode']}: contract held — {record['ok']} ok / "
+            f"{record['requests']} requests, {record['shed']} shed, "
+            f"{record['hard_errors']} hard, "
+            f"{record['transport_errors']} transport, p99 "
+            f"{record['p99_ms']}ms, recovered in {record['recovered_s']}s")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,6 +180,42 @@ def _check_serving(sv, where: str, errors: list) -> None:
             )
     if "open_loop" in sv:
         _check_open_loop(sv["open_loop"], w, errors)
+    if "chaos" in sv and isinstance(sv["chaos"], dict) \
+            and "error" not in sv["chaos"]:
+        _check_chaos(sv["chaos"], w, errors)
+
+
+def _check_chaos(ch: dict, where: str, errors: list) -> None:
+    """The PR-7 chaos/soak certification block: fault schedule + error
+    budgets + recovery evidence from ``tools/chaos_soak.py``."""
+    w = f"{where}.chaos"
+    _check_fields(
+        ch,
+        {
+            "mode": lambda v: isinstance(v, str),
+            "workers": _is_int, "duration_s": _is_num,
+            "offered_qps": _is_num, "requests": _is_int, "ok": _is_int,
+            "errors": _is_int, "hard_errors": _is_int, "shed": _is_int,
+            "transport_errors": _is_int, "wrong_bytes": _is_int,
+            "p99_ms": _is_num, "p99_budget_ms": _is_num,
+            "error_rate": _is_num, "error_budget": _is_num,
+            "transport_rate": _is_num, "transport_budget": _is_num,
+            "faults": lambda v: isinstance(v, list)
+            and all(isinstance(s, str) for s in v),
+            "recovered": lambda v: isinstance(v, bool),
+            "recovered_s": _is_num, "recovery_window_s": _is_num,
+            "violations": lambda v: isinstance(v, list),
+            "status_counts": lambda v: isinstance(v, dict)
+            and all(_is_int(n) for n in v.values()),
+        },
+        w, errors,
+        required=("requests", "wrong_bytes", "error_rate", "error_budget",
+                  "recovered", "recovered_s", "faults"),
+    )
+    if _is_num(ch.get("error_rate")) and not 0 <= ch["error_rate"] <= 1:
+        errors.append(f"{w}.error_rate: must be a ratio in [0, 1]")
+    if _is_int(ch.get("wrong_bytes")) and ch["wrong_bytes"] < 0:
+        errors.append(f"{w}.wrong_bytes: negative count")
 
 
 def _check_open_loop(ol, where: str, errors: list) -> None:
@@ -219,6 +255,9 @@ def _check_open_loop(ol, where: str, errors: list) -> None:
                 step,
                 {"offered_qps": _is_num, "achieved_qps": _is_num,
                  "p50_ms": _is_num, "p99_ms": _is_num, "errors": _is_int,
+                 "transport_errors": _is_int,
+                 "status_counts": lambda v: isinstance(v, dict)
+                 and all(_is_int(n) for n in v.values()),
                  "requests": _is_int, "seconds": _is_num},
                 sw, errors,
                 required=("offered_qps", "achieved_qps", "p99_ms"),

@@ -11,6 +11,9 @@
 #   3. check_bench_schema — committed BENCH_*.json records stay loadable
 #   4. serve_smoke — the HTTP query API answers point/region/metrics
 #                    against a tiny store on an ephemeral loopback port
+#   5. chaos_soak --smoke — a 1-worker fleet under open-loop load with
+#                    injected drain latency + a device-EIO breaker trip:
+#                    zero wrong bytes, bounded errors, clean recovery
 #
 # Exit: 0 all clean, 1 any check found problems.
 
@@ -37,6 +40,9 @@ python "$root/tools/check_bench_schema.py" || rc=1
 
 echo "== serve smoke ==" >&2
 python "$root/tools/serve_smoke.py" || rc=1
+
+echo "== chaos smoke ==" >&2
+python "$root/tools/chaos_soak.py" --smoke || rc=1
 
 if [ "$rc" -eq 0 ]; then
     echo "run_checks: all checks clean" >&2
