@@ -13,6 +13,8 @@ Usage::
         --workers 4 --hbmBudget 2g          # multi-process fleet
     curl localhost:8080/variant/8:1000:A:G
     curl 'localhost:8080/region/8:1000-250000?minCadd=20'
+    curl -d '{"regions":["8:1000-2000","8:9000-9500"],"limit":50}' \\
+        localhost:8080/regions              # batch region join (BITS)
 
 ``--port 0`` binds an ephemeral port (printed on startup) — the smoke/test
 mode.  ``--workers N`` (default ``AVDB_SERVE_WORKERS`` or 1) runs the

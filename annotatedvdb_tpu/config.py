@@ -83,6 +83,13 @@ ENV_VARS: dict = {
     "AVDB_SERVE_REGION_CACHE": "LRU capacity of the rendered hot-region "
                                "cache, keyed by store generation "
                                "(default 64; 0 disables)",
+    "AVDB_SERVE_REGIONS_MAX": "max query intervals per POST /regions batch "
+                              "(default 4096; over-cap batches are 400)",
+    "AVDB_SERVE_REGIONS_DEVICE_MIN": "min intervals per chromosome group "
+                                     "before the batched BITS kernel "
+                                     "engages (default 32; smaller groups "
+                                     "take the byte-identical host path, "
+                                     "0 sends every group to the device)",
     "AVDB_SERVE_WORKERS": "serve fleet size: N>1 runs N worker processes "
                           "sharing the port and one readonly store "
                           "generation (default 1)",

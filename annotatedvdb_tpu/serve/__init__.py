@@ -25,9 +25,11 @@ Entry point: ``python -m annotatedvdb_tpu serve --storeDir <dir>``.
 
 from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
 from annotatedvdb_tpu.serve.engine import (
+    IntervalIndex,
     QueryEngine,
     QueryError,
     RegionPage,
+    RegionsResult,
     parse_region,
     parse_variant_id,
     render_variant,
@@ -46,8 +48,10 @@ from annotatedvdb_tpu.serve.snapshot import (
 )
 
 __all__ = [
-    "DeadlineExceeded", "DeviceBreaker", "OverloadGovernor", "PointCache",
+    "DeadlineExceeded", "DeviceBreaker", "IntervalIndex",
+    "OverloadGovernor", "PointCache",
     "QueryBatcher", "QueueFull", "QueryEngine", "QueryError", "RegionPage",
-    "ResidencyManager", "SnapshotManager", "StaticSnapshots",
-    "StoreSnapshot", "parse_region", "parse_variant_id", "render_variant",
+    "RegionsResult", "ResidencyManager", "SnapshotManager",
+    "StaticSnapshots", "StoreSnapshot", "parse_region", "parse_variant_id",
+    "render_variant",
 ]

@@ -72,9 +72,11 @@ from annotatedvdb_tpu.serve.engine import (
 )
 from annotatedvdb_tpu.serve.http import (
     _RETURNED_RE,
+    REGIONS_BODY_ERROR,
     ServeContext,
     healthz_payload,
     parse_region_params,
+    parse_regions_body,
     readyz_payload,
     stats_payload,
 )
@@ -876,13 +878,13 @@ class AioServer:
         if isinstance(result, bytes):
             out += result
             return
-        page = result[1]
+        page = result[1]  # RegionPage or RegionsResult: same stream surface
         try:
             if out:  # ordering: everything before the stream goes first
                 writer.write(bytes(out))
                 out.clear()
             await self._stream_region(writer, page)
-            self.ctx.observe("region", time.perf_counter() - t0,
+            self.ctx.observe(qkind, time.perf_counter() - t0,
                              rows=page.returned)
         finally:
             self.ctx.release()
@@ -1039,15 +1041,18 @@ class AioServer:
                 length = int(headers.get("content-length", 0))
             except ValueError:
                 # parity with the threaded front end: a malformed
-                # Content-Length is a bad bulk request (400), not a
-                # too-large one; the body length is unknowable, so the
-                # connection cannot be reused
+                # Content-Length is a bad body-carrying request (400),
+                # not a too-large one; the body length is unknowable, so
+                # the connection cannot be reused
                 if path == "/variants":
                     ctx.errored("bulk")
                     return _error(400, (
                         'bulk body must be '
                         '{"ids": ["chr:pos:ref:alt", ...]}'
                     )), False
+                if path == "/regions":
+                    ctx.errored("regions")
+                    return _error(400, REGIONS_BODY_ERROR), False
                 return _error(404, f"no such route: {path}"), False
             if length < 0 or length > MAX_BODY:
                 return _error(
@@ -1074,6 +1079,25 @@ class AioServer:
                     client, weight = self._client_key(headers, writer)
                     max_ids = self.governor.bulk_budget(weight)
                 return self._bulk_item(body, client, max_ids, deadline_t), keep
+            if path == "/regions":
+                if ctx.governor.shed_bulk():
+                    ctx.brownout_shed()
+                    return _error(503, "brownout: region reads shed "
+                                       "(point reads keep serving)"), keep
+                retry = self._admit_client(headers, writer)
+                if retry:
+                    ctx.rejected("regions")
+                    return _error(
+                        429, "client over rate (region admission)",
+                        retry_after=max(int(retry + 0.999), 1),
+                    ), keep
+                client = max_ids = None
+                if self.governor is not None:
+                    client, weight = self._client_key(headers, writer)
+                    max_ids = self.governor.bulk_budget(weight)
+                return self._regions_item(
+                    body, http11, client, max_ids, deadline_t
+                ), keep
             if path == "/_chaos" and self._chaos_enabled:
                 return self._chaos_item(body), keep
             return _error(404, f"no such route: {path}"), keep
@@ -1238,6 +1262,93 @@ class AioServer:
         finally:
             ctx.release()
 
+    def _regions_item(self, body: bytes, http11: bool = True,
+                      client: str | None = None, max_ids: int | None = None,
+                      deadline_t: float | None = None):
+        """Batch region join: the bulk admission shape (slot + per-client
+        budget) with the region streaming shape (a panel whose total row
+        count exceeds the threshold streams chunked)."""
+        ctx = self.ctx
+        t0 = time.perf_counter()
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            return _error(504, "deadline exhausted at admission")
+        if not ctx.admit():
+            ctx.rejected("regions")
+            return _error(429, "server at capacity (region admission bound)",
+                          retry_after=1)
+        fut = self._loop.run_in_executor(
+            self._pool, self._regions_work, body, t0, http11, client,
+            max_ids, deadline_t
+        )
+        return ("exec", fut, "regions", t0)
+
+    def _regions_work(self, body: bytes, t0: float, http11: bool = True,
+                      client: str | None = None,
+                      max_ids: int | None = None,
+                      deadline_t: float | None = None):
+        """Executor half of a batch-region request.  Returns response
+        bytes, or ``("stream", RegionsResult)`` for a panel whose total
+        rendered rows exceed the stream threshold — the writer streams
+        per-interval envelopes chunked and releases the admission slot
+        when the body is done (exactly the single-region stream
+        contract)."""
+        ctx = self.ctx
+        stream_holds_slot = False
+        try:
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                ctx.deadline_shed("execute")
+                return _error(504, "deadline exhausted before execution")
+            try:
+                specs, min_cadd, max_rank, limit, tokenize = \
+                    parse_regions_body(body)
+            except QueryError as err:
+                ctx.errored("regions")
+                return _error(400, str(err))
+            if max_ids is not None and len(specs) > max_ids:
+                # same bounded-debt contract as bulk /variants: a panel
+                # the bucket could never repay within MAX_DEBT_S is
+                # rejected before any scan runs
+                ctx.rejected("regions")
+                return _error(429, (
+                    f"regions batch of {len(specs)} exceeds client rate "
+                    f"budget ({max_ids} intervals); split the request"
+                ), retry_after=1)
+            if client is not None and len(specs) > 1:
+                # admission spent ONE token; the other intervals debit
+                # the bucket too (on the loop thread — the governor is
+                # single-threaded by construction)
+                self._loop.call_soon_threadsafe(
+                    self.governor.charge, client, float(len(specs) - 1)
+                )
+            try:
+                cap = ctx.governor.region_limit_cap()
+                if cap is not None:
+                    # brownout level >= 1: bound per-interval render work
+                    limit = min(limit, cap)
+                result = ctx.engine.regions_serve(
+                    specs,
+                    min_cadd=min_cadd,
+                    max_conseq_rank=max_rank,
+                    limit=limit,
+                    tokenize=tokenize,
+                )
+            except QueryError as err:
+                ctx.errored("regions")
+                return _error(400, str(err))
+            except Exception as err:
+                ctx.errored("regions")
+                return _error(500, f"{type(err).__name__}: {err}")
+            if http11 and result.returned > self.stream_threshold:
+                stream_holds_slot = True
+                return ("stream", result)  # the writer releases that slot
+            ctx.observe("regions", time.perf_counter() - t0,
+                        rows=result.returned)
+            return _resp(200, result.assemble())
+        finally:
+            if not stream_holds_slot:
+                ctx.release()
+
     def _region_item(self, spec: str, query: str, http11: bool = True,
                      deadline_t: float | None = None):
         ctx = self.ctx
@@ -1345,7 +1456,9 @@ class AioServer:
     # -- streaming ----------------------------------------------------------
 
     async def _stream_region(self, writer, page) -> None:
-        """Chunked transfer of one RegionPage: prefix, rows in
+        """Chunked transfer of one RegionPage — or one RegionsResult,
+        whose "rows" are whole per-interval envelopes (same
+        prefix/rows/suffix surface): prefix, rows in
         ``_STREAM_ROWS_PER_CHUNK`` batches (rendered lazily — RSS holds
         one batch, not the body), suffix.  De-chunked, the bytes are
         exactly ``page.assemble()``.
@@ -1363,6 +1476,7 @@ class AioServer:
         )
         _write_chunk(writer, page.prefix().encode())
         buf: list[str] = []
+        buf_bytes = 0
         first = True
         truncated = cancelled = False
         try:
@@ -1373,10 +1487,16 @@ class AioServer:
                     truncated = True
                     break
                 buf.append(("" if first else ",") + row)
+                buf_bytes += len(buf[-1])
                 first = False
-                if len(buf) >= _STREAM_ROWS_PER_CHUNK:
+                # flush on a byte bound too: a RegionsResult "row" is a
+                # whole per-interval envelope, and 256 of those must not
+                # accumulate panel-sized RSS before the first write
+                if len(buf) >= _STREAM_ROWS_PER_CHUNK \
+                        or buf_bytes >= _WRITE_HIGH_WATER:
                     _write_chunk(writer, "".join(buf).encode())
                     buf.clear()
+                    buf_bytes = 0
                     await writer.drain()  # flow control + loop fairness
         except asyncio.CancelledError:
             # the drain budget expired with this stream still writing:

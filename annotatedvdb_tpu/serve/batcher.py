@@ -69,6 +69,31 @@ def resolve_batch_knobs(max_batch, max_wait_s, max_queue):
             max(int(max_queue), 0))
 
 
+def resolve_regions_knobs(regions_max, device_min):
+    """The region-microbatching knobs, resolved in ONE place (the same
+    contract as :func:`resolve_batch_knobs` — both front ends and the
+    engine must see identical env defaults):
+
+    - ``AVDB_SERVE_REGIONS_MAX``        — max query intervals per
+      ``POST /regions`` batch (default 4096; an over-cap batch is a 400,
+      never an unbounded device call);
+    - ``AVDB_SERVE_REGIONS_DEVICE_MIN`` — min intervals per chromosome
+      group before the batched BITS kernel engages (default 32: smaller
+      groups — including every single ``GET /region`` — take the
+      byte-identical host searchsorted twin, which beats a device
+      dispatch at that size; 0 sends every group to the device).
+    """
+    if regions_max is None:
+        regions_max = int(
+            os.environ.get("AVDB_SERVE_REGIONS_MAX", "") or 4096
+        )
+    if device_min is None:
+        device_min = int(
+            os.environ.get("AVDB_SERVE_REGIONS_DEVICE_MIN", "") or 32
+        )
+    return max(int(regions_max), 1), max(int(device_min), 0)
+
+
 class _Pending:
     """One caller's query in flight: the drain thread fills ``result`` or
     ``error`` then sets ``done`` (the Event publishes the write).  An

@@ -32,6 +32,20 @@ materializes parsed trees back into the column).
 Rendered region responses sit in a small LRU keyed by store generation
 (``AVDB_SERVE_REGION_CACHE``), so a hot region costs one dict probe until
 the next loader commit swaps the generation and naturally invalidates it.
+
+**Batched interval intersection (BITS).**  Region reads — single AND
+batched — resolve through a per-generation :class:`IntervalIndex`: one
+position-sorted, first-wins-deduplicated ``(pos, segment, row)`` view per
+chromosome group, against which every query interval is two sorted-
+endpoint binary searches (``ops/intervals``: the BITS kernel, arXiv
+1208.3407).  :meth:`QueryEngine.regions_serve` answers thousands of
+intervals in ONE device call per touched chromosome group — per-interval
+envelopes byte-identical to N sequential :meth:`QueryEngine.region`
+calls, a count-only mode that never materializes rows (a span width IS
+the post-dedup count), and an interval-tokenization output (per-interval
+bin token + row-id span, fixed-width arrays) for ML consumers.  The
+device circuit breaker and ``host_only=True`` route the searches to a
+byte-identical numpy twin.
 """
 
 from __future__ import annotations
@@ -47,6 +61,8 @@ from collections import OrderedDict
 import numpy as np
 
 from annotatedvdb_tpu.loaders.lookup import identity_hashes
+from annotatedvdb_tpu.ops import intervals as interval_ops
+from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
 from annotatedvdb_tpu.oracle.binindex import closed_form_path
 from annotatedvdb_tpu.store.variant_store import (
     _DIGEST_PK,
@@ -184,9 +200,16 @@ def _region_bin(start: int, end: int) -> tuple[int, int]:
     closed-form device kernel, batched [1] and memoized (hot regions skip
     the dispatch; the LRU also absorbs the one-time trace cost).  The test
     suite cross-checks this answer against the scalar host oracle
-    (``oracle.binindex.closed_form_bin``) per region query."""
-    from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
-
+    (``oracle.binindex.closed_form_bin``) per region query.  The kernel
+    import lives at module top: this function runs once per region
+    REQUEST (cache miss), and a per-call import-machinery lookup is
+    measurable at serving QPS.  Bounds clamp below the int32 position
+    sentinel EXACTLY like the batched span paths (``_clamped_queries``):
+    no store position can reach the clamp, the int32 cast can never
+    overflow on an absurd-but-grammatical bound, and the single and
+    batch routes stay byte-identical on such specs."""
+    start = min(int(start), interval_ops.MAX_QUERY_POS)
+    end = min(int(end), interval_ops.MAX_QUERY_POS)
     level, leaf = bin_index_kernel_jit(
         np.asarray([start], np.int32), np.asarray([end], np.int32)
     )
@@ -341,6 +364,168 @@ class RegionPage:
         return self.prefix() + ",".join(self.rows()) + self.suffix()
 
 
+class IntervalIndex:
+    """One chromosome group's deduplicated, position-sorted row view —
+    the BITS "database" every interval query searches against.
+
+    Built once per (store generation, chromosome): every segment's rows
+    concatenated, ordered by (pos, hash, segment age) and first-wins
+    deduplicated EXACTLY as :meth:`QueryEngine._region_rows` resolves a
+    single region — so a query's ``[lo, hi)`` span over ``pos`` is the
+    region's post-dedup match list verbatim, a span width is the exact
+    region count, and an N-interval panel shares one O(n log n) build
+    instead of paying N per-query dedup passes.  The common case (no
+    cross-segment (pos, hash) collisions — loader-deduplicated stores)
+    builds with three vectorized numpy ops; when collisions exist, the
+    per-row Python identity walk runs over ONLY the colliding (pos, hash)
+    runs (a singleton row can never be a duplicate), so one shadowed
+    duplicate on a 100M-row chromosome costs a few rows of Python, not a
+    full-chromosome loop.  The run-walk is ``_region_rows``'s dedup
+    policy verbatim — the parity suite pins them byte-identical.
+
+    ``device_pos()`` lazily uploads the sentinel-padded position array
+    once per index, so a panel's kernel calls re-use the resident copy
+    instead of re-shipping the index per request."""
+
+    __slots__ = ("pos", "si", "jj", "_dev_pos")
+
+    def __init__(self, pos, si, jj):
+        self.pos = pos  # [K] int32, sorted
+        self.si = si    # [K] int32 segment index per kept row
+        self.jj = jj    # [K] int64 local row per kept row
+        self._dev_pos = None
+
+    @property
+    def n(self) -> int:
+        return int(self.pos.shape[0])
+
+    @classmethod
+    def build(cls, shard) -> "IntervalIndex":
+        pos_parts, h_parts, si_parts, jj_parts = [], [], [], []
+        for si, seg in enumerate(shard.segments):
+            if seg.n == 0:
+                continue
+            pos_parts.append(seg.cols["pos"])
+            h_parts.append(seg.cols["h"])
+            si_parts.append(np.full(seg.n, si, np.int32))
+            jj_parts.append(np.arange(seg.n, dtype=np.int64))
+        if not pos_parts:
+            return cls(np.empty(0, np.int32), np.empty(0, np.int32),
+                       np.empty(0, np.int64))
+        pos = np.concatenate(pos_parts)
+        h = np.concatenate(h_parts)
+        si = np.concatenate(si_parts)
+        jj = np.concatenate(jj_parts)
+        order = np.lexsort((si, h, pos))
+        ps, hs = pos[order], h[order]
+        same = (ps[1:] == ps[:-1]) & (hs[1:] == hs[:-1])
+        if not bool(np.any(same)):
+            # no (pos, hash) collision anywhere: duplicates are impossible
+            # and the sorted view IS the dedup'd view (vectorized path)
+            return cls(np.ascontiguousarray(ps),
+                       np.ascontiguousarray(si[order]),
+                       np.ascontiguousarray(jj[order]))
+        # collision case: only members of a multi-row (pos, hash) run can
+        # be duplicates — walk those rows (and only those) with the exact
+        # first-wins identity compare of _region_rows
+        run_member = np.zeros(order.shape[0], bool)
+        run_member[1:] |= same
+        run_member[:-1] |= same
+        keep = np.ones(order.shape[0], bool)
+        run_key = None
+        run_seen: list = []  # identities kept for the current (pos, h)
+        si_o, jj_o = si[order], jj[order]
+        for t in np.nonzero(run_member)[0].tolist():
+            key = (int(ps[t]), int(hs[t]))
+            if key != run_key:
+                run_key, run_seen = key, []
+            seg = shard.segments[int(si_o[t])]
+            j = int(jj_o[t])
+            ident = (
+                int(seg.cols["ref_len"][j]), int(seg.cols["alt_len"][j]),
+                seg.ref[j].tobytes(), seg.alt[j].tobytes(),
+            )
+            if ident in run_seen:  # shadowed duplicate in a newer segment
+                keep[t] = False
+            else:
+                run_seen.append(ident)
+        return cls(np.ascontiguousarray(ps[keep]),
+                   np.ascontiguousarray(si_o[keep]),
+                   np.ascontiguousarray(jj_o[keep]))
+
+    def device_pos(self):
+        """The sentinel-padded position array on device (uploaded once;
+        a failure propagates to the caller, which falls back host-side
+        and feeds the circuit breaker)."""
+        if self._dev_pos is None:
+            import jax
+
+            from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
+
+            self._dev_pos = jax.device_put(
+                pad_pow2(self.pos, POS_SENTINEL)
+            )
+        return self._dev_pos
+
+    def device_bytes(self) -> int:
+        """Bytes the retained device copy occupies (0 when none): the
+        pow2-padded int32 position array."""
+        if self._dev_pos is None:
+            return 0
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        return next_pow2(self.n) * 4
+
+    def drop_device(self) -> None:
+        """Forget a (possibly half-built) device copy after a failed
+        kernel call or a budget eviction — the next device attempt
+        re-uploads cleanly (host arrays stay; correctness is
+        unaffected)."""
+        self._dev_pos = None
+
+
+class RegionsResult:
+    """One prepared batch-region answer: per-interval envelopes (each a
+    :class:`RegionPage`, byte-identical to its single-``region()`` call)
+    in request order, wrapped as ``{"n": N[, "tokens": {...}],
+    "results": [...]}``.  Same prefix/rows/suffix surface as
+    :class:`RegionPage`, so the streaming writer handles both shapes —
+    ``rows()`` yields one assembled per-interval envelope at a time (RSS
+    holds one interval's body, not the panel's)."""
+
+    __slots__ = ("pages", "tokens")
+
+    def __init__(self, pages: list, tokens: dict | None = None):
+        self.pages = pages
+        self.tokens = tokens
+
+    @property
+    def returned(self) -> int:
+        """Total rows rendered across the batch (the streaming-threshold
+        and metrics row count)."""
+        return sum(p.returned for p in self.pages)
+
+    def prefix(self) -> str:
+        head = f'{{"n":{len(self.pages)}'
+        if self.tokens is not None:
+            tok = ",".join(
+                f'"{k}":{json.dumps(v, separators=(",", ":"))}'
+                for k, v in self.tokens.items()
+            )
+            head += ',"tokens":{' + tok + "}"
+        return head + ',"results":['
+
+    def rows(self):
+        for page in self.pages:
+            yield page.assemble()
+
+    def suffix(self) -> str:
+        return "]}"
+
+    def assemble(self) -> str:
+        return self.prefix() + ",".join(self.rows()) + self.suffix()
+
+
 class QueryEngine:
     """Point/bulk/region queries over a snapshot provider
     (:class:`~annotatedvdb_tpu.serve.snapshot.SnapshotManager` in a server,
@@ -359,11 +544,29 @@ class QueryEngine:
     #: entries x record-size of RSS in a long-lived gc.freeze'd process
     POINT_RENDER_CACHE_BYTES = 64 << 20
 
+    #: retained interval indexes (one per (generation, chromosome); a
+    #: generation swap naturally ages the old entries out of the LRU)
+    INDEX_CACHE = 64
+    #: byte ceiling on RETAINED device copies of interval indexes (the
+    #: BITS kernel's search arrays, which live OUTSIDE the residency
+    #: manager's ``--hbmBudget`` plan): beyond it the least-recently-used
+    #: indexes drop their device copy — host arrays stay, answers are
+    #: byte-identical, only the re-upload cost returns.  Without this a
+    #: 64-entry count bound could pin 64 x chromosome-sized position
+    #: arrays of HBM on a large store.
+    INDEX_DEVICE_BYTES = 256 << 20
+
     def __init__(self, snapshots, registry=None,
                  region_cache_size: int | None = None, residency=None,
-                 breaker=None):
+                 breaker=None, regions_max: int | None = None,
+                 regions_device_min: int | None = None):
+        from annotatedvdb_tpu.serve.batcher import resolve_regions_knobs
+
         self.snapshots = snapshots
         self.residency = residency
+        self.regions_max, self.regions_device_min = resolve_regions_knobs(
+            regions_max, regions_device_min
+        )
         #: device-path circuit breaker (serve/resilience.DeviceBreaker) —
         #: None keeps the store's legacy one-failure-latches-host behavior
         self.breaker = breaker
@@ -386,6 +589,19 @@ class QueryEngine:
         #: (si, j) int64 arrays of the walk's post-filter matches, so an
         #: N-page cursor walk scans the region once, not once per page
         self._walk_cache: OrderedDict = OrderedDict()
+        #: guarded by self._cache_lock; (generation, code) ->
+        #: :class:`IntervalIndex` (the BITS search database per group)
+        self._index_cache: OrderedDict = OrderedDict()
+        #: guarded by self._cache_lock; id(index) -> (index, bytes) for
+        #: indexes holding a device copy — the INDEX_DEVICE_BYTES ledger
+        self._index_device: OrderedDict = OrderedDict()
+        #: serializes interval-index BUILDS (not lookups): after a
+        #: generation swap every concurrent region request misses the
+        #: cache at once, and a full-chromosome lexsort is seconds of CPU
+        #: and a multiple of the shard's RAM — N duplicate builds would
+        #: be an N-fold memory spike for identical results.  Losers wait
+        #: and take the winner's entry from the cache.
+        self._index_build_lock = threading.Lock()
         if registry is not None:
             self._cache_hits = registry.counter(
                 "avdb_query_cache_hits_total",
@@ -520,22 +736,27 @@ class QueryEngine:
     # -- region -------------------------------------------------------------
 
     def region(self, spec: str, min_cadd=None, max_conseq_rank=None,
-               limit: int | None = None, cursor: str | None = None) -> str:
+               limit: int | None = None, cursor: str | None = None,
+               host_only: bool = False) -> str:
         """JSON text answering ``chr:start-end`` (with optional filters):
         ``{"region", "bin_level", "bin_index", "count", "returned",
         "generation", "variants": [...]}``.  ``count`` is the post-filter
         match total; ``variants`` carries the first ``limit`` of them.
         With ``cursor`` (``""`` starts a paged walk, a returned token
-        continues it) the envelope additionally carries ``"next"``."""
+        continues it) the envelope additionally carries ``"next"``.
+        ``host_only=True`` pins the interval search to the numpy twin
+        (byte-identical — the circuit breaker's path)."""
         kind, payload = self.region_serve(
             spec, min_cadd=min_cadd, max_conseq_rank=max_conseq_rank,
             limit=limit, cursor=cursor, stream_threshold=None,
+            host_only=host_only,
         )
         return payload if kind == "text" else payload.assemble()
 
     def region_serve(self, spec: str, min_cadd=None, max_conseq_rank=None,
                      limit: int | None = None, cursor: str | None = None,
-                     stream_threshold: int | None = None):
+                     stream_threshold: int | None = None,
+                     host_only: bool = False):
         """The front ends' region entry point: ``("text", str)`` for
         responses small enough to buffer (cache-eligible when unpaged), or
         ``("page", RegionPage)`` when the row count exceeds
@@ -554,7 +775,8 @@ class QueryEngine:
             if text is not None:
                 return "text", text
         page = self._region_page(
-            snap, code, start, end, min_cadd, max_conseq_rank, limit, cursor
+            snap, code, start, end, min_cadd, max_conseq_rank, limit,
+            cursor, host_only,
         )
         if stream_threshold is not None and page.returned > stream_threshold:
             return "page", page
@@ -563,13 +785,135 @@ class QueryEngine:
             self._cache_put(cache_key, text)
         return "text", text
 
+    def regions_serve(self, specs: list, min_cadd=None, max_conseq_rank=None,
+                      limit: int | None = None, tokenize: bool = False,
+                      host_only: bool = False) -> RegionsResult:
+        """Bulk region join: a batch of ``chr:start-end`` specs answered
+        with ONE BITS kernel call per touched chromosome group.
+
+        Returns a :class:`RegionsResult` whose per-interval envelopes are
+        **byte-identical** to ``len(specs)`` sequential :meth:`region`
+        calls with the same filters/limit, in request order.  Grammar is
+        validated up front — one bad spec fails the CALL with
+        :class:`QueryError` (the bulk-``/variants`` contract: co-batched
+        strangers never share a client's grammar error, because the front
+        end maps this to one 400 for the one caller).
+
+        ``limit=0`` with no filters is the pure count-only mode: counts
+        come straight from the kernel's span widths (the index is already
+        deduplicated) and NO row is ever located, filtered, or rendered.
+        ``tokenize=True`` adds the fixed-width interval-token arrays
+        (``bin_level``/``leaf_bin``/``bin_index`` path, ``row_lo``/
+        ``row_hi`` spans into the generation's interval index, pre-filter
+        ``count``) for ML consumers."""
+        if len(specs) > self.regions_max:
+            raise QueryError(
+                f"regions batch of {len(specs)} exceeds the "
+                f"{self.regions_max}-interval cap (AVDB_SERVE_REGIONS_MAX); "
+                "split the request"
+            )
+        parsed = [parse_region(s) for s in specs]
+        snap = self.snapshots.current()
+        if self.residency is not None:
+            self.residency.govern(snap)
+        # crash point: the batch is parsed, nothing executed — a failure
+        # here must fail exactly this batch's caller and leave the engine
+        # serving the next one
+        faults.fire("serve.regions")
+        by_code: dict[int, list[int]] = {}
+        for i, (code, _s, _e) in enumerate(parsed):
+            by_code.setdefault(code, []).append(i)
+        # per-interval kernel outputs, scattered back to request order
+        n = len(parsed)
+        lo = np.zeros(n, np.int64)
+        hi = np.zeros(n, np.int64)
+        level = np.zeros(n, np.int64)
+        leaf = np.zeros(n, np.int64)
+        indexes: dict[int, IntervalIndex | None] = {}
+        for code, idxs in by_code.items():
+            index = indexes[code] = self._interval_index(snap, code)
+            if index is None:
+                level[idxs], leaf[idxs] = interval_ops.bin_tokens_host(
+                    [parsed[i][1] for i in idxs],
+                    [parsed[i][2] for i in idxs],
+                )
+                continue
+            g_lo, g_hi, g_level, g_leaf = self._interval_spans(
+                index, code,
+                [parsed[i][1] for i in idxs],
+                [parsed[i][2] for i in idxs],
+                host_only,
+            )
+            lo[idxs], hi[idxs] = g_lo, g_hi
+            level[idxs], leaf[idxs] = g_level, g_leaf
+        no_filters = min_cadd is None and max_conseq_rank is None
+        pages = []
+        for i, (code, start, end) in enumerate(parsed):
+            index = indexes[code]
+            shard = snap.store.shards.get(code)
+            label = chromosome_label(code)
+            i_lo, i_hi = int(lo[i]), int(hi[i])
+            span = i_hi - i_lo
+            if index is None:
+                kept: list = []
+                count = 0
+            elif no_filters:
+                # the index is deduplicated, so the span width IS the
+                # post-filter count — materialize ONLY the rows that will
+                # render (limit=0 is the pure count-only mode: none)
+                count = span
+                take = span if limit is None \
+                    else min(max(int(limit), 0), span)
+                kept = list(zip(index.si[i_lo:i_lo + take].tolist(),
+                                index.jj[i_lo:i_lo + take].tolist()))
+            else:
+                kept = list(zip(index.si[i_lo:i_hi].tolist(),
+                                index.jj[i_lo:i_hi].tolist()))
+                kept = [
+                    (si, j) for si, j in kept
+                    if self._passes(shard.segments[si], j,
+                                    min_cadd, max_conseq_rank)
+                ]
+                count = len(kept)
+            stop = len(kept) if limit is None \
+                else min(max(int(limit), 0), len(kept))
+            pages.append(RegionPage(
+                shard, label, int(level[i]),
+                closed_form_path(label, int(level[i]), int(leaf[i])),
+                count, snap.generation, kept[:stop],
+                f"{label}:{start}-{end}", None, paged=False,
+            ))
+        tokens = None
+        if tokenize:
+            tokens = {
+                "generation": snap.generation,
+                "bin_level": level.tolist(),
+                "leaf_bin": leaf.tolist(),
+                "bin_index": [
+                    _bin_path(chromosome_label(parsed[i][0]),
+                              int(level[i]), int(leaf[i]))
+                    for i in range(n)
+                ],
+                "row_lo": [
+                    int(lo[i]) if indexes[parsed[i][0]] is not None else -1
+                    for i in range(n)
+                ],
+                "row_hi": [
+                    int(hi[i]) if indexes[parsed[i][0]] is not None else -1
+                    for i in range(n)
+                ],
+                "count": (hi - lo).tolist(),
+            }
+        return RegionsResult(pages, tokens)
+
     #: distinct in-flight cursor walks whose match lists stay cached
     #: (two compact int64 arrays per walk, LRU; stale generations age out)
     WALK_CACHE = 8
 
     def _region_page(self, snap, code, start, end,
                      min_cadd, max_conseq_rank, limit,
-                     cursor: str | None) -> RegionPage:
+                     cursor: str | None, host_only: bool = False
+                     ) -> RegionPage:
         label = chromosome_label(code)
         level, leaf = _region_bin(start, end)
         shard = snap.store.shards.get(code)
@@ -582,10 +926,30 @@ class QueryEngine:
                 hit = self._walk_cache.get(wkey)
                 if hit is not None:
                     self._walk_cache.move_to_end(wkey)
+        full_count = None
         if hit is None:
             kept: list[tuple[int, int]] = []  # (segment index, local row)
-            if shard is not None and shard.n:
-                kept = self._region_rows(shard, start, end)
+            index = self._interval_index(snap, code)
+            if index is not None:
+                # the single-region route rides the SAME interval-index +
+                # BITS-span machinery as the batch API (one query is just
+                # a panel of one); the breaker/host_only fallback is
+                # byte-identical
+                lo, hi, _lvl, _leaf = self._interval_spans(
+                    index, code, [start], [end], host_only
+                )
+                i_lo, i_hi = int(lo[0]), int(hi[0])
+                if not paged and min_cadd is None \
+                        and max_conseq_rank is None:
+                    # dedup'd span width IS the count; no filter pass and
+                    # no walk cache to fill — materialize only the rows
+                    # that will render
+                    full_count = i_hi - i_lo
+                    take = full_count if limit is None \
+                        else min(max(int(limit), 0), full_count)
+                    i_hi = i_lo + take
+                kept = list(zip(index.si[i_lo:i_hi].tolist(),
+                                index.jj[i_lo:i_hi].tolist()))
             if min_cadd is not None or max_conseq_rank is not None:
                 kept = [
                     (si, j) for si, j in kept
@@ -628,9 +992,105 @@ class QueryEngine:
             else min(max(int(limit), 0), len(kept))
         return RegionPage(
             shard, label, level, closed_form_path(label, level, leaf),
-            len(kept), snap.generation, kept[:stop],
+            len(kept) if full_count is None else full_count,
+            snap.generation, kept[:stop],
             f"{label}:{start}-{end}", None, paged=False,
         )
+
+    # -- interval index (the BITS search database) ---------------------------
+
+    def _interval_index(self, snap, code: int) -> IntervalIndex | None:
+        """The (generation, chromosome) interval index, built lazily and
+        LRU-retained; ``None`` when the chromosome is unloaded or empty.
+        Stale generations age out of the cap like every other
+        generation-keyed cache here — their keys can never be probed
+        again."""
+        shard = snap.store.shards.get(code)
+        if shard is None or not shard.n:
+            return None
+        key = (snap.generation, code)
+        with self._cache_lock:
+            index = self._index_cache.get(key)
+            if index is not None:
+                self._index_cache.move_to_end(key)
+                return index
+        with self._index_build_lock:
+            # double-checked: the winner of the race built it while this
+            # thread waited — take the cached entry instead of paying a
+            # duplicate full-chromosome sort
+            with self._cache_lock:
+                index = self._index_cache.get(key)
+                if index is not None:
+                    self._index_cache.move_to_end(key)
+                    return index
+            index = IntervalIndex.build(shard)
+            evicted: list[IntervalIndex] = []
+            with self._cache_lock:
+                self._index_cache[key] = index
+                while len(self._index_cache) > self.INDEX_CACHE:
+                    _k, old = self._index_cache.popitem(last=False)
+                    # the device-byte ledger must not keep the evicted
+                    # index (and its HBM copy) alive behind the cache's
+                    # back
+                    if self._index_device.pop(id(old), None) is not None:
+                        evicted.append(old)
+        for old in evicted:
+            old.drop_device()
+        return index
+
+    def _device_spans(self, index: IntervalIndex, starts, ends):
+        """One batched BITS kernel call (test seam: monkeypatch to model
+        a failing device)."""
+        return interval_ops.interval_spans(
+            index.device_pos(), starts, ends, pos_padded=True
+        )
+
+    def _interval_spans(self, index: IntervalIndex, code: int,
+                        starts, ends, host_only: bool = False):
+        """(lo, hi, level, leaf) per query interval — the device kernel
+        when the batch is worth a dispatch and the group's circuit
+        breaker allows it, the byte-identical numpy twin otherwise.  A
+        device failure feeds the breaker (so a sick device stops being
+        attempted per panel) and falls back host-side: correct bytes
+        either way, the serving contract."""
+        breaker = self.breaker
+        if (not host_only
+                and len(starts) >= self.regions_device_min
+                and (breaker is None or breaker.allow_device(code))):
+            try:
+                out = self._device_spans(index, starts, ends)
+            except Exception as exc:
+                index.drop_device()
+                with self._cache_lock:
+                    self._index_device.pop(id(index), None)
+                if breaker is not None:
+                    breaker.record_failure(code, exc)
+            else:
+                if breaker is not None:
+                    breaker.record_success(code)
+                self._note_index_device(index)
+                return out
+        return interval_ops.interval_spans_host(index.pos, starts, ends)
+
+    def _note_index_device(self, index: IntervalIndex) -> None:
+        """Account the index's retained device copy against
+        ``INDEX_DEVICE_BYTES``, evicting the least-recently-used copies
+        past the ceiling (the just-used index always stays)."""
+        nbytes = index.device_bytes()
+        if not nbytes:
+            return
+        evicted: list[IntervalIndex] = []
+        with self._cache_lock:
+            self._index_device[id(index)] = (index, nbytes)
+            self._index_device.move_to_end(id(index))
+            total = sum(b for _i, b in self._index_device.values())
+            while total > self.INDEX_DEVICE_BYTES \
+                    and len(self._index_device) > 1:
+                _key, (old, b) = self._index_device.popitem(last=False)
+                evicted.append(old)
+                total -= b
+        for old in evicted:  # the device free happens off-lock
+            old.drop_device()
 
     @staticmethod
     def _region_rows(shard, start: int, end: int) -> list:
@@ -638,7 +1098,10 @@ class QueryEngine:
         duplicates resolved oldest-segment-first (the store's lookup
         policy).  Per segment this is two ``searchsorted`` calls — rows are
         (pos, hash)-sorted, so the position column is directly sliceable —
-        then one global lexsort over only the in-region rows."""
+        then one global lexsort over only the in-region rows.  This is the
+        ONE definition of the region dedup policy: serving traffic reads
+        it through the :class:`IntervalIndex` built from a full-span call
+        (collision case) or its vectorized equivalent (fast path)."""
         pos_parts, h_parts, si_parts, j_parts = [], [], [], []
         for si, seg in enumerate(shard.segments):
             if seg.n == 0:

@@ -14,6 +14,13 @@ deliberately small route surface:
                                       ``{"results": [rec|null, ...]}``
 ``GET /region/<chr:start-end>``       region query; ``?minCadd=``,
                                       ``maxConseqRank=``, ``limit=``
+``POST /regions``                     batch region join: body
+                                      ``{"regions": [...]}`` (+ optional
+                                      ``minCadd``/``maxConseqRank``/
+                                      ``limit``/``tokenize``) → per-interval
+                                      envelopes byte-identical to N single
+                                      ``/region`` calls, answered by ONE
+                                      BITS kernel call per chromosome group
 ====================================  =====================================
 
 Admission is bounded everywhere: point queries reject with **429** when the
@@ -146,6 +153,55 @@ def parse_region_params(query: str):
     )
 
 
+#: the one grammar message for a malformed /regions body (both front ends)
+REGIONS_BODY_ERROR = (
+    'regions body must be {"regions": ["chr:start-end", ...]} with '
+    'optional numeric "minCadd"/"maxConseqRank"/"limit" and boolean '
+    '"tokenize"'
+)
+
+
+def parse_regions_body(body: bytes):
+    """``(specs, min_cadd, max_conseq_rank, limit, tokenize)`` from a
+    ``POST /regions`` JSON body — the ONE parsing contract both front
+    ends share (the :func:`parse_region_params` convention: the batch
+    API's per-interval envelopes are pinned byte-identical to N single
+    ``/region`` calls, so the parameter grammar must not fork either).
+    Raises :class:`QueryError` on any malformed field; the per-spec
+    region grammar itself is validated by the engine (one bad spec fails
+    the call, the bulk-``/variants`` contract)."""
+    try:
+        obj = json.loads(body or b"{}")
+    except ValueError:
+        raise QueryError(REGIONS_BODY_ERROR) from None
+    if not isinstance(obj, dict):
+        raise QueryError(REGIONS_BODY_ERROR)
+    specs = obj.get("regions")
+    if not isinstance(specs, list) \
+            or not all(isinstance(s, str) for s in specs):
+        raise QueryError(REGIONS_BODY_ERROR)
+
+    def num(name, kinds):
+        v = obj.get(name)
+        if v is None:
+            return None
+        if isinstance(v, bool) or not isinstance(v, kinds):
+            raise QueryError(f"bad regions field {name}={v!r}")
+        return v
+
+    limit = num("limit", int)
+    tokenize = obj.get("tokenize", False)
+    if not isinstance(tokenize, bool):
+        raise QueryError(f"bad regions field tokenize={tokenize!r}")
+    return (
+        specs,
+        num("minCadd", (int, float)),
+        num("maxConseqRank", int),
+        DEFAULT_REGION_LIMIT if limit is None else limit,
+        tokenize,
+    )
+
+
 class ServeContext:
     """Everything a handler thread needs, shared across requests."""
 
@@ -206,7 +262,7 @@ class ServeContext:
         # key assembly) is measurable at serving QPS, so the hot path
         # indexes a dict instead of re-registering per request
         self._kind = {}
-        for kind in ("point", "bulk", "region"):
+        for kind in ("point", "bulk", "region", "regions"):
             labels = {"kind": kind}
             self._kind[kind] = (
                 registry.counter(
@@ -421,6 +477,9 @@ class ServeHandler(BaseHTTPRequestHandler):
         if path == "/variants":
             self._bulk(ctx)
             return
+        if path == "/regions":
+            self._regions(ctx)
+            return
         self._error(404, f"no such route: {path}")
 
     # -- query kinds --------------------------------------------------------
@@ -519,6 +578,67 @@ class ServeHandler(BaseHTTPRequestHandler):
                 + ",".join(r if r is not None else "null" for r in results)
                 + "]}"
             ))
+        finally:
+            ctx.release()
+
+    def _regions(self, ctx: ServeContext) -> None:
+        """Batch region join: admission/brownout/deadline shape of
+        ``_bulk``, execution through the engine's batched BITS path."""
+        t0 = time.perf_counter()
+        if ctx.governor.shed_bulk():
+            ctx.brownout_shed()
+            self._error(503, "brownout: region reads shed (point reads "
+                             "keep serving)")
+            return
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            self._error(504, "deadline exhausted at admission")
+            return
+        if not ctx.admit():
+            ctx.rejected("regions")
+            self._error(429, "server at capacity (region admission bound)")
+            return
+        try:
+            ctx.refresh_snapshot()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                specs, min_cadd, max_rank, limit, tokenize = \
+                    parse_regions_body(raw)
+            except (ValueError, QueryError) as err:
+                ctx.errored("regions")
+                self._error(400, str(err) if isinstance(err, QueryError)
+                            else REGIONS_BODY_ERROR)
+                return
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # body read/queueing ate the budget: shed BEFORE the scan
+                ctx.deadline_shed("execute")
+                self._error(504, "deadline exhausted before execution")
+                return
+            try:
+                cap = ctx.governor.region_limit_cap()
+                if cap is not None:
+                    # brownout level >= 1: bound per-interval render work
+                    limit = min(limit, cap)
+                result = ctx.engine.regions_serve(
+                    specs,
+                    min_cadd=min_cadd,
+                    max_conseq_rank=max_rank,
+                    limit=limit,
+                    tokenize=tokenize,
+                )
+            except QueryError as err:
+                ctx.errored("regions")
+                self._error(400, str(err))
+                return
+            except Exception as err:
+                ctx.errored("regions")
+                self._error(500, f"{type(err).__name__}: {err}")
+                return
+            ctx.observe("regions", time.perf_counter() - t0,
+                        rows=result.returned)
+            self._reply(200, result.assemble())
         finally:
             ctx.release()
 

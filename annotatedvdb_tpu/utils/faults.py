@@ -52,6 +52,11 @@ arming any other name is a ``ValueError`` at parse time):
                             before the coalesced microbatch executes (fires
                             on the batcher thread; every caller of the batch
                             observes the failure)
+``serve.regions``           per batch-region drain in ``serve.engine``
+                            (``regions_serve``) — the batch is parsed,
+                            nothing executed; a failure must fail exactly
+                            this batch's caller (HTTP 500) and leave the
+                            engine serving the next batch
 ``snapshot.swap``           in ``serve.snapshot`` after the new generation
                             loaded but before the atomic swap — a failure
                             must leave the old pinned generation serving
@@ -104,6 +109,7 @@ POINTS = frozenset({
     "egress.flush",
     "ingest.chunk",
     "serve.batch",
+    "serve.regions",
     "serve.accept",
     "serve.worker",
     "serve.wedge",

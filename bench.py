@@ -862,6 +862,142 @@ def bench_serve(n_rows: int = 50_000, clients: int = 16,
             shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_serve_regions(store_dir: str, ids: list,
+                        n_intervals: int = 2048, window_bp: int = 30,
+                        limit: int = 10, batch_size: int = 256):
+    """The batch-region-join leg: a gene-panel/BED-shaped workload of
+    ``n_intervals`` distinct windows over the loaded span, answered two
+    ways against ONE live server — sequentially (one keep-alive
+    ``GET /region`` per interval, the pre-batch-API access pattern) and
+    device-batched (``POST /regions`` in ``batch_size`` chunks, the BITS
+    kernel path) — reporting intervals/sec and p99 for both, the speedup,
+    and a byte-identity verdict (every sequential response body must
+    appear verbatim as its batch envelope).  A count-only run of the same
+    panel (``limit=0``, answered from kernel span widths alone) rides
+    along."""
+    import http.client
+
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+
+    positions = sorted(int(i.split(":")[1]) for i in ids)
+    lo_pos, hi_pos = positions[0], positions[-1]
+    rng = random.Random(12083407)
+    span = max(hi_pos - lo_pos - window_bp, 1)
+    panel = []
+    for _ in range(n_intervals):
+        start = lo_pos + rng.randrange(span)
+        panel.append((start, start + window_bp - 1))
+    specs = [f"1:{s}-{e}" for s, e in panel]
+
+    server = build_aio_server(store_dir=store_dir, port=0)
+    server.start_background()
+    try:
+        host, port = server.server_address[:2]
+
+        def request(conn, method, path, body=None):
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"}
+                         if body else {})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        # warmup OUTSIDE the clocks: first connection, route code paths,
+        # the per-generation interval-index build, and the BITS kernel
+        # trace all pay one-time costs that belong to no leg
+        request(conn, "GET", f"/region/{specs[0]}?limit={limit}")
+        request(conn, "POST", "/regions", json.dumps(
+            {"regions": specs[:batch_size], "limit": limit}
+        ))
+        settle()
+
+        # sequential baseline: one region per round-trip, keep-alive
+        seq_bodies = []
+        seq_lat = []
+        t0 = time.perf_counter()
+        for spec in specs:
+            t1 = time.perf_counter()
+            status, body = request(
+                conn, "GET", f"/region/{spec}?limit={limit}"
+            )
+            seq_lat.append(time.perf_counter() - t1)
+            if status != 200:
+                raise RuntimeError(f"sequential region {spec}: {status}")
+            seq_bodies.append(body.decode())
+        seq_dt = max(time.perf_counter() - t0, 1e-9)
+
+        settle()
+        # batched: the same panel through the BITS kernel path
+        batch_lat = []
+        batch_text = []
+        t0 = time.perf_counter()
+        for off in range(0, n_intervals, batch_size):
+            chunk = specs[off:off + batch_size]
+            t1 = time.perf_counter()
+            status, body = request(conn, "POST", "/regions", json.dumps(
+                {"regions": chunk, "limit": limit}
+            ))
+            batch_lat.append(time.perf_counter() - t1)
+            if status != 200:
+                raise RuntimeError(f"regions batch at {off}: {status}")
+            batch_text.append(body.decode())
+        batch_dt = max(time.perf_counter() - t0, 1e-9)
+
+        # byte identity: every sequential body must sit verbatim inside
+        # its chunk's batch response (the per-interval envelope contract)
+        mismatches = 0
+        for i, body in enumerate(seq_bodies):
+            if body not in batch_text[i // batch_size]:
+                mismatches += 1
+
+        settle()
+        # count-only: the never-materialize mode (limit=0, no filters)
+        t0 = time.perf_counter()
+        for off in range(0, n_intervals, batch_size):
+            status, _b = request(conn, "POST", "/regions", json.dumps(
+                {"regions": specs[off:off + batch_size], "limit": 0}
+            ))
+            if status != 200:
+                raise RuntimeError(f"count-only batch at {off}: {status}")
+        count_dt = max(time.perf_counter() - t0, 1e-9)
+        conn.close()
+
+        seq_ms = np.asarray(seq_lat) * 1000.0
+        bat_ms = np.asarray(batch_lat) * 1000.0
+        seq_ips = n_intervals / seq_dt
+        bat_ips = n_intervals / batch_dt
+        return {
+            "intervals": n_intervals,
+            "window_bp": window_bp,
+            "limit": limit,
+            "batch_size": batch_size,
+            "byte_identical": mismatches == 0,
+            "mismatches": mismatches,
+            "sequential": {
+                "intervals_per_sec": round(seq_ips, 1),
+                "p50_ms": round(float(np.percentile(seq_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(seq_ms, 99)), 3),
+                "seconds": round(seq_dt, 3),
+            },
+            "batched": {
+                "intervals_per_sec": round(bat_ips, 1),
+                "calls": len(batch_lat),
+                "p50_ms": round(float(np.percentile(bat_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(bat_ms, 99)), 3),
+                "seconds": round(batch_dt, 3),
+            },
+            "speedup": round(bat_ips / seq_ips, 2),
+            "count_only": {
+                "intervals_per_sec": round(n_intervals / count_dt, 1),
+                "seconds": round(count_dt, 3),
+                "speedup": round((n_intervals / count_dt) / seq_ips, 2),
+            },
+        }
+    finally:
+        server.shutdown()
+        server.ctx.batcher.close()
+
+
 def bench_multichip_virtual(n_devices: int = 8):
     """Mesh insert-step timing on a VIRTUAL n-device CPU mesh — a labeled
     scaling datapoint (reshard + annotate + dedup + membership as one mesh
@@ -1025,6 +1161,13 @@ def serve_only():
     try:
         store_dir, ids = _build_serve_store(work, 50_000)
         serving = bench_serve(store=(store_dir, ids))
+        settle()
+        try:
+            serving["regions"] = bench_serve_regions(store_dir, ids)
+        except Exception as exc:  # the legs after it must still record
+            serving["regions"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]
+            }
         settle()
         serving["open_loop"] = bench_serve_open_loop(store_dir, ids)
     finally:

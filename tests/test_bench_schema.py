@@ -57,6 +57,17 @@ GOOD = {
         "clients": 16, "errors": 0, "batch_fill": 0.06, "batches": 250,
         "seconds": 1.2, "store_rows": 50000,
         "region": {"qps": 110.0, "requests": 200, "seconds": 1.8},
+        "regions": {
+            "intervals": 2048, "window_bp": 30, "limit": 10,
+            "batch_size": 256, "byte_identical": True, "mismatches": 0,
+            "sequential": {"intervals_per_sec": 850.0, "p50_ms": 1.1,
+                           "p99_ms": 3.2, "seconds": 2.41},
+            "batched": {"intervals_per_sec": 7400.0, "calls": 8,
+                        "p50_ms": 33.0, "p99_ms": 41.0, "seconds": 0.28},
+            "speedup": 8.7,
+            "count_only": {"intervals_per_sec": 52000.0, "seconds": 0.04,
+                           "speedup": 61.2},
+        },
         "open_loop": {
             "slo_p99_ms": 25.0, "conns": 8, "duration_s": 2.5,
             "max_sustainable_qps": 11800.0,
@@ -135,6 +146,38 @@ def test_serving_block_is_validated_strictly():
     bad = copy.deepcopy(GOOD)
     bad["serving"]["region"] = {"requests": 200}  # qps/seconds required
     assert any("region" in e for e in validate_record(bad))
+
+
+def test_regions_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["regions"]["speedup"]
+    assert any("speedup" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["regions"]["batched"]["intervals_per_sec"]
+    assert any("intervals_per_sec" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["regions"]["byte_identical"] = "yes"  # bool, not str
+    assert any("byte_identical" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["regions"]["sequential"]["p99_ms"] = 0.5  # below p50
+    assert any("p99_ms below p50_ms" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["regions"]["intervals"] = 0
+    assert any("positive" in e for e in validate_record(bad))
+
+    # a serving block WITHOUT regions stays valid (r05-r07-era records)
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["regions"]
+    assert validate_record(old) == []
+
+    # a failed leg records its error and stays loadable
+    failed = copy.deepcopy(GOOD)
+    failed["serving"]["regions"] = {"error": "server did not start"}
+    assert validate_record(failed) == []
 
 
 def test_open_loop_block_is_validated_strictly():

@@ -289,6 +289,26 @@ def test_serve_batch_raise_fails_only_that_batch_and_recovers():
         batcher.close()
 
 
+def test_serve_regions_raise_fails_only_that_batch_and_recovers():
+    """An injected fault in the batch-region drain (serve.regions:1:raise)
+    must fail exactly that batch's caller — the front ends map it to one
+    500 — and leave the engine answering the next batch byte-identically
+    to the untouched single-region path."""
+    from annotatedvdb_tpu.serve import QueryEngine, StaticSnapshots
+    from annotatedvdb_tpu.utils.faults import InjectedFault
+
+    engine = QueryEngine(StaticSnapshots(_tiny_store()), region_cache_size=0)
+    specs = ["3:1-100", "3:5-25"]
+    want = [engine.region(s) for s in specs]
+    faults.reset("serve.regions:1:raise")
+    with pytest.raises(InjectedFault):
+        engine.regions_serve(specs)
+    # the engine survived its failed batch: the same panel now answers,
+    # byte-identical per interval to the single-region calls
+    got = engine.regions_serve(specs)
+    assert [p.assemble() for p in got.pages] == want
+
+
 def test_snapshot_swap_raise_keeps_old_generation_serving(tmp_path):
     """A fault between loading the new generation and swapping the pin
     (snapshot.swap:1:raise) must leave the OLD generation serving; an

@@ -673,20 +673,21 @@ def test_client_id_rotation_cannot_bypass_rate_limit():
 
 def test_paged_walk_scans_region_once(store, monkeypatch):
     """A cursor walk must reuse its match list across pages: without the
-    walk cache every page re-runs the full region scan + filter pass
-    (O(pages x region))."""
+    walk cache every page re-runs the full interval search + filter pass
+    (O(pages x region)).  The scan unit is one ``_interval_spans`` call
+    (the BITS search against the generation's interval index)."""
     from annotatedvdb_tpu.serve import QueryEngine, SnapshotManager
 
     store_dir, _truth = store
     engine = QueryEngine(SnapshotManager(store_dir), region_cache_size=0)
     calls = {"n": 0}
-    real = engine._region_rows
+    real = engine._interval_spans
 
-    def counting(shard, start, end):
+    def counting(index, code, starts, ends, host_only=False):
         calls["n"] += 1
-        return real(shard, start, end)
+        return real(index, code, starts, ends, host_only)
 
-    monkeypatch.setattr(engine, "_region_rows", counting)
+    monkeypatch.setattr(engine, "_interval_spans", counting)
     body = json.loads(engine.region("8:1-3000000", limit=5, cursor=""))
     pages = [body]
     while body.get("next"):

@@ -178,6 +178,9 @@ def _check_serving(sv, where: str, errors: list) -> None:
                 {"qps": _is_num, "requests": _is_int, "seconds": _is_num},
                 f"{w}.region", errors, required=("qps", "seconds"),
             )
+    if "regions" in sv and isinstance(sv["regions"], dict) \
+            and "error" not in sv["regions"]:
+        _check_regions(sv["regions"], w, errors)
     if "open_loop" in sv:
         _check_open_loop(sv["open_loop"], w, errors)
     if "chaos" in sv and isinstance(sv["chaos"], dict) \
@@ -216,6 +219,45 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
         errors.append(f"{w}.error_rate: must be a ratio in [0, 1]")
     if _is_int(ch.get("wrong_bytes")) and ch["wrong_bytes"] < 0:
         errors.append(f"{w}.wrong_bytes: negative count")
+
+
+def _check_regions(rg: dict, where: str, errors: list) -> None:
+    """The PR-8 batch-region-join leg: a ≥2k-interval panel answered
+    device-batched (``POST /regions``) vs the sequential single-region
+    baseline, with a byte-identity verdict."""
+    w = f"{where}.regions"
+    _check_fields(
+        rg,
+        {
+            "intervals": _is_int, "window_bp": _is_int, "limit": _is_int,
+            "batch_size": _is_int, "mismatches": _is_int,
+            "byte_identical": lambda v: isinstance(v, bool),
+            "speedup": _is_num,
+            "sequential": lambda v: isinstance(v, dict),
+            "batched": lambda v: isinstance(v, dict),
+            "count_only": lambda v: isinstance(v, dict),
+        },
+        w, errors,
+        required=("intervals", "sequential", "batched", "speedup",
+                  "byte_identical"),
+    )
+    for leg in ("sequential", "batched", "count_only"):
+        sub = rg.get(leg)
+        if not isinstance(sub, dict):
+            continue
+        _check_fields(
+            sub,
+            {"intervals_per_sec": _is_num, "seconds": _is_num,
+             "p50_ms": _is_num, "p99_ms": _is_num, "calls": _is_int,
+             "speedup": _is_num},
+            f"{w}.{leg}", errors,
+            required=("intervals_per_sec", "seconds"),
+        )
+        if _is_num(sub.get("p50_ms")) and _is_num(sub.get("p99_ms")) \
+                and sub["p99_ms"] < sub["p50_ms"]:
+            errors.append(f"{w}.{leg}: p99_ms below p50_ms")
+    if _is_int(rg.get("intervals")) and rg["intervals"] <= 0:
+        errors.append(f"{w}.intervals: must be positive")
 
 
 def _check_open_loop(ol, where: str, errors: list) -> None:

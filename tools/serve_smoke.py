@@ -70,6 +70,19 @@ def _get(port: int, path: str):
         return err.code, err.read().decode()
 
 
+def _post(port: int, path: str, payload) -> tuple[int, str]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=20) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
 def _drive_routes(port: int, n: int, check) -> str:
     """The shared route battery; returns the region body for parity."""
     status, body = _get(port, "/healthz")
@@ -90,6 +103,25 @@ def _drive_routes(port: int, n: int, check) -> str:
     status, body = _get(port, "/metrics")
     check("metrics", status == 200
           and "avdb_query_requests_total" in body, body[:200])
+    # batch region join: per-interval envelopes must be byte-identical to
+    # the single /region bodies (the BITS batch-API contract), plus the
+    # count-only and tokenize modes
+    specs = ["8:1-100000", "8:1000-1400", "8:999000-999999"]
+    status, batch = _post(port, "/regions",
+                          {"regions": specs, "minCadd": 1, "limit": 5})
+    rec = json.loads(batch) if status == 200 else {}
+    check("regions batch", status == 200 and rec.get("n") == 3, batch[:200])
+    for spec in specs:
+        _st, single = _get(port, f"/region/{spec}?minCadd=1&limit=5")
+        check(f"regions parity {spec}", single in batch, batch[:200])
+    status, body = _post(port, "/regions",
+                         {"regions": specs, "limit": 0, "tokenize": True})
+    rec = json.loads(body) if status == 200 else {}
+    check("regions count-only+tokens", status == 200
+          and rec.get("results", [{}])[0].get("returned") == 0
+          and rec.get("tokens", {}).get("count", [0])[0] > 0, body[:200])
+    status, body = _post(port, "/regions", {"regions": ["8:9-3"]})
+    check("regions 400", status == 400, body[:200])
     return region_body
 
 
