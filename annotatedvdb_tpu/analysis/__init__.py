@@ -18,12 +18,24 @@ AVDB5xx     CLI-contract: the six loader CLIs' shared flag set
             (``rules_cli``)
 AVDB6xx     hygiene: bare except, silent Exception-pass, mutable default
             args (``rules_hygiene``)
+AVDB7xx     async-safety: blocking calls on the event loop, await under a
+            sync lock (``rules_async``)
+AVDB8xx     cross-front-end parity: duplicated response literals /
+            ``AVDB_SERVE_*`` reads, shared-helper asymmetry between
+            ``serve/http.py`` and ``serve/aio.py`` (``rules_parity``)
+AVDB9xx     device/host twin contract: jitted ``ops/`` kernels vs the
+            ``ops.TWINS`` registry and its parity tests (``rules_twins``)
 ==========  ============================================================
 
-Entry point: ``python tools/avdb_check.py [--json] [paths...]`` — exit
-codes 0 (clean) / 1 (findings) / 2 (usage or internal error), mirroring
-``tools/store_fsck.py``.  Suppress a finding with
-``# avdb: noqa[CODE] -- reason``.
+Entry point: ``python tools/avdb_check.py [--json] [--diff REV]
+[paths...]`` — exit codes 0 (clean) / 1 (findings) / 2 (usage or
+internal error), mirroring ``tools/store_fsck.py``.  Suppress a finding
+with ``# avdb: noqa[CODE] -- reason``.
+
+The package also carries the DYNAMIC half of the suite:
+``analysis/lockorder`` — the lock-order/deadlock detector behind
+``AVDB_LOCK_TRACE=1`` (see ``utils.locks.make_lock``): per-thread
+acquisition-order graph, cycle detection, held-duration histograms.
 """
 
 from annotatedvdb_tpu.analysis.core import (  # noqa: F401 (public API)

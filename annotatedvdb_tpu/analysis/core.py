@@ -127,6 +127,18 @@ class ProjectFacts:
     #: never read") additionally needs the test tree, where the
     #: AVDB_SCALE_TEST-class gates are read
     tree_scan: bool = False
+    #: {front_end_path: {"literals": {value: first_line},
+    #:                   "refs": set_of_names}} — the two serve front
+    #: ends' parity facts (rules_parity)
+    parity: dict = field(default_factory=dict)
+    #: [(path, line, "module.attr")] — jitted kernels discovered under
+    #: ops/ (rules_twins)
+    ops_kernels: list = field(default_factory=list)
+    #: True when ops/__init__.py was scanned: only then are the TWINS
+    #: audit codes decidable (same gating idea as full_registry_scan)
+    twins_scan: bool = False
+    #: the scanned ops/__init__.py path (registry findings anchor there)
+    twins_registry_path: str = ""
 
 
 @dataclass
@@ -140,6 +152,7 @@ class Project:
     env_declared: dict             # parsed config.ENV_VARS literal
     loader_clis: tuple             # module paths of the six loader CLIs
     flag_registrars: dict          # {helper_name: {flag: spec}} from config/obs
+    twins: dict = field(default_factory=dict)  # parsed ops.TWINS literal
 
 
 def _read(path: str) -> str:
@@ -231,6 +244,14 @@ def load_project(root: str, loader_clis: tuple | None = None) -> Project:
         src = _read(os.path.join(root, rel))
         if src:
             registrars.update(extract_registrars(ast.parse(src)))
+    twins: dict = {}
+    ops_src = _read(
+        os.path.join(root, "annotatedvdb_tpu", "ops", "__init__.py")
+    )
+    if ops_src:
+        val = _literal_assignment(ast.parse(ops_src), "TWINS")
+        if isinstance(val, dict):
+            twins = val
     return Project(
         root=root,
         readme=_read(os.path.join(root, "README.md")),
@@ -243,6 +264,7 @@ def load_project(root: str, loader_clis: tuple | None = None) -> Project:
             loader_clis if loader_clis is not None else LOADER_CLIS
         ),
         flag_registrars=registrars,
+        twins=twins,
     )
 
 
@@ -269,20 +291,28 @@ def iter_python_files(paths) -> list[str]:
 
 
 def run_paths(paths, root: str | None = None,
-              loader_clis: tuple | None = None) -> tuple[list[Finding], int]:
+              loader_clis: tuple | None = None,
+              audit: bool = True) -> tuple[list[Finding], int]:
     """Analyze ``paths``; returns ``(findings, files_scanned)``.
 
     ``root`` overrides repo-root discovery (fixture tests point it at a
     synthetic tree); ``loader_clis`` overrides the CLI-contract file list
-    the same way.
+    the same way.  ``audit=False`` (the ``--diff`` mode) keeps per-file
+    and call-site codes but disables the whole-project audits
+    (AVDB302/305/4xx-audit/9xx): a partial scan that happens to include
+    ``config.py`` or ``ops/__init__.py`` must not judge the files it did
+    NOT scan.
     """
     from annotatedvdb_tpu.analysis import (
+        rules_async,
         rules_cli,
         rules_env,
         rules_hygiene,
         rules_locks,
+        rules_parity,
         rules_registry,
         rules_trace,
+        rules_twins,
     )
 
     files = iter_python_files(paths)
@@ -291,7 +321,7 @@ def run_paths(paths, root: str | None = None,
     project = load_project(root, loader_clis=loader_clis)
     facts = ProjectFacts()
     norm = [f.replace("\\", "/") for f in files]
-    facts.full_registry_scan = any(
+    facts.full_registry_scan = audit and any(
         f.endswith("annotatedvdb_tpu/config.py") for f in norm
     )
     facts.tree_scan = facts.full_registry_scan and any(
@@ -303,16 +333,21 @@ def run_paths(paths, root: str | None = None,
         rules_trace.check,
         rules_locks.check,
         rules_hygiene.check,
+        rules_async.check,
     )
     collectors = (
         rules_registry.collect,
         rules_env.collect,
         rules_cli.collect,
+        rules_parity.collect,
+        rules_twins.collect,
     )
     finalizers = (
         rules_registry.finalize,
         rules_env.finalize,
         rules_cli.finalize,
+        rules_parity.finalize,
+        rules_twins.finalize,
     )
 
     for path in files:
@@ -330,6 +365,8 @@ def run_paths(paths, root: str | None = None,
             findings.extend(rule(ctx))
         for coll in collectors:
             coll(ctx, facts, project)
+    if not audit:
+        facts.twins_scan = False  # collectors set it; --diff disables
     for fin in finalizers:
         findings.extend(fin(facts, project))
 

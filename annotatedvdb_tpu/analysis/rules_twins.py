@@ -1,0 +1,248 @@
+"""AVDB9xx — device/host twin contract: every kernel has a proven twin.
+
+The serving circuit breaker, the ``host_only`` probe path, and every
+remote-link fallback rest on one promise: for each jitted device kernel
+there is a host function producing byte-identical answers, and a parity
+test proves it.  PR 8's BITS kernel shipped with
+``interval_spans_host`` and the promise held; nothing STOPS the next
+kernel from shipping twinless — until its breaker trips in production
+and the "byte-identical fallback" turns out not to exist.
+
+``ops.TWINS`` (``annotatedvdb_tpu/ops/__init__.py``) is the canonical
+registry, the ``faults.POINTS`` pattern: a dict literal mapping each
+jitted kernel to its host twin, both as package-relative dotted names
+(``"ops.intervals.bits_spans_kernel_jit": "ops.intervals.
+interval_spans_host"``).
+
+Codes:
+
+- **AVDB901** — a jitted function under ``ops/`` (wrap assignment
+  ``X_jit = jax.jit(f)`` or a ``@jax.jit``/``@partial(jax.jit, ...)``
+  decorated def, at module level) not registered in ``ops.TWINS``;
+- **AVDB902** — a ``TWINS`` entry that does not resolve: its kernel key
+  names no discovered jitted function, or its twin value names no
+  function defined in the scanned tree (a stale registry silently
+  un-guards the kernel it meant to cover);
+- **AVDB903** — a registered pair whose kernel and twin names never
+  appear TOGETHER in any single test file: the twin exists but nothing
+  proves it agrees with the kernel (the parity test is the contract).
+
+Audit codes gate on ``ops/__init__.py`` being in the scan (fixture
+subsets stay judgeable against their own tree via ``run_paths(root=)``),
+and AVDB903 additionally needs the test tree scanned.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from annotatedvdb_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Project,
+    ProjectFacts,
+)
+
+HINT_901 = ("register the kernel in ops.TWINS with its host twin and add "
+            "a parity test referencing both (tests/test_twins.py)")
+HINT_902 = ("fix the dotted name (package-relative, e.g. "
+            "'ops.intervals.interval_spans_host') or delete the stale "
+            "entry")
+HINT_903 = ("add a parity test that drives the kernel and its twin "
+            "together and compares the answers byte-for-byte")
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _dotted(node: ast.AST) -> list | None:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``partial(jax.jit, ...)`` call expressions."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _dotted(node.func)
+    if chain and chain[-1] in _JIT_NAMES:
+        return True
+    if chain and chain[-1] == "partial" and node.args:
+        head = _dotted(node.args[0])
+        return bool(head) and head[-1] in _JIT_NAMES
+    return False
+
+
+def _module_key(path: str) -> str | None:
+    """``.../annotatedvdb_tpu/ops/intervals.py`` -> ``ops.intervals``
+    (fixture trees under a different root resolve the same way)."""
+    p = path.replace("\\", "/")
+    if "/ops/" not in p or not p.endswith(".py"):
+        return None
+    tail = p.rsplit("/ops/", 1)[1]
+    if "/" in tail:
+        return None  # no nested packages under ops/
+    stem = tail[:-3]
+    return "ops" if stem == "__init__" else f"ops.{stem}"
+
+
+def collect(ctx: FileContext, facts: ProjectFacts, project: Project) -> None:
+    mod = _module_key(ctx.path)
+    if mod is None:
+        return
+    if mod == "ops":
+        facts.twins_scan = True
+        facts.twins_registry_path = ctx.path
+        return
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and _is_jit_expr(stmt.value):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    facts.ops_kernels.append(
+                        (ctx.path, stmt.lineno, f"{mod}.{t.id}")
+                    )
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in stmt.decorator_list:
+                chain = _dotted(dec)
+                if chain and chain[-1] in _JIT_NAMES:
+                    facts.ops_kernels.append(
+                        (ctx.path, stmt.lineno, f"{mod}.{stmt.name}")
+                    )
+                elif _is_jit_expr(dec):
+                    facts.ops_kernels.append(
+                        (ctx.path, stmt.lineno, f"{mod}.{stmt.name}")
+                    )
+
+
+def _defines(source: str, attr: str) -> bool:
+    """Whether ``source`` defines ``attr`` at some top-ish level (def or
+    assignment) — textual, deliberately cheap."""
+    for line in source.splitlines():
+        s = line.strip()
+        if s.startswith(f"def {attr}(") or s.startswith(f"def {attr} ("):
+            return True
+        if s.startswith(f"{attr} =") or s.startswith(f"{attr}="):
+            return True
+        if s.startswith(f"async def {attr}("):
+            return True
+    return False
+
+
+def _resolve_value(value: str, project: Project, facts: ProjectFacts) -> bool:
+    """A twin value ``pkg.mod.attr`` resolves when the module file exists
+    (under the scan or the project root) and defines ``attr``."""
+    import os
+
+    if "." not in value:
+        return False
+    mod_path, attr = value.rsplit(".", 1)
+    rel = mod_path.replace(".", "/") + ".py"
+    # prefer a scanned context (fixture trees); fall back to the root
+    for path, ctx in facts.contexts.items():
+        if path.replace("\\", "/").endswith(rel):
+            return _defines(ctx.source, attr)
+    full = os.path.join(project.root, "annotatedvdb_tpu", rel)
+    try:
+        with open(full, encoding="utf-8") as f:
+            return _defines(f.read(), attr)
+    except OSError:
+        return False
+
+
+def finalize(facts: ProjectFacts, project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    if not facts.twins_scan:
+        return findings  # partial scan: nothing is decidable
+    twins = {
+        str(k): str(v) for k, v in project.twins.items()
+    }
+    registry_path = (
+        facts.twins_registry_path or "annotatedvdb_tpu/ops/__init__.py"
+    )
+
+    def _is_test_file(path: str) -> bool:
+        import os
+
+        if path == registry_path:
+            return False  # the registry lists every pair; never a proof
+        try:
+            p = os.path.relpath(path, project.root).replace("\\", "/")
+        except ValueError:
+            p = path.replace("\\", "/")
+        return p.startswith("tests/") or "/tests/" in p \
+            or p.rsplit("/", 1)[-1].startswith("test_")
+
+    # AVDB903 is decidable only when the scan included test files at all
+    tests_present = any(_is_test_file(p) for p in facts.contexts)
+
+    def _registry_line(kernel: str, twin: str) -> int:
+        """Anchor a registry finding at ITS entry: locate the (unique)
+        kernel key first, then the twin value on that line or the next
+        (entries wrap) — a twin shared by two kernels must not anchor
+        every finding at the first kernel's entry."""
+        ctx = facts.contexts.get(registry_path)
+        if ctx is None:
+            return 1
+        for i, line in enumerate(ctx.lines, start=1):
+            if kernel in line:
+                for j in (i, i + 1):
+                    if j - 1 < len(ctx.lines) and twin in ctx.lines[j - 1]:
+                        return j
+                return i
+        return 1
+
+    # -- AVDB901: unregistered jitted kernels -------------------------------
+    discovered = {}
+    for path, line, name in facts.ops_kernels:
+        discovered[name] = (path, line)
+        if name not in twins:
+            findings.append(Finding(
+                "AVDB901", path, line,
+                f"jitted kernel {name!r} is not registered in ops.TWINS "
+                f"(no declared host twin)",
+                HINT_901,
+            ))
+
+    # -- AVDB902: stale registry entries ------------------------------------
+    for kernel, twin in sorted(twins.items()):
+        if kernel not in discovered:
+            findings.append(Finding(
+                "AVDB902", registry_path, _registry_line(kernel, twin),
+                f"ops.TWINS entry {kernel!r} names no jitted function "
+                f"discovered under ops/",
+                HINT_902,
+            ))
+            continue
+        if not _resolve_value(twin, project, facts):
+            findings.append(Finding(
+                "AVDB902", registry_path, _registry_line(kernel, twin),
+                f"ops.TWINS twin {twin!r} (for {kernel!r}) does not "
+                f"resolve to a function in the tree",
+                HINT_902,
+            ))
+            continue
+        # -- AVDB903: pair must co-appear in one test file ------------------
+        if not tests_present:
+            continue
+        k_attr = kernel.rsplit(".", 1)[1]
+        t_attr = twin.rsplit(".", 1)[1]
+        covered = False
+        for path, ctx in facts.contexts.items():
+            if not _is_test_file(path):
+                continue
+            if k_attr in ctx.source and t_attr in ctx.source:
+                covered = True
+                break
+        if not covered:
+            findings.append(Finding(
+                "AVDB903", registry_path, _registry_line(kernel, twin),
+                f"twin pair {kernel!r} <-> {twin!r} is never exercised "
+                f"together by any test file (no parity proof)",
+                HINT_903,
+            ))
+    return findings

@@ -119,6 +119,11 @@ ENV_VARS: dict = {
     "AVDB_SERVE_CHAOS": "1 enables the POST /_chaos runtime fault-arming "
                         "route on the aio front end (chaos harness only; "
                         "never set in production)",
+    "AVDB_LOCK_TRACE": "1 arms the lock-order/deadlock detector: serve-"
+                       "stack locks record per-thread acquisition order "
+                       "(analysis/lockorder), cycles are potential "
+                       "deadlocks, held time exports as "
+                       "avdb_lock_held_seconds",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "

@@ -138,6 +138,111 @@ def annotate_kernel(pos, ref, alt, ref_len, alt_len):
 annotate_kernel_jit = jax.jit(annotate_kernel)
 
 
+def annotate_kernel_np(pos, ref, alt, ref_len, alt_len):
+    """Full numpy twin of :func:`annotate_kernel` — the registered host
+    fallback (``ops.TWINS``), bit-exact field for field on in-width rows
+    (over-width rows are ``host_fallback`` on both sides and their other
+    outputs are undefined by contract).  Parity is pinned by
+    ``tests/test_twins.py``; the scalar string oracle
+    (``oracle.annotator``) remains the independent truth both are tested
+    against."""
+    import numpy as _np
+
+    pos = _np.asarray(pos, _np.int32)
+    ref = _np.asarray(ref, _np.uint8)
+    alt = _np.asarray(alt, _np.uint8)
+    rlen = _np.asarray(ref_len, _np.int32)
+    alen = _np.asarray(alt_len, _np.int32)
+    n, w = ref.shape
+    col = _np.arange(w, dtype=_np.int32)[None, :]
+
+    ref_valid = col < rlen[:, None]
+    alt_valid = col < alen[:, None]
+    snv = (rlen == 1) & (alen == 1)
+    mnv_shape = (rlen == alen) & ~snv
+
+    match = (ref == alt) & ref_valid & alt_valid
+    prefix = (_np.cumsum(~match, axis=1) == 0).sum(axis=1).astype(_np.int32)
+    prefix = _np.where(snv, 0, prefix).astype(_np.int32)
+    nr = (rlen - prefix).astype(_np.int32)
+    na = (alen - prefix).astype(_np.int32)
+
+    rev_idx = _np.clip(alen[:, None] - 1 - col, 0, w - 1)
+    rev_alt = _np.take_along_axis(alt, rev_idx, axis=1)
+    inversion = mnv_shape & ((ref == rev_alt) | ~ref_valid).all(axis=1)
+
+    end_mnv = _np.where(inversion, pos + rlen - 1, pos + nr - 1)
+    end_ins = _np.where(
+        nr >= 1,
+        pos + nr,
+        _np.where((nr == 0) & (rlen > 1), pos + rlen - 1, pos + 1),
+    )
+    end_del = _np.where(nr == 0, pos + rlen - 1, pos + nr)
+    end = _np.where(
+        snv,
+        pos,
+        _np.where(mnv_shape, end_mnv,
+                  _np.where(na >= 1, end_ins, end_del)),
+    ).astype(_np.int32)
+
+    orig_len = rlen - 1
+    na_safe = _np.maximum(na, 1)
+    motif_idx = _np.clip(
+        prefix[:, None] + (col % na_safe[:, None]), 0, w - 1
+    )
+    motif = _np.take_along_axis(alt, motif_idx, axis=1)
+    shifted_ref = _np.concatenate(
+        [ref[:, 1:], _np.zeros((n, 1), _np.uint8)], axis=1
+    )
+    tile_cols = col < orig_len[:, None]
+    tiles = ((shifted_ref == motif) | ~tile_cols).all(axis=1)
+    is_dup = (
+        (orig_len > 0)
+        & (na > 0)
+        & (_np.remainder(orig_len, na_safe) == 0)
+        & tiles
+    )
+
+    ins_side = ~snv & ~mnv_shape & (na >= 1)
+    pure_ins = ins_side & (nr == 0) & (end == pos + 1)
+    cls = _np.select(
+        [
+            snv,
+            inversion,
+            mnv_shape,
+            ins_side & ~pure_ins,
+            pure_ins & is_dup,
+            pure_ins,
+        ],
+        [
+            _np.int8(VariantClass.SNV),
+            _np.int8(VariantClass.INVERSION),
+            _np.int8(VariantClass.MNV),
+            _np.int8(VariantClass.INDEL),
+            _np.int8(VariantClass.DUP),
+            _np.int8(VariantClass.INS),
+        ],
+        default=_np.int8(VariantClass.DEL),
+    ).astype(_np.int8)
+
+    loc_start = _np.where(
+        cls >= VariantClass.INS, pos + 1, pos
+    ).astype(_np.int32)
+
+    return {
+        "prefix_len": prefix,
+        "norm_ref_len": nr,
+        "norm_alt_len": na,
+        "end_location": end,
+        "location_start": loc_start,
+        "location_end": end,
+        "variant_class": cls,
+        "is_dup_motif": is_dup & ins_side,
+        "needs_digest": (rlen + alen) > MAX_PK_SEQUENCE_LENGTH,
+        "host_fallback": (rlen > w) | (alen > w),
+    }
+
+
 def vep_identity_np(ref, alt, ref_len, alt_len):
     """Host-side twin of the two annotate outputs the VEP update path
     consumes: ``(prefix_len, host_fallback)``, bit-exact with

@@ -123,8 +123,10 @@ def _kernel(meta_ref, ref_ref, alt_ref, rev_ref, out_ref, *, w: int):
 
     # ---- duplication-motif test (variant_annotator.py:197-201):
     # ref[1:] is whole copies of the inserted motif alt[prefix:prefix+na].
-    # Decomposed gather-free: (a) first copy matches at lag s = prefix - 1
-    # (pure insertions always have prefix >= 1 since rlen == prefix),
+    # Decomposed gather-free: (a) first copy matches at lag prefix — every
+    # prefix in [0, w) is tested, INCLUDING 0: deletion-shaped rows like
+    # AC->C have prefix == 0 yet tile (the reference kernel agrees; the
+    # twin parity suite caught the lag-0 case missing here),
     # (b) ref[1:] is periodic with period na, (c) na divides rlen - 1.
     orig_len = rlen - 1
     # masks are precomputed full-width and sliced per shift — building fresh
@@ -132,11 +134,11 @@ def _kernel(meta_ref, ref_ref, alt_ref, rev_ref, out_ref, *, w: int):
     # layout bug (array.h "limits[i] <= dim(i)" abort)
     in_na = jnp.clip(na - row, 0, 1)                 # [W, N] 1 where i < na
     first_ok = jnp.zeros((1, n), dtype=jnp.bool_)
-    for s in range(w - 1):
-        m = w - 1 - s
-        bad = neq(refi[1:1 + m, :], alti[s + 1:s + 1 + m, :]) * in_na[:m, :]
+    for lo in range(w):
+        m = min(w - lo, w - 1)
+        bad = neq(refi[1:1 + m, :], alti[lo:lo + m, :]) * in_na[:m, :]
         ok = jnp.sum(bad, axis=0, keepdims=True) == 0
-        first_ok = first_ok | (ok & (prefix == s + 1))
+        first_ok = first_ok | (ok & (prefix == lo))
     periodic = jnp.zeros((1, n), dtype=jnp.bool_)
     for p in range(1, w):
         m = w - 1 - p

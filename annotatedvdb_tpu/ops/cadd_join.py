@@ -24,6 +24,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from annotatedvdb_tpu.utils.arrays import POS_SENTINEL  # noqa: F401  (contract)
 
@@ -75,5 +76,37 @@ def cadd_join_kernel(
         hit = at_pos & ref_in & alt_in
         take = hit & ~matched
         match_idx = jnp.where(take, idx.astype(jnp.int32), match_idx)
+        matched = matched | hit
+    return matched, match_idx
+
+
+def cadd_join_host(
+    vpos, vref, valt,
+    spos, sref, salt,
+    probe: int = SNV_PROBE,
+):
+    """Numpy twin of :func:`cadd_join_kernel` — the registered host
+    fallback (``ops.TWINS``): the same searchsorted + fixed probe window
+    over the same sentinel-padded block, so ``(matched, match_idx)`` are
+    identical arrays (parity pinned by ``tests/test_twins.py``)."""
+    vpos = np.asarray(vpos)
+    vref = np.asarray(vref)
+    valt = np.asarray(valt)
+    spos = np.asarray(spos)
+    sref = np.asarray(sref)
+    salt = np.asarray(salt)
+    k_rows = spos.shape[0]
+    lo = np.searchsorted(spos, vpos, side="left")
+    matched = np.zeros(vpos.shape, bool)
+    match_idx = np.full(vpos.shape, -1, np.int32)
+    for k in range(probe):
+        idx = np.clip(lo + k, 0, k_rows - 1)
+        at_pos = spos[idx] == vpos
+        row_ref, row_alt = sref[idx], salt[idx]
+        ref_in = (vref == row_ref).all(-1) | (vref == row_alt).all(-1)
+        alt_in = (valt == row_ref).all(-1) | (valt == row_alt).all(-1)
+        hit = at_pos & ref_in & alt_in
+        take = hit & ~matched
+        match_idx = np.where(take, idx.astype(np.int32), match_idx)
         matched = matched | hit
     return matched, match_idx

@@ -180,3 +180,161 @@ mark_batch_duplicates_jit = jax.jit(mark_batch_duplicates)
 mark_batch_duplicates_multi_jit = jax.jit(mark_batch_duplicates_multi)
 lookup_in_sorted_jit = jax.jit(lookup_in_sorted)
 lookup_in_sorted_multi_jit = jax.jit(lookup_in_sorted_multi)
+
+
+# ---- numpy host twins (ops.TWINS registry; tests/test_twins.py) -------
+#
+# Each is the same algorithm in host numpy: the lexicographic identity
+# sort / two-level sorted probe over the same dtypes, so answers are
+# identical arrays.  They are the fallback the serving breaker and the
+# remote-link paths can take without a device in reach.
+
+
+def mark_batch_duplicates_np(pos, h, ref, alt, ref_len, alt_len):
+    """Numpy twin of :func:`mark_batch_duplicates`."""
+    import numpy as np
+
+    pos = np.asarray(pos)
+    h = np.asarray(h)
+    n = pos.shape[0]
+    idx = np.arange(n)
+    order = np.lexsort((idx, h, pos))  # primary key last: (pos, h, idx)
+    pos_s, h_s = pos[order], h[order]
+    ref_s, alt_s = np.asarray(ref)[order], np.asarray(alt)[order]
+    rlen_s = np.asarray(ref_len)[order]
+    alen_s = np.asarray(alt_len)[order]
+    same_key = (pos_s[1:] == pos_s[:-1]) & (h_s[1:] == h_s[:-1])
+    same_len = (rlen_s[1:] == rlen_s[:-1]) & (alen_s[1:] == alen_s[:-1])
+    same_bytes = (ref_s[1:] == ref_s[:-1]).all(axis=1) & (
+        alt_s[1:] == alt_s[:-1]
+    ).all(axis=1)
+    dup_sorted = np.concatenate(
+        [np.zeros(1, bool), same_key & same_len & same_bytes]
+    )
+    out = np.zeros(n, bool)
+    out[order] = dup_sorted
+    return out
+
+
+def mark_batch_duplicates_multi_np(chrom, pos, h, ref, alt,
+                                   ref_len, alt_len):
+    """Numpy twin of :func:`mark_batch_duplicates_multi`."""
+    import numpy as np
+
+    chrom = np.asarray(chrom, np.int32)
+    pos = np.asarray(pos)
+    h = np.asarray(h)
+    n = pos.shape[0]
+    idx = np.arange(n)
+    order = np.lexsort((idx, h, pos, chrom))
+    chrom_s, pos_s, h_s = chrom[order], pos[order], h[order]
+    ref_s, alt_s = np.asarray(ref)[order], np.asarray(alt)[order]
+    rlen_s = np.asarray(ref_len)[order]
+    alen_s = np.asarray(alt_len)[order]
+    same_key = (
+        (chrom_s[1:] == chrom_s[:-1])
+        & (pos_s[1:] == pos_s[:-1])
+        & (h_s[1:] == h_s[:-1])
+    )
+    same_len = (rlen_s[1:] == rlen_s[:-1]) & (alen_s[1:] == alen_s[:-1])
+    same_bytes = (ref_s[1:] == ref_s[:-1]).all(axis=1) & (
+        alt_s[1:] == alt_s[:-1]
+    ).all(axis=1)
+    dup_sorted = np.concatenate(
+        [np.zeros(1, bool), same_key & same_len & same_bytes]
+    )
+    out = np.zeros(n, bool)
+    out[order] = dup_sorted
+    return out
+
+
+def lookup_in_sorted_np(
+    store_pos, store_h, store_ref, store_alt, store_rlen, store_alen,
+    pos, h, ref, alt, ref_len, alt_len,
+):
+    """Numpy twin of :func:`lookup_in_sorted` (same two-level search and
+    fixed confirmation probes)."""
+    import numpy as np
+
+    store_pos = np.asarray(store_pos)
+    store_h = np.asarray(store_h)
+    store_ref, store_alt = np.asarray(store_ref), np.asarray(store_alt)
+    store_rlen = np.asarray(store_rlen)
+    store_alen = np.asarray(store_alen)
+    pos, h = np.asarray(pos), np.asarray(h)
+    ref, alt = np.asarray(ref), np.asarray(alt)
+    ref_len, alt_len = np.asarray(ref_len), np.asarray(alt_len)
+    m = store_pos.shape[0]
+    lo = np.searchsorted(store_pos, pos, side="left").astype(np.int32)
+    hi = np.searchsorted(store_pos, pos, side="right").astype(np.int32)
+    l, r = lo, hi
+    for _ in range(32):
+        active = l < r
+        mid = (l + r) >> 1
+        less = store_h[np.clip(mid, 0, m - 1)] < h
+        l = np.where(active & less, mid + 1, l)
+        r = np.where(active & ~less, mid, r)
+    found = np.zeros(pos.shape, bool)
+    index = np.full(pos.shape, -1, np.int32)
+    for k in range(4):
+        i = np.clip(l + k, 0, m - 1)
+        cand = (
+            (l + k < hi)
+            & (store_pos[i] == pos)
+            & (store_h[i] == h)
+            & (store_rlen[i] == ref_len)
+            & (store_alen[i] == alt_len)
+            & (store_ref[i] == ref).all(axis=1)
+            & (store_alt[i] == alt).all(axis=1)
+        )
+        take = cand & ~found
+        found = found | cand
+        index = np.where(take, i.astype(np.int32), index)
+    return found, index
+
+
+def lookup_in_sorted_multi_np(
+    store_chrom, store_pos, store_hm, store_ref, store_alt,
+    store_rlen, store_alen,
+    chrom, pos, hm, ref, alt, ref_len, alt_len,
+):
+    """Numpy twin of :func:`lookup_in_sorted_multi`."""
+    import numpy as np
+
+    store_chrom = np.asarray(store_chrom)
+    store_pos = np.asarray(store_pos)
+    store_hm = np.asarray(store_hm)
+    store_ref, store_alt = np.asarray(store_ref), np.asarray(store_alt)
+    store_rlen = np.asarray(store_rlen)
+    store_alen = np.asarray(store_alen)
+    chrom, pos, hm = np.asarray(chrom), np.asarray(pos), np.asarray(hm)
+    ref, alt = np.asarray(ref), np.asarray(alt)
+    ref_len, alt_len = np.asarray(ref_len), np.asarray(alt_len)
+    m = store_pos.shape[0]
+    lo = np.searchsorted(store_pos, pos, side="left").astype(np.int32)
+    hi = np.searchsorted(store_pos, pos, side="right").astype(np.int32)
+    l, r = lo, hi
+    for _ in range(32):
+        active = l < r
+        mid = (l + r) >> 1
+        less = store_hm[np.clip(mid, 0, m - 1)] < hm
+        l = np.where(active & less, mid + 1, l)
+        r = np.where(active & ~less, mid, r)
+    found = np.zeros(pos.shape, bool)
+    index = np.full(pos.shape, -1, np.int32)
+    for k in range(4):
+        i = np.clip(l + k, 0, m - 1)
+        cand = (
+            (l + k < hi)
+            & (store_pos[i] == pos)
+            & (store_hm[i] == hm)
+            & (store_chrom[i] == chrom)
+            & (store_rlen[i] == ref_len)
+            & (store_alen[i] == alt_len)
+            & (store_ref[i] == ref).all(axis=1)
+            & (store_alt[i] == alt).all(axis=1)
+        )
+        take = cand & ~found
+        found = found | cand
+        index = np.where(take, i.astype(np.int32), index)
+    return found, index

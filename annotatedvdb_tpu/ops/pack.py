@@ -48,6 +48,27 @@ def pack_outputs(h, dup, bin_level, leaf_bin, needs_digest, host_fallback):
 pack_outputs_jit = jax.jit(pack_outputs)
 
 
+def pack_outputs_np(h, dup, bin_level, leaf_bin, needs_digest,
+                    host_fallback):
+    """Numpy twin of :func:`pack_outputs` (ops.TWINS): same [n, 10]
+    little-endian byte layout from host arrays — the packer a breaker-
+    tripped or deviceless path can run, round-tripping through
+    :func:`unpack_outputs` exactly like the kernel output does (parity
+    pinned by tests/test_twins.py)."""
+    h = np.ascontiguousarray(np.asarray(h, "<u4"))
+    leaf = np.ascontiguousarray(np.asarray(leaf_bin, "<i4"))
+    n = h.shape[0]
+    h_b = h.view(np.uint8).reshape(n, 4)
+    leaf_b = leaf.view(np.uint8).reshape(n, 4)
+    level_b = np.asarray(bin_level).astype(np.uint8).reshape(n, 1)
+    flags = (
+        np.asarray(dup).astype(np.uint8)
+        | (np.asarray(needs_digest).astype(np.uint8) << 1)
+        | (np.asarray(host_fallback).astype(np.uint8) << 2)
+    ).reshape(n, 1)
+    return np.concatenate([h_b, leaf_b, level_b, flags], axis=1)
+
+
 # ---- nibble-packed allele uploads ------------------------------------
 #
 # Upload bandwidth is the insert path's floor on remote-attached TPUs: the
@@ -106,6 +127,21 @@ def inflate_alleles(ref_packed, alt_packed, width: int):
 
 
 inflate_alleles_jit = jax.jit(inflate_alleles, static_argnums=2)
+
+
+def inflate_alleles_np(ref_packed, alt_packed, width: int):
+    """Numpy twin of :func:`inflate_alleles` (ops.TWINS): the host-side
+    inverse of :func:`encode_alleles_nibble`, byte-identical to the
+    device inflate (parity pinned by tests/test_twins.py)."""
+    def one(packed):
+        packed = np.asarray(packed, np.uint8)
+        n, cols = packed.shape
+        lo = packed & np.uint8(0xF)
+        hi = packed >> np.uint8(4)
+        codes = np.stack([lo, hi], axis=2).reshape(n, 2 * cols)
+        return _DEC[codes][:, :width]
+
+    return one(ref_packed), one(alt_packed)
 
 _TRANSPORT_WANTED: bool | None = None
 
@@ -190,6 +226,21 @@ def pack_vep_outputs(h, prefix_len, host_fallback):
 
 
 pack_vep_outputs_jit = jax.jit(pack_vep_outputs)
+
+
+def pack_vep_outputs_np(h, prefix_len, host_fallback):
+    """Numpy twin of :func:`pack_vep_outputs` (ops.TWINS): same [n, 6]
+    little-endian layout (parity pinned by tests/test_twins.py)."""
+    h = np.ascontiguousarray(np.asarray(h, "<u4"))
+    n = h.shape[0]
+    return np.concatenate(
+        [
+            h.view(np.uint8).reshape(n, 4),
+            np.asarray(prefix_len).astype(np.uint8).reshape(n, 1),
+            np.asarray(host_fallback).astype(np.uint8).reshape(n, 1),
+        ],
+        axis=1,
+    )
 
 
 def unpack_vep_outputs(packed: np.ndarray):

@@ -72,6 +72,13 @@ from annotatedvdb_tpu.serve.engine import (
 )
 from annotatedvdb_tpu.serve.http import (
     _RETURNED_RE,
+    BULK_BODY_ERROR,
+    MSG_BROWNOUT_BULK,
+    MSG_BROWNOUT_REGION,
+    MSG_CAPACITY_BULK,
+    MSG_CAPACITY_REGION,
+    MSG_DEADLINE_ADMISSION,
+    MSG_DEADLINE_EXECUTE,
     REGIONS_BODY_ERROR,
     ServeContext,
     healthz_payload,
@@ -83,6 +90,7 @@ from annotatedvdb_tpu.serve.http import (
 from annotatedvdb_tpu.serve.resilience import DeadlineExceeded, DeviceBreaker
 from annotatedvdb_tpu.serve.snapshot import SnapshotManager
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils.locks import make_lock
 
 #: request body cap (bulk id lists); larger bodies are 413, never buffered
 MAX_BODY = 1 << 26
@@ -358,7 +366,7 @@ class _CompletionBridge:
 
     def __init__(self, loop: asyncio.AbstractEventLoop):
         self.loop = loop
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.aio.bridge")
         #: guarded by self._lock
         self._ready: list = []
         #: guarded by self._lock
@@ -540,10 +548,21 @@ class AioServer:
         self.port = port
         self.sock = sock  # pre-bound listening socket (fleet workers)
         #: fleet watchdog handshake: this worker's slot in the shared
-        #: mmap'd heartbeat file (None outside a fleet)
+        #: mmap'd heartbeat file (None outside a fleet).  Opened + mmap'd
+        #: HERE, at worker start — the maintenance tick runs ON the event
+        #: loop and must never touch the filesystem (AVDB701; the tick
+        #: only ``struct.pack_into``s the established mapping)
         self.heartbeat_file = heartbeat_file
         self.heartbeat_index = int(heartbeat_index)
         self._hb_mm = None
+        if heartbeat_file is not None:
+            try:
+                with open(heartbeat_file, "r+b") as f:
+                    self._hb_mm = mmap.mmap(f.fileno(), 0)
+            except (OSError, ValueError) as err:
+                ctx.log(f"heartbeat file unusable ({err}); "
+                        "watchdog will not see this worker")
+                self._hb_mm = None
         #: runtime fault arming (POST /_chaos) for the chaos harness —
         #: gated hard on the environment so the route does not exist on
         #: a production server (404, byte-identical to any unknown route)
@@ -652,6 +671,10 @@ class AioServer:
             # clean startup error instead of a 30s hang
             self._startup_error = err
             self._started.set()
+            if self._hb_mm is not None:
+                with contextlib.suppress(OSError, ValueError):
+                    self._hb_mm.close()
+                self._hb_mm = None
             return
         self.server_address = server.sockets[0].getsockname()[:2]
         self._started.set()
@@ -678,14 +701,8 @@ class AioServer:
     # -- loop maintenance tick ----------------------------------------------
 
     def _start_tick(self) -> None:
-        if self.heartbeat_file is not None:
-            try:
-                with open(self.heartbeat_file, "r+b") as f:
-                    self._hb_mm = mmap.mmap(f.fileno(), 0)
-            except (OSError, ValueError) as err:
-                self.ctx.log(f"heartbeat file unusable ({err}); "
-                             "watchdog will not see this worker")
-                self._hb_mm = None
+        # the heartbeat mapping was established in __init__ (worker
+        # start): this runs on the event loop, where file I/O is banned
         self._loop.call_soon(self._tick)
 
     def _tick(self) -> None:
@@ -1014,8 +1031,7 @@ class AioServer:
             if path.startswith("/region/"):
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, "brownout: region reads shed "
-                                       "(point reads keep serving)"), keep
+                    return _error(503, MSG_BROWNOUT_REGION), keep
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("region")
@@ -1046,10 +1062,7 @@ class AioServer:
                 # the connection cannot be reused
                 if path == "/variants":
                     ctx.errored("bulk")
-                    return _error(400, (
-                        'bulk body must be '
-                        '{"ids": ["chr:pos:ref:alt", ...]}'
-                    )), False
+                    return _error(400, BULK_BODY_ERROR), False
                 if path == "/regions":
                     ctx.errored("regions")
                     return _error(400, REGIONS_BODY_ERROR), False
@@ -1065,8 +1078,7 @@ class AioServer:
             if path == "/variants":
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, "brownout: bulk reads shed "
-                                       "(point reads keep serving)"), keep
+                    return _error(503, MSG_BROWNOUT_BULK), keep
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("bulk")
@@ -1082,8 +1094,7 @@ class AioServer:
             if path == "/regions":
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, "brownout: region reads shed "
-                                       "(point reads keep serving)"), keep
+                    return _error(503, MSG_BROWNOUT_REGION), keep
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("regions")
@@ -1115,7 +1126,7 @@ class AioServer:
         t0 = time.perf_counter()
         action, payload = ctx.point_preflight(variant_id, deadline_t)
         if action == "shed":
-            return _error(504, "deadline exhausted at admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
         if action == "cached":
             if payload is None:
                 ctx.observe("point", time.perf_counter() - t0)
@@ -1193,11 +1204,10 @@ class AioServer:
         t0 = time.perf_counter()
         if deadline_t is not None and time.monotonic() >= deadline_t:
             ctx.deadline_shed("admission")
-            return _error(504, "deadline exhausted at admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
         if not ctx.admit():
             ctx.rejected("bulk")
-            return _error(429, "server at capacity (bulk admission bound)",
-                          retry_after=1)
+            return _error(429, MSG_CAPACITY_BULK, retry_after=1)
         fut = self._loop.run_in_executor(
             self._pool, self._bulk_work, body, t0, client, max_ids,
             deadline_t
@@ -1215,7 +1225,7 @@ class AioServer:
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 # executor-queue lag ate the budget: shed BEFORE the probe
                 ctx.deadline_shed("execute")
-                return _error(504, "deadline exhausted before execution")
+                return _error(504, MSG_DEADLINE_EXECUTE)
             try:
                 parsed = json.loads(body or b"{}")
                 ids = parsed["ids"]
@@ -1224,9 +1234,7 @@ class AioServer:
                     raise KeyError("ids")
             except (ValueError, KeyError, TypeError):
                 ctx.errored("bulk")
-                return _error(400, (
-                    'bulk body must be {"ids": ["chr:pos:ref:alt", ...]}'
-                ))
+                return _error(400, BULK_BODY_ERROR)
             if max_ids is not None and len(ids) > max_ids:
                 # a bulk the bucket could never repay within MAX_DEBT_S:
                 # executing it and capping the debt would be rate-limit
@@ -1272,11 +1280,10 @@ class AioServer:
         t0 = time.perf_counter()
         if deadline_t is not None and time.monotonic() >= deadline_t:
             ctx.deadline_shed("admission")
-            return _error(504, "deadline exhausted at admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
         if not ctx.admit():
             ctx.rejected("regions")
-            return _error(429, "server at capacity (region admission bound)",
-                          retry_after=1)
+            return _error(429, MSG_CAPACITY_REGION, retry_after=1)
         fut = self._loop.run_in_executor(
             self._pool, self._regions_work, body, t0, http11, client,
             max_ids, deadline_t
@@ -1298,7 +1305,7 @@ class AioServer:
         try:
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 ctx.deadline_shed("execute")
-                return _error(504, "deadline exhausted before execution")
+                return _error(504, MSG_DEADLINE_EXECUTE)
             try:
                 specs, min_cadd, max_rank, limit, tokenize = \
                     parse_regions_body(body)
@@ -1355,11 +1362,10 @@ class AioServer:
         t0 = time.perf_counter()
         if deadline_t is not None and time.monotonic() >= deadline_t:
             ctx.deadline_shed("admission")
-            return _error(504, "deadline exhausted at admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
         if not ctx.admit():
             ctx.rejected("region")
-            return _error(429, "server at capacity (region admission bound)",
-                          retry_after=1)
+            return _error(429, MSG_CAPACITY_REGION, retry_after=1)
         fut = self._loop.run_in_executor(
             self._pool, self._region_work, spec, query, t0, http11,
             deadline_t
@@ -1380,7 +1386,7 @@ class AioServer:
         try:
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 ctx.deadline_shed("execute")
-                return _error(504, "deadline exhausted before execution")
+                return _error(504, MSG_DEADLINE_EXECUTE)
             try:
                 min_cadd, max_rank, limit, cursor = \
                     parse_region_params(query)

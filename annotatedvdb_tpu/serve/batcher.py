@@ -42,6 +42,7 @@ from annotatedvdb_tpu.serve.engine import parse_variant_id
 from annotatedvdb_tpu.serve.resilience import DeadlineExceeded
 from annotatedvdb_tpu.utils import faults
 from annotatedvdb_tpu.utils.pipeline import StageStats
+from annotatedvdb_tpu.utils.locks import make_lock
 
 #: batch-fill histogram edges (fraction of max_batch actually used)
 BATCH_FILL_EDGES = (0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
@@ -146,7 +147,7 @@ class QueryBatcher:
         self.stats = StageStats("serve.batch")
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.batcher.stats")
         #: guarded by self._lock
         self._batches = 0
         #: guarded by self._lock

@@ -78,6 +78,7 @@ from annotatedvdb_tpu.types import (
     encode_allele_array,
 )
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils.locks import make_lock
 
 
 class QueryError(ValueError):
@@ -572,7 +573,7 @@ class QueryEngine:
         self.breaker = breaker
         if breaker is not None:
             breaker.install()
-        self._render_lock = threading.Lock()
+        self._render_lock = make_lock("serve.engine.render")
         #: guarded by self._render_lock
         self._render_cache: OrderedDict = OrderedDict()
         #: guarded by self._render_lock
@@ -582,7 +583,7 @@ class QueryEngine:
                 os.environ.get("AVDB_SERVE_REGION_CACHE", "") or 64
             )
         self.region_cache_size = max(int(region_cache_size), 0)
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("serve.engine.cache")
         #: guarded by self._cache_lock
         self._region_cache: OrderedDict = OrderedDict()
         #: guarded by self._cache_lock; (generation, region, filters) ->
@@ -601,7 +602,7 @@ class QueryEngine:
         #: and a multiple of the shard's RAM — N duplicate builds would
         #: be an N-fold memory spike for identical results.  Losers wait
         #: and take the winner's entry from the cache.
-        self._index_build_lock = threading.Lock()
+        self._index_build_lock = make_lock("serve.engine.index_build")
         if registry is not None:
             self._cache_hits = registry.counter(
                 "avdb_query_cache_hits_total",

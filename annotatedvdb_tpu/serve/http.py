@@ -59,6 +59,7 @@ from annotatedvdb_tpu.serve.resilience import (
     PointCache,
 )
 from annotatedvdb_tpu.serve.snapshot import SnapshotManager
+from annotatedvdb_tpu.utils.locks import make_lock
 
 #: per-request latency histogram edges (seconds; sub-ms to 2.5s)
 QUERY_SECONDS_EDGES = (
@@ -160,6 +161,22 @@ REGIONS_BODY_ERROR = (
     '"tokenize"'
 )
 
+#: shared response-shaping messages — BOTH front ends render from these
+#: (the AVDB801 parity contract: a literal duplicated across the two
+#: front-end files forks the first time one side is edited, so the text
+#: lives here and ``serve/aio.py`` imports it)
+BULK_BODY_ERROR = 'bulk body must be {"ids": ["chr:pos:ref:alt", ...]}'
+MSG_DEADLINE_ADMISSION = "deadline exhausted at admission"
+MSG_DEADLINE_EXECUTE = "deadline exhausted before execution"
+MSG_BROWNOUT_BULK = (
+    "brownout: bulk reads shed (point reads keep serving)"
+)
+MSG_BROWNOUT_REGION = (
+    "brownout: region reads shed (point reads keep serving)"
+)
+MSG_CAPACITY_BULK = "server at capacity (bulk admission bound)"
+MSG_CAPACITY_REGION = "server at capacity (region admission bound)"
+
 
 def parse_regions_body(body: bytes):
     """``(specs, min_cadd, max_conseq_rank, limit, tokenize)`` from a
@@ -216,7 +233,7 @@ class ServeContext:
             max_inflight if max_inflight is not None else batcher.max_queue
         )
         self.log = log if log is not None else (lambda msg: None)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.ctx.inflight")
         #: guarded by self._lock
         self._inflight = 0
         #: default per-request deadline budget (0 = none unless the client
@@ -490,7 +507,7 @@ class ServeHandler(BaseHTTPRequestHandler):
         deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
         action, payload = ctx.point_preflight(variant_id, deadline_t)
         if action == "shed":
-            self._error(504, "deadline exhausted at admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
             return
         if action == "cached":
             if payload is None:
@@ -531,17 +548,16 @@ class ServeHandler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         if ctx.governor.shed_bulk():
             ctx.brownout_shed()
-            self._error(503, "brownout: bulk reads shed (point reads "
-                             "keep serving)")
+            self._error(503, MSG_BROWNOUT_BULK)
             return
         deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
         if deadline_t is not None and time.monotonic() >= deadline_t:
             ctx.deadline_shed("admission")
-            self._error(504, "deadline exhausted at admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
             return
         if not ctx.admit():
             ctx.rejected("bulk")
-            self._error(429, "server at capacity (bulk admission bound)")
+            self._error(429, MSG_CAPACITY_BULK)
             return
         try:
             ctx.refresh_snapshot()
@@ -554,12 +570,12 @@ class ServeHandler(BaseHTTPRequestHandler):
                     raise KeyError("ids")
             except (ValueError, KeyError, TypeError):
                 ctx.errored("bulk")
-                self._error(400, 'bulk body must be {"ids": ["chr:pos:ref:alt", ...]}')
+                self._error(400, BULK_BODY_ERROR)
                 return
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 # body read/queueing ate the budget: shed BEFORE the probe
                 ctx.deadline_shed("execute")
-                self._error(504, "deadline exhausted before execution")
+                self._error(504, MSG_DEADLINE_EXECUTE)
                 return
             try:
                 results = ctx.engine.lookup_many(ids)
@@ -587,17 +603,16 @@ class ServeHandler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         if ctx.governor.shed_bulk():
             ctx.brownout_shed()
-            self._error(503, "brownout: region reads shed (point reads "
-                             "keep serving)")
+            self._error(503, MSG_BROWNOUT_REGION)
             return
         deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
         if deadline_t is not None and time.monotonic() >= deadline_t:
             ctx.deadline_shed("admission")
-            self._error(504, "deadline exhausted at admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
             return
         if not ctx.admit():
             ctx.rejected("regions")
-            self._error(429, "server at capacity (region admission bound)")
+            self._error(429, MSG_CAPACITY_REGION)
             return
         try:
             ctx.refresh_snapshot()
@@ -614,7 +629,7 @@ class ServeHandler(BaseHTTPRequestHandler):
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 # body read/queueing ate the budget: shed BEFORE the scan
                 ctx.deadline_shed("execute")
-                self._error(504, "deadline exhausted before execution")
+                self._error(504, MSG_DEADLINE_EXECUTE)
                 return
             try:
                 cap = ctx.governor.region_limit_cap()
@@ -646,17 +661,16 @@ class ServeHandler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         if ctx.governor.shed_bulk():
             ctx.brownout_shed()
-            self._error(503, "brownout: region reads shed (point reads "
-                             "keep serving)")
+            self._error(503, MSG_BROWNOUT_REGION)
             return
         deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
         if deadline_t is not None and time.monotonic() >= deadline_t:
             ctx.deadline_shed("admission")
-            self._error(504, "deadline exhausted at admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
             return
         if not ctx.admit():
             ctx.rejected("region")
-            self._error(429, "server at capacity (region admission bound)")
+            self._error(429, MSG_CAPACITY_REGION)
             return
         try:
             ctx.refresh_snapshot()

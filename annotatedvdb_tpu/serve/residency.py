@@ -37,6 +37,7 @@ import threading
 import time
 
 from annotatedvdb_tpu.utils.arrays import next_pow2
+from annotatedvdb_tpu.utils.locks import make_lock
 
 #: score decay per DECAY_REF_S of ELAPSED time (half-life ~0.7s): an
 #: untouched segment ages out on a wall-clock schedule — the same at
@@ -164,7 +165,7 @@ class ResidencyManager:
             PLAN_INTERVAL_S if plan_interval_s is None
             else max(float(plan_interval_s), 0.0)
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.residency.manager")
         #: guarded by self._lock
         self._last_plan = time.monotonic()
         #: guarded by self._lock

@@ -56,6 +56,7 @@ import os
 import threading
 import time
 from collections import OrderedDict
+from annotatedvdb_tpu.utils.locks import make_lock
 
 #: region row ceiling under brownout level >= 1 (the "limit" rung): a hot
 #: serving process must bound per-request render work before it starts
@@ -128,7 +129,7 @@ class PointCache:
 
     def __init__(self, capacity: int = 8192):
         self.capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.resilience.point_cache")
         #: guarded by self._lock
         self._cache: OrderedDict = OrderedDict()
 
@@ -212,7 +213,7 @@ class OverloadGovernor:
             else max(float(eval_interval_s), 0.0)
         )
         self.hold_s = self.HOLD_S if hold_s is None else max(float(hold_s), 0.0)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.resilience.governor")
         #: guarded by self._lock
         self._level = LEVEL_NORMAL
         #: guarded by self._lock
@@ -373,7 +374,7 @@ class DeviceBreaker:
             self.FAILURE_THRESHOLD if failure_threshold is None
             else max(int(failure_threshold), 1)
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.resilience.breaker")
         #: guarded by self._lock; code -> {state, failures, reopen_at, cooldown}
         self._groups: dict[int, dict] = {}
         if registry is not None:

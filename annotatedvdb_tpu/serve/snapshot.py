@@ -37,6 +37,7 @@ import time
 
 from annotatedvdb_tpu.store import VariantStore
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils.locks import make_lock
 
 
 def _ttl_from_env() -> float:
@@ -76,7 +77,7 @@ class SnapshotManager:
         self.store_dir = store_dir
         self.log = log if log is not None else (lambda msg: None)
         self.ttl_s = _ttl_from_env() if ttl_s is None else max(float(ttl_s), 0.0)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.snapshot.pin")
         fingerprint = _manifest_fingerprint(store_dir)
         store = VariantStore.load(store_dir, readonly=True)
         #: guarded by self._lock

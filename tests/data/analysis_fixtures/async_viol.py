@@ -1,0 +1,71 @@
+"""Fixture: async-safety violations (AVDB701/AVDB702).
+
+``# EXPECT: <CODE>`` markers pin the expected findings; see
+tests/test_avdb_check.py.  Never imported — purely static analysis.
+"""
+import subprocess
+import threading
+import time
+
+
+async def blocking_directly(loop, pool):
+    time.sleep(0.5)                           # EXPECT: AVDB701
+    data = open("/tmp/f").read()              # EXPECT: AVDB701
+    subprocess.run(["ls"])                    # EXPECT: AVDB701
+    await loop.run_in_executor(pool, slow_scan)   # routed: allowed
+    return data
+
+
+def slow_scan():
+    # only referenced as an executor target, never CALLED from async:
+    # nothing here is flagged
+    time.sleep(1.0)
+    return open("/tmp/g").read()
+
+
+def helper_called_from_async():
+    with open("/tmp/h") as f:                 # EXPECT: AVDB701
+        return f.read()
+
+
+def second_hop():
+    return helper_called_from_async()
+
+
+async def blocking_via_helpers():
+    return helper_called_from_async() + second_hop()
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def _sync_write(self):
+        time.sleep(0.01)                      # EXPECT: AVDB701
+
+    async def handle(self, fut):
+        fut.result()                          # EXPECT: AVDB701
+        with self._lock:                      # EXPECT: AVDB701
+            self.value += 1
+        self._sync_write()
+
+    async def await_under_lock(self, fut):
+        with self._lock:                      # EXPECT: AVDB701
+            await fut                         # EXPECT: AVDB702
+        return self.value
+
+    async def suppressed(self):
+        time.sleep(0)  # avdb: noqa[AVDB701] -- fixture: justified block
+
+    async def callback_factory(self):
+        def cb():
+            # nested def: runs wherever its executor runs, not here
+            time.sleep(1.0)
+        return cb
+
+
+def plain_sync_function():
+    # no async reaches this: blocking is fine on a worker thread
+    time.sleep(0.1)
+    return open("/tmp/ok").read()

@@ -1,0 +1,10 @@
+# fixture aio front end (parity pair twin)       # EXPECT: AVDB803
+# AVDB803 reports file-level at line 1: parse_region_params is used by
+# the http twin but never referenced here.
+import os
+
+
+def handler():
+    knob = os.environ.get("AVDB_SERVE_FIXTURE_KNOB", "1")  # EXPECT: AVDB802
+    body = "fixture response body shaped here exactly once"  # EXPECT: AVDB801
+    return body + knob

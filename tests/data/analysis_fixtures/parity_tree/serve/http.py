@@ -1,0 +1,21 @@
+"""Fixture: the "threaded front end" half of the parity pair (AVDB8xx).
+
+Findings are reported against the aio twin (``serve/aio.py`` in this
+tree), which carries the EXPECT markers; this file is the reference
+side.  See tests/test_avdb_check.py.
+"""
+import os
+
+
+MSG_SHED = "fixture: bulk reads shed (point reads keep serving)"
+
+
+def parse_region_params(query):
+    """The shared helper the aio twin fails to use (AVDB803 over there)."""
+    return query
+
+
+def handler():
+    knob = os.environ.get("AVDB_SERVE_FIXTURE_KNOB", "1")
+    body = "fixture response body shaped here exactly once"
+    return parse_region_params(MSG_SHED + body + knob)

@@ -1,0 +1,15 @@
+"""Fixture ops registry for the AVDB9xx twin-contract rules."""
+
+TWINS: dict = {
+    # clean: jitted, twin resolves, pair referenced by tests/kernels_parity.py
+    "ops.kernels.good_kernel_jit": "ops.kernels.good_kernel_np",
+    # resolvable pair with NO test referencing both
+    "ops.kernels.untested_kernel_jit":
+        "ops.kernels.untested_kernel_np",     # EXPECT: AVDB903
+    # stale: no such jitted function under ops/
+    "ops.kernels.ghost_kernel_jit":
+        "ops.kernels.ghost_kernel_np",        # EXPECT: AVDB902
+    # stale the other way: kernel exists, twin target does not
+    "ops.kernels.orphan_kernel_jit":
+        "ops.kernels.no_such_twin",           # EXPECT: AVDB902
+}

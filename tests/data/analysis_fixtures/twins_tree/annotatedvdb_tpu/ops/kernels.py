@@ -1,0 +1,50 @@
+"""Fixture kernels for the AVDB9xx twin-contract rules."""
+import functools
+
+import jax
+
+
+def good_kernel(x):
+    return x
+
+
+def good_kernel_np(x):
+    return x
+
+
+good_kernel_jit = jax.jit(good_kernel)      # registered + tested: clean
+
+
+def untested_kernel(x):
+    return x
+
+
+def untested_kernel_np(x):
+    return x
+
+
+untested_kernel_jit = jax.jit(untested_kernel)  # 903 fires at the registry
+
+
+def orphan_kernel(x):
+    return x
+
+
+orphan_kernel_jit = jax.jit(orphan_kernel)  # its TWIN is stale (registry)
+
+
+def rogue_kernel(x):
+    return x
+
+
+rogue_kernel_jit = jax.jit(rogue_kernel)    # EXPECT: AVDB901
+
+
+@jax.jit
+def decorated_rogue(x):                     # EXPECT: AVDB901
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def partial_rogue(x, mode):                 # EXPECT: AVDB901
+    return x

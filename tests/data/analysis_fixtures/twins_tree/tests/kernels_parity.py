@@ -1,0 +1,6 @@
+"""Fixture parity "test" for the twins tree: references good_kernel_jit
+together with good_kernel_np, so that pair (and only that pair) counts
+as proven for AVDB903.  Not collected by pytest (no test_ prefix) — the
+analyzer only needs the names to co-occur in a file under tests/."""
+
+PAIR = ("good_kernel_jit", "good_kernel_np")

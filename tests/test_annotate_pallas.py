@@ -29,6 +29,9 @@ EDGE_VARIANTS = [
     ("8", 700, "ACA", "ACACA"),
     ("9", 800, "AGCGC", "AGC"),
     ("10", 900, "AGC", "AGCGCGC"),          # dup: inserted GCGC vs ref[1:] GC
+    ("10", 950, "AC", "C"),                 # prefix-0 tiling: ref[1:] == alt
+    ("10", 960, "GCC", "C"),                # (the lag-0 dup-flag case the
+                                            # twin suite caught missing)
     ("11", 1000, "ATTT", "GTT"),
     ("12", 1100, "CAAA", "CAAAA"),
     ("13", 15_625, "A", "ACCCCCCCCCCCCCCCCCCCCC"),  # crosses a leaf-bin edge

@@ -59,6 +59,7 @@ FIXTURE_FILES = [
     "registry_viol.py",
     "env_viol.py",
     "hygiene_viol.py",
+    "async_viol.py",
 ]
 
 
@@ -99,11 +100,84 @@ def test_fixtures_fail_via_cli_entrypoint():
 def test_every_rule_family_covered_by_fixtures():
     """One fixture-backed assertion per family, by construction."""
     families = set()
-    for name in FIXTURE_FILES + ["cli_viol.py"]:
+    tree_fixtures = [
+        os.path.join("parity_tree", "serve", "aio.py"),
+        os.path.join("twins_tree", "annotatedvdb_tpu", "ops",
+                     "__init__.py"),
+        os.path.join("twins_tree", "annotatedvdb_tpu", "ops",
+                     "kernels.py"),
+    ]
+    for name in FIXTURE_FILES + ["cli_viol.py"] + tree_fixtures:
         for _line, code in expected_pairs(os.path.join(FIXTURES, name)):
-            families.add(code[:5])  # AVDB1..AVDB6
+            families.add(code[:5])  # AVDB1..AVDB9
     assert families == {"AVDB1", "AVDB2", "AVDB3", "AVDB4", "AVDB5",
-                        "AVDB6"}
+                        "AVDB6", "AVDB7", "AVDB8", "AVDB9"}
+
+
+# ---------------------------------------------------------------------------
+# tree fixtures: the parity pair (AVDB8xx) and the twins registry (AVDB9xx)
+# are cross-file rules, so their fixtures are little trees, scanned whole
+
+
+def _tree_pairs(tree, files):
+    want = {}
+    for rel in files:
+        path = os.path.join(tree, rel)
+        for line, code in expected_pairs(path):
+            want.setdefault(rel.replace(os.sep, "/"), set()).add(
+                (line, code)
+            )
+    return want
+
+
+def test_parity_tree_fixture():
+    tree = os.path.join(FIXTURES, "parity_tree")
+    findings, n = run_paths([tree], root=tree)
+    assert n == 2
+    got = {}
+    for f in findings:
+        rel = f.path.replace("\\", "/").split("parity_tree/")[-1]
+        got.setdefault(rel, set()).add((f.line, f.code))
+    want = _tree_pairs(tree, [
+        os.path.join("serve", "http.py"), os.path.join("serve", "aio.py"),
+    ])
+    assert got == want, (got, want)
+
+
+def test_parity_silent_on_single_front_end():
+    """A scan holding only one front-end file cannot judge parity."""
+    tree = os.path.join(FIXTURES, "parity_tree")
+    findings, n = run_paths(
+        [os.path.join(tree, "serve", "aio.py")], root=tree
+    )
+    assert n == 1
+    assert [f for f in findings if f.code.startswith("AVDB8")] == []
+
+
+def test_twins_tree_fixture():
+    tree = os.path.join(FIXTURES, "twins_tree")
+    findings, n = run_paths([tree], root=tree)
+    assert n == 3
+    got = {}
+    for f in findings:
+        rel = f.path.replace("\\", "/").split("twins_tree/")[-1]
+        got.setdefault(rel, set()).add((f.line, f.code))
+    want = _tree_pairs(tree, [
+        os.path.join("annotatedvdb_tpu", "ops", "__init__.py"),
+        os.path.join("annotatedvdb_tpu", "ops", "kernels.py"),
+    ])
+    assert got == want, (got, want)
+
+
+def test_twins_silent_without_registry_scan():
+    """Scanning one ops module alone (the registry not in the scan) must
+    not fire the twin audits — AVDB9xx needs ops/__init__.py."""
+    tree = os.path.join(FIXTURES, "twins_tree")
+    findings, _n = run_paths(
+        [os.path.join(tree, "annotatedvdb_tpu", "ops", "kernels.py")],
+        root=tree,
+    )
+    assert [f for f in findings if f.code.startswith("AVDB9")] == []
 
 
 # ---------------------------------------------------------------------------
@@ -256,6 +330,55 @@ def test_audit_codes_gated_off_on_partial_scans():
     codes += [f.code for f in rules_env.finalize(facts, project)]
     assert "AVDB302" not in codes
     assert "AVDB402" not in codes and "AVDB403" not in codes
+
+
+# ---------------------------------------------------------------------------
+# --diff mode: the fast pre-commit scan
+
+
+def test_diff_mode_is_clean_and_audit_free():
+    """``--diff HEAD`` analyzes only changed files and must stay clean on
+    a tree the full gate accepts: the whole-project audit codes
+    (AVDB302/305/402/403/9xx) gate OFF — a partial scan that happens to
+    include config.py must not judge the files it did not scan."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "avdb_check.py"),
+         "--diff", "HEAD", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    report = json.loads(p.stdout)
+    assert report["findings"] == []
+
+
+def test_diff_mode_rejects_bad_rev_and_path_mix():
+    tool = os.path.join(REPO, "tools", "avdb_check.py")
+    p = subprocess.run(
+        [sys.executable, tool, "--diff", "no-such-rev-zzz"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 2
+    assert "failed" in p.stderr
+    p = subprocess.run(
+        [sys.executable, tool, "--diff", "HEAD", "somepath"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 2
+    assert "exclusive" in p.stderr
+
+
+def test_diff_mode_audit_gating_via_api():
+    """audit=False keeps call-site codes firing but silences the
+    project audits even when config.py is in the scan set."""
+    config = os.path.join(REPO, "annotatedvdb_tpu", "config.py")
+    bad = os.path.join(FIXTURES, "hygiene_viol.py")
+    findings, _n = run_paths([config, bad], audit=False)
+    codes = {f.code for f in findings}
+    assert any(c.startswith("AVDB6") for c in codes)  # per-file still on
+    assert not any(
+        c in {"AVDB302", "AVDB305", "AVDB402", "AVDB403"} or
+        c.startswith("AVDB9") for c in codes
+    ), codes
 
 
 # ---------------------------------------------------------------------------

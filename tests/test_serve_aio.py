@@ -844,3 +844,66 @@ def test_loop_batcher_burst_leaves_no_orphan_drain():
         b.close()
 
     asyncio.run(scenario())
+
+
+def test_heartbeat_mmap_preopened_at_worker_start(store, tmp_path):
+    """The heartbeat file is opened + mmap'd ONCE at construction (worker
+    start) — never on the event loop (AVDB701: the maintenance tick only
+    pack_intos the established mapping).  Pinned by unlinking the file
+    before the loop starts: a per-tick reopen would fail and stop the
+    beats, while the preopened mapping keeps advancing."""
+    import os
+    import struct
+
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+
+    store_dir, _truth = store
+    hb = tmp_path / "hb"
+    hb.write_bytes(b"\x00" * 8)
+    server = build_aio_server(
+        store_dir=store_dir, port=0, heartbeat_file=str(hb),
+        heartbeat_index=0,
+    )
+    try:
+        # the mapping exists BEFORE any loop does
+        assert server._hb_mm is not None
+        os.unlink(hb)  # a reopen from here on is impossible
+        server.start_background()
+        deadline = time.monotonic() + 10
+        beat1 = 0.0
+        while beat1 == 0.0 and time.monotonic() < deadline:
+            beat1 = struct.unpack_from("<d", server._hb_mm, 0)[0]
+            time.sleep(0.05)
+        assert beat1 > 0.0, "first heartbeat never landed"
+        beat2 = beat1
+        while beat2 <= beat1 and time.monotonic() < deadline:
+            beat2 = struct.unpack_from("<d", server._hb_mm, 0)[0]
+            time.sleep(0.05)
+        assert beat2 > beat1, "heartbeat stopped advancing after unlink"
+    finally:
+        server.shutdown()
+        server.ctx.batcher.close()
+
+
+def test_heartbeat_unusable_file_logs_and_serves(store, tmp_path):
+    """A missing/unopenable heartbeat file degrades exactly as before:
+    the worker logs, serves, and the watchdog just never sees it."""
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+
+    store_dir, _truth = store
+    logs: list = []
+    server = build_aio_server(
+        store_dir=store_dir, port=0,
+        heartbeat_file=str(tmp_path / "missing_hb"),
+        log=logs.append,
+    )
+    try:
+        assert server._hb_mm is None
+        assert any("heartbeat file unusable" in m for m in logs)
+        server.start_background()
+        port = server.server_address[1]
+        status, body, _hdrs = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+    finally:
+        server.shutdown()
+        server.ctx.batcher.close()

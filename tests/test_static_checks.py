@@ -5,9 +5,12 @@ enough to run on every PR.
 This is the enforcement half of the suite — the analyzer's own behavior
 is pinned fixture-by-fixture in ``tests/test_avdb_check.py``.  A finding
 here means new code violated a project invariant (trace-safety,
-lock-discipline, registry-drift, env-drift, CLI-contract, hygiene): fix
+lock-discipline, registry-drift, env-drift, CLI-contract, hygiene,
+async-safety, cross-front-end parity, device/host twin contract): fix
 it or suppress with ``# avdb: noqa[CODE] -- reason`` per README "Static
-analysis & code health".
+analysis & code health".  The chained script additionally runs the serve
+smoke under ``AVDB_LOCK_TRACE=1`` — the dynamic lock-order/deadlock
+detector — and fails on any acquisition-order cycle.
 """
 
 import os
@@ -37,7 +40,8 @@ def test_tree_is_clean_and_fast():
 
 def test_run_checks_script_clean():
     """The chained entry point (avdb_check + ruff-if-present + bench
-    schema) gates every future PR from one script."""
+    schema + lock-order-traced serve smoke + chaos smoke) gates every
+    future PR from one script."""
     p = subprocess.run(
         ["bash", os.path.join(REPO, "tools", "run_checks.sh")],
         capture_output=True, text=True, cwd=REPO,

@@ -4,13 +4,17 @@
 # gates every PR without separate CI infrastructure.
 #
 #   1. avdb_check  — project-native rules (trace-safety, lock-discipline,
-#                    registry-drift, env-drift, CLI-contract, hygiene)
+#                    registry-drift, env-drift, CLI-contract, hygiene,
+#                    async-safety, cross-front-end parity, twin contract)
 #   2. ruff        — generic pyflakes-class lint (pyproject.toml subset);
 #                    SKIPPED with a notice when ruff is not installed
 #                    (the container image does not ship it)
 #   3. check_bench_schema — committed BENCH_*.json records stay loadable
 #   4. serve_smoke — the HTTP query API answers point/region/metrics
-#                    against a tiny store on an ephemeral loopback port
+#                    against a tiny store on an ephemeral loopback port;
+#                    runs under AVDB_LOCK_TRACE=1, so every serve-stack
+#                    lock is order-traced and ANY acquisition-order cycle
+#                    (potential deadlock) fails the smoke
 #   5. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
@@ -38,8 +42,8 @@ fi
 echo "== bench schema ==" >&2
 python "$root/tools/check_bench_schema.py" || rc=1
 
-echo "== serve smoke ==" >&2
-python "$root/tools/serve_smoke.py" || rc=1
+echo "== serve smoke (lock-order traced) ==" >&2
+AVDB_LOCK_TRACE=1 python "$root/tools/serve_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1

@@ -182,6 +182,26 @@ def main() -> int:
         import shutil
 
         shutil.rmtree(work, ignore_errors=True)
+    if os.environ.get("AVDB_LOCK_TRACE", "") == "1":
+        # lock-order smoke: the whole battery just ran with every serve-
+        # stack lock traced — any cycle in the acquisition-order graph is
+        # a potential deadlock and fails the check (tools/run_checks.sh
+        # arms this; see analysis/lockorder).  Cycles join the ordinary
+        # failures list so the functional failures that may explain them
+        # still print alongside.
+        from annotatedvdb_tpu.analysis.lockorder import RECORDER
+
+        rep = RECORDER.report()
+        for cyc in rep["cycles"]:
+            check("lock-order cycle (potential deadlock)", False,
+                  " -> ".join(cyc + cyc[:1]))
+        if not rep["cycles"]:
+            print(
+                f"serve_smoke: lock order clean ({len(rep['locks'])} "
+                f"traced locks, {len(rep['edges'])} ordering edges, "
+                f"0 cycles)",
+                file=sys.stderr,
+            )
     if failures:
         for f in failures:
             print(f"serve_smoke FAIL {f}", file=sys.stderr)
