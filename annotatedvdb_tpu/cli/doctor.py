@@ -1,23 +1,31 @@
-"""CLI: store health — fsck/repair + quarantine replay.
+"""CLI: store health — fsck/repair, online compaction, quarantine replay.
 
 ``doctor`` (default verb) audits a store directory against its manifest's
 write-time integrity records and the ledger, and repairs what is safely
-repairable (see ``annotatedvdb_tpu.store.fsck``); ``doctor replay-rejects``
+repairable (see ``annotatedvdb_tpu.store.fsck``); ``doctor compact`` merges
+a store's accumulated checkpoint segments into one columnar segment per
+chromosome, crash-safe and online (``annotatedvdb_tpu.store.compact`` —
+safe to run while a serve fleet reads the store); ``doctor replay-rejects``
 reconstructs a loadable input file from a quarantine rejects file
 (``utils.quarantine``) after the bad lines have been fixed.
 
 Usage:
     python -m annotatedvdb_tpu doctor --storeDir ./vdb [--deep] [--repair] [--json]
+    python -m annotatedvdb_tpu doctor compact --storeDir ./vdb \
+        [--dry-run] [--maxBytes N] [--group 8 ...] [--json]
     python -m annotatedvdb_tpu doctor replay-rejects \
         --rejects ./vdb/quarantine/x.vcf.rejects.jsonl --out fixed.vcf
 
 Exit codes (fsck verb): 0 = clean, 1 = warnings / repaired, 2 = errors.
+Exit codes (compact verb): 0 = compacted / nothing to do, 1 = pass
+aborted cleanly (preempted by a loader commit or SIGTERM), 2 = error.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import signal
 import sys
 
 
@@ -42,10 +50,115 @@ def _replay(argv) -> int:
     return 0
 
 
+def _compact(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor compact",
+        description="merge a store's checkpoint segments into one "
+                    "position-sorted, deduplicated columnar segment per "
+                    "chromosome (crash-safe; online — safe under a live "
+                    "serve fleet)",
+    )
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--dry-run", action="store_true", dest="dry_run",
+                    help="print the plan (groups, segment counts, bytes) "
+                         "without touching the store")
+    ap.add_argument("--maxBytes", type=int, default=None, metavar="N",
+                    help="cap the pass: compact groups smallest-first "
+                         "until the next would push input bytes over N")
+    ap.add_argument("--group", action="append", default=None, metavar="L",
+                    help="chromosome label to compact (repeatable; "
+                         "'8' or 'chr8'; default: every eligible group)")
+    ap.add_argument("--chunkRows", type=int, default=None, metavar="N",
+                    help="rows per streamed merge chunk (default "
+                         "AVDB_COMPACT_CHUNK_ROWS or 262144)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    from annotatedvdb_tpu.store.compact import (
+        CompactionError,
+        compact_store,
+        plan_compaction,
+    )
+
+    log = (lambda m: None) if args.json else (
+        lambda m: print(m, file=sys.stderr)
+    )
+    if args.dry_run:
+        try:
+            plan = plan_compaction(args.storeDir, groups=args.group,
+                                   max_bytes=args.maxBytes)
+        except CompactionError as err:
+            print(f"doctor compact: {err}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(plan, indent=1))
+        else:
+            print(f"compact plan for {args.storeDir}:", file=sys.stderr)
+            for e in plan["eligible"]:
+                print(f"  chr{e['label']}: {e['stems']} segment file "
+                      f"pair(s) in {e['groups']} group(s), "
+                      f"{e['bytes_before']} bytes -> <= "
+                      f"{e['est_bytes_after']} bytes "
+                      f"(gain: {e['stems'] - 1} fewer file pairs"
+                      + (f", {e['rows']} rows" if e["rows"] is not None
+                         else "") + ")",
+                      file=sys.stderr)
+            for e in plan["skipped"]:
+                print(f"  chr{e['label']}: skipped — {e['reason']}",
+                      file=sys.stderr)
+            print(f"  total: {len(plan['eligible'])} group(s), "
+                  f"{plan['total_files_before']} file pair(s), "
+                  f"{plan['total_bytes_before']} bytes",
+                  file=sys.stderr)
+        return 0
+
+    # cooperative shutdown: SIGTERM flips the cancel flag, the pass aborts
+    # cleanly between chunks (temps removed, store untouched)
+    cancelled = {"flag": False}
+    previous = signal.getsignal(signal.SIGTERM)
+
+    def _on_term(_signum, _frame):
+        cancelled["flag"] = True
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # non-main thread (tests): keep the default
+        previous = None
+    # announced AFTER the handler is live: supervisors (and the SIGTERM
+    # regression test) key on this line before signaling
+    log(f"doctor compact: {args.storeDir}: pass starting "
+        "(SIGTERM aborts cleanly)")
+    try:
+        report = compact_store(
+            args.storeDir, groups=args.group, max_bytes=args.maxBytes,
+            chunk_rows=args.chunkRows, cancel=lambda: cancelled["flag"],
+            log=log,
+        )
+    except (CompactionError, OSError, ValueError) as err:
+        # hard failures (bad manifest, ENOSPC mid-merge, a source segment
+        # failing its integrity check — StoreCorruptError is a ValueError)
+        # are the documented exit 2, never the benign "aborted cleanly" 1
+        print(f"doctor compact: {type(err).__name__}: {err}",
+              file=sys.stderr)
+        return 2
+    finally:
+        if previous is not None:
+            signal.signal(signal.SIGTERM, previous)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"doctor compact: {args.storeDir}: {report['status']}"
+              + (f" ({report.get('reason')})"
+                 if report["status"] != "compacted" else ""),
+              file=sys.stderr)
+    return 1 if report["status"] == "aborted" else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "replay-rejects":
         return _replay(argv[1:])
+    if argv and argv[0] == "compact":
+        return _compact(argv[1:])
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--storeDir", required=True)

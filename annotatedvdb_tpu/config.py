@@ -72,6 +72,16 @@ ENV_VARS: dict = {
                   "fail the arm)",
     "AVDB_FAULT_SEED": "integer seed for the prob:<p> fault-arming coin "
                        "(default 0xA5DB) — chaos runs replay exactly",
+    "AVDB_STORE_SPILL_BYTES": "segment containers at/above this size load "
+                              "as copy-on-write memmaps (out-of-core tier; "
+                              "512m / 2g suffixes; unset/0 = materialize "
+                              "everything)",
+    "AVDB_COMPACT_CHUNK_ROWS": "rows per streamed merge chunk in doctor "
+                               "compact (default 262144) — the unit of "
+                               "peak row-payload memory during a pass",
+    "AVDB_COMPACT_MIN_SEGMENTS": "smallest on-disk segment-file count that "
+                                 "makes a chromosome group eligible for "
+                                 "doctor compact (default 2)",
     # query & serving (serve/)
     "AVDB_SERVE_BATCH_MAX": "max point queries coalesced into one device "
                             "microbatch (default 256)",

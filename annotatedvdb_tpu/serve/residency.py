@@ -59,22 +59,9 @@ PLAN_INTERVAL_S = 0.05
 HYSTERESIS = 1.1
 
 
-def parse_bytes(spec: str) -> int:
-    """``"512m"``/``"2g"``/``"65536"`` -> bytes (k/m/g suffixes, base 1024)."""
-    s = spec.strip().lower()
-    mult = 1
-    if s and s[-1] in "kmg":
-        mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[s[-1]]
-        s = s[:-1]
-    try:
-        n = int(float(s) * mult)
-    except ValueError:
-        raise ValueError(
-            f"bad byte size {spec!r}: expected <int>[k|m|g]"
-        ) from None
-    if n < 0:
-        raise ValueError(f"bad byte size {spec!r}: must be >= 0")
-    return n
+# the shared byte-size parser (also used by the store's spill tier and the
+# serve CLI) — re-exported here so existing call sites keep their import
+from annotatedvdb_tpu.utils.strings import parse_bytes  # noqa: F401
 
 
 def budget_from_env() -> int | None:

@@ -5,8 +5,10 @@ from .variant_store import (
     StoreCorruptError,
 )
 from .ledger import AlgorithmLedger
+from .compact import CompactionError, compact_store, plan_compaction
 
 __all__ = [
     "VariantStore", "ChromosomeShard", "JSONB_COLUMNS", "AlgorithmLedger",
-    "StoreCorruptError",
+    "StoreCorruptError", "CompactionError", "compact_store",
+    "plan_compaction",
 ]

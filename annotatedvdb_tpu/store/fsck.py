@@ -7,8 +7,9 @@ that state, and the mechanic that restores it when it is not:
 - **detect**: unreadable/missing manifest, segment files that are missing,
   size-mismatched (torn) or crc-mismatched (``deep=True``) against the
   manifest's write-time integrity records, orphaned segment/tmp files from
-  crashed saves, foreign files squatting in the directory, torn ledger
-  lines, and dangling ``undo_intent`` records (a crash mid-undo);
+  crashed saves, abandoned ``*.compact.tmp*`` temps from a killed
+  ``doctor compact`` pass, foreign files squatting in the directory, torn
+  ledger lines, and dangling ``undo_intent`` records (a crash mid-undo);
 - **repair** (opt-in): prune orphans and stale tmp files, rewrite the
   manifest without backing groups whose files are damaged (rolling the
   affected shard back to its last consistent rows), heal the ledger, and
@@ -201,6 +202,8 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                         damaged.add((label, gi))
 
     # ---- directory scan: orphans, stale tmp, foreign files -----------------
+    from annotatedvdb_tpu.store.compact import is_compact_tmp
+
     for fname in sorted(os.listdir(store_dir)):
         fp = os.path.join(store_dir, fname)
         if not os.path.isfile(fp):
@@ -208,6 +211,17 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
         if fname.startswith(".") and ".tmp" in fname:
             note("warn", "stale-tmp",
                  f"{fp}: leftover tmp file from a crashed save")
+            if repair:
+                os.remove(fp)
+                did(f"removed {fp}")
+            continue
+        if is_compact_tmp(fname):
+            # an online-compaction pass (store/compact.py) that was killed
+            # mid-merge: its temps are ours, never a foreign segment — the
+            # manifested store never referenced them, so pruning is safe
+            note("warn", "compact-tmp",
+                 f"{fp}: abandoned compaction temp from a killed "
+                 "`doctor compact` pass")
             if repair:
                 os.remove(fp)
                 did(f"removed {fp}")

@@ -162,6 +162,21 @@ class AlgorithmLedger:
         with self._lock:
             return [e for e in self._entries if e.get("type") == "run"]
 
+    def compact(self, record: dict) -> None:
+        """Append one ``{"type": "compact"}`` maintenance record — the
+        audit trail of a ``doctor compact`` pass (labels compacted, files/
+        bytes before and after, shadowed-duplicate rows dropped, wall
+        seconds).  Like run records, resume/undo logic ignores it; ops
+        tooling and fsck read it for provenance."""
+        self._append({"type": "compact", **record, "ts": time.time()})
+
+    def compactions(self) -> list[dict]:
+        """All compact records, oldest first."""
+        with self._lock:
+            return [
+                e for e in self._entries if e.get("type") == "compact"
+            ]
+
     def undo_intent(self, alg_id: int) -> None:
         """Record that an undo of ``alg_id`` is ABOUT to mutate the store.
 

@@ -157,6 +157,24 @@ def _verify_mode() -> str:
     return mode if mode in ("off", "size", "deep") else "size"
 
 
+def _spill_bytes() -> int:
+    """AVDB_STORE_SPILL_BYTES: segment containers at or above this size
+    load as copy-on-write memmaps instead of materialized arrays (the
+    out-of-core tier — see ``_read_segment``).  Accepts ``512m`` / ``2g``
+    suffixes (the shared ``utils.strings.parse_bytes`` grammar; malformed
+    values raise rather than silently disabling the tier); unset/0/off
+    disables (every segment materializes, the historical behavior)."""
+    raw = os.environ.get("AVDB_STORE_SPILL_BYTES", "").strip().lower()
+    if not raw or raw in ("0", "off"):
+        return 0
+    from annotatedvdb_tpu.utils.strings import parse_bytes
+
+    try:
+        return parse_bytes(raw)
+    except ValueError as err:
+        raise ValueError(f"AVDB_STORE_SPILL_BYTES: {err}") from None
+
+
 def crc32_file(path: str) -> int:
     """Chunked crc32 of a whole file — the read-side twin of the write-time
     integrity records (shared by load-time deep verify and fsck)."""
@@ -343,6 +361,29 @@ def jsonb_dumps(value) -> str:
     if isinstance(value, RawJson):
         return value.text
     return json.dumps(value)
+
+
+def sidecar_line(named_values, i: int) -> str | None:
+    """One annotation-sidecar JSONL line for row ``i`` (None when the row
+    carries no values) — the SINGLE serializer shared by ``save()``'s
+    segment writer and the compactor (``store/compact.py``): byte parity
+    between freshly saved and compacted sidecars depends on both writers
+    splicing identically.  ``named_values`` yields (column, value) pairs;
+    RawJson values write their text verbatim (no parse/re-serialize)."""
+    parts = []
+    for c, v in named_values:
+        if v is None:
+            continue
+        if isinstance(v, RawJson):
+            parts.append(f'"{c}":{v.text}')
+        elif c == _LONG_ALLELES:
+            parts.append(f'"{c}":{json.dumps(list(v))}')
+        else:
+            parts.append(f'"{c}":{json.dumps(v)}')
+    if not parts:
+        return None
+    parts.append(f'"i":{i}')
+    return "{" + ",".join(parts) + "}\n"
 
 
 class Segment:
@@ -1412,22 +1453,11 @@ class VariantStore:
             present = [(c, seg.obj[c]) for c in OBJECT_COLUMNS
                        if seg.obj[c] is not None]
             for i in range(seg.n) if present else ():
-                # rows are assembled by splicing so RawJson values write
-                # their text verbatim (no parse/re-serialize round trip)
-                parts = []
-                for c, col in present:
-                    v = col[i]
-                    if v is None:
-                        continue
-                    if isinstance(v, RawJson):
-                        parts.append(f'"{c}":{v.text}')
-                    elif c == _LONG_ALLELES:
-                        parts.append(f'"{c}":{json.dumps(list(v))}')
-                    else:
-                        parts.append(f'"{c}":{json.dumps(v)}')
-                if parts:
-                    parts.append(f'"i":{i}')
-                    f.write(("{" + ",".join(parts) + "}\n").encode())
+                line = sidecar_line(
+                    ((c, col[i]) for c, col in present), i
+                )
+                if line is not None:
+                    f.write(line.encode())
             if fsync_data:
                 f.flush()
                 os.fsync(f.fileno())
@@ -1562,17 +1592,30 @@ class VariantStore:
                 p, (integrity or {}).get(key), verify, path
             )
         try:
+            spill = _spill_bytes()
+            spill_this = bool(spill and os.path.getsize(fp) >= spill)
             with open(fp, "rb") as f:
                 head = f.read(1)
                 if head == b"{":
                     # flat container (see _write_segment): JSON name line +
-                    # sequential raw .npy streams
+                    # sequential raw .npy streams.  ``seg: 2`` (written by
+                    # store/compact.py) additionally dictionary-codes the
+                    # allele matrices (ref_dict/ref_codes streams).
                     f.seek(0)
                     names = json.loads(f.readline())["names"]
                     data = {
-                        name: np.lib.format.read_array(f, allow_pickle=False)
+                        name: cls._read_stream(f, fp, spill_this)
                         for name in names
                     }
+                    # dict-coded alleles decode to the plain matrices (the
+                    # dictionary is small by construction; the decode is
+                    # the bounded materialization a spilled segment pays
+                    # for coded columns — the numeric bulk stays mmapped)
+                    for col in ("ref", "alt"):
+                        if col + "_dict" in data:
+                            data[col] = data.pop(col + "_dict")[
+                                data.pop(col + "_codes")
+                            ]
                 else:  # legacy zip-backed npz from older builds
                     f.seek(0)
                     with np.load(f) as z:
@@ -1599,8 +1642,8 @@ class VariantStore:
             full[:, :alt.shape[1]] = alt
             alt = full
         obj: dict = {c: None for c in OBJECT_COLUMNS}
-        with open(ap) as f:
-            for k, line in enumerate(f, start=1):
+        try:
+            for k, line in enumerate(cls._iter_sidecar(ap), start=1):
                 try:
                     row = json.loads(line)
                     i = row.pop("i")
@@ -1613,6 +1656,74 @@ class VariantStore:
                     if obj[c] is None:
                         obj[c] = np.full((n,), None, object)
                     obj[c][i] = tuple(v) if c == _LONG_ALLELES else v
+        except zlib.error as err:
+            # a bit-flipped compressed sidecar (compaction's format) must
+            # surface with the same actionable contract as every other
+            # torn/corrupt segment file — never a bare zlib.error
+            raise StoreCorruptError(
+                f"{ap}: compressed annotation sidecar failed to inflate "
+                f"({err}); " + _fsck_hint(path)
+            ) from err
         seg = Segment(cols, ref, alt, obj, backing=[seg_id])
         seg.dirty = False
         return seg
+
+    @staticmethod
+    def _read_stream(f, fp: str, spill: bool) -> np.ndarray:
+        """One raw .npy stream from a flat container: materialized by
+        default; when ``spill`` (the out-of-core tier, see
+        AVDB_STORE_SPILL_BYTES) the array is a copy-on-write memmap view
+        of the file — reads page from disk on demand, and the update
+        loaders' in-place mutations land in private pages (a dirty
+        segment is rewritten wholesale on save, never written back
+        through the map)."""
+        if not spill:
+            return np.lib.format.read_array(f, allow_pickle=False)
+        start = f.tell()
+        version = np.lib.format.read_magic(f)
+        if version == (1, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+        elif version == (2, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+        else:  # unknown header rev: stay correct, give up laziness
+            shape = fortran = dtype = None
+        if shape is None or fortran or dtype.hasobject:
+            f.seek(start)
+            return np.lib.format.read_array(f, allow_pickle=False)
+        offset = f.tell()
+        nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        arr = np.memmap(fp, dtype=dtype, mode="c", shape=shape,
+                        offset=offset) if nbytes else np.empty(shape, dtype)
+        f.seek(offset + nbytes)
+        return arr
+
+    @staticmethod
+    def _iter_sidecar(ap: str):
+        """Annotation-sidecar lines: plain JSONL ('{' leading byte, the
+        save() format) or the zlib-compressed variant compaction writes
+        (0x78 leading byte) — streamed, never fully buffered."""
+        with open(ap, "rb") as f:
+            head = f.read(1)
+            if not head:
+                return
+            f.seek(0)
+            if head == b"{":
+                for raw in f:
+                    yield raw.decode()
+                return
+            d = zlib.decompressobj()
+            buf = b""
+            while True:
+                block = f.read(1 << 20)
+                if not block:
+                    break
+                buf += d.decompress(block)
+                lines = buf.split(b"\n")
+                buf = lines.pop()
+                for ln in lines:
+                    if ln:
+                        yield ln.decode()
+            buf += d.flush()
+            for ln in buf.split(b"\n"):
+                if ln:
+                    yield ln.decode()

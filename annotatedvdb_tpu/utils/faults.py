@@ -81,6 +81,22 @@ arming any other name is a ``ValueError`` at parse time):
                             serving circuit breaker must absorb it on the
                             byte-identical host path and re-close via
                             half-open probes
+``compact.plan``            in ``store.compact.compact_store`` after the
+                            plan is chosen, before any segment is read —
+                            a death here must leave the store byte-
+                            untouched
+``compact.merge``           mid-way through a compaction temp container
+                            body (``torn_write`` tears the ``*.compact.tmp``
+                            file; the manifested store must not notice)
+``compact.swap``            after the new segments are renamed into place,
+                            before the atomic manifest replace — a death
+                            here must leave the OLD manifest serving with
+                            the new files as prunable orphans
+``compact.gc``              after the manifest swap, before the replaced
+                            segment files are unlinked — a death here
+                            leaves the NEW layout serving with the old
+                            files as prunable orphans; ``eio`` must be
+                            absorbed (gc is best-effort)
 ======================== ====================================================
 
 ``fired()`` exposes per-point fire counts for the observability exports.
@@ -115,6 +131,10 @@ POINTS = frozenset({
     "serve.wedge",
     "engine.device_probe",
     "snapshot.swap",
+    "compact.plan",
+    "compact.merge",
+    "compact.swap",
+    "compact.gc",
 })
 
 

@@ -59,6 +59,29 @@ def convert_str2numeric_values(d: dict) -> dict:
     return {k: to_numeric(v) for k, v in d.items()}
 
 
+def parse_bytes(spec: str) -> int:
+    """``"512m"``/``"2g"``/``"65536"`` -> bytes (k/m/g suffixes, base 1024).
+
+    The ONE byte-size parser for every ``AVDB_*`` size knob
+    (``AVDB_SERVE_HBM_BUDGET``, ``AVDB_STORE_SPILL_BYTES``, the serve
+    CLI's ``--hbmBudget``): malformed input raises — a typo'd knob must
+    error loudly, never silently disable the feature it configures."""
+    s = spec.strip().lower()
+    mult = 1
+    if s and s[-1] in "kmg":
+        mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[s[-1]]
+        s = s[:-1]
+    try:
+        n = int(float(s) * mult)
+    except ValueError:
+        raise ValueError(
+            f"bad byte size {spec!r}: expected <int>[k|m|g]"
+        ) from None
+    if n < 0:
+        raise ValueError(f"bad byte size {spec!r}: must be >= 0")
+    return n
+
+
 def deep_update(base: dict, patch: dict) -> dict:
     """Recursive dict merge, patch wins; mirrors the server-side
     ``jsonb_merge()`` the reference leans on (``vep_variant_loader.py:227``)."""

@@ -767,6 +767,173 @@ def bench_chaos() -> dict:
     return record
 
 
+def _build_fragmented_store(work: str, n_rows: int, batch: int = 4096):
+    """(store_dir, ids): a synth store committed checkpoint-by-checkpoint
+    (persist per batch), so the directory holds one segment file pair per
+    checkpoint — the fragmented shape ``doctor compact`` exists to fix."""
+    from annotatedvdb_tpu.loaders import TpuVcfLoader
+    from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+    from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH
+
+    vcf = os.path.join(work, "frag.vcf")
+    write_synth_vcf(vcf, n_rows)
+    store_dir = os.path.join(work, "fragstore")
+    store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
+    ledger = AlgorithmLedger(os.path.join(work, "frag_ledger.jsonl"))
+    TpuVcfLoader(
+        store, ledger, batch_size=batch, log=lambda *a: None
+    ).load_file(vcf, commit=True, persist=lambda: store.save(store_dir))
+    store.save(store_dir)
+    ids = []
+    with open(vcf) as fh:
+        for line in fh:
+            if line.startswith("#"):
+                continue
+            chrom, pos, _vid, ref, alt = line.split("\t")[:5]
+            ids.append(f"{chrom}:{pos}:{ref}:{alt.split(',')[0]}")
+    return store_dir, ids
+
+
+def bench_compaction(n_rows: int = 40_000) -> dict:
+    """The store-maintenance leg: compact a fragmented synth store with a
+    REAL ``doctor compact`` subprocess while ONE live serve worker answers
+    open-loop point load against it.  Reports files/bytes before/after,
+    the merge rate, read amplification (mean segment files per chromosome
+    a scan must touch) before/after, the serve leg's latency DURING the
+    pass, and a byte-identity verdict: post-compaction responses (after
+    the snapshot TTL publishes the new generation) must equal the
+    pre-compaction reference bytes."""
+    import re
+    import signal
+    import subprocess
+    import urllib.request
+
+    from annotatedvdb_tpu.store.compact import segment_spans
+
+    work = tempfile.mkdtemp(prefix="avdb_compact_bench_")
+    proc = None
+    try:
+        store_dir, ids = _build_fragmented_store(work, n_rows)
+        spans = segment_spans(store_dir)
+        files_before = sum(spans.values())
+        read_amp_before = files_before / max(len(spans), 1)
+        bytes_before = sum(
+            os.path.getsize(os.path.join(store_dir, f))
+            for f in os.listdir(store_dir)
+            if f.endswith(".npz") or f.endswith(".ann.jsonl")
+        )
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", AVDB_JAX_PLATFORM="cpu")
+        env.pop("AVDB_FAULT", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+             "--storeDir", store_dir, "--port", "0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        m = re.search(r"http://([\d.]+):(\d+)", proc.stdout.readline())
+        if not m:
+            raise RuntimeError("serve worker printed no address line")
+        host, port = m.group(1), int(m.group(2))
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=10
+            ) as r:
+                return r.status, r.read().decode()
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if get("/healthz")[0] == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)  # back off on transport errors AND non-200s
+
+        sample = ids[:: max(len(ids) // 16, 1)][:16]
+        reference = {}
+        for vid in sample:
+            status, body = get(f"/variant/{vid}")
+            if status != 200:
+                raise RuntimeError(f"reference GET {vid} -> {status}")
+            reference[vid] = body
+
+        blobs = [
+            (f"GET /variant/{i} HTTP/1.1\r\nHost: b\r\n\r\n").encode()
+            for i in ids
+        ]
+        live: dict = {}
+
+        def drive():
+            live["step"] = _open_loop_step(
+                host, port, blobs, 400.0, 8.0, 4, timeout_s=10.0
+            )
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+        time.sleep(0.5)  # the pass runs under established load
+        t0 = time.perf_counter()
+        p = subprocess.run(
+            [sys.executable, "-m", "annotatedvdb_tpu", "doctor", "compact",
+             "--storeDir", store_dir, "--json"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        compact_s = max(time.perf_counter() - t0, 1e-9)
+        driver.join(timeout=60)
+        if p.returncode != 0:
+            return {"error": f"doctor compact rc={p.returncode}: "
+                             f"{p.stderr[-300:]}"}
+        report = json.loads(p.stdout)
+        if report["status"] != "compacted":
+            return {"error": f"pass did not compact: {report}"}
+
+        # the snapshot TTL (250ms) publishes the compacted generation;
+        # verify the served bytes never changed
+        time.sleep(0.6)
+        mismatches = 0
+        for vid, want in reference.items():
+            status, body = get(f"/variant/{vid}")
+            if status != 200 or body != want:
+                mismatches += 1
+        spans_after = segment_spans(store_dir)
+        step = live.get("step") or {}
+        return {
+            "rows": int(report["rows"]),
+            "files_before": int(files_before),
+            "files_after": int(report["files_after"]),
+            "bytes_before": int(bytes_before),
+            "bytes_after": int(report["bytes_after"]),
+            "bytes_reclaimed": int(report["bytes_reclaimed"]),
+            "rows_dropped": int(report["rows_dropped"]),
+            "seconds": round(compact_s, 3),
+            "segments_per_sec": round(files_before / compact_s, 2),
+            "read_amp_before": round(read_amp_before, 2),
+            "read_amp_after": round(
+                sum(spans_after.values()) / max(len(spans_after), 1), 2
+            ),
+            "byte_identical": mismatches == 0,
+            "mismatches": int(mismatches),
+            "serve": {
+                "offered_qps": float(step.get("offered_qps", 0.0)),
+                "achieved_qps": float(step.get("achieved_qps", 0.0)),
+                "p50_ms": float(step.get("p50_ms", 0.0)),
+                "p99_ms": float(step.get("p99_ms", 0.0)),
+                "errors": int(step.get("errors", 0)),
+                "transport_errors": int(step.get("transport_errors", 0)),
+                "requests": int(step.get("requests", 0)),
+            },
+        }
+    finally:
+        if proc is not None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_serve(n_rows: int = 50_000, clients: int = 16,
                 requests_per_client: int = 250, store=None):
     """Sustained concurrent-client serving bench (``serve/``): load a synth
@@ -1174,6 +1341,11 @@ def serve_only():
         shutil.rmtree(work, ignore_errors=True)
     settle()
     serving["chaos"] = bench_chaos()
+    settle()
+    try:
+        compaction = bench_compaction()
+    except Exception as exc:  # maintenance leg: record, never abort
+        compaction = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     sustainable = serving["open_loop"]["max_sustainable_qps"]
     if sustainable > 0:
         metric, headline = "serve_open_loop_sustainable_qps", sustainable
@@ -1193,6 +1365,7 @@ def serve_only():
         "backend": jax.default_backend(),
         "platform_pin": platform,
         "serving": serving,
+        "compaction": compaction,
     }))
 
 
@@ -1278,6 +1451,10 @@ def main():
         serving = bench_serve()
     except Exception as exc:  # serving leg is host-side too: record, not abort
         serving = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        compaction = bench_compaction()
+    except Exception as exc:  # maintenance leg: record, never abort
+        compaction = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     print(
         json.dumps(
@@ -1304,6 +1481,7 @@ def main():
                 "qc_update": qc,
                 "multichip_virtual": multichip,
                 "serving": serving,
+                "compaction": compaction,
             }
         )
     )

@@ -102,7 +102,22 @@ GOOD = {
             "breaker_trips": 1,
             "recovered": True, "recovered_s": 19.1,
             "recovery_window_s": 30.0, "violations": [],
+            "compact": {"status": "compacted", "files_before": 2,
+                        "files_after": 1, "bytes_reclaimed": 120034,
+                        "seconds": 0.8},
         },
+    },
+    "compaction": {
+        "rows": 40000, "rows_dropped": 0,
+        "files_before": 12, "files_after": 2,
+        "bytes_before": 2804211, "bytes_after": 1517804,
+        "bytes_reclaimed": 2804211, "seconds": 1.92,
+        "segments_per_sec": 6.25,
+        "read_amp_before": 6.0, "read_amp_after": 1.0,
+        "byte_identical": True, "mismatches": 0,
+        "serve": {"offered_qps": 400.0, "achieved_qps": 396.0,
+                  "p50_ms": 6.1, "p99_ms": 38.0, "errors": 0,
+                  "transport_errors": 0, "requests": 3200},
     },
 }
 
@@ -228,6 +243,49 @@ def test_chaos_block_is_validated_strictly():
     failed = copy.deepcopy(GOOD)
     failed["serving"]["chaos"] = {"error": "chaos soak timed out"}
     assert validate_record(failed) == []
+
+
+def test_compaction_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["compaction"]["byte_identical"]
+    assert any("byte_identical" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    del bad["compaction"]["files_after"]
+    assert any("files_after" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["compaction"]["byte_identical"] = "yes"  # bool, not string
+    assert any("byte_identical" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["compaction"]["files_after"] = 99  # compaction cannot grow files
+    assert any("files_after above files_before" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["compaction"]["bytes_before"] = -1
+    assert any("bytes_before" in e and "negative" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["compaction"]["serve"]["p99_ms"] = 1.0  # below p50: impossible
+    assert any("p99_ms below p50_ms" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    del bad["compaction"]["serve"]["p99_ms"]
+    assert any("serve" in e and "p99_ms" in e for e in validate_record(bad))
+    # a record WITHOUT the block stays valid (pre-r09 records)
+    old = copy.deepcopy(GOOD)
+    del old["compaction"]
+    assert validate_record(old) == []
+    # a failed leg records {"error": ...} and stays loadable
+    failed = copy.deepcopy(GOOD)
+    failed["compaction"] = {"error": "doctor compact rc=2"}
+    assert validate_record(failed) == []
+    # the chaos sub-block: compact summary validated when present
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["compact"] = {"files_before": 2}  # no status
+    assert any("compact" in e and "status" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["compact"]["seconds"] = "fast"
+    assert any("compact" in e and "seconds" in e
+               for e in validate_record(bad))
 
 
 def test_open_loop_step_transport_errors_validated():

@@ -16,6 +16,7 @@ in-tree test can simulate.  ``test_sigkill_*`` drives two points through a
 real subprocess SIGKILL for the no-finally-runs guarantee.
 """
 
+import json
 import os
 import signal
 import subprocess
@@ -508,6 +509,159 @@ def _get_status_or_none(get):
         return get("/variant/3:10:A:C")
     except OSError:
         return None
+
+
+# ---------------------------------------------------------------------------
+# compact.plan / compact.merge / compact.swap / compact.gc — the online
+# compactor's kill points (store/compact.py).  Contract: a death at ANY of
+# them leaves a store byte-identical to either the PRE- or the
+# POST-compaction reference — never a third state — and fsck --repair
+# prunes whatever debris (compact temps, orphaned segments) the death left.
+
+
+def _fragmented_store(store_dir: str) -> None:
+    """Four disjoint chr6 segments saved one checkpoint apart, with sparse
+    annotations — enough files that every compact kill point has real work
+    in flight when it fires."""
+    store = VariantStore(width=8)
+    shard = store.shard(6)
+    from annotatedvdb_tpu.store.variant_store import Segment
+
+    for k in range(4):
+        n = 250
+        cols = {
+            "pos": np.arange(500 + 20_000 * k, 500 + 20_000 * k + n,
+                             dtype=np.int32),
+            "h": np.arange(n, dtype=np.uint32) + 1,
+            "ref_len": np.full(n, 1, np.int32),
+            "alt_len": np.full(n, 1, np.int32),
+        }
+        shard.append_segment(Segment.build(
+            cols, np.full((n, 8), 65, np.uint8),
+            np.full((n, 8), 71, np.uint8),
+            annotations={"other_annotation":
+                         [{"k": int(i)} if i % 3 else None
+                          for i in range(n)]},
+        ))
+        shard._starts_cache = None
+        store.save(store_dir)
+
+
+def _store_signature(store_dir: str):
+    """Full content signature: every numeric column + alleles + a sample of
+    annotations, in position-sorted order (compaction-invariant)."""
+    from annotatedvdb_tpu.store.variant_store import _NUMERIC_COLUMNS
+
+    store = VariantStore.load(store_dir)
+    shard = store.shard(6)
+    shard.compact()
+    return (
+        tuple(shard.cols[c].tobytes() for c, _ in _NUMERIC_COLUMNS),
+        shard.ref.tobytes(), shard.alt.tobytes(),
+        tuple(json.dumps(shard.get_ann("other_annotation", i))
+              for i in range(0, store.n, 83)),
+        store.n,
+    )
+
+
+@pytest.fixture()
+def compact_refs(tmp_path):
+    """(store_dir, pre signature, post signature): the two states every
+    crashed compact pass must land on."""
+    import shutil
+
+    store_dir = str(tmp_path / "cstore")
+    _fragmented_store(store_dir)
+    pre = _store_signature(store_dir)
+    ref_dir = str(tmp_path / "cref")
+    shutil.copytree(store_dir, ref_dir)
+    from annotatedvdb_tpu.store import compact_store
+
+    report = compact_store(ref_dir)
+    assert report["status"] == "compacted"
+    post = _store_signature(ref_dir)
+    assert post == pre  # no duplicates here: content identical either way
+    return store_dir, pre, post
+
+
+@pytest.mark.parametrize("fault,expect_state", [
+    ("compact.plan:1:raise", "pre"),
+    ("compact.plan:1:eio", "pre"),
+    ("compact.merge:1:raise", "pre"),
+    ("compact.merge:1:eio", "pre"),
+    ("compact.swap:1:raise", "pre"),
+    ("compact.gc:1:eio", "post"),   # gc absorbs eio: committed, orphans
+])
+def test_compact_crash_matrix_in_process(compact_refs, fault, expect_state):
+    from annotatedvdb_tpu.store import compact_store
+    from annotatedvdb_tpu.store.fsck import fsck as run_fsck
+
+    store_dir, pre, post = compact_refs
+    faults.reset(fault)
+    try:
+        report = compact_store(store_dir)
+        fired = faults.fired()
+        assert expect_state == "post", f"{fault}: fault never surfaced"
+        assert report["status"] == "compacted" and fired
+    except (faults.InjectedFault, OSError):
+        assert expect_state == "pre"
+    finally:
+        faults.reset("")
+
+    got = _store_signature(store_dir)
+    assert got == (pre if expect_state == "pre" else post)
+    # in-process aborts clean their own temps; repair handles the rest
+    report = run_fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+    assert _store_signature(store_dir) == got
+    # an unarmed pass completes to the post state
+    final = compact_store(store_dir)
+    assert final["status"] in ("compacted", "noop")
+    assert _store_signature(store_dir) == post
+
+
+@pytest.mark.parametrize("fault", [
+    "compact.merge:1:kill",
+    "compact.merge:1:torn_write",
+    "compact.swap:1:kill",
+    "compact.gc:1:kill",
+])
+def test_compact_sigkill_matrix(compact_refs, fault):
+    """True process death through the CLI (`doctor compact` subprocess):
+    the durable store must equal pre OR post — never a hybrid — and the
+    repair + rerun path must converge on post."""
+    from annotatedvdb_tpu.store import compact_store
+    from annotatedvdb_tpu.store.fsck import fsck as run_fsck
+
+    store_dir, pre, post = compact_refs
+    env = dict(os.environ, JAX_PLATFORMS="cpu", AVDB_FAULT=fault)
+    p = subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu", "doctor", "compact",
+         "--storeDir", store_dir],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert p.returncode == -signal.SIGKILL, (
+        f"{fault}: expected SIGKILL death, rc={p.returncode}\n"
+        f"{p.stderr[-2000:]}"
+    )
+    got = _store_signature(store_dir)
+    assert got in (pre, post), f"{fault}: store is a third state"
+    # gc kill dies AFTER the commit point; everything earlier dies before
+    expect_committed = fault.startswith("compact.gc")
+    spans = json.load(open(os.path.join(store_dir, "manifest.json")))
+    n_stems = sum(len(g) for g in spans["shards"]["6"])
+    assert (n_stems == 1) == expect_committed
+
+    report = run_fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+    assert not [f for f in os.listdir(store_dir) if ".compact.tmp" in f]
+    assert _store_signature(store_dir) == got
+
+    final = compact_store(store_dir)
+    assert final["status"] in ("compacted", "noop")
+    assert _store_signature(store_dir) == post
+    assert run_fsck(store_dir, deep=True,
+                    log=lambda m: None)["exit_code"] == 0
 
 
 def test_serve_worker_kill_fleet_restarts_and_keeps_serving(tmp_path):

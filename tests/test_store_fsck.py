@@ -154,8 +154,10 @@ def test_corrupt_fixture_repairs_end_to_end(tmp_path):
         VariantStore.load(d)
     report = fsck(d, log=lambda m: None)
     assert report["exit_code"] == 2
-    assert {"segment-torn", "segment-orphan", "stale-tmp",
+    assert {"segment-torn", "segment-orphan", "stale-tmp", "compact-tmp",
             "ledger-torn", "undo-intent-dangling"} <= _codes(report)
+    # the abandoned compaction temp is attributed, never "foreign"
+    assert "foreign-file" not in _codes(report)
     # doctor --repair through the CLI entry point
     from annotatedvdb_tpu.cli import doctor
 

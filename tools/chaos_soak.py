@@ -109,6 +109,30 @@ def build_store(store_dir: str, n: int = 4000):
     return ids, f"8:{int(pos[0])}-{int(pos[min(n - 1, 400)])}"
 
 
+def compact_live_store(store_dir: str) -> dict:
+    """One real `doctor compact` subprocess against the store the fleet is
+    serving — the compact-during-serve leg.  Returns the pass report (or
+    an error dict); the caller judges it and the byte checker judges the
+    fleet."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", AVDB_JAX_PLATFORM="cpu")
+    env.pop("AVDB_FAULT", None)  # chaos faults are armed in workers, not here
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "annotatedvdb_tpu", "doctor", "compact",
+             "--storeDir", store_dir, "--json"],
+            env=env, capture_output=True, text=True, timeout=120, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return {"status": "error", "error": "doctor compact timed out"}
+    if p.returncode != 0:
+        return {"status": "error", "rc": p.returncode,
+                "stderr": p.stderr[-500:]}
+    try:
+        return json.loads(p.stdout)
+    except ValueError:
+        return {"status": "error", "error": f"unparseable: {p.stdout[:200]}"}
+
+
 def commit_new_generation(store_dir: str) -> None:
     """One real loader commit: append a row FAR from the sampled window
     (sampled point/region references stay byte-stable) and save — the
@@ -370,6 +394,7 @@ def run(args) -> tuple[dict, list[str]]:
             if delay > 0:
                 time.sleep(delay)
 
+        compact_result = None
         if args.smoke:
             schedule_desc = ["serve.batch:prob:0.25:delay:15",
                              "engine.device_probe:prob:1.0:eio"]
@@ -383,6 +408,7 @@ def run(args) -> tuple[dict, list[str]]:
                 "serve.batch:prob:0.2:delay:20",
                 "engine.device_probe:prob:1.0:eio",
                 "snapshot.swap:1:raise (+ real commit)",
+                "doctor compact (online, against the live store)",
                 "serve.accept:1:kill (worker SIGKILL)",
                 "serve.wedge:1:delay:30000 (watchdog SIGKILL)",
             ]
@@ -394,6 +420,22 @@ def run(args) -> tuple[dict, list[str]]:
             arm(host, port, "snapshot.swap:1:raise")
             commit_new_generation(store_dir)
             log("committed a new store generation under the armed swap")
+            at(14.5)
+            # compact-during-serve: a real `doctor compact` subprocess
+            # merges the live store's segments while the fleet answers —
+            # the checker keeps proving zero wrong bytes across the
+            # generation swap it publishes, and any 5xx it caused would
+            # land in the hard-error budget below
+            compact_result = compact_live_store(store_dir)
+            if compact_result.get("status") != "compacted":
+                violations.append(
+                    f"online compact pass failed: {compact_result}"
+                )
+            else:
+                log("online compact: "
+                    f"{compact_result['files_before']} -> "
+                    f"{compact_result['files_after']} segment file(s) "
+                    "under live serve load")
             at(16.0)
             arm(host, port, "serve.accept:1:kill")
             at(22.0)
@@ -524,6 +566,16 @@ def run(args) -> tuple[dict, list[str]]:
             "recovery_window_s": recovery_window_s,
             "violations": violations,
         }
+        if compact_result is not None:
+            record["compact"] = {
+                "status": str(compact_result.get("status")),
+                "files_before": int(compact_result.get("files_before") or 0),
+                "files_after": int(compact_result.get("files_after") or 0),
+                "bytes_reclaimed": int(
+                    compact_result.get("bytes_reclaimed") or 0
+                ),
+                "seconds": float(compact_result.get("seconds") or 0.0),
+            }
         return record, violations
     finally:
         proc.send_signal(signal.SIGTERM)

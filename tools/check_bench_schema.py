@@ -219,6 +219,61 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
         errors.append(f"{w}.error_rate: must be a ratio in [0, 1]")
     if _is_int(ch.get("wrong_bytes")) and ch["wrong_bytes"] < 0:
         errors.append(f"{w}.wrong_bytes: negative count")
+    if "compact" in ch:
+        # the compact-during-serve leg's summary (full schedule only)
+        if not isinstance(ch["compact"], dict):
+            errors.append(f"{w}.compact: must be an object")
+        else:
+            _check_fields(
+                ch["compact"],
+                {"status": lambda v: isinstance(v, str),
+                 "files_before": _is_int, "files_after": _is_int,
+                 "bytes_reclaimed": _is_int, "seconds": _is_num},
+                f"{w}.compact", errors, required=("status",),
+            )
+
+
+def _check_compaction(cp: dict, where: str, errors: list) -> None:
+    """The store-maintenance leg: a fragmented store compacted by a real
+    `doctor compact` subprocess under live serve load, with a byte-identity
+    verdict and read-amplification before/after."""
+    w = f"{where}.compaction"
+    _check_fields(
+        cp,
+        {
+            "rows": _is_int, "rows_dropped": _is_int,
+            "files_before": _is_int, "files_after": _is_int,
+            "bytes_before": _is_int, "bytes_after": _is_int,
+            "bytes_reclaimed": _is_int, "seconds": _is_num,
+            "segments_per_sec": _is_num,
+            "read_amp_before": _is_num, "read_amp_after": _is_num,
+            "byte_identical": lambda v: isinstance(v, bool),
+            "mismatches": _is_int,
+            "serve": lambda v: isinstance(v, dict),
+        },
+        w, errors,
+        required=("files_before", "files_after", "bytes_before",
+                  "bytes_after", "seconds", "byte_identical"),
+    )
+    for key in ("files_before", "files_after", "bytes_before",
+                "bytes_after"):
+        if _is_int(cp.get(key)) and cp[key] < 0:
+            errors.append(f"{w}.{key}: negative count")
+    if _is_int(cp.get("files_before")) and _is_int(cp.get("files_after")) \
+            and cp["files_after"] > cp["files_before"]:
+        errors.append(f"{w}: files_after above files_before")
+    if "serve" in cp and isinstance(cp["serve"], dict):
+        _check_fields(
+            cp["serve"],
+            {"offered_qps": _is_num, "achieved_qps": _is_num,
+             "p50_ms": _is_num, "p99_ms": _is_num, "errors": _is_int,
+             "transport_errors": _is_int, "requests": _is_int},
+            f"{w}.serve", errors, required=("p99_ms",),
+        )
+        if _is_num(cp["serve"].get("p50_ms")) \
+                and _is_num(cp["serve"].get("p99_ms")) \
+                and cp["serve"]["p99_ms"] < cp["serve"]["p50_ms"]:
+            errors.append(f"{w}.serve: p99_ms below p50_ms")
 
 
 def _check_regions(rg: dict, where: str, errors: list) -> None:
@@ -359,6 +414,9 @@ def validate_record(rec: dict, where: str = "record") -> list[str]:
     if "serving" in rec and isinstance(rec["serving"], dict) \
             and "error" not in rec["serving"]:
         _check_serving(rec["serving"], where, errors)
+    if "compaction" in rec and isinstance(rec["compaction"], dict) \
+            and "error" not in rec["compaction"]:
+        _check_compaction(rec["compaction"], where, errors)
     return errors
 
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Compaction smoke: the crash-safe `doctor compact` contract end to end.
+
+Tier-1-gated via tools/run_checks.sh.  Builds a tiny fragmented store,
+then walks the whole recovery story against REAL subprocesses:
+
+1. `doctor compact` with an armed kill fault (`compact.merge:1:kill`)
+   dies mid-merge -> the store must still load byte-identical to the
+   pre-compaction reference, with only `*.compact.tmp*` debris;
+2. `doctor --repair` prunes the debris and reports repaired/clean;
+3. an unarmed `doctor compact` completes -> one segment file pair per
+   chromosome, content STILL byte-identical, fsck deep-clean;
+4. a `--dry-run` afterwards reports nothing left to do.
+
+Exit: 0 contract held, 1 violated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def log(msg: str) -> None:
+    print(f"compact_smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def build_store(store_dir: str, nseg: int = 4, n: int = 300) -> None:
+    import numpy as np
+
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.store.variant_store import Segment
+
+    store = VariantStore(width=8)
+    shard = store.shard(4)
+    for k in range(nseg):
+        cols = {
+            "pos": np.arange(700 + 30_000 * k, 700 + 30_000 * k + n,
+                             dtype=np.int32),
+            "h": np.arange(n, dtype=np.uint32) + 9,
+            "ref_len": np.full(n, 1, np.int32),
+            "alt_len": np.full(n, 1, np.int32),
+        }
+        shard.append_segment(Segment.build(
+            cols, np.full((n, 8), 67, np.uint8),
+            np.full((n, 8), 84, np.uint8),
+            annotations={"cadd_scores":
+                         [{"CADD_phred": float(i % 31)} for i in range(n)]},
+        ))
+        shard._starts_cache = None
+        store.save(store_dir)
+
+
+def signature(store_dir: str):
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.store.variant_store import _NUMERIC_COLUMNS
+
+    store = VariantStore.load(store_dir)
+    shard = store.shard(4)
+    shard.compact()
+    return (
+        tuple(shard.cols[c].tobytes() for c, _ in _NUMERIC_COLUMNS),
+        shard.ref.tobytes(), shard.alt.tobytes(),
+        tuple(json.dumps(shard.get_ann("cadd_scores", i))
+              for i in range(0, store.n, 57)),
+        store.n,
+    )
+
+
+def run_doctor(args: list, fault: str | None = None) -> subprocess.CompletedProcess:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("AVDB_FAULT", None)
+    if fault:
+        env["AVDB_FAULT"] = fault
+    return subprocess.run(
+        [sys.executable, "-m", "annotatedvdb_tpu", "doctor", *args],
+        env=env, capture_output=True, text=True, timeout=240, cwd=ROOT,
+    )
+
+
+def main() -> int:
+    import signal as _signal
+
+    work = tempfile.mkdtemp(prefix="avdb_compact_smoke_")
+    store_dir = os.path.join(work, "store")
+    try:
+        log("building fragmented store (4 checkpoint segments)")
+        build_store(store_dir)
+        pre = signature(store_dir)
+        files_before = len([f for f in os.listdir(store_dir)
+                            if f.endswith(".npz")])
+        if files_before < 4:
+            log(f"FAIL: store not fragmented ({files_before} files)")
+            return 1
+
+        log("doctor compact under compact.merge:1:kill")
+        p = run_doctor(["compact", "--storeDir", store_dir],
+                       fault="compact.merge:1:kill")
+        if p.returncode != -_signal.SIGKILL:
+            log(f"FAIL: expected SIGKILL death, rc={p.returncode}\n"
+                f"{p.stderr[-1500:]}")
+            return 1
+        if signature(store_dir) != pre:
+            log("FAIL: killed pass changed store content")
+            return 1
+        debris = [f for f in os.listdir(store_dir) if ".compact.tmp" in f]
+        if not debris:
+            log("FAIL: killed pass left no compact temp (fault never bit?)")
+            return 1
+
+        log(f"doctor --repair prunes {len(debris)} compact temp(s)")
+        p = run_doctor(["--storeDir", store_dir, "--repair", "--json"])
+        report = json.loads(p.stdout)
+        if p.returncode not in (0, 1):
+            log(f"FAIL: repair rc={p.returncode}: {p.stdout[-800:]}")
+            return 1
+        codes = {f["code"] for f in report["findings"]}
+        if "compact-tmp" not in codes:
+            log(f"FAIL: repair did not attribute compact temps ({codes})")
+            return 1
+        if [f for f in os.listdir(store_dir) if ".compact.tmp" in f]:
+            log("FAIL: compact temps survived --repair")
+            return 1
+
+        log("unarmed doctor compact completes")
+        p = run_doctor(["compact", "--storeDir", store_dir, "--json"])
+        if p.returncode != 0:
+            log(f"FAIL: compact rc={p.returncode}: {p.stderr[-1500:]}")
+            return 1
+        rep = json.loads(p.stdout)
+        if rep["status"] != "compacted" or rep["files_after"] != 1:
+            log(f"FAIL: unexpected report {rep}")
+            return 1
+        if signature(store_dir) != pre:
+            log("FAIL: compacted store is not byte-identical to reference")
+            return 1
+
+        from annotatedvdb_tpu.store.fsck import fsck
+
+        final = fsck(store_dir, deep=True, log=lambda m: None)
+        if final["exit_code"] != 0:
+            log(f"FAIL: post-compaction fsck not clean: {final}")
+            return 1
+
+        p = run_doctor(["compact", "--storeDir", store_dir,
+                        "--dry-run", "--json"])
+        plan = json.loads(p.stdout)
+        if p.returncode != 0 or plan["eligible"]:
+            log(f"FAIL: dry-run still sees work: {plan}")
+            return 1
+        log(f"contract held: {files_before} -> 1 segment file(s), "
+            f"{rep['bytes_before']} -> {rep['bytes_after']} bytes, "
+            "kill/repair/byte-verify clean")
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

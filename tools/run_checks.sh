@@ -15,7 +15,11 @@
 #                    runs under AVDB_LOCK_TRACE=1, so every serve-stack
 #                    lock is order-traced and ANY acquisition-order cycle
 #                    (potential deadlock) fails the smoke
-#   5. chaos_soak --smoke — a 1-worker fleet under open-loop load with
+#   5. compact_smoke — crash-safe `doctor compact`: kill a pass mid-merge,
+#                    doctor --repair the debris, complete the pass, and
+#                    byte-verify the store against the pre-compaction
+#                    reference
+#   6. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
 #
@@ -44,6 +48,9 @@ python "$root/tools/check_bench_schema.py" || rc=1
 
 echo "== serve smoke (lock-order traced) ==" >&2
 AVDB_LOCK_TRACE=1 python "$root/tools/serve_smoke.py" || rc=1
+
+echo "== compact smoke ==" >&2
+python "$root/tools/compact_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1
