@@ -53,6 +53,12 @@ def _build_parser() -> argparse.ArgumentParser:
                         default="aio",
                         help="event-loop front end (default) or the "
                              "thread-per-connection reference server")
+    parser.add_argument("--upserts", action="store_true",
+                        default=None,
+                        help="enable the live write path: POST "
+                             "/variants/upsert with a per-worker "
+                             "write-ahead log, replayed on start "
+                             "(default: AVDB_SERVE_UPSERTS or off)")
     parser.add_argument("--maxBatch", type=int, default=None,
                         help="max point queries per coalesced microbatch "
                              "(default: AVDB_SERVE_BATCH_MAX or 256)")
@@ -102,6 +108,16 @@ def _build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _upserts_enabled(args) -> bool:
+    """Flag wins over environment; ``AVDB_SERVE_UPSERTS`` accepts the
+    usual truthy spellings.  Resolved ONCE here (never in a front end —
+    the AVDB802 knob-resolution contract)."""
+    if args.upserts is not None:
+        return bool(args.upserts)
+    return os.environ.get("AVDB_SERVE_UPSERTS", "").lower() \
+        not in ("", "0", "false")
+
+
 def _effective_workers(args) -> int:
     if args.workers is not None:
         return max(int(args.workers), 1)
@@ -129,6 +145,10 @@ def _knob_args(args, workers: int) -> list[str]:
     of probe caches (an explicit flag also overrides the inherited
     AVDB_SERVE_HBM_BUDGET, which would have the same problem)."""
     out: list[str] = ["--frontend", args.frontend]
+    if _upserts_enabled(args):
+        # every worker runs its own memtable + WAL (serve-w<idx>.*.wal):
+        # the flag must reach them all
+        out.append("--upserts")
     for flag, val in (
         ("--maxBatch", args.maxBatch),
         ("--batchWaitMs", args.batchWaitMs),
@@ -230,6 +250,37 @@ def _run_single(args, log) -> int:
         print(f"serve: cannot start: {err}", file=sys.stderr)
         return 1
 
+    memtable = None
+    if _upserts_enabled(args):
+        from annotatedvdb_tpu.serve.snapshot import MemtableSnapshots
+        from annotatedvdb_tpu.store.memtable import Memtable
+        from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+        worker = args._workerIndex or 0
+        try:
+            wal = WriteAheadLog(
+                args.storeDir, name=f"serve-w{worker}", log=log
+            )
+            memtable = Memtable(
+                width=manager.current().store.width,
+                store_dir=args.storeDir, wal=wal,
+                registry=registry, log=log,
+            )
+            # recovery: acknowledged-but-unflushed upserts from a previous
+            # incarnation (crash, SIGKILL, wedge kill) come back before
+            # the first request is accepted — idempotent, so a death
+            # mid-replay just replays again on the next respawn
+            replayed = memtable.replay(manager.current().store)
+        except (OSError, ValueError) as err:
+            print(f"serve: cannot start: {err}", file=sys.stderr)
+            return 1
+        if replayed:
+            log(f"wal: replayed {replayed} acknowledged upsert row(s) "
+                "into the memtable")
+        # reads resolve through the overlay from here on: upserted rows
+        # are visible immediately, first-wins against the base store
+        manager = MemtableSnapshots(manager, memtable)
+
     max_wait_s = (
         args.batchWaitMs / 1000.0 if args.batchWaitMs is not None else None
     )
@@ -243,7 +294,7 @@ def _run_single(args, log) -> int:
 
     if args.frontend == "threaded":
         return _run_threaded(args, manager, registry, residency, tracer,
-                             max_wait_s, log)
+                             max_wait_s, log, memtable=memtable)
 
     from annotatedvdb_tpu.serve.aio import build_aio_server
 
@@ -252,7 +303,7 @@ def _run_single(args, log) -> int:
             manager=manager, host=args.host, port=args.port, sock=sock,
             max_batch=args.maxBatch, max_wait_s=max_wait_s,
             max_queue=args.maxQueue, region_cache_size=args.regionCache,
-            registry=registry, residency=residency,
+            registry=registry, residency=residency, memtable=memtable,
             client_rate=args.clientRate,
             stream_threshold=args.streamThreshold,
             heartbeat_file=args._heartbeatFile,
@@ -332,6 +383,11 @@ def _run_single(args, log) -> int:
     finally:
         server.shutdown()
         ctx.batcher.close()
+        if memtable is not None and memtable.wal is not None:
+            # record-free WAL files protect nothing: drop them so a clean
+            # shutdown leaves no fsck warning (files WITH records stay —
+            # they are the durability of unflushed acknowledged upserts)
+            memtable.wal.close(remove_if_empty=True)
         _export(args, ctx.registry, tracer, log)
     return 0
 
@@ -349,7 +405,7 @@ def _worker_socket(args):
 
 
 def _run_threaded(args, manager, registry, residency, tracer,
-                  max_wait_s, log) -> int:
+                  max_wait_s, log, memtable=None) -> int:
     """The PR-5 thread-per-connection server (byte-parity reference)."""
     from annotatedvdb_tpu.serve.http import build_server
 
@@ -358,7 +414,8 @@ def _run_threaded(args, manager, registry, residency, tracer,
             manager=manager, host=args.host, port=args.port,
             max_batch=args.maxBatch, max_wait_s=max_wait_s,
             max_queue=args.maxQueue, region_cache_size=args.regionCache,
-            registry=registry, residency=residency, tracer=tracer, log=log,
+            registry=registry, residency=residency, memtable=memtable,
+            tracer=tracer, log=log,
         )
     except (OSError, ValueError) as err:
         print(f"serve: cannot start: {err}", file=sys.stderr)
@@ -375,6 +432,8 @@ def _run_threaded(args, manager, registry, residency, tracer,
     finally:
         httpd.server_close()
         ctx.batcher.close()
+        if memtable is not None and memtable.wal is not None:
+            memtable.wal.close(remove_if_empty=True)
         _export(args, ctx.registry, tracer, log)
     return 0
 

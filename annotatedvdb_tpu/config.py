@@ -82,6 +82,14 @@ ENV_VARS: dict = {
     "AVDB_COMPACT_MIN_SEGMENTS": "smallest on-disk segment-file count that "
                                  "makes a chromosome group eligible for "
                                  "doctor compact (default 2)",
+    "AVDB_MEMTABLE_BYTES": "approximate memtable size at which the live "
+                           "write path flushes to store segments "
+                           "(default 64m; 512m / 2g suffixes; 0 disables "
+                           "the size trigger)",
+    "AVDB_MEMTABLE_FLUSH_S": "oldest-unflushed-upsert age in seconds at "
+                             "which the memtable flushes regardless of "
+                             "size (default 30; 0 disables the age "
+                             "trigger)",
     # query & serving (serve/)
     "AVDB_SERVE_BATCH_MAX": "max point queries coalesced into one device "
                             "microbatch (default 256)",
@@ -129,6 +137,10 @@ ENV_VARS: dict = {
     "AVDB_SERVE_CHAOS": "1 enables the POST /_chaos runtime fault-arming "
                         "route on the aio front end (chaos harness only; "
                         "never set in production)",
+    "AVDB_SERVE_UPSERTS": "1 enables the live write path: POST "
+                          "/variants/upsert with a per-worker WAL "
+                          "(replayed on worker start) and memtable "
+                          "flushes to store segments",
     "AVDB_LOCK_TRACE": "1 arms the lock-order/deadlock detector: serve-"
                        "stack locks record per-thread acquisition order "
                        "(analysis/lockorder), cycles are potential "

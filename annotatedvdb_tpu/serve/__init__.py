@@ -42,6 +42,7 @@ from annotatedvdb_tpu.serve.resilience import (
     PointCache,
 )
 from annotatedvdb_tpu.serve.snapshot import (
+    MemtableSnapshots,
     SnapshotManager,
     StaticSnapshots,
     StoreSnapshot,
@@ -49,6 +50,7 @@ from annotatedvdb_tpu.serve.snapshot import (
 
 __all__ = [
     "DeadlineExceeded", "DeviceBreaker", "IntervalIndex",
+    "MemtableSnapshots",
     "OverloadGovernor", "PointCache",
     "QueryBatcher", "QueueFull", "QueryEngine", "QueryError", "RegionPage",
     "RegionsResult", "ResidencyManager", "SnapshotManager",

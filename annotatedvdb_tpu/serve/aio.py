@@ -75,15 +75,20 @@ from annotatedvdb_tpu.serve.http import (
     BULK_BODY_ERROR,
     MSG_BROWNOUT_BULK,
     MSG_BROWNOUT_REGION,
+    MSG_BROWNOUT_UPSERT,
     MSG_CAPACITY_BULK,
     MSG_CAPACITY_REGION,
+    MSG_CAPACITY_UPSERT,
     MSG_DEADLINE_ADMISSION,
     MSG_DEADLINE_EXECUTE,
     REGIONS_BODY_ERROR,
+    UPSERT_BODY_ERROR,
+    UPSERT_ROUTE,
     ServeContext,
     healthz_payload,
     parse_region_params,
     parse_regions_body,
+    parse_upsert_body,
     readyz_payload,
     stats_payload,
 )
@@ -106,6 +111,7 @@ MAX_CLIENT_WEIGHT = 16
 _STATUS = {
     200: b"HTTP/1.1 200 OK\r\n",
     400: b"HTTP/1.1 400 Bad Request\r\n",
+    403: b"HTTP/1.1 403 Forbidden\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
     413: b"HTTP/1.1 413 Payload Too Large\r\n",
     429: b"HTTP/1.1 429 Too Many Requests\r\n",
@@ -734,6 +740,10 @@ class AioServer:
                     )
             with contextlib.suppress(Exception):
                 self.ctx.governor.maybe_step()
+            with contextlib.suppress(Exception):
+                # memtable age/size flush triggers (the flush itself runs
+                # on its own thread; this is one lock + compare)
+                self.ctx.maybe_flush_memtable()
         finally:
             # the next tick is unconditional: whatever one pass hit, the
             # heartbeat/brownout machinery must keep running
@@ -1063,6 +1073,9 @@ class AioServer:
                 if path == "/variants":
                     ctx.errored("bulk")
                     return _error(400, BULK_BODY_ERROR), False
+                if path == UPSERT_ROUTE:
+                    ctx.errored("upsert")
+                    return _error(400, UPSERT_BODY_ERROR), False
                 if path == "/regions":
                     ctx.errored("regions")
                     return _error(400, REGIONS_BODY_ERROR), False
@@ -1091,6 +1104,24 @@ class AioServer:
                     client, weight = self._client_key(headers, writer)
                     max_ids = self.governor.bulk_budget(weight)
                 return self._bulk_item(body, client, max_ids, deadline_t), keep
+            if path == UPSERT_ROUTE:
+                if ctx.governor.shed_bulk():
+                    ctx.brownout_shed()
+                    return _error(503, MSG_BROWNOUT_UPSERT), keep
+                retry = self._admit_client(headers, writer)
+                if retry:
+                    ctx.rejected("upsert")
+                    return _error(
+                        429, "client over rate (upsert admission)",
+                        retry_after=max(int(retry + 0.999), 1),
+                    ), keep
+                client = max_ids = None
+                if self.governor is not None:
+                    client, weight = self._client_key(headers, writer)
+                    max_ids = self.governor.bulk_budget(weight)
+                return self._upsert_item(
+                    body, client, max_ids, deadline_t
+                ), keep
             if path == "/regions":
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
@@ -1267,6 +1298,60 @@ class AioServer:
                 + ",".join(r if r is not None else "null" for r in results)
                 + "]}"
             ))
+        finally:
+            ctx.release()
+
+    def _upsert_item(self, body: bytes, client: str | None = None,
+                     max_rows: int | None = None,
+                     deadline_t: float | None = None):
+        """Live write path: the bulk admission shape (slot + per-client
+        budget); the WAL fsync runs on the executor pool — the ack
+        barrier is blocking I/O and must never touch the event loop."""
+        ctx = self.ctx
+        t0 = time.perf_counter()
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
+        if not ctx.admit():
+            ctx.rejected("upsert")
+            return _error(429, MSG_CAPACITY_UPSERT, retry_after=1)
+        fut = self._loop.run_in_executor(
+            self._pool, self._upsert_work, body, t0, client, max_rows,
+            deadline_t
+        )
+        return ("exec", fut, "upsert", t0)
+
+    def _upsert_work(self, body: bytes, t0: float,
+                     client: str | None = None,
+                     max_rows: int | None = None,
+                     deadline_t: float | None = None) -> bytes:
+        """Executor half of an upsert (parse, WAL append+fsync, memtable
+        insert, ack) — the shared :meth:`ServeContext.upsert_execute`
+        does the work; never raises — errors become response bytes."""
+        ctx = self.ctx
+        try:
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # executor-queue lag ate the budget: shed BEFORE the WAL
+                # write (nothing durable happened, nothing acknowledged)
+                ctx.deadline_shed("execute")
+                return _error(504, MSG_DEADLINE_EXECUTE)
+            status, text, rows = ctx.upsert_execute(body, max_rows=max_rows)
+            if client is not None and rows > 1 and status == 200:
+                # admission spent ONE token; the other rows debit the
+                # bucket too (on the loop thread — the governor is
+                # single-threaded by construction), the bulk contract.
+                # ONLY acknowledged work charges: an over-budget 429 was
+                # rejected before any WAL/memtable work ran, and debiting
+                # it anyway would let one oversized request starve the
+                # client's legitimate follow-ups (the bulk path's
+                # reject-before-charge precedent)
+                self._loop.call_soon_threadsafe(
+                    self.governor.charge, client, float(rows - 1)
+                )
+            if status == 200:
+                ctx.maybe_flush_memtable()
+            retry = 1 if status in (429, 503) else None
+            return _resp(status, text, retry_after=retry)
         finally:
             ctx.release()
 
@@ -1543,7 +1628,8 @@ def build_aio_server(store_dir: str | None = None, manager=None,
                      max_queue: int | None = None,
                      region_cache_size: int | None = None,
                      registry: MetricsRegistry | None = None,
-                     residency=None, client_rate: float | None = None,
+                     residency=None, memtable=None,
+                     client_rate: float | None = None,
                      stream_threshold: int | None = None,
                      heartbeat_file: str | None = None,
                      heartbeat_index: int = 0,
@@ -1566,7 +1652,8 @@ def build_aio_server(store_dir: str | None = None, manager=None,
         engine, max_batch=max_batch, max_wait_s=max_wait_s,
         max_queue=max_queue, tracer=tracer, registry=registry,
     )
-    ctx = ServeContext(manager, engine, batcher, registry, log=log)
+    ctx = ServeContext(manager, engine, batcher, registry,
+                       memtable=memtable, log=log)
     return AioServer(
         ctx, host=host, port=port, sock=sock, client_rate=client_rate,
         stream_threshold=stream_threshold,

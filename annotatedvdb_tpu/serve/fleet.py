@@ -176,9 +176,14 @@ class ServeFleet:
         # not get the replacement killed before it comes up
         struct.pack_into("<d", self._hb_mm, index * 8, 0.0)
         env = dict(os.environ)
-        if respawn and env.get("AVDB_FAULT", "").startswith("serve."):
-            # an injected serve-side fault killed the previous incarnation;
-            # the replacement must come up clean (see module docstring)
+        if respawn and env.get("AVDB_FAULT", "").startswith(
+                ("serve.", "wal.", "memtable.")):
+            # an injected worker-side fault (serve path OR the upsert
+            # write path, which also runs inside workers) killed the
+            # previous incarnation; the replacement must come up clean
+            # (see module docstring) — a wal.replay kill re-armed on
+            # every respawn would otherwise be a crash loop by
+            # construction, not a crash test
             self.log(f"worker {index}: respawning with AVDB_FAULT cleared")
             env.pop("AVDB_FAULT")
         proc = subprocess.Popen(

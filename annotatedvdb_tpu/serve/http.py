@@ -51,7 +51,11 @@ _RETURNED_RE = re.compile(r'"returned":(\d+)')
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
 from annotatedvdb_tpu.serve import resilience
 from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
-from annotatedvdb_tpu.serve.engine import QueryEngine, QueryError
+from annotatedvdb_tpu.serve.engine import (
+    QueryEngine,
+    QueryError,
+    parse_variant_id,
+)
 from annotatedvdb_tpu.serve.resilience import (
     DeadlineExceeded,
     DeviceBreaker,
@@ -168,6 +172,76 @@ REGIONS_BODY_ERROR = (
 BULK_BODY_ERROR = 'bulk body must be {"ids": ["chr:pos:ref:alt", ...]}'
 MSG_DEADLINE_ADMISSION = "deadline exhausted at admission"
 MSG_DEADLINE_EXECUTE = "deadline exhausted before execution"
+MSG_BROWNOUT_UPSERT = (
+    "brownout: upserts shed (point reads keep serving)"
+)
+MSG_CAPACITY_UPSERT = "server at capacity (upsert admission bound)"
+MSG_UPSERTS_DISABLED = (
+    "upserts are not enabled on this server (start with --upserts or "
+    "AVDB_SERVE_UPSERTS=1)"
+)
+
+#: the one grammar message for a malformed /variants/upsert body
+UPSERT_BODY_ERROR = (
+    'upsert body must be {"variants": [{"id": "chr:pos:ref:alt", '
+    '"ref_snp": N?, "annotations": {<jsonb column>: <value>, ...}?}, ...]}'
+)
+
+#: rows per upsert call cap (a request is one WAL frame + one ack fsync;
+#: bigger batches belong to the offline loaders)
+UPSERT_MAX_ROWS = 4096
+
+#: the live-write route path — shared so the two front ends' routing
+#: cannot drift (the AVDB801 contract)
+UPSERT_ROUTE = "/variants/upsert"
+
+
+def parse_upsert_body(body: bytes) -> list[dict]:
+    """Validated entries from a ``POST /variants/upsert`` JSON body — the
+    ONE body grammar both front ends share (the
+    :func:`parse_region_params` convention).  Returns
+    ``[{"id", "ref_snp", "annotations"}, ...]``; raises
+    :class:`QueryError` on any malformed field (the whole call fails —
+    an upsert is atomic per request, never partially applied)."""
+    from annotatedvdb_tpu.store.variant_store import JSONB_COLUMNS
+
+    try:
+        obj = json.loads(body or b"{}")
+    except ValueError:
+        raise QueryError(UPSERT_BODY_ERROR) from None
+    if not isinstance(obj, dict):
+        raise QueryError(UPSERT_BODY_ERROR)
+    variants = obj.get("variants")
+    if not isinstance(variants, list) or not variants \
+            or not all(isinstance(v, dict) for v in variants):
+        raise QueryError(UPSERT_BODY_ERROR)
+    if len(variants) > UPSERT_MAX_ROWS:
+        raise QueryError(
+            f"upsert of {len(variants)} rows exceeds the "
+            f"{UPSERT_MAX_ROWS}-row cap; split the request (bulk loads "
+            "belong to the offline loader CLIs)"
+        )
+    out = []
+    for v in variants:
+        vid = v.get("id")
+        if not isinstance(vid, str):
+            raise QueryError(UPSERT_BODY_ERROR)
+        rs = v.get("ref_snp")
+        if rs is not None and (isinstance(rs, bool)
+                               or not isinstance(rs, int) or rs < 0):
+            raise QueryError(f"bad upsert field ref_snp={rs!r}")
+        ann = v.get("annotations")
+        if ann is not None:
+            if not isinstance(ann, dict):
+                raise QueryError(UPSERT_BODY_ERROR)
+            for col in ann:
+                if col not in JSONB_COLUMNS:
+                    raise QueryError(
+                        f"unknown annotation column {col!r} (one of: "
+                        + ", ".join(JSONB_COLUMNS) + ")"
+                    )
+        out.append({"id": vid, "ref_snp": rs, "annotations": ann})
+    return out
 MSG_BROWNOUT_BULK = (
     "brownout: bulk reads shed (point reads keep serving)"
 )
@@ -224,11 +298,15 @@ class ServeContext:
 
     def __init__(self, manager, engine: QueryEngine, batcher: QueryBatcher,
                  registry: MetricsRegistry, max_inflight: int | None = None,
-                 log=None):
+                 memtable=None, log=None):
         self.manager = manager
         self.engine = engine
         self.batcher = batcher
         self.registry = registry
+        #: the live write path (``store/memtable.py``), or None for the
+        #: historical read-only server — the upsert route answers
+        #: MSG_UPSERTS_DISABLED when unset
+        self.memtable = memtable
         self.max_inflight = (
             max_inflight if max_inflight is not None else batcher.max_queue
         )
@@ -275,11 +353,26 @@ class ServeContext:
             "avdb_serve_abandoned_responses_total",
             "responses dropped because the client connection died first",
         )
+        self._m_upsert_requests = registry.counter(
+            "avdb_upsert_requests_total", "upsert requests acknowledged"
+        )
+        self._m_upsert_rows = registry.counter(
+            "avdb_upsert_rows_total", "upsert rows accepted into the memtable"
+        )
+        self._m_upsert_rejected = registry.counter(
+            "avdb_upsert_rejected_total",
+            "upsert rows not applied (shadowed by an existing row under "
+            "the first-wins policy, or duplicated within the batch)",
+        )
+        self._m_upsert_ack = registry.histogram(
+            "avdb_upsert_ack_seconds", QUERY_SECONDS_EDGES,
+            "upsert latency from arrival to durable acknowledgement",
+        )
         # per-kind series resolved ONCE: the registry probe (lock + label
         # key assembly) is measurable at serving QPS, so the hot path
         # indexes a dict instead of re-registering per request
         self._kind = {}
-        for kind in ("point", "bulk", "region", "regions"):
+        for kind in ("point", "bulk", "region", "regions", "upsert"):
             labels = {"kind": kind}
             self._kind[kind] = (
                 registry.counter(
@@ -379,6 +472,117 @@ class ServeContext:
                        record) -> None:
         self.point_cache.put(generation, variant_id, record)
 
+    # -- upserts (the live write path) --------------------------------------
+
+    def upsert_execute(self, body: bytes,
+                       max_rows: int | None = None):
+        """The upsert decision+execution BOTH front ends share (the
+        ``point_preflight`` convention: logic lives once, front ends only
+        render).  Returns ``(status, json_body, rows_in_request)``.
+
+        The 200 is the ACK: it is built only after the accepted rows'
+        WAL frame is fsync'd (``Memtable.upsert`` orders WAL-then-
+        visibility), so an acknowledged upsert survives SIGKILL at any
+        instant."""
+        memtable = self.memtable
+        if memtable is None:
+            return 403, json.dumps({"error": MSG_UPSERTS_DISABLED}), 0
+        t0 = time.perf_counter()
+        try:
+            entries = parse_upsert_body(body)
+            parsed = self.upsert_parse_entries(entries)
+        except QueryError as err:
+            self.errored("upsert")
+            return 400, json.dumps({"error": str(err)}), 0
+        if max_rows is not None and len(parsed) > max_rows:
+            # bounded-debt contract (the bulk-/variants shape): a batch
+            # the client bucket could never repay is rejected before any
+            # WAL/memtable work runs
+            self.rejected("upsert")
+            return 429, json.dumps({"error": (
+                f"upsert of {len(parsed)} rows exceeds client rate "
+                f"budget ({max_rows} rows); split the request"
+            )}), len(parsed)
+        base = getattr(self.manager, "base", self.manager)
+        try:
+            accepted, shadowed, _wal_bytes = memtable.upsert(
+                base.current().store, parsed
+            )
+        except (ValueError, KeyError, TypeError) as err:
+            self.errored("upsert")
+            return 400, json.dumps({"error": str(err)}), len(parsed)
+        except Exception as err:
+            # WAL append/fsync failure included: nothing became visible,
+            # nothing was acknowledged — the client must retry
+            self.errored("upsert")
+            return 500, json.dumps(
+                {"error": f"{type(err).__name__}: {err}"}
+            ), len(parsed)
+        generation = self.manager.current().generation
+        dt = time.perf_counter() - t0
+        self._m_upsert_requests.inc()
+        if accepted:
+            self._m_upsert_rows.inc(accepted)
+        if shadowed:
+            self._m_upsert_rejected.inc(shadowed)
+        self._m_upsert_ack.observe(dt)
+        self.observe("upsert", dt, rows=accepted)
+        return 200, (
+            f'{{"n":{len(parsed)},"accepted":{accepted},'
+            f'"shadowed":{shadowed},"generation":{generation}}}'
+        ), len(parsed)
+
+    def upsert_parse_entries(self, entries: list[dict]) -> list[dict]:
+        """Validated body entries -> the memtable's plain-data rows:
+        ids resolve through the SAME grammar every read path uses
+        (:func:`~annotatedvdb_tpu.serve.engine.parse_variant_id`), and
+        alleles are bounded by the store width (long-allele rows belong
+        to the offline loaders, which retain original strings and digest
+        PKs)."""
+        width = self.manager.current().store.width
+        parsed = []
+        for e in entries:
+            code, pos, ref, alt = parse_variant_id(e["id"])
+            if len(ref) > width or len(alt) > width:
+                raise QueryError(
+                    f"upsert {e['id']!r}: allele length "
+                    f"{max(len(ref), len(alt))} exceeds the store width "
+                    f"{width}; load long-allele rows through the offline "
+                    "loader CLIs"
+                )
+            parsed.append({
+                "code": code, "pos": pos, "ref": ref, "alt": alt,
+                "ref_snp": e.get("ref_snp"),
+                "ann": e.get("annotations"),
+            })
+        return parsed
+
+    def maybe_flush_memtable(self, force: bool = False) -> bool:
+        """Kick a background memtable flush when a trigger
+        (``AVDB_MEMTABLE_BYTES`` / ``AVDB_MEMTABLE_FLUSH_S``) is due.
+        Called after upsert completions and from the maintenance paths —
+        the flush itself runs on its own thread (it writes segment files
+        and fsyncs a manifest: seconds, never on a request thread or the
+        event loop) and self-guards against duplicates."""
+        m = self.memtable
+        if m is None:
+            return False
+        if not (force or m.should_flush()):
+            return False
+        base = getattr(self.manager, "base", self.manager)
+        threading.Thread(
+            target=self._flush_memtable, args=(base,), daemon=True,
+            name="memtable-flush",
+        ).start()
+        return True
+
+    def _flush_memtable(self, base_manager) -> None:
+        try:
+            self.memtable.flush(base_manager=base_manager)
+        except Exception as err:
+            self.log(f"memtable flush failed ({type(err).__name__}: "
+                     f"{err}); rows stay in the memtable")
+
     def ready_state(self) -> tuple[bool, str]:
         """(ready, reason): readiness gates routing, not liveness.  Not
         ready while a snapshot swap is loading (the warming-worker case)
@@ -386,8 +590,12 @@ class ServeContext:
         ladder too (time-gated): a shed_bulk worker a router has fully
         DRAINED completes no requests, so on the threaded front end the
         router's own readiness probes are what lets the now-idle ladder
-        de-escalate back to ready."""
+        de-escalate back to ready.  Probes also check the memtable flush
+        triggers, so an idle threaded worker's age-based flush fires off
+        its health polls (the aio front end additionally checks on its
+        maintenance tick)."""
         self.governor.maybe_step()
+        self.maybe_flush_memtable()
         if getattr(self.manager, "swapping", False):
             return False, "snapshot swap in progress"
         if self.governor.shed_bulk():
@@ -494,6 +702,9 @@ class ServeHandler(BaseHTTPRequestHandler):
         if path == "/variants":
             self._bulk(ctx)
             return
+        if path == UPSERT_ROUTE:
+            self._upsert(ctx)
+            return
         if path == "/regions":
             self._regions(ctx)
             return
@@ -594,6 +805,45 @@ class ServeHandler(BaseHTTPRequestHandler):
                 + ",".join(r if r is not None else "null" for r in results)
                 + "]}"
             ))
+        finally:
+            ctx.release()
+
+    def _upsert(self, ctx: ServeContext) -> None:
+        """Live write path: the bulk admission shape (brownout shed,
+        deadline at admission AND before execution, inflight slot, 429)
+        around the shared :meth:`ServeContext.upsert_execute` — the 200
+        is the durable ack."""
+        if ctx.governor.shed_bulk():
+            ctx.brownout_shed()
+            self._error(503, MSG_BROWNOUT_UPSERT)
+            return
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
+            return
+        if not ctx.admit():
+            ctx.rejected("upsert")
+            self._error(429, MSG_CAPACITY_UPSERT)
+            return
+        try:
+            ctx.refresh_snapshot()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+            except ValueError:
+                ctx.errored("upsert")
+                self._error(400, UPSERT_BODY_ERROR)
+                return
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # body read/queueing ate the budget: shed BEFORE the WAL
+                # write (nothing durable happened, nothing acknowledged)
+                ctx.deadline_shed("execute")
+                self._error(504, MSG_DEADLINE_EXECUTE)
+                return
+            status, body, _rows = ctx.upsert_execute(raw)
+            self._reply(status, body)
+            ctx.maybe_flush_memtable()
         finally:
             ctx.release()
 
@@ -713,7 +963,7 @@ def build_server(store_dir: str | None = None, manager=None,
                  max_queue: int | None = None,
                  region_cache_size: int | None = None,
                  registry: MetricsRegistry | None = None,
-                 residency=None,
+                 residency=None, memtable=None,
                  tracer=None, log=None) -> ThreadingHTTPServer:
     """Wire manager → engine → batcher → HTTP server (not yet serving; call
     ``serve_forever`` or run it on a thread).  The server carries its
@@ -735,5 +985,6 @@ def build_server(store_dir: str | None = None, manager=None,
     )
     httpd = ThreadingHTTPServer((host, port), ServeHandler)
     httpd.daemon_threads = True
-    httpd.ctx = ServeContext(manager, engine, batcher, registry, log=log)
+    httpd.ctx = ServeContext(manager, engine, batcher, registry,
+                             memtable=memtable, log=log)
     return httpd

@@ -171,6 +171,106 @@ class SnapshotManager:
         return True
 
 
+class _OverlayStore:
+    """Read-only store view: the base generation's shards with the
+    memtable's in-memory segments appended AFTER them — so every read
+    path's first-wins dedup resolves collisions toward the stored (older)
+    row, and upserted rows render through the exact same ``Segment``
+    machinery loaded rows do.  The Segment objects are shared with the
+    base store and the memtable; only the per-shard lists are fresh."""
+
+    __slots__ = ("width", "readonly", "shards")
+
+    def __init__(self, base_store, mem_segments: dict):
+        from annotatedvdb_tpu.store.variant_store import ChromosomeShard
+
+        self.width = base_store.width
+        self.readonly = True
+        shards = {}
+        for code, bshard in base_store.shards.items():
+            sh = ChromosomeShard(code, self.width)
+            sh.segments = list(bshard.segments) \
+                + list(mem_segments.get(code, ()))
+            shards[code] = sh
+        for code, segs in mem_segments.items():
+            if code in shards or not segs:
+                continue
+            sh = ChromosomeShard(code, self.width)
+            sh.segments = list(segs)
+            shards[code] = sh
+        self.shards = shards
+
+    @property
+    def n(self) -> int:
+        return sum(s.n for s in self.shards.values())
+
+
+class MemtableSnapshots:
+    """Snapshot provider overlaying a live memtable on a base provider —
+    the read-your-writes half of the online write path.
+
+    Until the first upsert (memtable epoch 0) this is a pure pass-through:
+    ``current()`` returns the base provider's snapshot object unchanged,
+    so read-only serving pays nothing and generation numbering is exactly
+    the historical one.  From the first upsert on, every distinct
+    (base generation, memtable epoch) pair maps to a FRESH, monotonically
+    increasing generation number strictly greater than any base
+    generation handed out before — generation-keyed caches (point render,
+    region LRU, interval indexes, cursor walks, the brownout point cache)
+    can therefore never serve pre-upsert bytes for a post-upsert view,
+    and ordering-aware consumers (residency govern) keep their invariant.
+    """
+
+    def __init__(self, base, memtable):
+        self.base = base
+        self.memtable = memtable
+        self._lock = make_lock("serve.snapshot.overlay")
+        #: guarded by self._lock
+        self._last_key = None
+        #: guarded by self._lock
+        self._last_snap: StoreSnapshot | None = None
+        #: guarded by self._lock — the remapped generation counter (kept
+        #: strictly above every base generation observed)
+        self._gen = 0
+
+    def current(self) -> StoreSnapshot:
+        base = self.base.current()
+        epoch, segs, _rows, _bytes = self.memtable.view()
+        if epoch == 0:
+            return base  # pristine: exact legacy behavior, zero overhead
+        key = (base.generation, epoch)
+        with self._lock:
+            if key == self._last_key:
+                return self._last_snap
+        overlay = _OverlayStore(base.store, segs)
+        with self._lock:
+            if key == self._last_key:  # a racing builder won; take its snap
+                return self._last_snap
+            self._gen = max(self._gen + 1, base.generation + 1)
+            snap = StoreSnapshot(overlay, self._gen, base.fingerprint)
+            self._last_key = key
+            self._last_snap = snap
+            return snap
+
+    def maybe_refresh(self) -> bool:
+        return self.base.maybe_refresh()
+
+    def refresh(self) -> bool:
+        return self.base.refresh()
+
+    def refresh_due(self) -> bool:
+        return self.base.refresh_due() \
+            if hasattr(self.base, "refresh_due") else False
+
+    @property
+    def swaps(self) -> int:
+        return self.base.swaps
+
+    @property
+    def swapping(self) -> bool:
+        return bool(getattr(self.base, "swapping", False))
+
+
 class StaticSnapshots:
     """Snapshot provider over an in-memory store (tests, bench) — one fixed
     generation, ``refresh`` is a no-op."""

@@ -203,6 +203,8 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
 
     # ---- directory scan: orphans, stale tmp, foreign files -----------------
     from annotatedvdb_tpu.store.compact import is_compact_tmp
+    from annotatedvdb_tpu.store.memtable import is_flush_tmp
+    from annotatedvdb_tpu.store.wal import is_wal_file, is_wal_tmp
 
     for fname in sorted(os.listdir(store_dir)):
         fp = os.path.join(store_dir, fname)
@@ -211,6 +213,43 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
         if fname.startswith(".") and ".tmp" in fname:
             note("warn", "stale-tmp",
                  f"{fp}: leftover tmp file from a crashed save")
+            if repair:
+                os.remove(fp)
+                did(f"removed {fp}")
+            continue
+        if is_wal_tmp(fname):
+            # a killed WAL rotation (memtable flush start): the rename
+            # never happened, so no record in it was ever acknowledged
+            note("warn", "wal-tmp",
+                 f"{fp}: abandoned write-ahead-log rotation temp from a "
+                 "killed memtable flush (nothing in it was acknowledged)")
+            if repair:
+                os.remove(fp)
+                did(f"removed {fp}")
+            continue
+        if is_wal_file(fname):
+            # the live write path's durability file: it may hold
+            # ACKNOWLEDGED upserts that have not flushed to segments yet —
+            # the right recovery is a serve-worker restart (which replays
+            # it), not deletion; --repair prunes it only as the explicit
+            # destructive choice, and says what is lost
+            note("warn", "wal-pending",
+                 f"{fp}: upsert write-ahead log — may hold acknowledged "
+                 "writes not yet flushed to store segments; restart the "
+                 "serve worker with upserts enabled to replay it, or "
+                 "--repair prunes it (unflushed acknowledged upserts in "
+                 "it are LOST)")
+            if repair:
+                os.remove(fp)
+                did(f"removed {fp} (unreplayed upserts dropped)")
+            continue
+        if is_flush_tmp(fname):
+            # a memtable flush killed before its rename step: the
+            # manifest never referenced these and the WAL still covers
+            # every acknowledged row — pruning is safe
+            note("warn", "flush-tmp",
+                 f"{fp}: abandoned memtable-flush temp from a killed "
+                 "flush pass (the WAL still covers its rows)")
             if repair:
                 os.remove(fp)
                 did(f"removed {fp}")

@@ -177,6 +177,21 @@ class AlgorithmLedger:
                 e for e in self._entries if e.get("type") == "compact"
             ]
 
+    def flush(self, record: dict) -> None:
+        """Append one ``{"type": "flush"}`` maintenance record — the audit
+        trail of a memtable flush (``store/memtable.py``: labels flushed,
+        rows, new seg ids, bytes, wall seconds).  Like compact records,
+        resume/undo logic ignores it; ops tooling reads it for the
+        provenance of segments the live write path created."""
+        self._append({"type": "flush", **record, "ts": time.time()})
+
+    def flushes(self) -> list[dict]:
+        """All memtable-flush records, oldest first."""
+        with self._lock:
+            return [
+                e for e in self._entries if e.get("type") == "flush"
+            ]
+
     def undo_intent(self, alg_id: int) -> None:
         """Record that an undo of ``alg_id`` is ABOUT to mutate the store.
 

@@ -1200,6 +1200,15 @@ class VariantStore:
         import uuid
 
         self._uid = uuid.uuid4().hex
+        # cooperative-writer adoption state (see save()): seg ids below
+        # the floor existed when this store loaded (ours to manage,
+        # including dropping them on undo); ids at/above it that we did
+        # not allocate ourselves belong to ANOTHER writer that committed
+        # into this directory since — a memtable flush or compaction —
+        # and save() must carry their groups forward, never clobber or
+        # orphan them.  None = fresh store (no on-disk lineage to adopt).
+        self._sid_floor: int | None = None
+        self._my_sids: set[int] = set()
 
     def shard(self, chrom_code: int) -> ChromosomeShard:
         code = int(chrom_code)
@@ -1250,18 +1259,81 @@ class VariantStore:
     # updated since the last save) — the reference's analog is the WAL-less
     # UNLOGGED-table commit, not a full table rewrite.
 
-    def _dir_trusted(self, path: str) -> bool:
-        """Whether pre-existing segment files in ``path`` belong to THIS
-        store's lineage (its manifest carries our uid).  Untrusted
+    def _dir_manifest(self, path: str) -> dict | None:
+        """The directory's CURRENT manifest when it belongs to THIS
+        store's lineage (carries our uid), else None.  Untrusted
         directories get every segment rewritten — stale same-stem files
         from another/older store must never be adopted as this segment's
         data."""
         try:
             with open(os.path.join(path, "manifest.json")) as f:
-                uid = json.load(f).get("store_uid")
+                manifest = json.load(f)
         except (OSError, ValueError):
-            return False
-        return uid is not None and uid == self._uid
+            return None
+        if not isinstance(manifest, dict) \
+                or manifest.get("store_uid") != self._uid:
+            return None
+        return manifest
+
+    def _adoptable_groups(self, on_disk: dict | None) -> dict:
+        """{label: [group, ...]} of backing groups ANOTHER cooperative
+        writer (a serve worker's memtable flush, or a compaction pass)
+        committed into this directory since this store loaded — detected
+        by seg id: at/above the load-time floor and not allocated by
+        this store.  save() carries these forward verbatim: dropping
+        them would silently destroy rows this store never held (for a
+        flush, ACKNOWLEDGED upserts whose WAL was already truncated),
+        and re-deriving them fresh from the live manifest every save
+        keeps us consistent if a later pass (compaction) replaces them.
+        Groups below the floor are ours to manage — including NOT
+        carrying them when an undo dropped their rows."""
+        if self._sid_floor is None or on_disk is None:
+            return {}
+        if int(on_disk.get("next_seg_id", 1)) <= self._sid_floor:
+            return {}  # no id at/above the floor can exist in it
+        floor = self._sid_floor
+        fmt2 = on_disk.get("format") == 2
+        adopted: dict[str, list] = {}
+        for label, groups in (on_disk.get("shards") or {}).items():
+            norm = [[g] for g in groups] if fmt2 else groups
+            keep = [
+                list(group) for group in norm
+                if group and all(
+                    isinstance(sid, int) and sid >= floor
+                    and sid not in self._my_sids for sid in group
+                )
+            ]
+            if keep:
+                adopted[label] = keep
+        return adopted
+
+    @staticmethod
+    def _peek_segment_rows(path: str, stem: str) -> int:
+        """Row count of one on-disk segment from its container header
+        alone (no column data read) — the stats entry for adopted
+        groups.  Best-effort: stats are advisory, a parse failure
+        reports 0 rather than failing the save."""
+        fp = os.path.join(path, stem + ".npz")
+        try:
+            with open(fp, "rb") as f:
+                head = f.readline()
+                if not head.startswith(b"{"):
+                    with open(fp, "rb") as zf:  # legacy zip npz
+                        with np.load(zf) as z:
+                            return int(z["ref"].shape[0])
+                meta = json.loads(head)
+                if "rows" in meta:  # seg: 2 (compaction) records it
+                    return int(meta["rows"])
+                version = np.lib.format.read_magic(f)
+                if version == (1, 0):
+                    shape, _f, _d = np.lib.format.read_array_header_1_0(f)
+                elif version == (2, 0):
+                    shape, _f, _d = np.lib.format.read_array_header_2_0(f)
+                else:
+                    return 0
+                return int(shape[0])
+        except (OSError, ValueError, KeyError):
+            return 0
 
     def save(self, path: str) -> None:
         if self.readonly:
@@ -1270,12 +1342,24 @@ class VariantStore:
                 "readonly=True — reload without it to mutate)"
             )
         os.makedirs(path, exist_ok=True)
-        trusted = self._dir_trusted(path)
+        on_disk = self._dir_manifest(path)
+        trusted = on_disk is not None
+        # cooperative-writer sync: a memtable flush (or compaction)
+        # committed since this store loaded or last saved — its groups
+        # are carried forward below, and its seg ids must NEVER be
+        # reallocated here (writing chr<L>.<sid> would clobber its files
+        # before the rename even races anything)
+        adopted = self._adoptable_groups(on_disk)
+        if trusted:
+            self._next_seg_id = max(
+                self._next_seg_id, int(on_disk.get("next_seg_id", 1))
+            )
         live_files = {"manifest.json"}
         manifest = {
             "format": 3, "width": self.width, "store_uid": self._uid,
             "shards": {},
         }
+        adopted_rows: dict[str, int] = {}
         for code, shard in sorted(self.shards.items()):
             label = chromosome_label(code)
             groups = []
@@ -1300,6 +1384,7 @@ class VariantStore:
                     # renames can otherwise tear an npz/jsonl pair)
                     sid = self._next_seg_id
                     self._next_seg_id += 1
+                    self._my_sids.add(sid)
                     stems = [f"chr{label}.{sid:06d}"]
                     self._integrity[stems[0]] = self._write_segment(
                         path, stems[0], seg
@@ -1310,6 +1395,24 @@ class VariantStore:
                     live_files.update({stem + ".npz", stem + ".ann.jsonl"})
                 groups.append(list(seg.backing))
             manifest["shards"][label] = groups
+        # append adopted groups AFTER this store's own (they are the
+        # NEWER writes: first-wins ordering on disk matches the overlay
+        # their writer served), carrying their integrity records
+        for label, groups in sorted(adopted.items()):
+            manifest["shards"].setdefault(label, [])
+            rows = 0
+            for group in groups:
+                manifest["shards"][label].append(list(group))
+                for sid in group:
+                    stem = f"chr{label}.{sid:06d}"
+                    live_files.update(
+                        {stem + ".npz", stem + ".ann.jsonl"}
+                    )
+                    rec = (on_disk.get("integrity") or {}).get(stem)
+                    if rec is not None:
+                        self._integrity[stem] = rec
+                    rows += self._peek_segment_rows(path, stem)
+            adopted_rows[label] = rows
         manifest["next_seg_id"] = self._next_seg_id
         # write-time integrity records for every LIVE segment file (size +
         # crc32 of the exact bytes handed to the OS).  Stems with no record
@@ -1328,14 +1431,17 @@ class VariantStore:
         # DETERMINISTIC on store content only — no timestamps/host data:
         # serial and overlapped loads of the same input must stay
         # byte-identical, manifest included (tests/test_pipeline_modes.py)
+        stats_rows = {
+            chromosome_label(code): int(shard.n)
+            for code, shard in sorted(self.shards.items())
+        }
+        for label, rows in sorted(adopted_rows.items()):
+            stats_rows[label] = stats_rows.get(label, 0) + rows
         manifest["stats"] = {
-            "rows": {
-                chromosome_label(code): int(shard.n)
-                for code, shard in sorted(self.shards.items())
-            },
+            "rows": stats_rows,
             "segments": {
-                chromosome_label(code): len(shard.segments)
-                for code, shard in sorted(self.shards.items())
+                label: len(groups)
+                for label, groups in manifest["shards"].items()
             },
         }
         # atomic swap: a PROCESS crash mid-save must leave the previous
@@ -1498,6 +1604,10 @@ class VariantStore:
             )
         store = cls(manifest["width"])
         store._next_seg_id = manifest.get("next_seg_id", 1)
+        # adoption floor (see save()): everything below this id is this
+        # manifest's own lineage; a cooperative writer committing later
+        # allocates at/above it
+        store._sid_floor = int(store._next_seg_id)
         uid = manifest.get("store_uid")
         if uid:
             # resume this store's on-disk lineage: saves back into this

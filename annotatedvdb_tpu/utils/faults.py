@@ -97,7 +97,36 @@ arming any other name is a ``ValueError`` at parse time):
                             leaves the NEW layout serving with the old
                             files as prunable orphans; ``eio`` must be
                             absorbed (gc is best-effort)
+``wal.append``              in ``store.wal`` before an upsert's CRC frame
+                            is written — raise/eio fail the request with
+                            nothing durable; ``torn_write`` lands half
+                            the frame then kills (the torn tail replay
+                            must drop: the request was never acked)
+``wal.fsync``               after the frame write, before its fsync — a
+                            death here may leave the record durable but
+                            UNACKNOWLEDGED; replay applies it in full or
+                            not at all, never a hybrid
+``wal.replay``              once per WAL file during worker-start replay
+                            — a death mid-replay must be recoverable by
+                            replaying again on respawn
+``memtable.flush``          twice per memtable flush: after the plan is
+                            captured (nothing written — a death leaves
+                            the store byte-untouched), and mid-manifest-
+                            commit (the tmp is written, the atomic
+                            replace has not happened — the OLD manifest
+                            keeps serving, the WAL still covers every
+                            acknowledged row)
 ======================== ====================================================
+
+**Process-death actions are subprocess-only.**  ``kill``/``torn_write``
+SIGKILL the CURRENT process; arming them explicitly in-process
+(``faults.reset(spec)`` from a test) would kill the test harness itself,
+which used to fail obscurely.  :func:`reset` therefore rejects an
+explicit arm of a death action unless the point is a WORKER point
+(:data:`WORKER_POINTS` — points that fire inside a disposable serve
+worker, the chaos harness's lever); environment arming
+(``AVDB_FAULT=...`` in a spawned subprocess) remains unrestricted — that
+IS the subprocess path.
 
 ``fired()`` exposes per-point fire counts for the observability exports.
 """
@@ -135,7 +164,21 @@ POINTS = frozenset({
     "compact.merge",
     "compact.swap",
     "compact.gc",
+    "wal.append",
+    "wal.fsync",
+    "wal.replay",
+    "memtable.flush",
 })
+
+#: points that fire inside a disposable serve WORKER process: the one
+#: place an explicit in-process arm of a death action (``kill``/
+#: ``torn_write``) is intentional — the chaos harness arms live workers
+#: through POST /_chaos and the supervisor absorbs the death.  Everywhere
+#: else a death action must be armed via a subprocess environment.
+WORKER_POINTS = frozenset({"serve.accept", "serve.worker", "serve.wedge"})
+
+#: actions that SIGKILL the current process (see WORKER_POINTS)
+DEATH_ACTIONS = ("kill", "torn_write")
 
 
 class InjectedFault(RuntimeError):
@@ -237,11 +280,29 @@ def _parse(spec: str | None) -> tuple | None:
 def reset(spec: str | None = None) -> None:
     """Re-arm from ``spec`` (or the current environment), zero the hit
     counters, and re-seed the ``prob`` coin (``AVDB_FAULT_SEED``) — the
-    test-suite entry point for in-process fault runs."""
+    test-suite entry point for in-process fault runs.
+
+    An EXPLICIT spec arming a death action (``kill``/``torn_write``) at a
+    non-worker point is rejected: those actions SIGKILL the current
+    process, so arming them in-process would kill the test harness —
+    the valid in-process actions are named in the error, and the
+    subprocess path (``AVDB_FAULT`` in a child environment, which the
+    import-time arm below parses) stays unrestricted."""
     global _ARMED
-    _ARMED = _parse(
+    armed = _parse(
         spec if spec is not None else os.environ.get("AVDB_FAULT")
     )
+    if spec is not None and armed is not None:
+        point, _nth, _prob, action, _ms = armed
+        if action in DEATH_ACTIONS and point not in WORKER_POINTS:
+            raise ValueError(
+                f"AVDB_FAULT={spec!r}: action {action!r} at point "
+                f"{point!r} is subprocess-only (it SIGKILLs the current "
+                "process) — arm it via AVDB_FAULT in the child process "
+                f"environment; valid in-process actions for {point!r}: "
+                "raise, eio, delay"
+            )
+    _ARMED = armed
     _SEEN.clear()
     _FIRED.clear()
     try:

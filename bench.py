@@ -740,6 +740,161 @@ def bench_serve_open_loop(store_dir: str, ids: list,
     return out
 
 
+def bench_serve_mixed_workload(store_dir: str, ids: list,
+                               read_qps: float = 2_000.0,
+                               upserts_per_sec: float = 150.0,
+                               duration_s: float = 6.0, conns: int = 8,
+                               slo_p99_ms: float = 25.0) -> dict:
+    """Mixed read/write leg: sustained point-read QPS measured open-loop
+    WHILE a writer drives durable upserts through the same worker.
+
+    A real 1-worker ``serve --upserts`` subprocess runs over a COPY of
+    the synth store (the write path mutates it; other legs must not
+    see that).  The reader is the open-loop step machinery; the writer
+    is closed-loop at a fixed target rate on one keep-alive connection,
+    each POST a WAL-fsync'd ack whose latency is sampled.  After the
+    step, every acknowledged upsert id is read back through bulk
+    ``POST /variants`` — ``acked_missing`` MUST be 0 (zero
+    acknowledged-write loss, the ack contract under load)."""
+    import http.client
+    import re as re_mod
+    import signal
+    import subprocess
+    import threading
+    import urllib.request
+
+    work = tempfile.mkdtemp(prefix="avdb_mixed_")
+    mixed_dir = os.path.join(work, "store")
+    shutil.copytree(store_dir, mixed_dir)
+    blobs = [
+        (f"GET /variant/{i} HTTP/1.1\r\nHost: b\r\n\r\n").encode()
+        for i in ids[:20_000]
+    ]
+    out: dict = {
+        "read_qps_target": float(read_qps),
+        "upserts_per_sec_target": float(upserts_per_sec),
+        "duration_s": duration_s,
+        "slo_p99_ms": slo_p99_ms,
+        "conns": conns,
+    }
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               # triggers stay quiet during the measured window: the
+               # flush leg of the story is certified by the smoke/matrix,
+               # this leg measures steady-state write+read throughput
+               AVDB_MEMTABLE_BYTES="0", AVDB_MEMTABLE_FLUSH_S="0")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", mixed_dir, "--port", "0", "--upserts",
+         "--maxQueue", "65536"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re_mod.search(r"http://([\d.]+):(\d+)", line)
+        if m is None:
+            out["error"] = f"no address line: {line[:120]!r}"
+            return out
+        host, port = m.group(1), int(m.group(2))
+        for _ in range(300):
+            try:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=2)
+                break
+            except OSError:
+                time.sleep(0.2)
+        settle()
+        _open_loop_step(host, port, blobs, 500, 0.5, conns)  # warmup
+
+        acks: list = []
+        acked_ids: list = []
+        wstats = {"errors": 0}
+        stop = threading.Event()
+
+        def writer():
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            interval = 1.0 / upserts_per_sec
+            k = 0
+            t0 = time.perf_counter()
+            while not stop.is_set():
+                target = t0 + k * interval
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(min(target - now, 0.05))
+                    continue
+                vid = f"9:{50_000_000 + k}:A:G"
+                body = json.dumps({"variants": [
+                    {"id": vid,
+                     "annotations": {"other_annotation": {"k": k}}},
+                ]}).encode()
+                ts = time.perf_counter()
+                try:
+                    conn.request("POST", "/variants/upsert", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    ok = resp.status == 200
+                    resp.read()
+                except OSError:
+                    ok = False
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=10)
+                if ok:
+                    acks.append(time.perf_counter() - ts)
+                    acked_ids.append(vid)
+                else:
+                    wstats["errors"] += 1
+                k += 1
+            conn.close()
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        t0 = time.perf_counter()
+        read_step = _open_loop_step(
+            host, port, blobs, read_qps, duration_s, conns)
+        stop.set()
+        wt.join(timeout=30)
+        dt = max(time.perf_counter() - t0, 1e-9)
+
+        # zero acknowledged-write loss: every acked id answers
+        missing = 0
+        for lo in range(0, len(acked_ids), 500):
+            chunk = acked_ids[lo:lo + 500]
+            req = urllib.request.Request(
+                f"http://{host}:{port}/variants", method="POST",
+                data=json.dumps({"ids": chunk}).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                found = json.loads(r.read())["found"]
+            missing += len(chunk) - found
+        ack_ms = np.asarray(acks or [0.0]) * 1000.0
+        out.update({
+            "read": read_step,
+            "read_slo_met": bool(
+                read_step["errors"] == 0
+                and read_step.get("transport_errors", 0) == 0
+                and read_step["p99_ms"] <= slo_p99_ms
+            ),
+            "upserts": {
+                "acked": len(acked_ids),
+                "errors": int(wstats["errors"]),
+                "achieved_per_sec": round(len(acked_ids) / dt, 1),
+                "ack_p50_ms": round(float(np.percentile(ack_ms, 50)), 3),
+                "ack_p99_ms": round(float(np.percentile(ack_ms, 99)), 3),
+            },
+            "acked_verified": len(acked_ids),
+            "acked_missing": int(missing),
+        })
+        return out
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_chaos() -> dict:
     """The chaos/soak certification leg (``tools/chaos_soak.py``, full
     schedule): a 2-worker fleet under open-loop load absorbs injected
@@ -1337,6 +1492,14 @@ def serve_only():
             }
         settle()
         serving["open_loop"] = bench_serve_open_loop(store_dir, ids)
+        settle()
+        try:
+            serving["mixed_workload"] = bench_serve_mixed_workload(
+                store_dir, ids)
+        except Exception as exc:  # the legs after it must still record
+            serving["mixed_workload"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]
+            }
     finally:
         shutil.rmtree(work, ignore_errors=True)
     settle()

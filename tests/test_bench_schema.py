@@ -88,6 +88,20 @@ GOOD = {
                  ]},
             ],
         },
+        "mixed_workload": {
+            "read_qps_target": 2000.0, "upserts_per_sec_target": 150.0,
+            "duration_s": 6.0, "slo_p99_ms": 25.0, "conns": 8,
+            "read": {"offered_qps": 2000.0, "achieved_qps": 1988.0,
+                     "p50_ms": 8.2, "p99_ms": 19.4, "errors": 0,
+                     "transport_errors": 0,
+                     "status_counts": {"200": 11928},
+                     "requests": 11928, "seconds": 6.0},
+            "read_slo_met": True,
+            "upserts": {"acked": 894, "errors": 0,
+                        "achieved_per_sec": 148.8,
+                        "ack_p50_ms": 2.4, "ack_p99_ms": 9.7},
+            "acked_verified": 894, "acked_missing": 0,
+        },
         "chaos": {
             "mode": "full", "workers": 2, "duration_s": 40.0,
             "offered_qps": 600.0, "requests": 24734, "ok": 23359,
@@ -105,6 +119,8 @@ GOOD = {
             "compact": {"status": "compacted", "files_before": 2,
                         "files_after": 1, "bytes_reclaimed": 120034,
                         "seconds": 0.8},
+            "upserts": {"acked": 360, "errors": 2, "missing": 0,
+                        "verify_s": 3.1},
         },
     },
     "compaction": {
@@ -333,3 +349,52 @@ def test_checker_cli_over_committed_records():
         capture_output=True, text=True, timeout=120,
     )
     assert res.returncode == 0, res.stderr
+
+
+def test_mixed_workload_block_is_validated_strictly():
+    mx = GOOD["serving"]["mixed_workload"]
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["mixed_workload"]["acked_missing"]
+    assert any("acked_missing" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["mixed_workload"]["acked_missing"] = 3
+    assert any("acknowledged upsert" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["mixed_workload"]["upserts"]["ack_p99_ms"] = 0.1
+    assert any("ack_p99_ms below ack_p50_ms" in e
+               for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["mixed_workload"]["upserts"]["achieved_per_sec"]
+    assert any("achieved_per_sec" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["mixed_workload"]["read"]["achieved_qps"] = "fast"
+    assert any("achieved_qps" in e for e in validate_record(bad))
+
+    # a failed leg records {"error": ...} and must not fail validation
+    failed = copy.deepcopy(GOOD)
+    failed["serving"]["mixed_workload"] = {"error": "TimeoutError: x"}
+    assert validate_record(failed) == []
+
+    # historic records (no mixed_workload at all) keep validating
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["mixed_workload"]
+    assert validate_record(old) == []
+    assert isinstance(mx, dict)
+
+
+def test_chaos_upserts_subblock_is_validated():
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["upserts"]["missing"] = 4
+    assert any("acknowledged-write loss" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["chaos"]["upserts"]["acked"]
+    assert any("acked" in e for e in validate_record(bad))
+
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["chaos"]["upserts"]
+    assert validate_record(old) == []

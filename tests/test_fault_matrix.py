@@ -724,3 +724,416 @@ def test_serve_worker_kill_fleet_restarts_and_keeps_serving(tmp_path):
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=60)
     assert rc == 0, proc.stdout.read()[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# wal.append / wal.fsync / wal.replay / memtable.flush — the live write
+# path's kill points (store/wal.py + store/memtable.py).  Contract: an
+# ACKNOWLEDGED upsert (Memtable.upsert returned) is present after
+# recovery; an unacknowledged one is applied in full or not at all —
+# never a hybrid, never a torn store.
+
+
+_UPSERT_ROW = {
+    "code": 3, "pos": 15, "ref": "A", "alt": "G", "ref_snp": 7,
+    "ann": {"other_annotation": {"k": 1}},
+}
+
+
+def _upsert_env(tmp_path):
+    """(store_dir, base readonly store, memtable-with-wal) over the tiny
+    chr3 store — the in-process write-path fixture."""
+    from annotatedvdb_tpu.store.memtable import Memtable
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    store_dir = str(tmp_path / "ustore")
+    _tiny_store().save(store_dir)
+    base = VariantStore.load(store_dir, readonly=True)
+    wal = WriteAheadLog(store_dir, "serve-w0", log=lambda m: None)
+    mem = Memtable(width=8, store_dir=store_dir, wal=wal,
+                   log=lambda m: None)
+    return store_dir, base, mem
+
+
+def _fresh_replayed(store_dir, base):
+    """A brand-new memtable rebuilt from the on-disk WAL — the respawned
+    worker's view."""
+    from annotatedvdb_tpu.store.memtable import Memtable
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    mem = Memtable(width=8, store_dir=store_dir,
+                   wal=WriteAheadLog(store_dir, "serve-w0",
+                                     log=lambda m: None),
+                   log=lambda m: None)
+    applied = mem.replay(base)
+    return mem, applied
+
+
+@pytest.mark.parametrize("fault", [
+    "wal.append:1:raise",
+    "wal.append:1:eio",
+])
+def test_wal_append_fault_leaves_prestate(tmp_path, fault):
+    """A failure BEFORE the WAL frame lands must fail the request with
+    nothing visible, nothing durable, and nothing to replay — the
+    consistent pre-state (the request was never acknowledged)."""
+    store_dir, base, mem = _upsert_env(tmp_path)
+    faults.reset(fault)
+    try:
+        with pytest.raises((faults.InjectedFault, OSError)):
+            mem.upsert(base, [dict(_UPSERT_ROW)])
+    finally:
+        faults.reset("")
+    assert mem.rows == 0
+    replayed, applied = _fresh_replayed(store_dir, base)
+    assert applied == 0 and replayed.rows == 0
+    # unarmed retry succeeds and IS durable
+    accepted, shadowed, _b = mem.upsert(base, [dict(_UPSERT_ROW)])
+    assert (accepted, shadowed) == (1, 0)
+    _, applied = _fresh_replayed(store_dir, base)
+    assert applied == 1
+
+
+def test_wal_fsync_fault_is_all_or_nothing(tmp_path):
+    """A failure between the frame write and its fsync: the request was
+    NOT acknowledged, but the frame is complete — replay applies it in
+    full (never a torn half-row), which the contract allows for un-acked
+    writes.  The failing request itself left nothing visible."""
+    store_dir, base, mem = _upsert_env(tmp_path)
+    faults.reset("wal.fsync:1:raise")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            mem.upsert(base, [dict(_UPSERT_ROW)])
+    finally:
+        faults.reset("")
+    assert mem.rows == 0  # nothing became visible in the failing worker
+    replayed, applied = _fresh_replayed(store_dir, base)
+    assert applied in (0, 1)
+    if applied:
+        # applied IN FULL: the row answers with its exact content
+        from annotatedvdb_tpu.serve import QueryEngine, StaticSnapshots
+        from annotatedvdb_tpu.serve.snapshot import MemtableSnapshots
+
+        engine = QueryEngine(
+            MemtableSnapshots(StaticSnapshots(base), replayed),
+            region_cache_size=0,
+        )
+        rec = engine.lookup("3:15:A:G")
+        assert rec is not None and '"rs7"' in rec \
+            and '"other_annotation":{"k": 1}' in rec
+
+
+def test_wal_replay_fault_then_retry_recovers(tmp_path):
+    """A death mid-replay (wal.replay) is recovered by replaying again on
+    the next respawn — replay mutates nothing durable, and the first-wins
+    check makes double-application impossible."""
+    store_dir, base, mem = _upsert_env(tmp_path)
+    accepted, _s, _b = mem.upsert(base, [dict(_UPSERT_ROW)])
+    assert accepted == 1
+    faults.reset("wal.replay:1:raise")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            _fresh_replayed(store_dir, base)
+    finally:
+        faults.reset("")
+    # the respawn replays clean; a second replay pass over the same WAL
+    # (the crash-during-replay recovery) changes nothing
+    replayed, applied = _fresh_replayed(store_dir, base)
+    assert applied == 1 and replayed.rows == 1
+    accepted, shadowed, _b = replayed.upsert(
+        base, [dict(_UPSERT_ROW)], durable=False
+    )
+    assert (accepted, shadowed) == (0, 1)
+
+
+@pytest.mark.parametrize("fault", [
+    "memtable.flush:1:raise",   # before anything is written
+    "memtable.flush:1:eio",
+    "memtable.flush:2:raise",   # mid-manifest-commit (segments renamed)
+    "memtable.flush:2:eio",
+])
+def test_memtable_flush_crash_matrix_in_process(tmp_path, fault):
+    """A flush failure at either kill point leaves the on-disk store
+    byte-identical to its pre-flush state, the memtable + WAL keeping
+    every acknowledged row (reads unaffected); fsck prunes any debris
+    and an unarmed retry completes."""
+    from annotatedvdb_tpu.store.fsck import fsck as run_fsck
+
+    store_dir, base, mem = _upsert_env(tmp_path)
+    pre = _store_signature_chr3(store_dir)
+    accepted, _s, _b = mem.upsert(base, [dict(_UPSERT_ROW)])
+    assert accepted == 1
+    faults.reset(fault)
+    try:
+        with pytest.raises((faults.InjectedFault, OSError)):
+            mem.flush(base_manager=None)
+    finally:
+        faults.reset("")
+    # store untouched; the acknowledged row is still served (memtable)
+    assert _store_signature_chr3(store_dir) == pre
+    assert mem.rows == 1
+    report = run_fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+    # repair prunes WAL debris too in this mode — but the MEMTABLE still
+    # holds the row, so the retry flush makes it durable regardless
+    result = mem.flush(base_manager=None)
+    assert result["status"] == "flushed" and result["rows"] == 1
+    assert mem.rows == 0
+    store = VariantStore.load(store_dir)
+    assert store.shard(3).n == 4
+    final = run_fsck(store_dir, repair=True, log=lambda m: None)
+    assert final["exit_code"] in (0, 1), final
+
+
+def _store_signature_chr3(store_dir: str):
+    store = VariantStore.load(store_dir)
+    shard = store.shard(3)
+    shard.compact()
+    return (
+        shard.cols["pos"].tobytes(), shard.cols["h"].tobytes(),
+        shard.ref.tobytes(), shard.alt.tobytes(), store.n,
+    )
+
+
+def test_memtable_flush_preempted_by_loader_commit(tmp_path):
+    """The three-writer coordination contract: a loader committing a new
+    generation between the flush's plan and its commit point PREEMPTS the
+    flush (status aborted, temps cleaned, memtable untouched) — and the
+    retry lands the rows on top of the loader's generation."""
+    store_dir, base, mem = _upsert_env(tmp_path)
+    accepted, _s, _b = mem.upsert(base, [dict(_UPSERT_ROW)])
+    assert accepted == 1
+
+    from annotatedvdb_tpu.store import memtable as memtable_mod
+
+    real_write = VariantStore._write_segment
+    fired = {"n": 0}
+
+    def racing_write(path, stem, seg):
+        rec = real_write(path, stem, seg)
+        if fired["n"] == 0:
+            fired["n"] = 1
+            # a loader commits a new generation AFTER our temp is written,
+            # BEFORE the flush's rename step re-checks the fingerprint
+            loader = VariantStore.load(store_dir)
+            loader.shard(3).append(
+                {"pos": np.asarray([40], np.int32),
+                 "h": np.asarray([99], np.uint32),
+                 "ref_len": np.full(1, 1, np.int32),
+                 "alt_len": np.full(1, 1, np.int32)},
+                np.full((1, 8), 65, np.uint8),
+                np.full((1, 8), 71, np.uint8),
+            )
+            loader.save(store_dir)
+        return rec
+
+    import unittest.mock as mock
+
+    with mock.patch.object(VariantStore, "_write_segment",
+                           staticmethod(racing_write)):
+        result = mem.flush(base_manager=None)
+    assert result["status"] == "aborted", result
+    assert mem.rows == 1  # nothing acknowledged was lost
+    assert not [f for f in os.listdir(store_dir) if ".flush.tmp" in f]
+    # the retry flushes onto the loader's generation
+    result = mem.flush(base_manager=None)
+    assert result["status"] == "flushed"
+    store = VariantStore.load(store_dir)
+    assert store.shard(3).n == 5  # 3 loaded + 1 loader row + 1 upsert
+
+
+def _spawn_upsert_server(store_dir, env_extra=None, timeout=60):
+    """One real `serve --upserts` worker process on an ephemeral port;
+    returns (proc, host, port) once the address line printed."""
+    import re
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               AVDB_MEMTABLE_FLUSH_S="0", AVDB_MEMTABLE_BYTES="0")
+    env.pop("AVDB_FAULT", None)
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", store_dir, "--port", "0", "--upserts"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    lines = []
+    for _ in range(50):  # replay/log lines may precede the address line
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        m = re.search(r"http://([\d.]+):(\d+)", line)
+        if m:
+            return proc, m.group(1), int(m.group(2))
+    raise AssertionError(f"no serve address line: {lines!r}")
+
+
+def _post_upsert(host, port, vid, timeout=10):
+    import urllib.request
+
+    body = json.dumps({"variants": [{"id": vid}]}).encode()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/variants/upsert", data=body, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_variant(host, port, vid, timeout=10):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/variant/{vid}", timeout=timeout
+        ) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def test_upsert_sigkill_unacked_never_appears_acked_survives(tmp_path):
+    """The ack contract through the REAL serve CLI:
+
+    1. a worker armed ``wal.append:1:torn_write`` dies mid-frame on the
+       first upsert — the client never got a 200, and after a clean
+       respawn the row is ABSENT (the torn tail was dropped);
+    2. the respawned worker ACKs the same upsert (200) and is then
+       SIGKILLed outright — after another respawn the acknowledged row
+       is PRESENT, byte-identical, served from the replayed WAL."""
+    import urllib.error
+
+    store_dir = str(tmp_path / "sstore")
+    _tiny_store().save(store_dir)
+
+    # -- stage 1: death mid-WAL-append => un-acked, absent ---------------
+    proc, host, port = _spawn_upsert_server(
+        store_dir, env_extra={"AVDB_FAULT": "wal.append:1:torn_write"}
+    )
+    try:
+        with pytest.raises((urllib.error.URLError, ConnectionError,
+                            TimeoutError)):
+            _post_upsert(host, port, "3:15:A:G")
+    finally:
+        rc = proc.wait(timeout=60)
+    assert rc == -signal.SIGKILL, f"expected SIGKILL death, rc={rc}"
+
+    proc, host, port = _spawn_upsert_server(store_dir)
+    try:
+        status, _body = _get_variant(host, port, "3:15:A:G")
+        assert status == 404, "un-acked upsert must not appear"
+
+        # -- stage 2: acked upsert survives a SIGKILL --------------------
+        status, body = _post_upsert(host, port, "3:15:A:G")
+        assert status == 200 and b'"accepted":1' in body
+        status, want = _get_variant(host, port, "3:15:A:G")
+        assert status == 200
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    proc, host, port = _spawn_upsert_server(store_dir)
+    try:
+        status, got = _get_variant(host, port, "3:15:A:G")
+        assert status == 200 and got == want, \
+            "acknowledged upsert lost or changed across SIGKILL"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+
+
+def test_memtable_flush_sigkill_through_cli_recovers_to_post(tmp_path):
+    """memtable.flush:2:kill through the REAL serve CLI: the worker acks
+    an upsert, its flush dies AT THE MANIFEST COMMIT POINT (segments
+    renamed, manifest not swapped) — the durable store is byte-identical
+    pre-state with fsck-attributable debris, the acknowledged row
+    survives in the WAL, and a clean respawn replays it, flushes it, and
+    converges on the post state."""
+    import shutil as _shutil
+    import time
+
+    store_dir = str(tmp_path / "fstore")
+    _tiny_store().save(store_dir)
+
+    pre_manifest = json.load(open(os.path.join(store_dir,
+                                               "manifest.json")))
+
+    # stage 1: ack a row with flush triggers off, drain cleanly (the
+    # WAL keeps the row: the memtable never flushed)
+    proc, host, port = _spawn_upsert_server(store_dir)
+    try:
+        status, body = _post_upsert(host, port, "3:15:A:G")
+        assert status == 200 and b'"accepted":1' in body
+        status, want = _get_variant(host, port, "3:15:A:G")
+        assert status == 200
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+
+    # stage 2: respawn with the commit-point kill armed and a 1-byte
+    # bound — replay crosses the bound, the maintenance tick fires the
+    # flush, and the armed kill lands at the manifest commit (no request
+    # in flight: the ack already happened, a restart ago)
+    proc, host, port = _spawn_upsert_server(
+        store_dir,
+        env_extra={"AVDB_FAULT": "memtable.flush:2:kill",
+                   "AVDB_MEMTABLE_BYTES": "1"},
+    )
+    try:
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == -signal.SIGKILL, f"expected flush kill, rc={rc}"
+
+    # pre-state: the manifest never swapped (same shard groups), the
+    # renamed segments are orphan debris, the WAL survives
+    now_manifest = json.load(open(os.path.join(store_dir,
+                                               "manifest.json")))
+    assert now_manifest["shards"] == pre_manifest["shards"]
+    assert any(f.endswith(".wal") for f in os.listdir(store_dir))
+    from annotatedvdb_tpu.store.fsck import fsck as run_fsck
+
+    # repair on a COPY first: pruning must yield a clean pre-state store
+    audit = str(tmp_path / "audit")
+    _shutil.copytree(store_dir, audit)
+    report = run_fsck(audit, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+
+    # a clean respawn replays the acked row and completes the flush
+    proc, host, port = _spawn_upsert_server(
+        store_dir, env_extra={"AVDB_MEMTABLE_BYTES": "1"}
+    )
+    try:
+        status, got = _get_variant(host, port, "3:15:A:G")
+        assert status == 200 and got == want
+        deadline = time.time() + 60
+        flushed = False
+        while time.time() < deadline:
+            rows = json.load(open(os.path.join(
+                store_dir, "manifest.json"
+            ))).get("stats", {}).get("rows", {})
+            if int(rows.get("3", 0)) >= 4:
+                flushed = True
+                break
+            time.sleep(0.25)
+        assert flushed, "respawned worker never completed the flush"
+        status, got = _get_variant(host, port, "3:15:A:G")
+        assert status == 200 and got == want
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    store = VariantStore.load(store_dir)
+    assert store.shard(3).n == 4
+    # the dead flush's stale .manifest.tmp is the one prescribed repair
+    # (the per-kill-point table); after it the store deep-fscks clean
+    report = run_fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+    assert run_fsck(store_dir, deep=True,
+                    log=lambda m: None)["exit_code"] == 0
+    assert VariantStore.load(store_dir).shard(3).n == 4

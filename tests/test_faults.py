@@ -348,3 +348,34 @@ def test_gave_up_counts_retry_exhaustion_only():
     with pytest.raises(OSError):
         with_backoff(always_transient, attempts=2, base_delay=0.001)
     assert retry_mod.stats["gave_up"] - before["gave_up"] == 1
+
+
+def test_death_actions_are_subprocess_only_in_process():
+    """Explicitly arming kill/torn_write at a non-worker point would
+    SIGKILL the test process itself — reset() must reject it with an
+    error that names the valid in-process actions; worker points (the
+    chaos harness's /_chaos lever) and environment arming stay allowed."""
+    for spec in ("ingest.chunk:1:kill", "store.save.pre_manifest:1:kill",
+                 "wal.append:1:torn_write", "memtable.flush:1:kill"):
+        with pytest.raises(ValueError) as exc:
+            faults.reset(spec)
+        msg = str(exc.value)
+        assert "subprocess-only" in msg
+        assert "raise, eio, delay" in msg
+        assert faults.armed_point() is None  # nothing stayed armed
+    # worker points: an in-process arm of a death action is the chaos
+    # harness's intended lever (the supervisor absorbs the death)
+    faults.reset("serve.accept:1:kill")
+    assert faults.armed_point() == "serve.accept"
+    faults.reset("")
+
+
+def test_death_actions_allowed_via_environment(monkeypatch):
+    """Environment arming IS the subprocess path: reset() with no
+    explicit spec must accept a death action at any point (the armed
+    process is the child that will die, not the harness)."""
+    monkeypatch.setenv("AVDB_FAULT", "store.save.pre_manifest:1:kill")
+    faults.reset()  # parses the environment: no rejection
+    assert faults.armed_point() == "store.save.pre_manifest"
+    monkeypatch.delenv("AVDB_FAULT")
+    faults.reset("")

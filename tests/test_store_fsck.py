@@ -155,8 +155,10 @@ def test_corrupt_fixture_repairs_end_to_end(tmp_path):
     report = fsck(d, log=lambda m: None)
     assert report["exit_code"] == 2
     assert {"segment-torn", "segment-orphan", "stale-tmp", "compact-tmp",
+            "wal-pending", "wal-tmp", "flush-tmp",
             "ledger-torn", "undo-intent-dangling"} <= _codes(report)
-    # the abandoned compaction temp is attributed, never "foreign"
+    # the abandoned compaction/flush temps and the WAL are attributed,
+    # never "foreign"
     assert "foreign-file" not in _codes(report)
     # doctor --repair through the CLI entry point
     from annotatedvdb_tpu.cli import doctor
@@ -220,3 +222,56 @@ def test_deep_verify_catches_flipped_byte(tmp_path, monkeypatch, ext):
     # and fsck --deep agrees
     report = fsck(d, deep=True, log=lambda m: None)
     assert "segment-bitrot" in _codes(report)
+
+
+# ---------------------------------------------------------------------------
+# live-write-path debris: WAL files, rotation temps, flush temps
+
+
+def test_wal_debris_attributed_and_pruned(tmp_path):
+    """``*.wal`` / ``*.wal.tmp`` / ``*.flush.tmp.*`` from the upsert path
+    get dedicated finding codes (never ``foreign-file``); --repair prunes
+    them, with the wal-pending message naming what is lost and the
+    non-destructive alternative (a serve-worker restart replays it)."""
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    d = str(tmp_path / "vdb")
+    _mkstore(d)
+    wal = WriteAheadLog(d, "serve-w0", log=lambda m: None)
+    wal.append({"rows": [{"code": 1, "pos": 150, "ref": "A", "alt": "G",
+                          "ref_snp": None, "ann": None}]})
+    wal.close()
+    open(os.path.join(d, "serve-w1.000003.wal.tmp"), "wb").write(
+        b'{"wal": 1}\n')
+    open(os.path.join(d, "chr1.000060.flush.tmp.ann.jsonl"), "wb").write(
+        b"")
+    report = fsck(d, log=lambda m: None)
+    codes = _codes(report)
+    assert {"wal-pending", "wal-tmp", "flush-tmp"} <= codes
+    assert "foreign-file" not in codes
+    assert report["exit_code"] == 1  # warnings, not errors
+    pending = [f for f in report["findings"] if f["code"] == "wal-pending"]
+    assert "restart the serve worker" in pending[0]["message"]
+    assert "LOST" in pending[0]["message"]
+    # detection alone never deletes
+    assert any(f.endswith(".wal") for f in os.listdir(d))
+    report = fsck(d, repair=True, log=lambda m: None)
+    assert report["repairs"]
+    left = os.listdir(d)
+    assert not any(".wal" in f or ".flush.tmp" in f for f in left), left
+    assert fsck(d, log=lambda m: None)["status"] == "clean"
+
+
+def test_wal_survives_loader_save_cleanup(tmp_path):
+    """A loader commit's orphan cleanup must never touch WAL files — the
+    durability of another process's acknowledged upserts."""
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    d = str(tmp_path / "vdb")
+    store = _mkstore(d)
+    wal = WriteAheadLog(d, "serve-w0", log=lambda m: None)
+    wal.append({"rows": []})
+    wal.close()
+    store.shard(1).set_col("ref_snp", [0], [77])  # dirty a segment
+    store.save(d)  # save() prunes orphans; the WAL must survive
+    assert any(f.endswith(".wal") for f in os.listdir(d))

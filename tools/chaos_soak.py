@@ -217,6 +217,98 @@ class LoadDriver(threading.Thread):
             ))
 
 
+class UpsertDriver(threading.Thread):
+    """Sustained durable writes during chaos (full mode): single-row
+    ``POST /variants/upsert`` calls on a keep-alive connection at a fixed
+    rate inside a scheduled window.  Every 200 is an ACK the harness
+    holds the fleet to afterwards: acknowledged ids must ALL answer once
+    flush + snapshot propagation settle (zero acknowledged-write loss) —
+    through worker kills, a wedged loop, and the live compaction pass
+    running concurrently.  Failed/refused posts are fine (never
+    acknowledged, nothing promised)."""
+
+    def __init__(self, host: str, port: int, t_start: float,
+                 start_rel: float, stop_rel: float, rate: float = 30.0):
+        super().__init__(name="chaos-upserts", daemon=True)
+        self.host, self.port = host, port
+        self.t_start = t_start
+        self.start_rel, self.stop_rel = start_rel, stop_rel
+        self.rate = rate
+        self.acked: list[str] = []
+        self.errors = 0
+
+    def run(self) -> None:
+        import http.client
+
+        delay = self.t_start + self.start_rel - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=5)
+        interval = 1.0 / self.rate
+        k = 0
+        t0 = time.monotonic()
+        stop_t = self.t_start + self.stop_rel
+        while time.monotonic() < stop_t:
+            target = t0 + k * interval
+            now = time.monotonic()
+            if target > now:
+                time.sleep(min(target - now, 0.05))
+                continue
+            vid = f"8:{8_000_001 + 7 * k}:A:G"
+            body = json.dumps({"variants": [
+                {"id": vid, "annotations": {"other_annotation": {"k": k}}},
+            ]}).encode()
+            try:
+                conn.request("POST", "/variants/upsert", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                ok = resp.status == 200
+                resp.read()
+            except OSError:
+                # a chaos kill ate the connection (and maybe the worker):
+                # nothing acknowledged, reconnect and continue
+                ok = False
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=5
+                )
+            if ok:
+                self.acked.append(vid)
+            else:
+                self.errors += 1
+            k += 1
+        conn.close()
+
+
+def verify_acked_upserts(host: str, port: int, acked: list,
+                         deadline_s: float = 25.0) -> tuple[int, float]:
+    """(missing, seconds) — bulk-read every acknowledged upsert id until
+    ALL answer or the window lapses.  Rows acked by one worker become
+    globally visible through that worker's memtable flush + the snapshot
+    TTL (the documented bounded-staleness model), so verification polls
+    rather than demanding instant cross-worker visibility."""
+    t0 = time.monotonic()
+    missing = len(acked)
+    while missing and time.monotonic() - t0 < deadline_s:
+        missing = 0
+        for lo in range(0, len(acked), 500):
+            chunk = acked[lo:lo + 500]
+            req = urllib.request.Request(
+                f"http://{host}:{port}/variants", method="POST",
+                data=json.dumps({"ids": chunk}).encode(),
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    found = json.loads(r.read())["found"]
+            except (OSError, ValueError):
+                missing = len(acked)
+                break
+            missing += len(chunk) - found
+        if missing:
+            time.sleep(1.0)
+    return missing, round(time.monotonic() - t0, 2)
+
+
 class Checker(threading.Thread):
     """Byte-verification side channel: low-rate point GETs of the sampled
     reference ids on FRESH connections; every 200 must match the
@@ -343,6 +435,13 @@ def run(args) -> tuple[dict, list[str]]:
         AVDB_SERVE_WEDGE_TIMEOUT_S="2",
         AVDB_SERVE_DEFAULT_DEADLINE_MS="2000",
     )
+    if not args.smoke:
+        # the live write path joins the full schedule: upserts + reads +
+        # a real compaction run concurrently.  A short flush age makes
+        # the three-writer story real DURING the soak (memtable flush vs
+        # compact vs the scripted loader commit).
+        env["AVDB_SERVE_UPSERTS"] = "1"
+        env["AVDB_MEMTABLE_FLUSH_S"] = "6"
     env.pop("AVDB_FAULT", None)  # the schedule arms at runtime, not spawn
     proc = subprocess.Popen(
         [sys.executable, "-m", "annotatedvdb_tpu", "serve",
@@ -395,6 +494,14 @@ def run(args) -> tuple[dict, list[str]]:
                 time.sleep(delay)
 
         compact_result = None
+        upserts = None
+        if not args.smoke:
+            # durable writes run from t=8 to t=20: across the device-EIO
+            # burst, the armed snapshot swap + real commit, the online
+            # compaction pass, and the worker SIGKILL
+            upserts = UpsertDriver(host, port, t_start,
+                                   start_rel=8.0, stop_rel=20.0)
+            upserts.start()
         if args.smoke:
             schedule_desc = ["serve.batch:prob:0.25:delay:15",
                              "engine.device_probe:prob:1.0:eio"]
@@ -409,6 +516,7 @@ def run(args) -> tuple[dict, list[str]]:
                 "engine.device_probe:prob:1.0:eio",
                 "snapshot.swap:1:raise (+ real commit)",
                 "doctor compact (online, against the live store)",
+                "upserts 8s-20s (WAL-durable writes through the fleet)",
                 "serve.accept:1:kill (worker SIGKILL)",
                 "serve.wedge:1:delay:30000 (watchdog SIGKILL)",
             ]
@@ -427,6 +535,13 @@ def run(args) -> tuple[dict, list[str]]:
             # generation swap it publishes, and any 5xx it caused would
             # land in the hard-error budget below
             compact_result = compact_live_store(store_dir)
+            if compact_result.get("status") == "aborted":
+                # a concurrent memtable flush (the upsert leg) or loader
+                # commit preempted the pass — a CLEAN, retry-safe abort
+                # by the cooperative-writer contract; one retry must land
+                log(f"online compact preempted "
+                    f"({compact_result.get('reason')}); retrying once")
+                compact_result = compact_live_store(store_dir)
             if compact_result.get("status") != "compacted":
                 violations.append(
                     f"online compact pass failed: {compact_result}"
@@ -445,6 +560,34 @@ def run(args) -> tuple[dict, list[str]]:
 
         load.join()
         last_fault_t = t_start + last_fault_rel
+
+        upsert_stats = None
+        if upserts is not None:
+            upserts.join(timeout=30)
+            missing, verify_s = verify_acked_upserts(
+                host, port, upserts.acked
+            )
+            upsert_stats = {
+                "acked": len(upserts.acked),
+                "errors": int(upserts.errors),
+                "missing": int(missing),
+                "verify_s": verify_s,
+            }
+            if missing:
+                violations.append(
+                    f"{missing} of {len(upserts.acked)} ACKNOWLEDGED "
+                    "upserts unreadable after the propagation window — "
+                    "acknowledged-write loss"
+                )
+            elif not upserts.acked:
+                violations.append(
+                    "upsert leg acknowledged nothing (the write path "
+                    "never engaged; the leg proves nothing)"
+                )
+            else:
+                log(f"upserts: {len(upserts.acked)} acked, 0 lost "
+                    f"(verified in {verify_s}s), "
+                    f"{upserts.errors} unacknowledged attempts")
 
         # -- recovery: bounded window after the last fault ------------------
         recovered = False
@@ -566,6 +709,8 @@ def run(args) -> tuple[dict, list[str]]:
             "recovery_window_s": recovery_window_s,
             "violations": violations,
         }
+        if upsert_stats is not None:
+            record["upserts"] = upsert_stats
         if compact_result is not None:
             record["compact"] = {
                 "status": str(compact_result.get("status")),

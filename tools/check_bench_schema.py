@@ -183,9 +183,66 @@ def _check_serving(sv, where: str, errors: list) -> None:
         _check_regions(sv["regions"], w, errors)
     if "open_loop" in sv:
         _check_open_loop(sv["open_loop"], w, errors)
+    if "mixed_workload" in sv and isinstance(sv["mixed_workload"], dict) \
+            and "error" not in sv["mixed_workload"]:
+        _check_mixed_workload(sv["mixed_workload"], w, errors)
     if "chaos" in sv and isinstance(sv["chaos"], dict) \
             and "error" not in sv["chaos"]:
         _check_chaos(sv["chaos"], w, errors)
+
+
+def _check_mixed_workload(mx: dict, where: str, errors: list) -> None:
+    """The live-write-path leg: open-loop point reads at a p99 SLO while
+    a writer sustains WAL-durable upserts, with every acknowledged
+    upsert read back afterwards (``acked_missing`` must be 0 — the zero
+    acknowledged-write-loss contract)."""
+    w = f"{where}.mixed_workload"
+    _check_fields(
+        mx,
+        {"read_qps_target": _is_num, "upserts_per_sec_target": _is_num,
+         "duration_s": _is_num, "slo_p99_ms": _is_num, "conns": _is_int,
+         "read_slo_met": lambda v: isinstance(v, bool),
+         "acked_verified": _is_int, "acked_missing": _is_int},
+        w, errors,
+        required=("read_qps_target", "upserts_per_sec_target",
+                  "read", "upserts", "acked_missing"),
+    )
+    if _is_int(mx.get("acked_missing")) and mx["acked_missing"] != 0:
+        errors.append(
+            f"{w}.acked_missing: {mx['acked_missing']} acknowledged "
+            "upsert(s) were lost — the ack contract is broken"
+        )
+    rd = mx.get("read")
+    if rd is not None:
+        if not isinstance(rd, dict):
+            errors.append(f"{w}.read: must be an object")
+        else:
+            _check_fields(
+                rd,
+                {"offered_qps": _is_num, "achieved_qps": _is_num,
+                 "p50_ms": _is_num, "p99_ms": _is_num, "errors": _is_int,
+                 "transport_errors": _is_int, "requests": _is_int,
+                 "seconds": _is_num},
+                f"{w}.read", errors,
+                required=("offered_qps", "achieved_qps", "p99_ms"),
+            )
+    up = mx.get("upserts")
+    if up is not None:
+        if not isinstance(up, dict):
+            errors.append(f"{w}.upserts: must be an object")
+        else:
+            _check_fields(
+                up,
+                {"acked": _is_int, "errors": _is_int,
+                 "achieved_per_sec": _is_num,
+                 "ack_p50_ms": _is_num, "ack_p99_ms": _is_num},
+                f"{w}.upserts", errors,
+                required=("acked", "achieved_per_sec", "ack_p99_ms"),
+            )
+            if _is_num(up.get("ack_p50_ms")) \
+                    and _is_num(up.get("ack_p99_ms")) \
+                    and up["ack_p99_ms"] < up["ack_p50_ms"]:
+                errors.append(f"{w}.upserts: ack_p99_ms below ack_p50_ms")
 
 
 def _check_chaos(ch: dict, where: str, errors: list) -> None:
@@ -231,6 +288,23 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
                  "bytes_reclaimed": _is_int, "seconds": _is_num},
                 f"{w}.compact", errors, required=("status",),
             )
+    if "upserts" in ch:
+        # the durable-writes-under-chaos leg (full schedule only):
+        # acknowledged upserts verified readable after propagation
+        if not isinstance(ch["upserts"], dict):
+            errors.append(f"{w}.upserts: must be an object")
+        else:
+            _check_fields(
+                ch["upserts"],
+                {"acked": _is_int, "errors": _is_int, "missing": _is_int,
+                 "verify_s": _is_num},
+                f"{w}.upserts", errors, required=("acked", "missing"),
+            )
+            if _is_int(ch["upserts"].get("missing")) \
+                    and ch["upserts"]["missing"] != 0:
+                errors.append(
+                    f"{w}.upserts.missing: acknowledged-write loss"
+                )
 
 
 def _check_compaction(cp: dict, where: str, errors: list) -> None:

@@ -19,7 +19,10 @@
 #                    doctor --repair the debris, complete the pass, and
 #                    byte-verify the store against the pre-compaction
 #                    reference
-#   6. chaos_soak --smoke — a 1-worker fleet under open-loop load with
+#   6. upsert_smoke — the WAL-durable live write path: upsert -> SIGKILL
+#                    the worker -> respawn replays the WAL -> byte-verify
+#                    -> memtable flush -> deep fsck clean
+#   7. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
 #
@@ -51,6 +54,9 @@ AVDB_LOCK_TRACE=1 python "$root/tools/serve_smoke.py" || rc=1
 
 echo "== compact smoke ==" >&2
 python "$root/tools/compact_smoke.py" || rc=1
+
+echo "== upsert smoke ==" >&2
+python "$root/tools/upsert_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1
