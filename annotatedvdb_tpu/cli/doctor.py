@@ -1,24 +1,31 @@
-"""CLI: store health — fsck/repair, online compaction, quarantine replay.
+"""CLI: store health — fsck/repair, online compaction, status, replay.
 
 ``doctor`` (default verb) audits a store directory against its manifest's
 write-time integrity records and the ledger, and repairs what is safely
 repairable (see ``annotatedvdb_tpu.store.fsck``); ``doctor compact`` merges
 a store's accumulated checkpoint segments into one columnar segment per
 chromosome, crash-safe and online (``annotatedvdb_tpu.store.compact`` —
-safe to run while a serve fleet reads the store); ``doctor replay-rejects``
+safe to run while a serve fleet reads the store); ``doctor status`` prints
+the one-screen store health report (per-group segment counts + read-amp vs
+the maintenance watermarks, WAL files pending replay, crash debris, disk
+free vs reserve, last ledger compact/flush records —
+``store.maintenance.store_status``); ``doctor replay-rejects``
 reconstructs a loadable input file from a quarantine rejects file
 (``utils.quarantine``) after the bad lines have been fixed.
 
 Usage:
     python -m annotatedvdb_tpu doctor --storeDir ./vdb [--deep] [--repair] [--json]
     python -m annotatedvdb_tpu doctor compact --storeDir ./vdb \
-        [--dry-run] [--maxBytes N] [--group 8 ...] [--json]
+        [--dry-run] [--maxBytes N] [--group 8 ...] [--retries N] [--json]
+    python -m annotatedvdb_tpu doctor status --storeDir ./vdb [--json]
     python -m annotatedvdb_tpu doctor replay-rejects \
         --rejects ./vdb/quarantine/x.vcf.rejects.jsonl --out fixed.vcf
 
 Exit codes (fsck verb): 0 = clean, 1 = warnings / repaired, 2 = errors.
 Exit codes (compact verb): 0 = compacted / nothing to do, 1 = pass
-aborted cleanly (preempted by a loader commit or SIGTERM), 2 = error.
+aborted cleanly (preempted by a loader commit or SIGTERM) even after
+``--retries``, 2 = error.
+Exit codes (status verb): 0 = report printed, 2 = not a readable store.
 """
 
 from __future__ import annotations
@@ -50,6 +57,62 @@ def _replay(argv) -> int:
     return 0
 
 
+def _status(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor status",
+        description="one-screen store health report: segment counts + "
+                    "read-amp vs the maintenance watermarks, WAL files "
+                    "pending replay, crash debris, disk free vs reserve, "
+                    "last ledger compact/flush records",
+    )
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    from annotatedvdb_tpu.store.maintenance import store_status
+
+    try:
+        report = store_status(args.storeDir)
+    except (OSError, ValueError) as err:
+        print(f"doctor status: {type(err).__name__}: {err} "
+              "(run `doctor --storeDir ...` for repair)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1))
+        return 0
+    wm = report["watermarks"]
+    ra = report["read_amp"]
+    print(f"store {report['store_dir']}: {report['rows']} row(s), "
+          f"{len(report['groups'])} chromosome group(s)", file=sys.stderr)
+    print(f"  read-amp: max {ra['max']} / mean {ra['mean']} segment "
+          f"file(s) per group (watermarks: high {wm['high']}, low "
+          f"{wm['low']}, compact floor {wm['min_segments']})",
+          file=sys.stderr)
+    for label, g in report["groups"].items():
+        over = "  << over high watermark" \
+            if label in wm["over_high"] else ""
+        rows = g["rows"] if g["rows"] is not None else "?"
+        print(f"    chr{label}: {g['segments']} segment file(s), "
+              f"{rows} row(s){over}", file=sys.stderr)
+    wal = report["wal"]
+    print(f"  wal: {wal['files']} file(s), "
+          f"{wal['records_pending_replay']} record(s) pending replay "
+          f"({wal['bytes']} bytes) — a serve worker restart replays them",
+          file=sys.stderr)
+    debris = {k: v for k, v in report["debris"].items() if v}
+    print(f"  debris: {debris if debris else 'none'}"
+          + (" — `doctor --repair` prunes it" if debris else ""),
+          file=sys.stderr)
+    disk = report["disk"]
+    state = "BREACHED (upserts shed 507)" if disk["breached"] else "ok"
+    print(f"  disk: {disk['free_bytes']} free vs "
+          f"{disk['reserve_bytes']} reserve — {state}", file=sys.stderr)
+    led = report["ledger"]
+    print(f"  ledger: {led['runs']} load run(s); last compact: "
+          f"{led['last_compact'] or 'never'}; last flush: "
+          f"{led['last_flush'] or 'never'}", file=sys.stderr)
+    return 0
+
+
 def _compact(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="doctor compact",
@@ -71,6 +134,10 @@ def _compact(argv) -> int:
     ap.add_argument("--chunkRows", type=int, default=None, metavar="N",
                     help="rows per streamed merge chunk (default "
                          "AVDB_COMPACT_CHUNK_ROWS or 262144)")
+    ap.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="re-run a CLEANLY-preempted pass up to N times "
+                         "with backoff (the shared preemption-retry "
+                         "policy; default 0 — hard failures never retry)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     from annotatedvdb_tpu.store.compact import (
@@ -127,11 +194,18 @@ def _compact(argv) -> int:
     # regression test) key on this line before signaling
     log(f"doctor compact: {args.storeDir}: pass starting "
         "(SIGTERM aborts cleanly)")
+    from annotatedvdb_tpu.utils.retry import retry_preempted
+
     try:
-        report = compact_store(
-            args.storeDir, groups=args.group, max_bytes=args.maxBytes,
-            chunk_rows=args.chunkRows, cancel=lambda: cancelled["flag"],
-            log=log,
+        report = retry_preempted(
+            lambda: compact_store(
+                args.storeDir, groups=args.group, max_bytes=args.maxBytes,
+                chunk_rows=args.chunkRows,
+                cancel=lambda: cancelled["flag"], log=log,
+            ),
+            retries=max(args.retries, 0),
+            cancel=lambda: cancelled["flag"],  # SIGTERM: never retried
+            log=log, what="doctor compact pass",
         )
     except (CompactionError, OSError, ValueError) as err:
         # hard failures (bad manifest, ENOSPC mid-merge, a source segment
@@ -159,6 +233,8 @@ def main(argv=None) -> int:
         return _replay(argv[1:])
     if argv and argv[0] == "compact":
         return _compact(argv[1:])
+    if argv and argv[0] == "status":
+        return _status(argv[1:])
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--storeDir", required=True)

@@ -59,6 +59,14 @@ def _build_parser() -> argparse.ArgumentParser:
                              "/variants/upsert with a per-worker "
                              "write-ahead log, replayed on start "
                              "(default: AVDB_SERVE_UPSERTS or off)")
+    parser.add_argument("--maintain", action="store_true",
+                        default=None,
+                        help="arm the autonomous maintenance daemon in "
+                             "the fleet supervisor: watermark-driven "
+                             "background compaction, load-aware and "
+                             "crash-safe (default: AVDB_MAINTAIN or off; "
+                             "aio front end only — implies fleet mode "
+                             "even with --workers 1)")
     parser.add_argument("--maxBatch", type=int, default=None,
                         help="max point queries per coalesced microbatch "
                              "(default: AVDB_SERVE_BATCH_MAX or 256)")
@@ -116,6 +124,17 @@ def _upserts_enabled(args) -> bool:
         return bool(args.upserts)
     return os.environ.get("AVDB_SERVE_UPSERTS", "").lower() \
         not in ("", "0", "false")
+
+
+def _maintain_enabled(args) -> bool:
+    """Flag wins over environment (``AVDB_MAINTAIN``) — the env spelling
+    lives once in ``store.maintenance``, per the knob-resolution
+    contract."""
+    if args.maintain is not None:
+        return bool(args.maintain)
+    from annotatedvdb_tpu.store.maintenance import maintain_enabled_from_env
+
+    return maintain_enabled_from_env()
 
 
 def _effective_workers(args) -> int:
@@ -191,11 +210,14 @@ def main(argv=None):
             print(f"serve: {', '.join(dead)} only apply to the aio front "
                   "end and are ignored with --frontend threaded",
                   file=sys.stderr)
-    if args._workerIndex is None and workers > 1:
+    maintain = args._workerIndex is None and _maintain_enabled(args)
+    if args._workerIndex is None and (workers > 1 or maintain):
         if args.frontend == "threaded":
             # the threaded server binds its own port and cannot join a
-            # shared-socket fleet — refusing beats a worker crash loop
-            print("serve: --workers > 1 requires the aio front end "
+            # shared-socket fleet (and writes no heartbeat health for
+            # the maintenance daemon) — refusing beats a crash loop
+            what = "--workers > 1" if workers > 1 else "--maintain"
+            print(f"serve: {what} requires the aio front end "
                   "(--frontend threaded is single-process only)",
                   file=sys.stderr)
             return 2
@@ -206,10 +228,13 @@ def main(argv=None):
         from annotatedvdb_tpu.serve.fleet import ServeFleet
 
         try:
+            # --maintain hosts the maintenance daemon in the supervisor,
+            # so it forces fleet mode even at --workers 1 (the daemon
+            # must outlive any single worker's death/respawn)
             fleet = ServeFleet(
                 args.storeDir, host=args.host, port=args.port,
                 workers=workers, worker_args=_knob_args(args, workers),
-                log=log,
+                log=log, maintain=maintain,
                 reuseport=False if args._forceHandoff else None,
             )
         except (OSError, ValueError) as err:

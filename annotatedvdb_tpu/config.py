@@ -90,6 +90,30 @@ ENV_VARS: dict = {
                              "which the memtable flushes regardless of "
                              "size (default 30; 0 disables the age "
                              "trigger)",
+    "AVDB_MAINTAIN": "1 arms the autonomous maintenance daemon in the "
+                     "serve fleet supervisor (watermark-driven background "
+                     "compaction; the --maintain flag is the CLI "
+                     "spelling)",
+    "AVDB_MAINTAIN_SEGMENTS_HIGH": "per-group segment-file count at which "
+                                   "the maintenance daemon engages a "
+                                   "compaction pass (default 8)",
+    "AVDB_MAINTAIN_SEGMENTS_LOW": "hysteresis exit: the daemon disengages "
+                                  "once every group is at/below this many "
+                                  "segment files (default 2; clamped "
+                                  "below the high watermark)",
+    "AVDB_MAINTAIN_TICK_S": "maintenance daemon poll cadence in seconds, "
+                            "jittered +/-25% (default 2)",
+    "AVDB_MAINTAIN_COOLDOWN_S": "base cool-down after a paused/preempted/"
+                                "failed maintenance pass, doubling per "
+                                "consecutive setback up to 60s "
+                                "(default 5)",
+    "AVDB_STORE_DISK_RESERVE_BYTES": "free-disk reserve under the store "
+                                     "below which upserts answer 507 "
+                                     "Insufficient Storage on both front "
+                                     "ends (512m / 2g suffixes; unset/0 "
+                                     "disables) — reads, flushes of "
+                                     "acknowledged rows, and compaction "
+                                     "keep running",
     # query & serving (serve/)
     "AVDB_SERVE_BATCH_MAX": "max point queries coalesced into one device "
                             "microbatch (default 256)",

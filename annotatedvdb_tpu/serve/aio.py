@@ -92,6 +92,7 @@ from annotatedvdb_tpu.serve.http import (
     readyz_payload,
     stats_payload,
 )
+from annotatedvdb_tpu.serve.fleet import HB_SLOT
 from annotatedvdb_tpu.serve.resilience import DeadlineExceeded, DeviceBreaker
 from annotatedvdb_tpu.serve.snapshot import SnapshotManager
 from annotatedvdb_tpu.utils import faults
@@ -120,6 +121,7 @@ _STATUS = {
     501: b"HTTP/1.1 501 Not Implemented\r\n",
     503: b"HTTP/1.1 503 Service Unavailable\r\n",
     504: b"HTTP/1.1 504 Gateway Timeout\r\n",
+    507: b"HTTP/1.1 507 Insufficient Storage\r\n",
 }
 
 _CT_JSON = b"Content-Type: application/json\r\nContent-Length: "
@@ -732,11 +734,17 @@ class AioServer:
                 # struct.error on a mis-sized/mis-indexed slot file
                 # included: losing one beat is survivable, losing the
                 # TICK CHAIN gets a healthy worker watchdog-killed in a
-                # loop
+                # loop.  Beside the beat, the slot publishes this
+                # worker's health (brownout level, p99-exceedance EWMA,
+                # queue depth) so the supervisor's maintenance daemon can
+                # yield to live traffic without an HTTP poll.
                 with contextlib.suppress(OSError, ValueError, struct.error):
-                    struct.pack_into(
-                        "<d", self._hb_mm, self.heartbeat_index * 8,
-                        time.time(),
+                    gov = self.ctx.governor
+                    HB_SLOT.pack_into(
+                        self._hb_mm,
+                        self.heartbeat_index * HB_SLOT.size,
+                        time.time(), gov.exceedance, gov.level,
+                        self.ctx.batcher.depth(),
                     )
             with contextlib.suppress(Exception):
                 self.ctx.governor.maybe_step()

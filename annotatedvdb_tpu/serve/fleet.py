@@ -52,6 +52,14 @@ import sys
 import tempfile
 import time
 
+#: one heartbeat slot per worker in the shared mmap'd file:
+#: ``(beat_time, p99_exceedance_ewma, brownout_level, queue_depth)``.
+#: The beat (written from the worker's EVENT LOOP) is the watchdog's
+#: liveness signal; the other three fields are the worker-health feed
+#: the maintenance daemon reads so background compaction can yield to
+#: live traffic without a single HTTP poll (syscalls cost ~400µs here).
+HB_SLOT = struct.Struct("<ddii")
+
 
 def wedge_timeout_from_env() -> float:
     """``AVDB_SERVE_WEDGE_TIMEOUT_S`` (default 10; 0 disables the
@@ -94,7 +102,8 @@ class ServeFleet:
                  port: int = 0, workers: int = 2, worker_args=(),
                  log=None, restart_backoff_s: float = 0.5,
                  drain_s: float = 10.0, reuseport: bool | None = None,
-                 wedge_timeout_s: float | None = None):
+                 wedge_timeout_s: float | None = None,
+                 maintain: bool = False):
         self.store_dir = store_dir
         self.host = host
         self.workers = max(int(workers), 1)
@@ -102,23 +111,51 @@ class ServeFleet:
         self.log = log if log is not None else (lambda msg: None)
         self.restart_backoff_s = restart_backoff_s
         self.drain_s = drain_s
+        # a typo'd AVDB_STORE_DISK_RESERVE_BYTES would otherwise be
+        # discovered inside every spawned WORKER (ServeContext builds the
+        # guard) — a rapid-death respawn loop instead of a startup
+        # failure; validate it here, before anything spawns
+        from annotatedvdb_tpu.store.maintenance import disk_reserve_from_env
+
+        disk_reserve_from_env()
+        #: autonomous storage management: host a MaintenanceDaemon
+        #: (store/maintenance.py) beside the restart loop.  The watermark
+        #: knobs resolve NOW so a typo'd AVDB_MAINTAIN_* fails startup
+        #: (rc 1) instead of silently disabling autonomy mid-flight.
+        self.maintain = bool(maintain)
+        self._maintain_knobs = None
+        if self.maintain:
+            from annotatedvdb_tpu.store.maintenance import (
+                cooldown_from_env,
+                segments_high_from_env,
+                segments_low_from_env,
+                tick_from_env,
+            )
+
+            self._maintain_knobs = {
+                "high": segments_high_from_env(),
+                "low": segments_low_from_env(),
+                "tick_s": tick_from_env(),
+                "cooldown_s": cooldown_from_env(),
+            }
         # wedged-worker watchdog: workers heartbeat through a shared
-        # mmap'd slot file (8 bytes of time.time() per worker, written on
+        # mmap'd slot file (one HB_SLOT per worker: beat time written on
         # the worker's EVENT LOOP — a parked loop stops beating even when
-        # the process is alive); the supervisor SIGKILLs any live worker
-        # whose beat goes stale past the timeout and respawns it.  A slot
-        # still at 0.0 means the worker has not come up yet: startup
-        # (jax import + store load) is covered by the rapid-death logic,
-        # not the wedge timeout.
+        # the process is alive — plus the brownout/p99/queue health
+        # fields the maintenance daemon reads); the supervisor SIGKILLs
+        # any live worker whose beat goes stale past the timeout and
+        # respawns it.  A slot still at 0.0 means the worker has not come
+        # up yet: startup (jax import + store load) is covered by the
+        # rapid-death logic, not the wedge timeout.
         self.wedge_timeout_s = (
             wedge_timeout_from_env() if wedge_timeout_s is None
             else max(float(wedge_timeout_s), 0.0)
         )
         fd, self._hb_path = tempfile.mkstemp(prefix="avdb_serve_hb_")
-        os.write(fd, b"\x00" * (8 * self.workers))
+        os.write(fd, b"\x00" * (HB_SLOT.size * self.workers))
         os.close(fd)
         with open(self._hb_path, "r+b") as f:
-            self._hb_mm = mmap.mmap(f.fileno(), 8 * self.workers)
+            self._hb_mm = mmap.mmap(f.fileno(), HB_SLOT.size * self.workers)
         # reuseport=False forces the parent accept-handoff path (the
         # portability fallback) — how tests exercise it on Linux too
         self.reuseport = (
@@ -173,8 +210,10 @@ class ServeFleet:
 
     def _spawn(self, index: int, respawn: bool = False) -> None:
         # zero the slot: a stale beat from the previous incarnation must
-        # not get the replacement killed before it comes up
-        struct.pack_into("<d", self._hb_mm, index * 8, 0.0)
+        # not get the replacement killed before it comes up (and its
+        # stale health fields must not feed the maintenance daemon)
+        self._hb_mm[index * HB_SLOT.size:(index + 1) * HB_SLOT.size] = \
+            b"\x00" * HB_SLOT.size
         env = dict(os.environ)
         if respawn and env.get("AVDB_FAULT", "").startswith(
                 ("serve.", "wal.", "memtable.")):
@@ -196,6 +235,64 @@ class ServeFleet:
         self.log(f"worker {index}: pid {proc.pid} "
                  f"({'SO_REUSEPORT' if self.reuseport else 'shared fd'})")
 
+    def worker_health(self) -> dict:
+        """Aggregate health across LIVE, beating workers — the
+        maintenance daemon's load signal, read straight from the
+        heartbeat slots (no HTTP poll, no syscalls beyond memory reads).
+        Workers that are dead or have not ticked yet contribute nothing
+        (a fleet that is all-starting reads as calm: the daemon would
+        rather compact an idle store than wait on workers that do not
+        exist yet)."""
+        levels: list[int] = []
+        exceeds: list[float] = []
+        depth_max = 0
+        for i, proc in list(self._procs.items()):
+            if proc.poll() is not None:
+                continue
+            try:
+                beat, exceed, level, depth = HB_SLOT.unpack_from(
+                    self._hb_mm, i * HB_SLOT.size
+                )
+            except (struct.error, ValueError):
+                continue
+            if beat <= 0.0:
+                continue
+            levels.append(int(level))
+            exceeds.append(float(exceed))
+            depth_max = max(depth_max, int(depth))
+        return {
+            "workers": len(levels),
+            "brownout_max": max(levels, default=0),
+            "exceed_max": max(exceeds, default=0.0),
+            "queue_depth_max": depth_max,
+        }
+
+    def _start_maintenance(self):
+        """Arm the maintenance daemon (``--maintain``/``AVDB_MAINTAIN``).
+        A daemon that cannot START is logged and skipped — the fleet must
+        serve either way; knob errors were already caught at __init__."""
+        if not self.maintain:
+            return None
+        try:
+            from annotatedvdb_tpu.store.maintenance import MaintenanceDaemon
+
+            daemon = MaintenanceDaemon(
+                self.store_dir, health=self.worker_health,
+                log=self.log, **self._maintain_knobs,
+            )
+            daemon.start()
+            self.log(
+                f"maintain: daemon armed (high {daemon.high} / low "
+                f"{daemon.low} segment files per group, tick "
+                f"~{daemon.tick_s:g}s, cooldown {daemon.cooldown_s:g}s)"
+            )
+            return daemon
+        except Exception as err:
+            self.log(f"maintain: daemon failed to start "
+                     f"({type(err).__name__}: {err}); fleet serves "
+                     "without autonomous maintenance")
+            return None
+
     def run(self) -> int:
         """Spawn the fleet and supervise until SIGTERM/SIGINT; returns the
         exit code (0 on a clean drain)."""
@@ -204,9 +301,11 @@ class ServeFleet:
 
         old_term = signal.signal(signal.SIGTERM, _request_stop)
         old_int = signal.signal(signal.SIGINT, _request_stop)
+        daemon = None
         try:
             for i in range(self.workers):
                 self._spawn(i)
+            daemon = self._start_maintenance()
             self.log(
                 f"fleet: serving {self.store_dir} on "
                 f"http://{self.host}:{self.port} with {self.workers} "
@@ -248,9 +347,17 @@ class ServeFleet:
                         time.sleep(0.1)
                     if not self._stopping:
                         self._spawn(i, respawn=True)
+            if daemon is not None:
+                # stop maintenance BEFORE draining workers: an in-flight
+                # pass aborts cleanly between chunks (cancel observes
+                # stop), and no new pass may start under a dying fleet
+                daemon.stop()
+                daemon = None
             rc = self._drain()
             return 1 if failed else rc
         finally:
+            if daemon is not None:  # exception path
+                daemon.stop()
             signal.signal(signal.SIGTERM, old_term)
             signal.signal(signal.SIGINT, old_int)
             self._reserve.close()
@@ -273,7 +380,8 @@ class ServeFleet:
         for i, proc in self._procs.items():
             if proc.poll() is not None:
                 continue  # already dead: the restart loop handles it
-            beat = struct.unpack_from("<d", self._hb_mm, i * 8)[0]
+            beat = struct.unpack_from("<d", self._hb_mm,
+                                      i * HB_SLOT.size)[0]
             if beat <= 0.0:
                 continue
             stale = now - beat
@@ -282,7 +390,8 @@ class ServeFleet:
                     f"worker {i}: wedged (alive, no heartbeat for "
                     f"{stale:.1f}s > {self.wedge_timeout_s:.1f}s); killing"
                 )
-                struct.pack_into("<d", self._hb_mm, i * 8, 0.0)
+                self._hb_mm[i * HB_SLOT.size:(i + 1) * HB_SLOT.size] = \
+                    b"\x00" * HB_SLOT.size
                 with contextlib.suppress(OSError):
                     proc.kill()
 

@@ -180,6 +180,16 @@ MSG_UPSERTS_DISABLED = (
     "upserts are not enabled on this server (start with --upserts or "
     "AVDB_SERVE_UPSERTS=1)"
 )
+#: the 507 Insufficient Storage body — ONE constant (the AVDB801 parity
+#: rule): free disk under the store fell below the configured reserve, so
+#: new writes are refused while everything that HOLDS or RECLAIMS space
+#: keeps running
+MSG_DISK_RESERVE = (
+    "insufficient storage: free disk space is below the configured "
+    "reserve (AVDB_STORE_DISK_RESERVE_BYTES); upserts are suspended "
+    "until space is freed — reads, flushes of acknowledged rows, and "
+    "compaction keep running"
+)
 
 #: the one grammar message for a malformed /variants/upsert body
 UPSERT_BODY_ERROR = (
@@ -311,6 +321,20 @@ class ServeContext:
             max_inflight if max_inflight is not None else batcher.max_queue
         )
         self.log = log if log is not None else (lambda msg: None)
+        #: disk-pressure degradation (``store/maintenance.py``): while
+        #: free disk under the store sits below
+        #: AVDB_STORE_DISK_RESERVE_BYTES, upserts answer 507 on both
+        #: front ends (the shared upsert_execute below is the one gate).
+        #: None when the server is read-only or the store has no
+        #: directory (in-memory test stores)
+        self.disk_guard = None
+        if memtable is not None \
+                and getattr(memtable, "store_dir", None):
+            from annotatedvdb_tpu.store.maintenance import DiskReserveGuard
+
+            self.disk_guard = DiskReserveGuard(
+                memtable.store_dir, log=self.log
+            )
         self._lock = make_lock("serve.ctx.inflight")
         #: guarded by self._lock
         self._inflight = 0
@@ -367,6 +391,11 @@ class ServeContext:
         self._m_upsert_ack = registry.histogram(
             "avdb_upsert_ack_seconds", QUERY_SECONDS_EDGES,
             "upsert latency from arrival to durable acknowledgement",
+        )
+        self._m_upsert_disk_shed = registry.counter(
+            "avdb_upsert_disk_shed_total",
+            "upserts answered 507 under the free-disk reserve guard "
+            "(AVDB_STORE_DISK_RESERVE_BYTES)",
         )
         # per-kind series resolved ONCE: the registry probe (lock + label
         # key assembly) is measurable at serving QPS, so the hot path
@@ -487,6 +516,15 @@ class ServeContext:
         memtable = self.memtable
         if memtable is None:
             return 403, json.dumps({"error": MSG_UPSERTS_DISABLED}), 0
+        if self.disk_guard is not None and self.disk_guard.breached():
+            # disk-pressure degradation ladder: WRITES shed first (507,
+            # both front ends byte-identical through this one gate);
+            # reads, flushes of already-acknowledged rows, and
+            # space-reclaiming compaction keep running.  Nothing durable
+            # happened, nothing was acknowledged — the client retries
+            # once space is freed.
+            self._m_upsert_disk_shed.inc()
+            return 507, json.dumps({"error": MSG_DISK_RESERVE}), 0
         t0 = time.perf_counter()
         try:
             entries = parse_upsert_body(body)
@@ -577,8 +615,24 @@ class ServeContext:
         return True
 
     def _flush_memtable(self, base_manager) -> None:
+        from annotatedvdb_tpu.utils import retry
+
         try:
-            self.memtable.flush(base_manager=base_manager)
+            # ENOSPC/EDQUOT (and classic transient-I/O blips) get a
+            # bounded backoff-retry on this flush thread: a transiently
+            # full disk degrades — the memtable keeps growing under the
+            # 507 write shed while compaction reclaims space — instead of
+            # wedging the flush path; a still-full disk after the retries
+            # lands in the except below, and the next trigger retries
+            # from scratch (acknowledged rows stay in memtable + WAL
+            # either way)
+            retry.with_backoff(
+                lambda: self.memtable.flush(base_manager=base_manager),
+                attempts=3, base_delay=0.5,
+                retryable=lambda exc: (retry.is_disk_full(exc)
+                                       or retry.is_transient_io(exc)),
+                log=self.log, what="memtable flush",
+            )
         except Exception as err:
             self.log(f"memtable flush failed ({type(err).__name__}: "
                      f"{err}); rows stay in the memtable")

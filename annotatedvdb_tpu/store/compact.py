@@ -567,8 +567,8 @@ def _merge_label_to_temp(store_dir: str, label: str, glist: list,
 
 
 def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
-                  chunk_rows: int | None = None, cancel=None,
-                  registry=None, log=None) -> dict:
+                  chunk_rows: int | None = None, min_stems: int | None = None,
+                  cancel=None, registry=None, log=None) -> dict:
     """One compaction pass.  Returns a report dict:
 
     ``{"status": "compacted" | "noop" | "aborted", "reason", "labels",
@@ -584,7 +584,8 @@ def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
     chunk = _chunk_rows() if chunk_rows is None else max(int(chunk_rows), 1024)
     met = _metrics(registry)
     t0 = time.perf_counter()
-    plan = plan_compaction(store_dir, groups=groups, max_bytes=max_bytes)
+    plan = plan_compaction(store_dir, groups=groups, max_bytes=max_bytes,
+                           min_stems=min_stems)
     if not plan["eligible"]:
         return {
             "status": "noop", "reason": "no eligible chromosome groups",

@@ -67,6 +67,34 @@ def is_wal_tmp(fname: str) -> bool:
     return fname.endswith(".wal.tmp")
 
 
+def count_records(path: str) -> int:
+    """Intact records in one WAL file (the torn tail excluded, exactly as
+    replay would see it) — the ``doctor status`` pending-replay surface.
+    Never raises: an unreadable/alien file counts as zero."""
+    n = 0
+    try:
+        with open(path, "rb") as f:
+            try:
+                head = json.loads(f.readline())
+                if not isinstance(head, dict) or head.get("wal") != 1:
+                    return 0
+            except ValueError:
+                return 0
+            while True:
+                raw = f.read(_FRAME.size)
+                if len(raw) < _FRAME.size:
+                    return n
+                length, crc = _FRAME.unpack(raw)
+                if length > MAX_RECORD_BYTES:
+                    return n
+                blob = f.read(length)
+                if len(blob) < length or zlib.crc32(blob) != crc:
+                    return n
+                n += 1
+    except OSError:
+        return n
+
+
 class WriteAheadLog:
     """Append/fsync/replay over the per-worker WAL file set.
 

@@ -116,6 +116,18 @@ arming any other name is a ``ValueError`` at parse time):
                             replace has not happened — the OLD manifest
                             keeps serving, the WAL still covers every
                             acknowledged row)
+``maintain.tick``           per maintenance-daemon tick
+                            (``store.maintenance``), before the watermark
+                            evaluation — a dying tick must be absorbed by
+                            the daemon (logged, backed off), never kill
+                            the hosting fleet supervisor
+``maintain.disk_guard``     per free-disk reading in the
+                            ``AVDB_STORE_DISK_RESERVE_BYTES`` guard —
+                            ``raise``/``eio`` model an unreadable
+                            statvfs, which the guard treats as a LOW-DISK
+                            reading (fail toward refusing writes): the
+                            lever tests use to flip upserts to 507
+                            without filling a real disk
 ======================== ====================================================
 
 **Process-death actions are subprocess-only.**  ``kill``/``torn_write``
@@ -168,6 +180,8 @@ POINTS = frozenset({
     "wal.fsync",
     "wal.replay",
     "memtable.flush",
+    "maintain.tick",
+    "maintain.disk_guard",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

@@ -26,6 +26,12 @@ TRANSIENT_ERRNOS = frozenset({
     errno.EIO, errno.EAGAIN, errno.EBUSY, errno.EINTR, errno.ESTALE,
 })
 
+#: errno values that mean the DISK is full (distinct from the transient
+#: set: a full disk is not a blip, but it is recoverable — space frees
+#: when the maintenance daemon compacts or an operator intervenes, so the
+#: memtable-flush path retries these with backoff instead of wedging)
+DISK_FULL_ERRNOS = frozenset({errno.ENOSPC, errno.EDQUOT})
+
 #: substrings of accelerator-runtime errors that indicate a transient
 #: transfer failure (grpc/XLA status names embedded in the message).
 #: RESOURCE_EXHAUSTED is deliberately ABSENT: on a device_put it means
@@ -42,6 +48,10 @@ stats = {"retries": 0, "gave_up": 0}
 
 def is_transient_io(exc: BaseException) -> bool:
     return isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS
+
+
+def is_disk_full(exc: BaseException) -> bool:
+    return isinstance(exc, OSError) and exc.errno in DISK_FULL_ERRNOS
 
 
 def is_transient_device(exc: BaseException) -> bool:
@@ -78,6 +88,44 @@ def with_backoff(fn, *, attempts: int = 3, base_delay: float = 0.05,
                     f"retrying in {delay:.2f}s"
                 )
             time.sleep(delay)
+
+
+def retry_preempted(run, *, retries: int = 1, base_delay: float = 0.2,
+                    max_delay: float = 5.0, cancel=None, log=None,
+                    what: str = "pass"):
+    """Run a cooperative store pass and retry it while it reports a CLEAN
+    preemption — the ONE definition of the preemption-retry policy shared
+    by the maintenance daemon, ``doctor compact --retries``, and the chaos
+    soak.
+
+    ``run()`` must return a report dict; a report whose ``status`` is
+    ``"aborted"`` means another writer preempted the pass under the
+    cooperative commit protocol (store untouched, retry-safe by contract),
+    so the pass is re-run after an exponential backoff, at most
+    ``retries`` more times.  Every other status — ``compacted``/``noop``/
+    ``flushed``/``error`` — and every exception returns/propagates
+    unchanged: hard failures must alert, not spin.
+
+    ``cancel`` is the CALLER's own abort flag (the same callable the pass
+    observes): an abort the caller itself requested — SIGTERM, daemon
+    stop, a hot-health yield — is not a preemption to retry, and
+    re-running would only delay the shutdown (or re-abort against the
+    same still-hot condition) behind backoff sleeps.
+    """
+    report = run()
+    attempt = 0
+    while (isinstance(report, dict) and report.get("status") == "aborted"
+           and attempt < retries
+           and not (cancel is not None and cancel())):
+        attempt += 1
+        delay = min(base_delay * (2 ** (attempt - 1)), max_delay)
+        if log is not None:
+            log(f"{what} preempted cleanly "
+                f"({report.get('reason', 'another writer committed')}); "
+                f"retry {attempt}/{retries} in {delay:.2f}s")
+        time.sleep(delay)
+        report = run()
+    return report
 
 
 def device_put(x, *, attempts: int = 3):

@@ -1089,6 +1089,105 @@ def bench_compaction(n_rows: int = 40_000) -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_autonomy(duration_s: float = 12.0) -> dict:
+    """The autonomy leg (``storage.autonomy``): a maintenance daemon
+    holds read amplification bounded while a checkpoint writer keeps
+    fragmenting the store — the watermark trips, daemon passes run
+    through the cooperative protocol (preemptions by the live writer are
+    expected and retried/backed off), and once the writer stops the
+    store converges to <= the LOW watermark with nobody invoking
+    ``doctor compact``.  Reports the daemon's pass/preemption/pause
+    counters (the ``avdb_maintain_*`` series) and the read-amp-over-time
+    envelope."""
+    import numpy as np
+
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.store.compact import segment_spans
+    from annotatedvdb_tpu.store.maintenance import MaintenanceDaemon
+    from annotatedvdb_tpu.store.variant_store import Segment
+
+    # high = low + 1: every over-low state trips the daemon, so the end
+    # state after the writer stops is ALWAYS <= low (a gap between the
+    # watermarks would leave amp parked in it — correct hysteresis, but
+    # not the convergence this leg certifies)
+    high, low = 3, 2
+    work = tempfile.mkdtemp(prefix="avdb_autonomy_")
+    store_dir = os.path.join(work, "store")
+    daemon = None
+    try:
+        def checkpoint(k: int, n: int = 1500) -> None:
+            """One loader-shaped checkpoint: fresh load (the live
+            manifest may have been compacted under us) -> append one
+            disjoint segment -> save."""
+            if os.path.exists(os.path.join(store_dir, "manifest.json")):
+                store = VariantStore.load(store_dir)
+            else:
+                store = VariantStore(width=8)
+            shard = store.shard(8)
+            cols = {
+                "pos": np.arange(1000 + 400_000 * k,
+                                 1000 + 400_000 * k + n, dtype=np.int32),
+                "h": np.arange(n, dtype=np.uint32) + 3,
+                "ref_len": np.full(n, 1, np.int32),
+                "alt_len": np.full(n, 1, np.int32),
+            }
+            shard.append_segment(Segment.build(
+                cols, np.full((n, 8), 65, np.uint8),
+                np.full((n, 8), 71, np.uint8),
+            ))
+            shard._starts_cache = None
+            store.save(store_dir)
+
+        checkpoint(0)
+        registry = MetricsRegistry()
+        daemon = MaintenanceDaemon(
+            store_dir, high=high, low=low, tick_s=0.2, cooldown_s=0.3,
+            registry=registry, log=lambda m: None,
+        )
+        daemon.start()
+        t0 = time.monotonic()
+        k = 1
+        peak = 1
+        amps = []
+        while time.monotonic() - t0 < duration_s:
+            checkpoint(k)
+            k += 1
+            amp = max(segment_spans(store_dir).values())
+            peak = max(peak, amp)
+            amps.append(int(amp))
+            time.sleep(0.7)
+        # the writer stops; the daemon must converge on its own
+        amp = peak
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            amp = max(segment_spans(store_dir).values())
+            if amp <= low:
+                break
+            time.sleep(0.2)
+        stats = daemon.stats()
+        bound = 2 * high  # transient ceiling: trip + in-flight writer +
+        # one preemption backoff must never stack past this
+        return {
+            "high": high, "low": low,
+            "segments_written": int(k),
+            "passes": int(stats["passes"]),
+            "preemptions": int(stats["preemptions"]),
+            "paused": int(stats["paused"]),
+            "read_amp_peak": int(peak),
+            "read_amp_bound": int(bound),
+            "read_amp_bounded": bool(peak <= bound),
+            "read_amp_end": int(amp),
+            "read_amp_samples": amps,
+            "converged": bool(amp <= low),
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+    finally:
+        if daemon is not None:
+            daemon.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_serve(n_rows: int = 50_000, clients: int = 16,
                 requests_per_client: int = 250, store=None):
     """Sustained concurrent-client serving bench (``serve/``): load a synth
@@ -1509,6 +1608,13 @@ def serve_only():
         compaction = bench_compaction()
     except Exception as exc:  # maintenance leg: record, never abort
         compaction = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    settle()
+    try:
+        storage = {"autonomy": bench_autonomy()}
+    except Exception as exc:  # autonomy leg: record, never abort
+        storage = {"autonomy": {
+            "error": f"{type(exc).__name__}: {exc}"[:300]
+        }}
     sustainable = serving["open_loop"]["max_sustainable_qps"]
     if sustainable > 0:
         metric, headline = "serve_open_loop_sustainable_qps", sustainable
@@ -1529,6 +1635,7 @@ def serve_only():
         "platform_pin": platform,
         "serving": serving,
         "compaction": compaction,
+        "storage": storage,
     }))
 
 
@@ -1618,6 +1725,12 @@ def main():
         compaction = bench_compaction()
     except Exception as exc:  # maintenance leg: record, never abort
         compaction = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        storage = {"autonomy": bench_autonomy()}
+    except Exception as exc:  # autonomy leg: record, never abort
+        storage = {"autonomy": {
+            "error": f"{type(exc).__name__}: {exc}"[:300]
+        }}
 
     print(
         json.dumps(
@@ -1645,6 +1758,7 @@ def main():
                 "multichip_virtual": multichip,
                 "serving": serving,
                 "compaction": compaction,
+                "storage": storage,
             }
         )
     )

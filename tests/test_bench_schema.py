@@ -121,6 +121,18 @@ GOOD = {
                         "seconds": 0.8},
             "upserts": {"acked": 360, "errors": 2, "missing": 0,
                         "verify_s": 3.1},
+            "maintain": {"high": 3, "low": 2, "passes": 20, "paused": 2,
+                         "preempted": 1, "read_amp_end": 1,
+                         "converged": True},
+        },
+    },
+    "storage": {
+        "autonomy": {
+            "high": 3, "low": 2, "segments_written": 12, "passes": 5,
+            "preemptions": 0, "paused": 0, "read_amp_peak": 3,
+            "read_amp_bound": 6, "read_amp_bounded": True,
+            "read_amp_end": 2, "read_amp_samples": [2, 3, 2, 3, 2],
+            "converged": True, "seconds": 8.4,
         },
     },
     "compaction": {
@@ -397,4 +409,58 @@ def test_chaos_upserts_subblock_is_validated():
 
     old = copy.deepcopy(GOOD)
     del old["serving"]["chaos"]["upserts"]
+    assert validate_record(old) == []
+
+
+def test_autonomy_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["storage"]["autonomy"]["converged"]
+    assert any("converged" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["storage"]["autonomy"]["converged"] = False
+    assert any("never converged" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["storage"]["autonomy"]["passes"] = 0
+    assert any("proves nothing" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["storage"]["autonomy"]["read_amp_end"] = 4  # above low=2
+    assert any("above the low watermark" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["storage"]["autonomy"]["read_amp_bounded"] = False
+    assert any("escaped" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["storage"]["autonomy"]["read_amp_samples"] = [2, "x"]
+    assert any("read_amp_samples" in e for e in validate_record(bad))
+
+    # a failed leg records its error without poisoning the file
+    failed = copy.deepcopy(GOOD)
+    failed["storage"]["autonomy"] = {"error": "OSError: boom"}
+    assert validate_record(failed) == []
+
+    # historic records (no storage block at all) keep validating
+    old = copy.deepcopy(GOOD)
+    del old["storage"]
+    assert validate_record(old) == []
+
+
+def test_chaos_maintain_subblock_is_validated():
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["maintain"]["converged"] = False
+    assert any("autonomy is broken" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["chaos"]["maintain"]["passes"]
+    assert any("passes" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["maintain"]["paused"] = "two"
+    assert any("paused" in e for e in validate_record(bad))
+
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["chaos"]["maintain"]
     assert validate_record(old) == []

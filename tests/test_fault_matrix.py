@@ -21,6 +21,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -1137,3 +1138,140 @@ def test_memtable_flush_sigkill_through_cli_recovers_to_post(tmp_path):
     assert run_fsck(store_dir, deep=True,
                     log=lambda m: None)["exit_code"] == 0
     assert VariantStore.load(store_dir).shard(3).n == 4
+
+
+# ---------------------------------------------------------------------------
+# maintain.tick / maintain.disk_guard — the autonomy layer's fault points
+# (store/maintenance.py).  Contract: a dying daemon tick is absorbed
+# (logged + backed off) and never propagates to the hosting fleet
+# supervisor; an injected low-disk reading flips upserts to 507 on both
+# front ends (through the ONE shared upsert_execute gate) and clears
+# cleanly on the next reading.
+
+
+@pytest.mark.parametrize("fault", [
+    "maintain.tick:1:raise",
+    "maintain.tick:1:eio",
+])
+def test_maintain_tick_fault_absorbed_next_tick_compacts(tmp_path, fault):
+    """A dying tick must never kill the daemon (and therefore never the
+    supervisor or the fleet hosting it): the fault is logged, the daemon
+    backs off, and the NEXT tick runs the watermark evaluation normally
+    — the fragmented store still gets compacted."""
+    from annotatedvdb_tpu.store.maintenance import MaintenanceDaemon
+
+    store_dir = str(tmp_path / "mstore")
+    _fragmented_store(store_dir)
+    pre = _store_signature(store_dir)
+    logs: list = []
+    daemon = MaintenanceDaemon(
+        store_dir, high=4, low=2, tick_s=0.05, cooldown_s=0.0,
+        log=logs.append,
+    )
+    faults.reset(fault)
+    assert daemon.tick() == "error"  # absorbed, not raised
+    assert any("tick failed" in m for m in logs), logs
+    # nth=1 consumed: the next tick trips the watermark and compacts
+    assert daemon.tick() == "pass"
+    assert max(daemon.read_amp().values()) == 1
+    assert _store_signature(store_dir) == pre
+    assert daemon.stats()["disabled"] is False
+
+
+def test_maintain_tick_fault_daemon_thread_survives(tmp_path):
+    """Same point through the REAL daemon thread (what the supervisor
+    hosts): with the fault armed the thread keeps ticking — it neither
+    dies nor wedges, which is exactly what keeps the fleet alive."""
+    from annotatedvdb_tpu.store.maintenance import MaintenanceDaemon
+
+    store_dir = str(tmp_path / "mstore2")
+    _fragmented_store(store_dir)
+    daemon = MaintenanceDaemon(
+        store_dir, high=4, low=2, tick_s=0.05, cooldown_s=0.0,
+        log=lambda m: None,
+    )
+    faults.reset("maintain.tick:1:raise")
+    daemon.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if daemon.stats()["passes"] >= 1:
+                break
+            time.sleep(0.05)
+        stats = daemon.stats()
+        assert daemon._thread.is_alive()
+        assert stats["passes"] >= 1, stats
+        assert stats["ticks"] >= 2, stats
+    finally:
+        daemon.stop()
+
+
+def test_maintain_disk_guard_fault_flips_507_both_front_ends_and_clears(
+        tmp_path):
+    """maintain.disk_guard (raise/eio): an injected free-space reading
+    failure IS a low-disk observation — the guard reports breached, the
+    shared upsert gate answers 507 with the single-source body on BOTH
+    front ends, nothing becomes durable, and the next (clean) reading
+    clears the degradation."""
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.serve.http import (
+        MSG_DISK_RESERVE,
+        build_server,
+    )
+    from annotatedvdb_tpu.serve.snapshot import (
+        MemtableSnapshots,
+        SnapshotManager,
+    )
+    from annotatedvdb_tpu.store.maintenance import DiskReserveGuard
+    from annotatedvdb_tpu.store.memtable import Memtable
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    store_dir = str(tmp_path / "dstore")
+    _fragmented_store(store_dir)
+
+    # guard level: injected failure = breached; clean reading = clear
+    guard = DiskReserveGuard(store_dir, reserve=1, ttl_s=0.0,
+                             log=lambda m: None)
+    faults.reset("maintain.disk_guard:1:eio")
+    breached, free = guard.state(force=True)
+    assert breached is True and free == -1
+    breached, free = guard.state(force=True)  # nth=1 consumed: clean now
+    assert breached is False and free > 0
+    faults.reset("")
+
+    # route level: the ONE shared gate (ServeContext.upsert_execute)
+    # renders the 507 for both front ends, so asserting it per-context
+    # IS the parity proof at the decision layer (the HTTP-level parity
+    # battery lives in tests/test_maintenance.py)
+    registry = MetricsRegistry()
+    mgr = SnapshotManager(store_dir, log=lambda m: None)
+    mem = Memtable(
+        width=8, store_dir=store_dir,
+        wal=WriteAheadLog(store_dir, "serve-dg", log=lambda m: None),
+        registry=registry, log=lambda m: None,
+    )
+    httpd = build_server(manager=MemtableSnapshots(mgr, mem), port=0,
+                        memtable=mem, registry=registry)
+    ctx = httpd.ctx
+    try:
+        ctx.disk_guard = DiskReserveGuard(store_dir, reserve=1,
+                                          ttl_s=0.0, log=lambda m: None)
+        body = json.dumps(
+            {"variants": [{"id": "6:999999:A:G"}]}
+        ).encode()
+        faults.reset("maintain.disk_guard:1:raise")
+        status, text, _rows = ctx.upsert_execute(body)
+        assert status == 507
+        assert json.loads(text)["error"] == MSG_DISK_RESERVE
+        assert mem.rows == 0  # nothing durable, nothing visible
+        # the degraded window clears on the next clean reading: the
+        # SAME request now acks durably
+        status, text, _rows = ctx.upsert_execute(body)
+        assert status == 200
+        assert json.loads(text)["accepted"] == 1
+        assert mem.rows == 1
+    finally:
+        faults.reset("")
+        httpd.server_close()
+        ctx.batcher.close()
+        mem.wal.close(remove_if_empty=True)

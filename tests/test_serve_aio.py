@@ -858,8 +858,10 @@ def test_heartbeat_mmap_preopened_at_worker_start(store, tmp_path):
     from annotatedvdb_tpu.serve.aio import build_aio_server
 
     store_dir, _truth = store
+    from annotatedvdb_tpu.serve.fleet import HB_SLOT
+
     hb = tmp_path / "hb"
-    hb.write_bytes(b"\x00" * 8)
+    hb.write_bytes(b"\x00" * HB_SLOT.size)
     server = build_aio_server(
         store_dir=store_dir, port=0, heartbeat_file=str(hb),
         heartbeat_index=0,

@@ -34,6 +34,17 @@ Modes:
   the whole schedule — injected latency, device EIO, snapshot-swap
   failure against a real commit, a worker SIGKILL, and a wedged loop the
   watchdog must catch.
+- ``--soak``   (>= 2 min): the LONG-AUTONOMY certification — the fleet
+  runs with the maintenance daemon armed (``AVDB_MAINTAIN``), upserts
+  sustain for most of the run so memtable flushes keep fragmenting the
+  store, and compaction is DAEMON-DRIVEN (this harness never invokes
+  ``doctor compact``): loads + upserts + auto-compaction + the full
+  kill/wedge/EIO chaos schedule run concurrently.  Beyond the base
+  contract the soak additionally asserts zero acknowledged-write loss,
+  >= 1 daemon compaction pass recorded in the ledger, >= 1
+  brownout-PAUSED pass observed (injected latency windows push workers
+  hot while the watermark is tripped), and read-amp back at/below the
+  low watermark at the end — the human is certified out of the loop.
 
 Exit codes: 0 contract held, 1 violated, 2 harness error.
 ``--json PATH`` (or ``-`` for stdout) emits the machine-readable record
@@ -412,14 +423,20 @@ def check_recovered(host: str, port: int, workers: int,
     return None
 
 
+#: the soak's maintenance watermarks: low enough that the upsert leg's
+#: memtable flushes re-trip the daemon several times per run
+MAINTAIN_HIGH, MAINTAIN_LOW = 3, 2
+
+
 def run(args) -> tuple[dict, list[str]]:
     work = tempfile.mkdtemp(prefix="avdb_chaos_")
     store_dir = os.path.join(work, "store")
-    mode = "smoke" if args.smoke else "full"
+    mode = "smoke" if args.smoke else ("soak" if args.soak else "full")
     workers = 1 if args.smoke else 2
-    duration_s = args.duration or (8.0 if args.smoke else 40.0)
-    qps = 250.0 if args.smoke else 600.0
-    conns = 4 if args.smoke else 8
+    duration_s = args.duration or {"smoke": 8.0, "full": 40.0,
+                                   "soak": 130.0}[mode]
+    qps = {"smoke": 250.0, "full": 600.0, "soak": 300.0}[mode]
+    conns = {"smoke": 4, "full": 8, "soak": 6}[mode]
     error_budget = 0.02 if args.smoke else 0.05
     transport_budget = 0.05 if args.smoke else 0.25
     p99_budget_ms = 1500.0 if args.smoke else 2500.0
@@ -442,6 +459,21 @@ def run(args) -> tuple[dict, list[str]]:
         # compact vs the scripted loader commit).
         env["AVDB_SERVE_UPSERTS"] = "1"
         env["AVDB_MEMTABLE_FLUSH_S"] = "6"
+    if args.soak:
+        # the long-autonomy leg: compaction is DAEMON-driven — tight
+        # flush age + low watermarks so the write stream re-trips the
+        # daemon several times, and a tight tick/cooldown so pauses and
+        # passes both happen inside the run.  The p99 target drops to
+        # 100ms so the scheduled latency windows genuinely push workers
+        # hot (brownout >= 1) while the watermark is tripped — the
+        # brownout-paused-pass observable.
+        env["AVDB_MEMTABLE_FLUSH_S"] = "3"
+        env["AVDB_MAINTAIN"] = "1"
+        env["AVDB_MAINTAIN_SEGMENTS_HIGH"] = str(MAINTAIN_HIGH)
+        env["AVDB_MAINTAIN_SEGMENTS_LOW"] = str(MAINTAIN_LOW)
+        env["AVDB_MAINTAIN_TICK_S"] = "0.5"
+        env["AVDB_MAINTAIN_COOLDOWN_S"] = "2"
+        env["AVDB_SERVE_BROWNOUT_P99_MS"] = "100"
     env.pop("AVDB_FAULT", None)  # the schedule arms at runtime, not spawn
     proc = subprocess.Popen(
         [sys.executable, "-m", "annotatedvdb_tpu", "serve",
@@ -493,14 +525,34 @@ def run(args) -> tuple[dict, list[str]]:
             if delay > 0:
                 time.sleep(delay)
 
+        def arm_retry(spec: str, ttl_s: float | None = None,
+                      attempts: int = 4) -> None:
+            """arm() with bounded retry: a soak arm can land while the
+            targeted worker is mid-respawn (kill/wedge phases) — a
+            transient refusal must not abort a 2-minute run."""
+            for attempt in range(1, attempts + 1):
+                try:
+                    arm(host, port, spec, ttl_s=ttl_s)
+                    return
+                except OSError as err:
+                    if attempt == attempts:
+                        raise
+                    log(f"arm {spec!r} refused ({err}); retrying")
+                    time.sleep(1.0)
+
         compact_result = None
         upserts = None
         if not args.smoke:
-            # durable writes run from t=8 to t=20: across the device-EIO
-            # burst, the armed snapshot swap + real commit, the online
-            # compaction pass, and the worker SIGKILL
-            upserts = UpsertDriver(host, port, t_start,
-                                   start_rel=8.0, stop_rel=20.0)
+            # durable writes run across the chaos: in full mode t=8-20
+            # (device EIO, armed swap + real commit, online compaction,
+            # worker SIGKILL); in the soak they sustain for almost the
+            # whole run so memtable flushes keep fragmenting the store
+            # the maintenance daemon must keep re-converging
+            upserts = UpsertDriver(
+                host, port, t_start,
+                start_rel=4.0 if args.soak else 8.0,
+                stop_rel=(duration_s - 15.0) if args.soak else 20.0,
+            )
             upserts.start()
         if args.smoke:
             schedule_desc = ["serve.batch:prob:0.25:delay:15",
@@ -510,6 +562,45 @@ def run(args) -> tuple[dict, list[str]]:
             at(4.5)
             arm(host, port, "engine.device_probe:prob:1.0:eio", ttl_s=2.0)
             last_fault_rel = 6.5
+        elif args.soak:
+            hot1, hot2 = 72.0, 92.0
+            schedule_desc = [
+                "serve.batch:prob:0.2:delay:20 (injected latency)",
+                "engine.device_probe:prob:1.0:eio",
+                "snapshot.swap:1:raise (+ real commit)",
+                "serve.accept:1:kill (worker SIGKILL)",
+                "serve.wedge:1:delay:30000 (watchdog SIGKILL)",
+                "serve.batch:prob:0.5:delay:150 x2 (brownout windows "
+                "over a tripped watermark: the daemon must PAUSE)",
+                f"maintenance daemon armed (high {MAINTAIN_HIGH} / low "
+                f"{MAINTAIN_LOW}) — compaction is daemon-driven, never "
+                "invoked by this harness",
+                f"upserts 4s-{duration_s - 15.0:.0f}s (WAL-durable "
+                "writes through the fleet)",
+            ]
+            at(2.0)
+            arm_retry("serve.batch:prob:0.2:delay:20", ttl_s=6.0)
+            at(20.0)
+            arm_retry("engine.device_probe:prob:1.0:eio", ttl_s=2.0)
+            at(30.0)
+            arm_retry("snapshot.swap:1:raise")
+            commit_new_generation(store_dir)
+            log("committed a new store generation under the armed swap")
+            at(45.0)
+            arm_retry("serve.accept:1:kill")
+            at(58.0)
+            arm_retry("serve.wedge:1:delay:30000")
+            # two sustained latency windows late in the write stream:
+            # the injected delay must EXCEED the 100ms p99 target or no
+            # request ever reads as over-target — workers go hot
+            # (brownout + exceedance) while the flush cadence keeps the
+            # watermark tripping, and the engaged daemon observes hot
+            # health and pauses
+            at(hot1)
+            arm_retry("serve.batch:prob:0.5:delay:150", ttl_s=12.0)
+            at(hot2)
+            arm_retry("serve.batch:prob:0.5:delay:150", ttl_s=12.0)
+            last_fault_rel = hot2 + 12.0
         else:
             schedule_desc = [
                 "serve.batch:prob:0.2:delay:20",
@@ -534,14 +625,17 @@ def run(args) -> tuple[dict, list[str]]:
             # the checker keeps proving zero wrong bytes across the
             # generation swap it publishes, and any 5xx it caused would
             # land in the hard-error budget below
-            compact_result = compact_live_store(store_dir)
-            if compact_result.get("status") == "aborted":
-                # a concurrent memtable flush (the upsert leg) or loader
-                # commit preempted the pass — a CLEAN, retry-safe abort
-                # by the cooperative-writer contract; one retry must land
-                log(f"online compact preempted "
-                    f"({compact_result.get('reason')}); retrying once")
-                compact_result = compact_live_store(store_dir)
+            # a concurrent memtable flush (the upsert leg) or loader
+            # commit may cleanly preempt the pass — retry-safe by the
+            # cooperative-writer contract; one retry must land (the
+            # SHARED preemption-retry policy, utils.retry.retry_preempted
+            # — the same one the daemon and doctor compact --retries use)
+            from annotatedvdb_tpu.utils.retry import retry_preempted
+
+            compact_result = retry_preempted(
+                lambda: compact_live_store(store_dir),
+                retries=1, log=log, what="online compact",
+            )
             if compact_result.get("status") != "compacted":
                 violations.append(
                     f"online compact pass failed: {compact_result}"
@@ -612,6 +706,59 @@ def run(args) -> tuple[dict, list[str]]:
             )
         else:
             log(f"recovered {recovered_s}s after the last fault")
+
+        # -- autonomy observables (soak mode) -------------------------------
+        maintain_stats = None
+        if args.soak:
+            from annotatedvdb_tpu.store.compact import segment_spans
+            from annotatedvdb_tpu.store.ledger import AlgorithmLedger
+
+            # the writers stopped 15s before the end: the daemon must
+            # walk read-amp back to <= the low watermark on its own
+            # (the fleet — and the daemon — are still running here)
+            amp = 0
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                amp = max(segment_spans(store_dir).values(), default=0)
+                if amp <= MAINTAIN_LOW:
+                    break
+                time.sleep(0.5)
+            converged = amp <= MAINTAIN_LOW
+            try:
+                passes = len(AlgorithmLedger(
+                    os.path.join(store_dir, "ledger.jsonl"),
+                    log=lambda m: None,
+                ).compactions())
+            except Exception:
+                passes = 0
+            joined = "".join(stderr_lines)
+            paused = joined.count("maintain: pass paused")
+            preempted = joined.count("maintain: pass preempted")
+            maintain_stats = {
+                "high": MAINTAIN_HIGH, "low": MAINTAIN_LOW,
+                "passes": int(passes), "paused": int(paused),
+                "preempted": int(preempted),
+                "read_amp_end": int(amp), "converged": bool(converged),
+            }
+            if passes < 1:
+                violations.append(
+                    "maintenance daemon committed no compaction pass — "
+                    "the autonomy leg proves nothing"
+                )
+            if paused < 1:
+                violations.append(
+                    "no brownout-paused compaction observed: the "
+                    "pause/resume contract was never exercised"
+                )
+            if not converged:
+                violations.append(
+                    f"read-amp {amp} did not return to <= the low "
+                    f"watermark {MAINTAIN_LOW} after the write stream "
+                    "ended"
+                )
+            log(f"maintain: {passes} daemon pass(es), {paused} paused, "
+                f"{preempted} preempted, read-amp end {amp} "
+                f"(converged={converged})")
 
         # -- aggregate + judge ----------------------------------------------
         status_counts: dict[str, int] = dict(checker.status_counts)
@@ -711,6 +858,8 @@ def run(args) -> tuple[dict, list[str]]:
         }
         if upsert_stats is not None:
             record["upserts"] = upsert_stats
+        if maintain_stats is not None:
+            record["maintain"] = maintain_stats
         if compact_result is not None:
             record["compact"] = {
                 "status": str(compact_result.get("status")),
@@ -741,13 +890,20 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="<=30s tier-1 smoke: 1 worker, 2 fault "
                              "points, no process kills")
+    parser.add_argument("--soak", action="store_true",
+                        help=">=2min long-autonomy soak: maintenance "
+                             "daemon armed, sustained upserts, "
+                             "daemon-driven compaction + the full chaos "
+                             "schedule concurrently")
     parser.add_argument("--duration", type=float, default=None,
                         help="load duration in seconds (default: 8 smoke, "
-                             "40 full)")
+                             "40 full, 130 soak)")
     parser.add_argument("--json", default=None, metavar="PATH",
                         help="write the chaos record as JSON to PATH "
                              "('-' = stdout)")
     args = parser.parse_args(argv)
+    if args.smoke and args.soak:
+        parser.error("--smoke and --soak are mutually exclusive")
     try:
         record, violations = run(args)
     except Exception as exc:

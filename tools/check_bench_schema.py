@@ -305,6 +305,28 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
                 errors.append(
                     f"{w}.upserts.missing: acknowledged-write loss"
                 )
+    if "maintain" in ch:
+        # the long-autonomy soak's daemon observables (--soak only):
+        # daemon-driven passes, >= 1 brownout pause, and convergence
+        # back to the low watermark are the certification
+        if not isinstance(ch["maintain"], dict):
+            errors.append(f"{w}.maintain: must be an object")
+        else:
+            mt = ch["maintain"]
+            _check_fields(
+                mt,
+                {"high": _is_int, "low": _is_int, "passes": _is_int,
+                 "paused": _is_int, "preempted": _is_int,
+                 "read_amp_end": _is_int,
+                 "converged": lambda v: isinstance(v, bool)},
+                f"{w}.maintain", errors,
+                required=("passes", "converged"),
+            )
+            if mt.get("converged") is False:
+                errors.append(
+                    f"{w}.maintain.converged: read-amp never returned "
+                    "below the low watermark — autonomy is broken"
+                )
 
 
 def _check_compaction(cp: dict, where: str, errors: list) -> None:
@@ -348,6 +370,64 @@ def _check_compaction(cp: dict, where: str, errors: list) -> None:
                 and _is_num(cp["serve"].get("p99_ms")) \
                 and cp["serve"]["p99_ms"] < cp["serve"]["p50_ms"]:
             errors.append(f"{w}.serve: p99_ms below p50_ms")
+
+
+def _check_autonomy(au: dict, where: str, errors: list) -> None:
+    """The storage.autonomy leg: a maintenance daemon holds read-amp
+    bounded against a live checkpoint writer and converges the store to
+    <= the low watermark once the writer stops — ``converged`` is
+    REQUIRED to be true (the acked_missing precedent: a record that
+    shows autonomy failing is a broken build, not a data point)."""
+    w = f"{where}.autonomy"
+    _check_fields(
+        au,
+        {
+            "high": _is_int, "low": _is_int,
+            "segments_written": _is_int, "passes": _is_int,
+            "preemptions": _is_int, "paused": _is_int,
+            "read_amp_peak": _is_int, "read_amp_bound": _is_int,
+            "read_amp_bounded": lambda v: isinstance(v, bool),
+            "read_amp_end": _is_int, "seconds": _is_num,
+            "read_amp_samples": lambda v: isinstance(v, list)
+            and all(_is_int(x) for x in v),
+            "converged": lambda v: isinstance(v, bool),
+        },
+        w, errors,
+        required=("high", "low", "passes", "read_amp_peak",
+                  "read_amp_end", "converged"),
+    )
+    if au.get("converged") is False:
+        errors.append(
+            f"{w}.converged: the daemon never converged read-amp back "
+            "below the low watermark"
+        )
+    if au.get("read_amp_bounded") is False:
+        errors.append(
+            f"{w}.read_amp_bounded: read amplification escaped its "
+            "declared transient ceiling"
+        )
+    if _is_int(au.get("passes")) and au["passes"] < 1:
+        errors.append(
+            f"{w}.passes: no daemon compaction pass ran — the leg "
+            "proves nothing"
+        )
+    if _is_int(au.get("read_amp_end")) and _is_int(au.get("low")) \
+            and au["read_amp_end"] > au["low"]:
+        errors.append(
+            f"{w}.read_amp_end: {au['read_amp_end']} above the low "
+            f"watermark {au['low']}"
+        )
+
+
+def _check_storage(st, where: str, errors: list) -> None:
+    """The storage-management block (``storage.autonomy``)."""
+    if not isinstance(st, dict):
+        errors.append(f"{where}: storage must be an object")
+        return
+    w = f"{where}.storage"
+    if "autonomy" in st and isinstance(st["autonomy"], dict) \
+            and "error" not in st["autonomy"]:
+        _check_autonomy(st["autonomy"], w, errors)
 
 
 def _check_regions(rg: dict, where: str, errors: list) -> None:
@@ -491,6 +571,8 @@ def validate_record(rec: dict, where: str = "record") -> list[str]:
     if "compaction" in rec and isinstance(rec["compaction"], dict) \
             and "error" not in rec["compaction"]:
         _check_compaction(rec["compaction"], where, errors)
+    if "storage" in rec:
+        _check_storage(rec["storage"], where, errors)
     return errors
 
 

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Autonomy smoke: the watermark-driven maintenance daemon end to end.
+
+Tier-1-gated via tools/run_checks.sh (~15s).  The whole loop, against a
+REAL fleet subprocess with the daemon armed:
+
+1. build a store fragmented to just BELOW the high watermark;
+2. start `serve --workers 1 --maintain --upserts` (fleet mode: the
+   daemon lives in the supervisor) and capture reference read bytes;
+3. sustain single-row upserts; short memtable flush age turns them into
+   new on-disk segments until the watermark trips;
+4. assert the daemon's compaction passes converge read-amp back to
+   <= the LOW watermark with ZERO manual `doctor compact` invocations
+   (the ledger's compact records are the daemon's), byte-identical
+   reference reads, and every acknowledged upsert readable.
+
+Exit: 0 contract held, 1 violated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+#: high = low + 1: any over-low state trips the daemon, so convergence
+#: to <= LOW after the writes stop is deterministic (a wider gap is
+#: legitimate hysteresis but would let the run end parked between the
+#: watermarks)
+HIGH, LOW = 3, 2
+
+
+def log(msg: str) -> None:
+    print(f"maintain_smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def build_store(store_dir: str, nseg: int = 3, n: int = 600):
+    """``nseg`` checkpoint segments of real-identity chr8 rows (the
+    daemon starts BELOW the high watermark; upsert flushes push it
+    over)."""
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    width = 8
+    store = VariantStore(width=width)
+    ids = []
+    for k in range(nseg):
+        refs = ["A", "C", "G", "T"] * (n // 4)
+        alts = ["G", "T", "A", "C"] * (n // 4)
+        ref, ref_len = encode_allele_array(refs, width)
+        alt, alt_len = encode_allele_array(alts, width)
+        h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+        pos = np.arange(1000 + 500_000 * k, 1000 + 500_000 * k + 61 * n,
+                        61, dtype=np.int32)[:n]
+        store.shard(8).append(
+            {"pos": pos, "h": h, "ref_len": ref_len, "alt_len": alt_len},
+            ref, alt,
+        )
+        store.save(store_dir)
+        ids.extend(f"8:{int(p)}:{r}:{a}"
+                   for p, r, a in zip(pos, refs, alts))
+    return ids
+
+
+def get(port: int, path: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def post_upsert(port: int, vid: str):
+    body = json.dumps({"variants": [{"id": vid}]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/variants/upsert", data=body,
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status
+    except (urllib.error.HTTPError, OSError):
+        return 0
+
+
+def main() -> int:
+    from annotatedvdb_tpu.store.compact import segment_spans
+
+    work = tempfile.mkdtemp(prefix="avdb_maintain_smoke_")
+    store_dir = os.path.join(work, "store")
+    proc = None
+    try:
+        log(f"building store ({HIGH - 1} segments, below high={HIGH})")
+        ids = build_store(store_dir, nseg=HIGH - 1)
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu", AVDB_JAX_PLATFORM="cpu",
+            AVDB_MAINTAIN_SEGMENTS_HIGH=str(HIGH),
+            AVDB_MAINTAIN_SEGMENTS_LOW=str(LOW),
+            AVDB_MAINTAIN_TICK_S="0.3",
+            AVDB_MAINTAIN_COOLDOWN_S="0.5",
+            AVDB_MEMTABLE_FLUSH_S="1.5",
+        )
+        env.pop("AVDB_FAULT", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+             "--storeDir", store_dir, "--port", "0",
+             "--workers", "1", "--maintain", "--upserts"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        stderr_lines: list[str] = []
+        threading.Thread(
+            target=lambda: stderr_lines.extend(proc.stderr),
+            name="maintain-smoke-stderr", daemon=True,
+        ).start()
+        line = proc.stdout.readline()
+        m = re.search(r"http://[\d.]+:(\d+)", line)
+        if not m:
+            log(f"FAIL: no fleet address line: {line!r}")
+            return 1
+        port = int(m.group(1))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if get(port, "/healthz", timeout=2.0)[0] == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        else:
+            log("FAIL: fleet never became healthy")
+            return 1
+        log(f"fleet up on :{port} (daemon armed)")
+
+        sample = ids[:: max(len(ids) // 8, 1)][:8]
+        reference = {}
+        for vid in sample:
+            status, body = get(port, f"/variant/{vid}")
+            if status != 200:
+                log(f"FAIL: reference GET {vid} -> {status}")
+                return 1
+            reference[vid] = body
+
+        # sustain upserts until a flush pushes the store over the high
+        # watermark (the daemon must trip on its own — no doctor compact)
+        acked = []
+        t0 = time.monotonic()
+        k = 0
+        tripped = False
+        while time.monotonic() - t0 < 12.0:
+            vid = f"8:{9_000_001 + 13 * k}:A:G"
+            if post_upsert(port, vid) == 200:
+                acked.append(vid)
+            k += 1
+            amp = max(segment_spans(store_dir).values())
+            if amp >= HIGH:
+                tripped = True
+                log(f"watermark tripped after {len(acked)} acked "
+                    f"upserts (read-amp {amp} >= {HIGH})")
+                break
+            time.sleep(0.05)
+        if not tripped:
+            log("FAIL: upsert flushes never pushed read-amp over the "
+                f"high watermark ({segment_spans(store_dir)})")
+            return 1
+
+        # the daemon must now converge read-amp to <= LOW on its own
+        deadline = time.monotonic() + 30
+        converged = False
+        while time.monotonic() < deadline:
+            amp = max(segment_spans(store_dir).values())
+            if amp <= LOW:
+                converged = True
+                break
+            time.sleep(0.25)
+        if not converged:
+            log(f"FAIL: read-amp never returned to <= {LOW} "
+                f"({segment_spans(store_dir)})")
+            return 1
+        log(f"auto-compaction converged (read-amp "
+            f"{max(segment_spans(store_dir).values())} <= {LOW})")
+
+        # daemon-driven: the ledger's compact records are the proof no
+        # human ran `doctor compact`
+        from annotatedvdb_tpu.store.ledger import AlgorithmLedger
+
+        ledger = AlgorithmLedger(os.path.join(store_dir, "ledger.jsonl"),
+                                 log=lambda m: None)
+        if not ledger.compactions():
+            log("FAIL: no compact record in the ledger (who converged "
+                "the store?)")
+            return 1
+
+        # byte-identical reads across the whole autonomous cycle
+        for vid, want in reference.items():
+            status, body = get(port, f"/variant/{vid}")
+            if status != 200 or body != want:
+                log(f"FAIL: {vid}: wrong bytes after auto-compaction")
+                return 1
+        # every acknowledged upsert still answers
+        missing = 0
+        for lo in range(0, len(acked), 200):
+            chunk = acked[lo:lo + 200]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/variants", method="POST",
+                data=json.dumps({"ids": chunk}).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                missing += len(chunk) - json.loads(r.read())["found"]
+        if missing:
+            log(f"FAIL: {missing}/{len(acked)} acknowledged upserts "
+                "unreadable")
+            return 1
+        joined = "".join(stderr_lines)
+        if "maintain: daemon armed" not in joined:
+            log("FAIL: supervisor never armed the daemon")
+            return 1
+        log(f"contract held: {len(acked)} acked upserts readable, "
+            f"{len(ledger.compactions())} daemon pass(es), reads "
+            "byte-identical")
+        return 0
+    finally:
+        if proc is not None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

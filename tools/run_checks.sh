@@ -22,7 +22,12 @@
 #   6. upsert_smoke — the WAL-durable live write path: upsert -> SIGKILL
 #                    the worker -> respawn replays the WAL -> byte-verify
 #                    -> memtable flush -> deep fsck clean
-#   7. chaos_soak --smoke — a 1-worker fleet under open-loop load with
+#   7. maintain_smoke — autonomous storage management: a fleet with the
+#                    maintenance daemon armed sustains upserts until the
+#                    segment watermark trips, and daemon-driven
+#                    compaction converges read-amp back below the low
+#                    watermark with byte-identical reads
+#   8. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
 #
@@ -57,6 +62,9 @@ python "$root/tools/compact_smoke.py" || rc=1
 
 echo "== upsert smoke ==" >&2
 python "$root/tools/upsert_smoke.py" || rc=1
+
+echo "== maintain smoke ==" >&2
+python "$root/tools/maintain_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1
