@@ -17,8 +17,10 @@ interval_spans_host"``).
 Codes:
 
 - **AVDB901** — a jitted function under ``ops/`` (wrap assignment
-  ``X_jit = jax.jit(f)`` or a ``@jax.jit``/``@partial(jax.jit, ...)``
-  decorated def, at module level) not registered in ``ops.TWINS``;
+  ``X_jit = jax.jit(f)``, ``X_mesh = mesh_pjit(f_jit, ...)`` — the
+  mesh-sharded kernel surface from ``parallel.mesh`` — or a
+  ``@jax.jit``/``@partial(jax.jit, ...)`` decorated def, at module
+  level) not registered in ``ops.TWINS``;
 - **AVDB902** — a ``TWINS`` entry that does not resolve: its kernel key
   names no discovered jitted function, or its twin value names no
   function defined in the scanned tree (a stale registry silently
@@ -51,7 +53,10 @@ HINT_902 = ("fix the dotted name (package-relative, e.g. "
 HINT_903 = ("add a parity test that drives the kernel and its twin "
             "together and compares the answers byte-for-byte")
 
-_JIT_NAMES = {"jit", "pjit"}
+#: jit spellings the kernel discovery recognizes; ``mesh_pjit`` is the
+#: project's sharded-kernel factory (``parallel.mesh``) — a mesh surface
+#: without a registered twin must be a finding exactly like a bare jit
+_JIT_NAMES = {"jit", "pjit", "mesh_pjit"}
 
 
 def _dotted(node: ast.AST) -> list | None:

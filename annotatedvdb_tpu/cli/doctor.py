@@ -93,6 +93,16 @@ def _status(argv) -> int:
         rows = g["rows"] if g["rows"] is not None else "?"
         print(f"    chr{label}: {g['segments']} segment file(s), "
               f"{rows} row(s){over}", file=sys.stderr)
+    mesh = report.get("mesh")
+    if mesh:
+        per_dev = ", ".join(
+            f"dev{d}: {n} group(s) ~{mesh['est_resident_bytes_per_device'].get(d, 0)}B"
+            for d, n in mesh["groups_per_device"].items()
+        )
+        budget = mesh["per_device_budget_bytes"]
+        print(f"  mesh: {mesh['devices']} device(s); {per_dev}"
+              + (f" vs {budget}B/device budget" if budget else ""),
+              file=sys.stderr)
     wal = report["wal"]
     print(f"  wal: {wal['files']} file(s), "
           f"{wal['records_pending_replay']} record(s) pending replay "

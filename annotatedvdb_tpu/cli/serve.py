@@ -261,11 +261,31 @@ def _run_single(args, log) -> int:
         budget = _resolve_budget(args)
         # None = unmanaged (the store's own ski-rental rule); an EXPLICIT
         # 0 is the managed degenerate case — nothing may be resident,
-        # which is the opposite of unmanaged on a memory-pressured device
-        residency = (
-            ResidencyManager(budget, registry=registry, log=log)
-            if budget is not None else None
-        )
+        # which is the opposite of unmanaged on a memory-pressured device.
+        # When MESH SERVING is on (the serve_mesh_on resolution — never
+        # the bare device count: a mesh-off server must keep the
+        # historical single-bucket plan) the worker's budget splits PER
+        # DEVICE and segments pin to their chromosome's placed device —
+        # the mesh twin of the fleet's per-worker split in _knob_args
+        residency = None
+        if budget is not None:
+            from annotatedvdb_tpu.serve.mesh_exec import serve_mesh_on
+
+            mesh = serve_mesh_on()
+            if mesh is not None:
+                from annotatedvdb_tpu.parallel.mesh import (
+                    chromosome_placement,
+                )
+
+                n_dev = int(mesh.devices.size)
+                residency = ResidencyManager(
+                    budget // n_dev, registry=registry, log=log,
+                    placement=chromosome_placement(n_dev),
+                    devices=list(mesh.devices.flat),
+                )
+            else:
+                residency = ResidencyManager(budget, registry=registry,
+                                             log=log)
         manager = SnapshotManager(
             args.storeDir, log=log,
             ttl_s=(args.snapshotTtlMs / 1000.0

@@ -56,6 +56,17 @@ ENV_VARS: dict = {
                            "packed output transport",
     "AVDB_LOAD_GC": "0 keeps the collector enabled during bulk loads "
                     "(default: gc paused, one collect per load)",
+    # device mesh (parallel/mesh.py is the single authority)
+    "AVDB_MESH_SHAPE": "device count of the global 1-D mesh (unset = all "
+                       "visible devices; a malformed value fails the "
+                       "entry point; also recorded as the manifest's "
+                       "advisory mesh_placement block at save time)",
+    "AVDB_SERVE_MESH": "serve-side mesh execution: auto (default — "
+                       "engages with >1 device on a non-CPU backend) | "
+                       "1 (force, e.g. the tier-1 virtual-CPU mesh "
+                       "tests) | 0 (disable)",
+    "AVDB_MESH_BULK_MIN": "smallest bulk-lookup batch that pays a mesh "
+                          "dispatch (default 64; 0 sends every batch)",
     # multi-host
     "AVDB_COORDINATOR": "host:port of the jax.distributed coordinator",
     "AVDB_NUM_PROCESSES": "world size for multi-host init",
@@ -225,15 +236,16 @@ class RuntimeConfig:
         # reference's worker model) and numpy batches stay addressable; the
         # global mesh is the device-resident/dryrun path, not the load path
         devices = jax.local_devices()
-        want = (
-            len(devices) if self.max_workers == "auto"
-            else min(int(self.max_workers), len(devices))
-        )
-        if want <= 1:
-            return None
-        from annotatedvdb_tpu.parallel import make_mesh
+        # resolution goes through the ONE mesh authority: AVDB_MESH_SHAPE
+        # bounds the fan-out (and a typo'd shape fails here, loudly),
+        # --maxWorkers clamps it further, single device returns None
+        from annotatedvdb_tpu.parallel.mesh import global_mesh
 
-        return make_mesh(want, devices=devices)
+        return global_mesh(
+            limit=None if self.max_workers == "auto"
+            else int(self.max_workers),
+            devices=devices,
+        )
 
 
 from annotatedvdb_tpu.types import DEFAULT_ALLELE_WIDTH

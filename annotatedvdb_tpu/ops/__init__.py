@@ -37,6 +37,15 @@ TWINS: dict = {
     "ops.hashing.allele_hash_jit": "ops.hashing.allele_hash_np",
     "ops.intervals.bits_spans_kernel_jit":
         "ops.intervals.interval_spans_host",
+    # mesh-sharded (pjit-with-sharded-inputs) kernel surfaces: same math,
+    # same numpy twins — the mesh only changes WHERE the rows compute
+    "ops.annotate.annotate_kernel_mesh": "ops.annotate.annotate_kernel_np",
+    "ops.hashing.allele_hash_mesh": "ops.hashing.allele_hash_np",
+    "ops.binindex.bin_index_kernel_mesh": "oracle.binindex.closed_form_bin",
+    "ops.dedup.mark_batch_duplicates_mesh":
+        "ops.dedup.mark_batch_duplicates_np",
+    "ops.intervals.bits_spans_stacked_jit":
+        "ops.intervals.bits_spans_stacked_host",
     "ops.pack.pack_outputs_jit": "ops.pack.pack_outputs_np",
     "ops.pack.inflate_alleles_jit": "ops.pack.inflate_alleles_np",
     "ops.pack.pack_vep_outputs_jit": "ops.pack.pack_vep_outputs_np",

@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from annotatedvdb_tpu.parallel.mesh import mesh_pjit
 from annotatedvdb_tpu.types import MAX_PK_SEQUENCE_LENGTH, VariantClass
 
 
@@ -136,6 +137,15 @@ def annotate_kernel(pos, ref, alt, ref_len, alt_len):
 
 
 annotate_kernel_jit = jax.jit(annotate_kernel)
+
+
+# the sharded-call surface (pjit with batch-dim-sharded inputs): pad rows
+# carry sentinel positions + 1-base lengths (the _pad_batch fill) and are
+# sliced away; on a single device this IS annotate_kernel_jit.  The
+# registered host twin stays annotate_kernel_np (ops.TWINS).
+annotate_kernel_mesh = mesh_pjit(
+    annotate_kernel_jit, ("sentinel", "zero", "zero", "one", "one")
+)
 
 
 def annotate_kernel_np(pos, ref, alt, ref_len, alt_len):

@@ -24,6 +24,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from annotatedvdb_tpu.parallel.mesh import mesh_pjit
+
 LEAF_SIZE = 15_625
 NUM_BIN_LEVELS = 13  # levels 1..13 below the whole-chromosome level 0
 
@@ -49,3 +51,10 @@ def bin_index_kernel(start, end):
 
 
 bin_index_kernel_jit = jax.jit(bin_index_kernel)
+
+
+# the sharded-call surface (pjit with batch-dim-sharded inputs) — the bin
+# stage of the sharded ingest pipeline; pure per-row integer arithmetic,
+# so sharding is trivially exact.  Host twin: the scalar oracle
+# (oracle.binindex.closed_form_bin).
+bin_index_kernel_mesh = mesh_pjit(bin_index_kernel_jit, ("one", "one"))

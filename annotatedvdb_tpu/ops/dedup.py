@@ -22,6 +22,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from annotatedvdb_tpu.parallel.mesh import mesh_pjit
+
 
 def mark_batch_duplicates(pos, h, ref, alt, ref_len, alt_len):
     """Flag rows that duplicate an earlier row in the batch.
@@ -180,6 +182,17 @@ mark_batch_duplicates_jit = jax.jit(mark_batch_duplicates)
 mark_batch_duplicates_multi_jit = jax.jit(mark_batch_duplicates_multi)
 lookup_in_sorted_jit = jax.jit(lookup_in_sorted)
 lookup_in_sorted_multi_jit = jax.jit(lookup_in_sorted_multi)
+
+# the sharded-call surface (pjit with batch-dim-sharded inputs) — the
+# in-batch dedup stage of the sharded ingest pipeline.  The identity sort
+# is global, so XLA inserts the cross-device collectives itself (jit
+# semantics are sharding-independent); pad rows carry unique NEGATIVE
+# positions (the insert step's salting trick), so they can never compare
+# equal to a real row or each other.  Host twin: mark_batch_duplicates_np.
+mark_batch_duplicates_mesh = mesh_pjit(
+    mark_batch_duplicates_jit,
+    ("neg_unique", "zero", "zero", "zero", "one", "one"),
+)
 
 
 # ---- numpy host twins (ops.TWINS registry; tests/test_twins.py) -------

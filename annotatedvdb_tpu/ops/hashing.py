@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from annotatedvdb_tpu.parallel.mesh import mesh_pjit
+
 # numpy scalars, NOT jnp: a module-level jnp constant initializes the JAX
 # backend at import time, before entry points can pin the platform (this
 # hung every CLI subprocess when the TPU tunnel was wedged)
@@ -43,6 +45,13 @@ def allele_hash(ref, alt, ref_len, alt_len):
 
 
 allele_hash_jit = jax.jit(allele_hash)
+
+
+# the sharded-call surface (pjit with batch-dim-sharded inputs); pad rows
+# hash to garbage that is sliced away.  Host twin: allele_hash_np.
+allele_hash_mesh = mesh_pjit(
+    allele_hash_jit, ("zero", "zero", "one", "one")
+)
 
 
 def allele_hash_np(ref, alt, ref_len, alt_len) -> np.ndarray:

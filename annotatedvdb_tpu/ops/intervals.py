@@ -85,12 +85,81 @@ def bits_spans_kernel(pos, starts, ends):
 bits_spans_kernel_jit = jax.jit(bits_spans_kernel)
 
 
+def bits_spans_stacked(pos, starts, ends):
+    """BITS spans + bin tokens for a STACK of chromosome groups — the
+    mesh-sharded panel kernel.
+
+    ``pos`` [B, R] — one sentinel-padded position row per group (empty
+    groups are all-sentinel rows); ``starts``/``ends`` [B, Q] — each
+    group's query intervals, zero-padded to the common Q.  Sharded over
+    axis 0 (``parallel.mesh.batch_sharding``) this answers EVERY group of
+    a region panel in ONE device call: each device searches only the
+    groups placed on it, and materializing the outputs is the cross-
+    device gather.  Row-for-row identical to :func:`bits_spans_kernel`
+    on the same (pos row, query row) — the stacking adds a vmap, never
+    arithmetic."""
+    pos = pos.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    ends = ends.astype(jnp.int32)
+    lo = jax.vmap(
+        lambda p, s: jnp.searchsorted(p, s, side="left")
+    )(pos, starts).astype(jnp.int32)
+    hi = jax.vmap(
+        lambda p, e: jnp.searchsorted(p, e, side="right")
+    )(pos, ends).astype(jnp.int32)
+    a = (starts - 1) // LEAF_SIZE
+    b = (ends - 1) // LEAF_SIZE
+    x = a ^ b
+    shifts = jnp.arange(NUM_BIN_LEVELS, dtype=jnp.int32)
+    mism = jnp.sum(
+        (x[:, :, None] >> shifts[None, None, :]) != 0, axis=2,
+        dtype=jnp.int32,
+    )
+    level = (NUM_BIN_LEVELS - mism).astype(jnp.int8)
+    return lo, hi, level, a
+
+
+bits_spans_stacked_jit = jax.jit(bits_spans_stacked)
+
+
+def bits_spans_stacked_host(pos, starts, ends):
+    """Numpy twin of :func:`bits_spans_stacked` — the registered host
+    fallback (``ops.TWINS``): the same per-row binary searches and bin
+    arithmetic over the same int32 values, byte-identical by
+    construction."""
+    pos = np.asarray(pos, np.int32)
+    starts = np.asarray(starts, np.int32)
+    ends = np.asarray(ends, np.int32)
+    lo = np.stack([
+        np.searchsorted(pos[i], starts[i], side="left").astype(np.int32)
+        for i in range(pos.shape[0])
+    ]) if pos.shape[0] else np.zeros(starts.shape, np.int32)
+    hi = np.stack([
+        np.searchsorted(pos[i], ends[i], side="right").astype(np.int32)
+        for i in range(pos.shape[0])
+    ]) if pos.shape[0] else np.zeros(ends.shape, np.int32)
+    a = (starts.astype(np.int64) - 1) // LEAF_SIZE
+    b = (ends.astype(np.int64) - 1) // LEAF_SIZE
+    x = a ^ b
+    shifts = np.arange(NUM_BIN_LEVELS, dtype=np.int64)
+    mism = ((x[:, :, None] >> shifts[None, None, :]) != 0).sum(axis=2)
+    level = (NUM_BIN_LEVELS - mism).astype(np.int8)
+    return lo, hi, level, a.astype(np.int32)
+
+
 def _clamped_queries(starts, ends):
     """int32 query bounds, clamped into the representable position range
     (both search paths clamp identically, so they stay byte-identical)."""
     starts = np.clip(np.asarray(starts, np.int64), 0, MAX_QUERY_POS)
     ends = np.clip(np.asarray(ends, np.int64), 0, MAX_QUERY_POS)
     return starts.astype(np.int32), ends.astype(np.int32)
+
+
+#: public spelling of the clamp every search path applies (the serve
+#: engine pre-clamps panel queries for the mesh path with it, so mesh and
+#: single-device spans stay byte-identical on absurd bounds)
+def clamped_queries(starts, ends):
+    return _clamped_queries(starts, ends)
 
 
 def interval_spans(pos, starts, ends, *, pos_padded: bool = False):

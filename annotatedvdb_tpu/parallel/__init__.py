@@ -1,8 +1,34 @@
-from .mesh import make_mesh, SHARD_AXIS
-from .distributed import distributed_annotate_step, reshard_by_owner
-from .multihost import init_multihost, multihost_env, process_info
+"""Device-mesh parallelism: the mesh authority, sharded steps, multi-host.
+
+Only :mod:`.mesh` loads eagerly — it is the leaf module the ``ops/``
+kernels import their ``mesh_pjit`` surface from, and an eager
+``.distributed`` import here would close the cycle
+``ops -> parallel -> distributed -> models.pipeline -> ops``.  The
+historical package-level names keep working through PEP 562 lazy
+resolution below.
+"""
+
+from .mesh import SHARD_AXIS, global_mesh, make_mesh, mesh_pjit
+
+_LAZY = {
+    "distributed_annotate_step": ".distributed",
+    "reshard_by_owner": ".distributed",
+    "init_multihost": ".multihost",
+    "multihost_env": ".multihost",
+    "process_info": ".multihost",
+}
 
 __all__ = [
-    "make_mesh", "SHARD_AXIS", "distributed_annotate_step",
+    "make_mesh", "mesh_pjit", "global_mesh", "SHARD_AXIS",
+    "distributed_annotate_step",
     "reshard_by_owner", "init_multihost", "multihost_env", "process_info",
 ]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)

@@ -373,6 +373,97 @@ def distributed_update_step(mesh, batch: VariantBatch, dev_store,
     )
 
 
+def distributed_serve_lookup_step(mesh, chrom, pos, hm, ref, alt,
+                                  ref_len, alt_len, dev_store,
+                                  capacity: int | None = None,
+                                  row_id=None):
+    """Sharded SERVE bulk lookup: chromosome re-shard + in-mesh store
+    membership, one mesh program — the serving read path's twin of
+    :func:`distributed_update_step`.
+
+    Differences that matter to serving byte-parity:
+
+    - the identity hash arrives **host-computed** (``hm``: the loaders'
+      ``identity_hashes`` full-string hash, chromosome-mixed) instead of
+      being re-derived in-trace from width-truncated bytes — so
+      long-allele queries resolve with EXACTLY the host ``Segment.probe``
+      semantics (full-string hash + truncated byte/length confirmation)
+      and no host re-check pass is needed;
+    - no counters ride the program (serving wants rows, and a psum per
+      bulk drain is a collective the hot path should not pay).
+
+    Returns ``(rid_out, found, store_row)``, each ``[n_shards *
+    capacity]`` in post-exchange order — materializing them IS the
+    cross-device gather.  Scatter back with ``rid_out`` (−1 = empty/pad
+    slot); ``store_row`` is the host-store global row id (−1 = miss),
+    directly renderable via ``serve.engine.render_variant``."""
+    n = chrom.shape[0]
+    n_shards = mesh.devices.size
+    if n % n_shards:
+        raise ValueError(
+            f"query batch {n} not divisible by {n_shards} shards — pad "
+            "with chrom-0 rows first"
+        )
+    if capacity is None:
+        host_owner = np.asarray(chromosome_owner_table(n_shards))[
+            np.clip(np.asarray(chrom, np.int32), 0, NUM_CHROMOSOMES)
+        ]
+        capacity = min(exact_capacity(host_owner, n_shards), n // n_shards)
+    if row_id is None:
+        row_id = np.arange(n, dtype=np.int32)
+    step = _serve_lookup_program(mesh, n_shards, capacity)
+    return step(
+        chrom, pos, hm, ref, alt, ref_len, alt_len, row_id,
+        *(dev_store[:7] + (dev_store.row_id,)),
+    )
+
+
+@lru_cache(maxsize=64)
+def _serve_lookup_program(mesh, n_shards: int, capacity: int):
+    """The shard_map program for :func:`distributed_serve_lookup_step`,
+    cached by (mesh, shape parameters) — same re-compile trap as the
+    other steps."""
+    from annotatedvdb_tpu.ops.dedup import lookup_in_sorted_multi
+
+    spec = P(SHARD_AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * 8 + (spec,) * 8,
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+    def step(chrom, pos, hm, ref, alt, ref_len, alt_len, rid, *store_cols):
+        owner = chromosome_owner(chrom, n_shards)
+        arrays = (chrom, pos, hm, ref, alt, ref_len, alt_len, rid)
+        (chrom, pos, hm, ref, alt, ref_len, alt_len, rid), valid, _dropped = (
+            reshard_by_owner(owner, arrays, n_shards, capacity)
+        )
+        (s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al, s_rid) = store_cols
+        s_chrom, s_pos, s_hm = s_chrom[0], s_pos[0], s_hm[0]
+        s_ref, s_alt, s_rl, s_al = s_ref[0], s_alt[0], s_rl[0], s_al[0]
+        s_rid = s_rid[0]
+        real = valid & (chrom > 0)
+        # pad/empty slots carry chrom 0 + zero identities: salt their
+        # position out of the sorted probe so they can never alias a row
+        slot = jnp.arange(pos.shape[0], dtype=jnp.int32)
+        pos_k = jnp.where(real, pos, -1 - slot)
+        found, idx = lookup_in_sorted_multi(
+            s_chrom, s_pos, s_hm, s_ref, s_alt, s_rl, s_al,
+            chrom, pos_k, hm, ref, alt, ref_len, alt_len,
+        )
+        found = found & real
+        store_row = jnp.where(
+            found, s_rid[jnp.clip(idx, 0, s_rid.shape[0] - 1)], -1
+        )
+        rid_out = jnp.where(real, rid, -1)
+        return rid_out, found, store_row
+
+    # see _annotate_step_program: un-jitted shard_map executes eagerly
+    return jax.jit(step)
+
+
 @lru_cache(maxsize=64)
 def _update_step_program(mesh, n_shards: int, capacity: int,
                          position_routing: bool = False):

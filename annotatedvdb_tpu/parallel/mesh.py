@@ -1,20 +1,45 @@
-"""Device-mesh construction.
+"""The device-mesh authority: one mesh, one axis name, one sharding rule.
 
 The reference's only parallelism is per-chromosome OS processes sharing a
 Postgres server (``Load/bin/load_vcf_file.py:307-313``).  Here the same
-decomposition is a 1-D device mesh: batches are sharded over the ``shard``
-axis, variants are routed to their owning chromosome shard with an
-``all_to_all`` (see ``distributed.py``), and counters aggregate with ``psum``
-— collectives ride ICI instead of the Postgres TCP wire (SURVEY.md §5.8).
-Multi-host later extends the same mesh over DCN via ``jax.distributed``.
+decomposition is a 1-D device mesh: loader batches shard over the
+``shard`` axis (batch-dim ``NamedSharding`` — every row-wise kernel in
+``ops/`` runs as one SPMD program across the mesh), serving store segments
+place per chromosome group onto their owning device, and collectives ride
+ICI instead of the Postgres TCP wire (SURVEY.md §5.8).  Multi-host later
+extends the same mesh over DCN via ``jax.distributed``.
+
+This module is the ONLY place mesh shape, axis names, sharding specs, and
+chromosome→device placement are decided:
+
+- :func:`global_mesh` — the process-wide mesh, auto-sized to
+  ``jax.devices()`` and bounded by ``AVDB_MESH_SHAPE`` (a device count; a
+  typo fails loudly — the compact spill-tier precedent: a mis-spelled
+  knob must never silently change the layout).  ``None`` means a single
+  device: every caller keeps its single-device path, so a laptop process
+  never pays mesh overhead.
+- :func:`batch_sharding` / :func:`replicated` — the two NamedShardings
+  the tree uses.  Batch-dim sharding splits axis 0 across the mesh;
+  everything else is replicated.
+- :func:`shard_rows` — commit host arrays onto the mesh batch-sharded
+  (callers pad axis 0 to a device multiple first: :func:`pad_rows`).
+- :func:`chromosome_placement` — the chromosome→device placement map for
+  resident store segments (variant-count-balanced greedy packing, the
+  same table the distributed loader steps route with — serving and
+  loading agree on who owns a chromosome).
+- :func:`placement_hint` — the advisory ``mesh_placement`` block the
+  store manifest records at save time (``doctor status`` reads it back).
 """
 
 from __future__ import annotations
 
+import os
+import threading
+
 import numpy as np
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shard"
 
@@ -35,3 +60,218 @@ def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS,
             )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def mesh_shape_from_env() -> int | None:
+    """``AVDB_MESH_SHAPE`` as a device count, or None when unset/empty.
+
+    The knob bounds how many of the visible devices the global mesh uses
+    (the 1-D shape; a 2-D mesh is a future axis, not a silent grammar).
+    A malformed value raises — a typo'd shape must fail the entry point,
+    never quietly fall back to a different device layout."""
+    spec = os.environ.get("AVDB_MESH_SHAPE", "").strip()
+    if not spec:
+        return None
+    try:
+        n = int(spec)
+    except ValueError:
+        raise ValueError(
+            f"AVDB_MESH_SHAPE must be a device count, not {spec!r}"
+        ) from None
+    if n < 1:
+        raise ValueError(f"AVDB_MESH_SHAPE must be >= 1, not {n}")
+    return n
+
+
+_LOCK = threading.Lock()
+#: (env shape, device-pool size) -> Mesh | None; the cache key makes a
+#: changed AVDB_MESH_SHAPE (tests) or a late backend init resolve fresh
+_GLOBAL: dict = {}
+
+
+def global_mesh(limit: int | None = None, devices=None):
+    """The process-wide 1-D mesh, or ``None`` when it resolves to a single
+    device (single-device code paths stay in charge).
+
+    Sizing: all of ``jax.devices()`` (or the caller's ``devices`` pool),
+    clamped by ``AVDB_MESH_SHAPE`` and the optional ``limit`` (the
+    loaders' ``--maxWorkers``).  The mesh is cached per (shape, pool) —
+    ``Mesh`` objects hash by device set, and every ``lru_cache``'d
+    program in ``parallel.distributed`` keys on the mesh, so handing out
+    one object keeps the compile caches warm."""
+    if devices is None:
+        devices = jax.devices()
+    want = len(devices)
+    env = mesh_shape_from_env()
+    if env is not None:
+        if env > len(devices):
+            raise ValueError(
+                f"AVDB_MESH_SHAPE={env} exceeds the {len(devices)} visible "
+                "devices"
+            )
+        want = min(want, env)
+    if limit is not None:
+        want = min(want, max(int(limit), 1))
+    if want <= 1:
+        return None
+    key = (env, want, tuple(id(d) for d in devices[:want]))
+    with _LOCK:
+        mesh = _GLOBAL.get(key)
+        if mesh is None:
+            mesh = _GLOBAL[key] = make_mesh(want, devices=devices)
+        return mesh
+
+
+def reset_global_mesh() -> None:
+    """Drop the cached mesh resolutions (tests that monkeypatch
+    ``AVDB_MESH_SHAPE`` between cases)."""
+    with _LOCK:
+        _GLOBAL.clear()
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Axis-0 (batch/row dim) sharding over the mesh — THE input layout of
+    every mesh-compiled row-wise kernel."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated layout (small operands every device needs whole)."""
+    return NamedSharding(mesh, P())
+
+
+def pad_rows(n: int, mesh: Mesh) -> int:
+    """Smallest row count >= n divisible by the mesh size (batch-dim
+    sharding splits axis 0 evenly; callers pad with their kernel's pad
+    rows, e.g. ``loaders.vcf_loader._pad_batch``)."""
+    d = mesh.devices.size
+    return n + (-n) % d
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Commit host arrays onto the mesh batch-sharded (axis 0 must already
+    be a device multiple).  Returns the committed jax arrays, one per
+    input; a jitted kernel called on them compiles as one SPMD program —
+    the ``pjit``-with-sharded-inputs pattern (SNIPPETS.md [1][2][3])."""
+    sharding = batch_sharding(mesh)
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if a.shape[0] % mesh.devices.size:
+            raise ValueError(
+                f"axis 0 of shape {a.shape} not divisible by the "
+                f"{mesh.devices.size}-device mesh — pad_rows() first"
+            )
+        out.append(jax.device_put(a, sharding))
+    return tuple(out) if len(out) != 1 else out[0]
+
+
+def _pad_arg(a: np.ndarray, spec: str, pad: int) -> np.ndarray:
+    """One argument's pad rows for :func:`mesh_pjit`.  2-D (allele byte)
+    arrays always pad with zero rows; 1-D specs: ``sentinel`` (position
+    columns — sorts last, never matches), ``one`` (length columns — a
+    legal 1-base allele), ``neg_unique`` (identity-sort keys that must
+    never compare equal to anything, the insert step's salting trick),
+    ``zero`` (everything else)."""
+    a = np.asarray(a)
+    if a.ndim == 2:
+        tail = np.zeros((pad, a.shape[1]), a.dtype)
+    elif spec == "sentinel":
+        from annotatedvdb_tpu.utils.arrays import POS_SENTINEL
+
+        tail = np.full(pad, POS_SENTINEL, a.dtype)
+    elif spec == "one":
+        tail = np.ones(pad, a.dtype)
+    elif spec == "neg_unique":
+        tail = (-1 - np.arange(pad)).astype(a.dtype)
+    else:
+        tail = np.zeros(pad, a.dtype)
+    return np.concatenate([a, tail])
+
+
+def mesh_pjit(kernel_jit, pads: tuple):
+    """The sharded-call surface of a jitted row-wise kernel: pad axis 0
+    to a device multiple (``pads`` names each argument's fill — see
+    :func:`_pad_arg`), commit the inputs batch-sharded, run the SAME
+    jitted program (jit IS pjit: committed sharded arrays compile it
+    SPMD over the mesh), and slice the pad rows back off every output.
+
+    On a single device (``global_mesh()`` is None and no ``mesh`` is
+    passed) the wrapper IS the plain jitted kernel — zero overhead, same
+    bytes.  A ``X_mesh = mesh_pjit(X_jit, ...)`` assignment in ``ops/``
+    is a registered kernel surface: the static analyzer discovers it
+    exactly like a ``jax.jit`` wrap assignment (AVDB901 — a sharded
+    kernel without a ``TWINS`` host twin is a finding)."""
+    def call(*args, mesh=None):
+        if mesh is None:
+            mesh = global_mesh()
+        if mesh is None:
+            return kernel_jit(*args)
+        n = int(np.asarray(args[0]).shape[0])
+        m = pad_rows(n, mesh)
+        if m != n:
+            args = tuple(
+                _pad_arg(a, spec, m - n) for a, spec in zip(args, pads)
+            )
+        sharded = shard_rows(mesh, *args)
+        if len(args) == 1:
+            sharded = (sharded,)
+        out = kernel_jit(*sharded)
+        return jax.tree.map(lambda v: v[:n], out)
+
+    call.__name__ = f"{getattr(kernel_jit, '__name__', 'kernel')}_mesh"
+    call.__qualname__ = call.__name__
+    return call
+
+
+# -- chromosome -> device placement -----------------------------------------
+
+
+def chromosome_placement(n_devices: int, build: str = "GRCh38") -> dict:
+    """Chromosome code -> device index for resident store segments.
+
+    The variant-count-balanced greedy packing the distributed loader steps
+    already route with (``parallel.distributed.chromosome_owner_table``) —
+    serving placement and loader routing MUST agree, or a served store's
+    resident slices would sit on different devices than the mesh programs
+    search."""
+    from annotatedvdb_tpu.parallel.distributed import chromosome_owner_table
+    from annotatedvdb_tpu.types import NUM_CHROMOSOMES
+
+    table = chromosome_owner_table(n_devices, build)
+    return {code: int(table[code]) for code in range(1, NUM_CHROMOSOMES + 1)}
+
+
+def placement_hint(n_devices: int | None = None) -> dict | None:
+    """The advisory ``mesh_placement`` manifest block: the placement map a
+    >1-device mesh would serve this store with (labels, not codes — the
+    manifest is a human-debuggable artifact).  ``None`` on a single-device
+    resolution: single-device stores carry no mesh metadata."""
+    from annotatedvdb_tpu.types import chromosome_label
+
+    if n_devices is None:
+        n_devices = mesh_shape_from_env()
+        if n_devices is None or n_devices <= 1:
+            return None
+    if n_devices <= 1:
+        return None
+    placement = chromosome_placement(n_devices)
+    return {
+        "devices": int(n_devices),
+        "groups": {
+            chromosome_label(code): dev for code, dev in placement.items()
+        },
+    }
+
+
+def groups_per_device(placement: dict, codes) -> dict:
+    """device index -> sorted chromosome codes placed on it (``doctor
+    status`` / ``/stats`` rendering), restricted to the ``codes`` actually
+    present in the store."""
+    out: dict = {}
+    for code in sorted(codes):
+        dev = placement.get(code)
+        if dev is None:
+            continue
+        out.setdefault(dev, []).append(code)
+    return out

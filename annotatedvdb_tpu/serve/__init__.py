@@ -34,6 +34,7 @@ from annotatedvdb_tpu.serve.engine import (
     parse_variant_id,
     render_variant,
 )
+from annotatedvdb_tpu.serve.mesh_exec import MeshExecutor, serve_mesh_executor
 from annotatedvdb_tpu.serve.residency import ResidencyManager
 from annotatedvdb_tpu.serve.resilience import (
     DeadlineExceeded,
@@ -50,7 +51,7 @@ from annotatedvdb_tpu.serve.snapshot import (
 
 __all__ = [
     "DeadlineExceeded", "DeviceBreaker", "IntervalIndex",
-    "MemtableSnapshots",
+    "MemtableSnapshots", "MeshExecutor", "serve_mesh_executor",
     "OverloadGovernor", "PointCache",
     "QueryBatcher", "QueueFull", "QueryEngine", "QueryError", "RegionPage",
     "RegionsResult", "ResidencyManager", "SnapshotManager",

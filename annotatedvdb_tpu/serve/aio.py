@@ -1651,10 +1651,19 @@ def build_aio_server(store_dir: str | None = None, manager=None,
             raise ValueError("build_aio_server needs store_dir or manager")
         manager = SnapshotManager(store_dir, log=log)
     registry = registry if registry is not None else MetricsRegistry()
+    from annotatedvdb_tpu.serve.mesh_exec import serve_mesh_executor
+
+    breaker = DeviceBreaker(registry=registry, log=log)
     engine = QueryEngine(
         manager, registry=registry, region_cache_size=region_cache_size,
-        residency=residency,
-        breaker=DeviceBreaker(registry=registry, log=log),
+        residency=residency, breaker=breaker,
+        # mesh state budget = the residency manager's per-device share
+        # (see build_server — the two builders must not drift)
+        mesh=serve_mesh_executor(
+            registry=registry, breaker=breaker, log=log,
+            budget_bytes=residency.budget if residency is not None
+            else None,
+        ),
     )
     batcher = LoopBatcher(
         engine, max_batch=max_batch, max_wait_s=max_wait_s,

@@ -560,11 +560,16 @@ class QueryEngine:
     def __init__(self, snapshots, registry=None,
                  region_cache_size: int | None = None, residency=None,
                  breaker=None, regions_max: int | None = None,
-                 regions_device_min: int | None = None):
+                 regions_device_min: int | None = None, mesh=None):
         from annotatedvdb_tpu.serve.batcher import resolve_regions_knobs
 
         self.snapshots = snapshots
         self.residency = residency
+        #: mesh executor (serve/mesh_exec.MeshExecutor) or None — when set,
+        #: bulk lookups and region panels collapse to ONE sharded call
+        #: each; every mesh miss/failure falls back to the single-device
+        #: paths below, whose answers are byte-identical (tests/test_mesh)
+        self.mesh = mesh
         self.regions_max, self.regions_device_min = resolve_regions_knobs(
             regions_max, regions_device_min
         )
@@ -639,6 +644,11 @@ class QueryEngine:
             self.residency.govern(snap)
         store = snap.store
         width = store.width
+        if self.mesh is not None and len(ids) >= self.mesh.bulk_min \
+                and self.mesh.would_dispatch(snap):
+            got = self._mesh_lookup_many(snap, parsed, out)
+            if got is not None:
+                return got
         by_code: dict[int, list] = {}
         for i, (code, _pos, _ref, _alt) in enumerate(parsed):
             by_code.setdefault(code, []).append(i)
@@ -704,6 +714,55 @@ class QueryEngine:
                                 host_only=True)
         if not obs.failed:
             breaker.record_success(code)
+        return out
+
+    def _mesh_lookup_many(self, snap, parsed, out):
+        """The mesh bulk path: every id of the batch — all chromosome
+        groups at once — resolves through ONE sharded call
+        (``serve.mesh_exec.MeshExecutor.bulk_lookup``), and hits render
+        through the exact same generation-keyed cache the single-device
+        path uses.  Returns None when the executor declines (off/tripped/
+        over budget/failed) — the caller runs the per-group loop, whose
+        answers are byte-identical."""
+        store = snap.store
+        width = store.width
+        refs = [p[2] for p in parsed]
+        alts = [p[3] for p in parsed]
+        ref, ref_len = encode_allele_array(refs, width)
+        alt, alt_len = encode_allele_array(alts, width)
+        n = len(parsed)
+        pos = np.fromiter((p[1] for p in parsed), np.int32, count=n)
+        chrom = np.fromiter((p[0] for p in parsed), np.int8, count=n)
+        h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+        got = self.mesh.bulk_lookup(
+            snap, chrom, pos, h, ref, alt, ref_len, alt_len
+        )
+        if got is None:
+            return None
+        found, gid = got
+        if self.residency is not None:
+            # mesh traffic must keep feeding the residency heat scores:
+            # the per-segment caches are what the single-device FALLBACK
+            # serves from, and a decayed-to-zero plan would evict them
+            # exactly when a tripped mesh needs them warm
+            qkey = combined_key(pos, h)
+            by_code: dict[int, list] = {}
+            for i, (code, _p, _r, _a) in enumerate(parsed):
+                by_code.setdefault(code, []).append(i)
+            for code, idxs in by_code.items():
+                shard = store.shards.get(code)
+                if shard is None:
+                    continue
+                k = qkey[idxs]
+                self.residency.touch_window(
+                    shard, k.min(), k.max(), len(idxs)
+                )
+        generation = snap.generation
+        for i, (code, _pos, _ref, _alt) in enumerate(parsed):
+            if found[i]:
+                out[i] = self._render_cached(
+                    store.shards[code], code, int(gid[i]), generation
+                )
         return out
 
     def _render_cached(self, shard, code: int, gid: int,
@@ -831,6 +890,23 @@ class QueryEngine:
         level = np.zeros(n, np.int64)
         leaf = np.zeros(n, np.int64)
         indexes: dict[int, IntervalIndex | None] = {}
+        mesh_spans = None
+        if self.mesh is not None and not host_only:
+            # ONE sharded stacked-BITS call for the whole panel (every
+            # touched group answered on the device that owns it); a None
+            # return or a missing code falls through to the per-group
+            # path below — byte-identical either way
+            mesh_spans = self.mesh.panel_spans(
+                snap,
+                {
+                    code: interval_ops.clamped_queries(
+                        [parsed[i][1] for i in idxs],
+                        [parsed[i][2] for i in idxs],
+                    )
+                    for code, idxs in by_code.items()
+                },
+                lambda code: self._interval_index(snap, code),
+            )
         for code, idxs in by_code.items():
             index = indexes[code] = self._interval_index(snap, code)
             if index is None:
@@ -839,12 +915,15 @@ class QueryEngine:
                     [parsed[i][2] for i in idxs],
                 )
                 continue
-            g_lo, g_hi, g_level, g_leaf = self._interval_spans(
-                index, code,
-                [parsed[i][1] for i in idxs],
-                [parsed[i][2] for i in idxs],
-                host_only,
-            )
+            if mesh_spans is not None and code in mesh_spans:
+                g_lo, g_hi, g_level, g_leaf = mesh_spans[code]
+            else:
+                g_lo, g_hi, g_level, g_leaf = self._interval_spans(
+                    index, code,
+                    [parsed[i][1] for i in idxs],
+                    [parsed[i][2] for i in idxs],
+                    host_only,
+                )
             lo[idxs], hi[idxs] = g_lo, g_hi
             level[idxs], leaf[idxs] = g_level, g_leaf
         no_filters = min_cadd is None and max_conseq_rank is None

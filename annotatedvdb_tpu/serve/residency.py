@@ -102,14 +102,17 @@ class _Entry:
     on a touch path would lazily materialize its full combined-key array
     under the manager lock."""
 
-    __slots__ = ("seg", "nbytes", "score", "resident", "key_min", "key_max")
+    __slots__ = ("seg", "nbytes", "score", "resident", "key_min", "key_max",
+                 "device")
 
-    def __init__(self, seg, nbytes: int):
+    def __init__(self, seg, nbytes: int, device: int | None = None):
         self.seg = seg
         self.nbytes = nbytes
         self.score = 0.0
         self.resident = False
         self.key_min, self.key_max = _key_bounds(seg)
+        #: placement device index (None = default device / no placement)
+        self.device = device
 
 
 class ResidencyManager:
@@ -128,10 +131,21 @@ class ResidencyManager:
                  log=None, upload: bool | None = None,
                  min_rows: int | None = None,
                  async_upload: bool | None = None,
-                 plan_interval_s: float | None = None):
+                 plan_interval_s: float | None = None,
+                 placement: dict | None = None, devices=None):
         if budget_bytes is None:
             budget_bytes = budget_from_env() or 0
         self.budget = max(int(budget_bytes), 0)
+        #: chromosome code -> device index (parallel.mesh
+        #: chromosome_placement).  With a placement installed the byte
+        #: budget is PER DEVICE — each device packs its own hottest
+        #: segments up to ``budget`` — and uploads pin to the placed
+        #: device instead of the default one.  None keeps the historical
+        #: single-device plan (one bucket, default device).
+        self.placement = placement
+        #: jax device objects indexed by placement value; resolved lazily
+        #: (tests with upload=True on any backend pass their own)
+        self._devices = devices
         self.log = log if log is not None else (lambda msg: None)
         self._upload = upload
         # uploads run on a dedicated worker thread by default: touch_window
@@ -203,12 +217,17 @@ class ResidencyManager:
         # callers must not serialize behind the per-segment bound and
         # byte-size computation
         entries: dict[int, _Entry] = {}
-        for shard in snap.store.shards.values():
+        for code, shard in snap.store.shards.items():
+            device = (
+                self.placement.get(code) if self.placement is not None
+                else None
+            )
             for seg in shard.segments:
                 seg.residency = "managed"
                 if seg.n >= self.min_rows:
                     entries[id(seg)] = _Entry(
-                        seg, device_cache_bytes(seg, shard.width)
+                        seg, device_cache_bytes(seg, shard.width),
+                        device=device,
                     )
         with self._lock:
             if (self._generation is not None
@@ -281,7 +300,10 @@ class ResidencyManager:
         # greedy hottest-first pack into the budget; residents rank with a
         # HYSTERESIS bonus so a near-tied challenger never thrashes the
         # upload path, and the packed set respects the budget by
-        # construction
+        # construction.  With a placement map the budget is PER DEVICE:
+        # each device's bucket packs independently (a cold device never
+        # donates its headroom to a hot one — the bytes live in different
+        # HBMs)
         ranked = sorted(
             entries,
             key=lambda e: (
@@ -289,12 +311,13 @@ class ResidencyManager:
             ),
         )
         want_ids = set()
-        used = 0
+        used: dict = {}
         for e in ranked:
-            if e.score <= 0.0 or e.nbytes > self.budget - used:
+            spent = used.get(e.device, 0)
+            if e.score <= 0.0 or e.nbytes > self.budget - spent:
                 continue
             want_ids.add(id(e))
-            used += e.nbytes
+            used[e.device] = spent + e.nbytes
         evict, upload = [], []
         for e in entries:
             if e.resident and id(e) not in want_ids:
@@ -336,6 +359,20 @@ class ResidencyManager:
         if self._m_resident is not None:
             self._m_resident.set(self.resident_bytes())
 
+    def _device_for(self, index: int | None):
+        """The jax device object a placement index names (None = default
+        device).  The pool resolves lazily and is cached — govern/touch
+        paths must never pay a backend query."""
+        if index is None:
+            return None
+        if self._devices is None:
+            import jax
+
+            self._devices = jax.devices()
+        if index >= len(self._devices):
+            return None  # placement wider than this process's pool
+        return self._devices[index]
+
     def _do_uploads(self, upload: list) -> None:
         for i, e in enumerate(upload):
             with self._lock:
@@ -344,7 +381,7 @@ class ResidencyManager:
             try:
                 # the retrying device_put path (utils.retry) rides
                 # inside _ensure_device_cache
-                e.seg._ensure_device_cache()
+                e.seg._ensure_device_cache(device=self._device_for(e.device))
                 with self._lock:
                     # a plan may have evicted e WHILE the transfer ran
                     # (its seg._device=None landed before the cache did);
@@ -379,7 +416,7 @@ class ResidencyManager:
         """Summary for ``/stats`` and tests."""
         with self._lock:
             entries = list(self._entries.values())
-            return {
+            out = {
                 "budget_bytes": self.budget,
                 "candidates": len(entries),
                 "resident": sum(1 for e in entries if e.resident),
@@ -388,3 +425,11 @@ class ResidencyManager:
                 ),
                 "generation": self._generation,
             }
+            if self.placement is not None:
+                per_device: dict = {}
+                for e in entries:
+                    if e.resident:
+                        key = str(e.device)
+                        per_device[key] = per_device.get(key, 0) + e.nbytes
+                out["per_device_bytes"] = per_device
+            return out

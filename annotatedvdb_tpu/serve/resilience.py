@@ -458,6 +458,22 @@ class DeviceBreaker:
             return True
         return False
 
+    def would_allow(self, code: int) -> bool:
+        """:meth:`allow_device`'s verdict WITHOUT consuming the half-open
+        trial slot or transitioning state — for pre-flight gates (the
+        mesh executor's ``would_dispatch``) that run BEFORE the real
+        admission check; calling ``allow_device`` twice per dispatch
+        would spend the single half-open trial on the pre-check and
+        refuse the dispatch itself, wedging recovery."""
+        now = self._clock()
+        with self._lock:
+            g = self._groups.get(code)
+            if g is None or g["state"] == "closed":
+                return True
+            if g["state"] == "open":
+                return now >= g["reopen_at"]
+            return False  # half_open: the one trial is already in flight
+
     def record_failure(self, code: int, exc: BaseException) -> None:
         now = self._clock()
         tripped = False

@@ -52,14 +52,18 @@ class StoreSnapshot:
 
     ``generation`` increments per swap (1-based); ``fingerprint`` is the
     manifest identity the generation was loaded from (None for in-memory
-    stores pinned by :class:`StaticSnapshots`)."""
+    stores pinned by :class:`StaticSnapshots`); ``placement`` is the
+    manifest's advisory chromosome->device map (``mesh_placement``, None
+    when the store was saved single-device) — the serve mesh path and
+    ``doctor status`` report it."""
 
-    __slots__ = ("store", "generation", "fingerprint")
+    __slots__ = ("store", "generation", "fingerprint", "placement")
 
     def __init__(self, store: VariantStore, generation: int, fingerprint):
         self.store = store
         self.generation = generation
         self.fingerprint = fingerprint
+        self.placement = getattr(store, "mesh_placement", None)
 
 
 def _manifest_fingerprint(store_dir: str) -> tuple:

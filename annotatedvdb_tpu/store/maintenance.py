@@ -646,6 +646,45 @@ def store_status(store_dir: str) -> dict:
             # an unreadable ledger is fsck's finding, not status's: the
             # report still carries everything the directory itself shows
             last_compact = last_flush = None
+    # mesh placement: devices + groups-per-device from the manifest's
+    # advisory block (written at save time under AVDB_MESH_SHAPE) or from
+    # the env itself.  Resident bytes are an ESTIMATE from row counts
+    # (rows x identity-cache bytes/row) — status must never touch a jax
+    # backend (a wedged accelerator tunnel would hang the report), so it
+    # reports what WOULD be resident per device against the per-device
+    # share of AVDB_SERVE_HBM_BUDGET.
+    placement = manifest.get("mesh_placement")
+    if not isinstance(placement, dict):
+        from annotatedvdb_tpu.parallel.mesh import placement_hint
+
+        placement = placement_hint()
+    mesh_block = None
+    if placement and placement.get("devices", 0) > 1:
+        n_dev = int(placement["devices"])
+        width = int(manifest.get("width", 0))
+        per_device_groups: dict = {}
+        per_device_bytes: dict = {}
+        for label, dev in (placement.get("groups") or {}).items():
+            if label not in groups:
+                continue
+            key = str(dev)
+            per_device_groups[key] = per_device_groups.get(key, 0) + 1
+            rows = groups[label]["rows"] or 0
+            per_device_bytes[key] = (
+                per_device_bytes.get(key, 0) + rows * (16 + 2 * width)
+            )
+        from annotatedvdb_tpu.utils.strings import parse_bytes
+
+        budget_spec = os.environ.get("AVDB_SERVE_HBM_BUDGET", "").strip()
+        budget = parse_bytes(budget_spec) if budget_spec else 0
+        mesh_block = {
+            "devices": n_dev,
+            "groups_per_device": dict(sorted(per_device_groups.items())),
+            "est_resident_bytes_per_device": dict(
+                sorted(per_device_bytes.items())
+            ),
+            "per_device_budget_bytes": budget // n_dev if budget else 0,
+        }
     return {
         "store_dir": store_dir,
         "rows": sum(
@@ -653,6 +692,7 @@ def store_status(store_dir: str) -> dict:
             if g["rows"] is not None
         ),
         "groups": groups,
+        "mesh": mesh_block,
         "read_amp": {
             "max": max(amps, default=0),
             "mean": round(sum(amps) / len(amps), 2) if amps else 0.0,

@@ -662,17 +662,19 @@ class Segment:
             found[sel] = True
         return found, index
 
-    def _ensure_device_cache(self) -> None:
+    def _ensure_device_cache(self, device=None) -> None:
         """Upload this segment's identity columns to HBM (once; pow2-padded
         so compile count stays O(log n) — the sentinel position sorts last
-        and can't match a real query)."""
+        and can't match a real query).  ``device`` pins the destination
+        (the residency manager's chromosome->device placement); None keeps
+        the default device — the historical single-device layout."""
         if self._device is not None:
             return
         from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
         from annotatedvdb_tpu.utils.retry import device_put
 
         self._device = tuple(
-            device_put(x) for x in (
+            device_put(x, device=device) for x in (
                 pad_pow2(self.cols["pos"], POS_SENTINEL),
                 pad_pow2(self.cols["h"], 0),
                 pad_pow2(self.ref, 0), pad_pow2(self.alt, 0),
@@ -1191,6 +1193,11 @@ class VariantStore:
         # torn or bit-rotted segment files; populated by _write_segment and
         # inherited from the manifest on load (clean segments keep theirs)
         self._integrity: dict[str, dict] = {}
+        #: advisory chromosome->device placement block read back from the
+        #: manifest (written by save() when a >1-device mesh is
+        #: configured; ``doctor status`` and the serve mesh path report
+        #: it) — None for single-device stores
+        self.mesh_placement: dict | None = None
         # identity of THIS store's on-disk lineage: save() only trusts
         # pre-existing segment files in a directory whose manifest carries
         # this uid — a same-stem file left by a DIFFERENT store must be
@@ -1444,6 +1451,17 @@ class VariantStore:
                 for label, groups in manifest["shards"].items()
             },
         }
+        # advisory mesh placement: which device each chromosome group
+        # would serve from under the configured AVDB_MESH_SHAPE (absent on
+        # single-device resolutions — the historical manifest byte-for-
+        # byte).  Deterministic on env + content only, never on jax state:
+        # save() must not initialize a backend.  Compaction and the flush
+        # writer copy the whole manifest dict, so the block survives both.
+        from annotatedvdb_tpu.parallel.mesh import placement_hint
+
+        placement = placement_hint()
+        if placement is not None:
+            manifest["mesh_placement"] = placement
         # atomic swap: a PROCESS crash mid-save must leave the previous
         # manifest intact (segments are also written via tmp+rename, so the
         # old manifest's files are never mutated in place) — the store is
@@ -1616,6 +1634,9 @@ class VariantStore:
             # their directory rewrites segments once, then records the uid.
             store._uid = uid
         store._integrity = dict(manifest.get("integrity") or {})
+        placement = manifest.get("mesh_placement")
+        if isinstance(placement, dict):
+            store.mesh_placement = placement
         verify = _verify_mode()
         from annotatedvdb_tpu.types import chromosome_code
 

@@ -128,6 +128,14 @@ arming any other name is a ``ValueError`` at parse time):
                             reading (fail toward refusing writes): the
                             lever tests use to flip upserts to 507
                             without filling a real disk
+``mesh.dispatch``           per sharded mesh call in ``serve.mesh_exec``
+                            (bulk lookup AND region-panel spans), after
+                            the inputs are prepared, before the program
+                            runs — ``raise``/``eio`` model a device
+                            failure inside the sharded gather; the mesh
+                            breaker group must absorb it on the byte-
+                            identical single-device path, never wrong
+                            bytes
 ======================== ====================================================
 
 **Process-death actions are subprocess-only.**  ``kill``/``torn_write``
@@ -182,6 +190,7 @@ POINTS = frozenset({
     "memtable.flush",
     "maintain.tick",
     "maintain.disk_guard",
+    "mesh.dispatch",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

@@ -128,13 +128,15 @@ def retry_preempted(run, *, retries: int = 1, base_delay: float = 0.2,
     return report
 
 
-def device_put(x, *, attempts: int = 3):
+def device_put(x, *, attempts: int = 3, device=None):
     """``jax.device_put`` with bounded retry on transient runtime errors —
-    the upload half of every dispatch on remote-attached devices."""
+    the upload half of every dispatch on remote-attached devices.
+    ``device`` pins the destination (the residency manager's
+    chromosome->device placement); None keeps the default device."""
     import jax
 
     return with_backoff(
-        lambda: jax.device_put(x),
+        lambda: jax.device_put(x, device),
         attempts=attempts, retryable=is_transient_device,
         what="device transfer",
     )

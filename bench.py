@@ -1492,6 +1492,251 @@ def bench_multichip_virtual(n_devices: int = 8):
     }
 
 
+def bench_multichip_curve(device_counts=(1, 2, 4, 8)):
+    """The MULTICHIP scaling-curve block: the mesh-sharded annotate
+    pipeline and the sharded serve bulk lookup measured at 1→2→4→8
+    devices on a forced host mesh, byte-verified against the
+    single-device answers AT EVERY COUNT.
+
+    Honesty first: on a virtual-CPU mesh every "device" shares this
+    host's physical cores, so the wall-clock speedup ceiling is the core
+    count, not the device count — the block records ``cores`` and labels
+    itself accordingly.  What the curve DOES prove: the sharded programs
+    are correct at every width (byte_identical), the per-device work
+    genuinely partitions (speedup tracks min(devices, cores)), and on
+    real chips — where devices stop sharing silicon — the same programs
+    scale with the mesh instead of the host."""
+    import jax
+
+    from annotatedvdb_tpu.io.synth import synthetic_batch
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.models.pipeline import annotate_pipeline_jit
+    from annotatedvdb_tpu.ops.dedup import CHROM_MIX
+    from annotatedvdb_tpu.parallel.device_store import (
+        build_device_shard_store,
+    )
+    from annotatedvdb_tpu.parallel.distributed import (
+        distributed_serve_lookup_step,
+    )
+    from annotatedvdb_tpu.parallel.mesh import batch_sharding, make_mesh
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.ops.hashing import allele_hash_np
+
+    cpu_devices = jax.devices("cpu")
+    counts = [d for d in device_counts if d <= len(cpu_devices)]
+    if not counts or counts[-1] < max(device_counts):
+        return {
+            "skipped": f"only {len(cpu_devices)} CPU devices (flag not "
+                       "set before backend init)"
+        }
+
+    # ---- annotate pipeline leg (ingest -> normalize -> class -> bin) ----
+    rows = 1 << 19
+    width = 16
+    batch = synthetic_batch(rows, width=width, seed=23)
+    args_np = (batch.chrom, batch.pos, batch.ref, batch.alt,
+               batch.ref_len, batch.alt_len)
+    annotate_leg = {"rows": rows, "width": width, "per_device": []}
+    reference = None
+    iters, rounds = 5, 3
+    ann_ctx = []
+    for nd in counts:
+        mesh = make_mesh(nd, devices=cpu_devices)
+        sharding = batch_sharding(mesh)
+        dargs = tuple(jax.device_put(np.asarray(a), sharding)
+                      for a in args_np)
+        out = annotate_pipeline_jit(*dargs)  # compile + verify pass
+        jax.block_until_ready(out)
+        got = {f: np.asarray(getattr(out, f))
+               for f in out._fields}
+        if reference is None:
+            reference = got
+        identical = all(
+            np.array_equal(reference[f], got[f]) for f in reference
+        )
+        ann_ctx.append({"devices": nd, "args": dargs,
+                        "byte_identical": bool(identical),
+                        "dt": float("inf")})
+    # interleaved best-of rounds: the box's background load swings 2-3x
+    # on minute timescales, so each device count gets measured in every
+    # time window and keeps its best — one noisy window can't tilt the
+    # curve toward whichever count it happened to land on
+    for _round in range(rounds):
+        for ctx in ann_ctx:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = annotate_pipeline_jit(*ctx["args"])
+            jax.block_until_ready(out)
+            ctx["dt"] = min(
+                ctx["dt"],
+                max((time.perf_counter() - t0) / iters, 1e-9),
+            )
+    for ctx in ann_ctx:
+        annotate_leg["per_device"].append({
+            "devices": ctx["devices"],
+            "rows_per_sec": round(rows / ctx["dt"], 1),
+            "seconds": round(ctx["dt"], 4),
+            "byte_identical": ctx["byte_identical"],
+        })
+
+    # ---- serve bulk-lookup leg (one sharded call + cross-device gather) --
+    store_rows = 1 << 21
+    n_queries = 1 << 16
+    resident = synthetic_batch(store_rows, width=width, seed=29)
+    store = VariantStore(width=width)
+    h_all = allele_hash_np(resident.ref, resident.alt,
+                           resident.ref_len, resident.alt_len)
+    for code in np.unique(resident.chrom):
+        sel = np.where(resident.chrom == code)[0]
+        order = np.argsort(
+            (resident.pos[sel].astype(np.uint64) << np.uint64(32))
+            | h_all[sel], kind="stable",
+        )
+        sel = sel[order]
+        store.shard(int(code)).append(
+            {"pos": resident.pos[sel], "h": h_all[sel],
+             "ref_len": resident.ref_len[sel],
+             "alt_len": resident.alt_len[sel]},
+            resident.ref[sel], resident.alt[sel],
+        )
+    # queries: half present (sampled store rows), half absent
+    rng = np.random.default_rng(31)
+    take = rng.choice(store_rows, n_queries, replace=False)
+    q_chrom = resident.chrom[take].copy()
+    q_pos = resident.pos[take].copy()
+    q_ref = resident.ref[take].copy()
+    q_alt = resident.alt[take].copy()
+    q_rl = resident.ref_len[take].copy()
+    q_al = resident.alt_len[take].copy()
+    q_pos[::2] = q_pos[::2] + 1  # misses (position off by one)
+    q_h = identity_hashes(width, q_ref, q_alt, q_rl, q_al)
+    q_hm = q_h ^ (q_chrom.astype(np.uint32) * np.uint32(CHROM_MIX))
+    # the single-device production reference: the store's own host path
+    ref_found = np.zeros(n_queries, bool)
+    ref_gid = np.full(n_queries, -1, np.int64)
+    for code in np.unique(q_chrom):
+        sel = np.where(q_chrom == code)[0]
+        shard = store.shards.get(int(code))
+        if shard is None:
+            continue
+        f, g = shard.lookup(q_pos[sel], q_h[sel], q_ref[sel], q_alt[sel],
+                            q_rl[sel], q_al[sel], host_only=True)
+        ref_found[sel], ref_gid[sel] = f, g
+    bulk_leg = {"store_rows": store_rows, "queries": n_queries,
+                "per_device": []}
+    bulk_ctx = []
+    for nd in counts:
+        mesh = make_mesh(nd, devices=cpu_devices)
+        sharding = batch_sharding(mesh)
+        host_store = build_device_shard_store(store, nd)
+        dev_store = type(host_store)(*(
+            jax.device_put(np.asarray(getattr(host_store, f)), sharding)
+            if f != "n_rows" else host_store.n_rows
+            for f in host_store._fields
+        ))
+
+        def step(mesh=mesh, dev_store=dev_store):
+            return distributed_serve_lookup_step(
+                mesh, q_chrom, q_pos, q_hm, q_ref, q_alt, q_rl, q_al,
+                dev_store,
+            )
+
+        rid_out, found, store_row = step()  # compile + verify pass
+        rid_out = np.asarray(rid_out)
+        found = np.asarray(found)
+        store_row = np.asarray(store_row)
+        got_found = np.zeros(n_queries, bool)
+        got_gid = np.full(n_queries, -1, np.int64)
+        take_slots = rid_out >= 0
+        got_found[rid_out[take_slots]] = found[take_slots]
+        got_gid[rid_out[take_slots]] = store_row[take_slots]
+        identical = bool(
+            np.array_equal(got_found, ref_found)
+            and np.array_equal(got_gid, ref_gid)
+        )
+        bulk_ctx.append({"devices": nd, "step": step,
+                         "byte_identical": identical,
+                         "dt": float("inf")})
+    for _round in range(rounds):  # interleaved best-of (see annotate leg)
+        for ctx in bulk_ctx:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = ctx["step"]()
+            jax.block_until_ready(out[0])
+            ctx["dt"] = min(
+                ctx["dt"],
+                max((time.perf_counter() - t0) / iters, 1e-9),
+            )
+    for ctx in bulk_ctx:
+        bulk_leg["per_device"].append({
+            "devices": ctx["devices"],
+            "lookups_per_sec": round(n_queries / ctx["dt"], 1),
+            "seconds": round(ctx["dt"], 4),
+            "byte_identical": ctx["byte_identical"],
+        })
+
+    def _finish(leg, key):
+        base = leg["per_device"][0][key]
+        for entry in leg["per_device"]:
+            entry["speedup"] = round(entry[key] / base, 2)
+            entry["efficiency"] = round(
+                entry[key] / base / entry["devices"], 3
+            )
+        leg["speedup_at_max"] = leg["per_device"][-1]["speedup"]
+
+    _finish(annotate_leg, "rows_per_sec")
+    _finish(bulk_leg, "lookups_per_sec")
+    cores = os.cpu_count() or 1
+    return {
+        "devices": counts,
+        "cores": cores,
+        "label": ("virtual-cpu host mesh: all devices share this host's "
+                  f"{cores} core(s), so the wall-clock speedup ceiling "
+                  "is min(devices, cores) — correctness and partitioning "
+                  "are what the curve certifies here; chip-count scaling "
+                  "needs real chips"),
+        "annotate": annotate_leg,
+        "bulk_lookup": bulk_leg,
+    }
+
+
+def multichip_only():
+    """One-command mesh scaling capture (``python bench.py --multichip``):
+    force the 8-virtual-device CPU host platform, run the MULTICHIP
+    scaling curve (annotate pipeline + sharded bulk lookup at 1→2→4→8
+    devices, byte-verified at every count), and print one schema-valid
+    JSON line."""
+    from annotatedvdb_tpu.utils import runtime
+
+    runtime.force_cpu_mesh(8)
+    import jax
+
+    out = {
+        "mode": "multichip",
+        "metric": "multichip_annotate_speedup_8dev",
+        "unit": "x_vs_1dev",
+        "backend": jax.default_backend(),
+        "platform_pin": "cpu",
+    }
+    try:
+        curve = bench_multichip_curve()
+        out["multichip"] = curve
+        speedup = (
+            curve.get("annotate", {}).get("speedup_at_max", 0.0)
+            if "skipped" not in curve else 0.0
+        )
+        out["value"] = speedup
+        # the honest baseline for a virtual mesh is the CORE-count
+        # ceiling, not the device count (see the block's label)
+        ceiling = min(8, os.cpu_count() or 1)
+        out["vs_baseline"] = round(speedup / ceiling, 3) if ceiling else 0.0
+    except Exception as exc:  # record the failure, never die silently
+        out["value"] = 0.0
+        out["vs_baseline"] = 0.0
+        out["error"] = f"{type(exc).__name__}: {exc}"[:500]
+    print(json.dumps(out))
+
+
 def _argv_opt(name: str) -> str | None:
     """Minimal ``--flag VALUE`` / ``--flag=VALUE`` lookup (the bench keeps
     argv handling dependency-free, like --tpu-only)."""
@@ -1645,6 +1890,9 @@ def main():
         return
     if "--serve" in sys.argv[1:]:
         serve_only()
+        return
+    if "--multichip" in sys.argv[1:]:
+        multichip_only()
         return
     # Pin the platform BEFORE any backend touch: round 1's bench died with
     # rc=1 because the TPU tunnel errored during jax.default_backend(), and

@@ -16,6 +16,24 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache: the suite's wall time is dominated by
+# jit compiles (the mesh programs alone are ~10s each), and tier-1 runs
+# under a hard timeout.  The cache is content-keyed — a stale entry can
+# never serve wrong code — and subprocess tests (serve fleet workers,
+# CLI loads) inherit it through the environment, so re-runs and
+# sibling-process first-touches load from disk instead of recompiling.
+# setdefault: an explicit caller choice (or disabling with an empty
+# value) always wins.
+import tempfile as _tempfile
+
+_uid = getattr(os, "getuid", lambda: "u")()
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(_tempfile.gettempdir(), f"avdb_test_xla_cache.{_uid}"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 # A sitecustomize.py in this image re-pins jax_platforms to the TPU tunnel at
 # import time, overriding the env var — so the env alone is not enough. Update
 # the config after import; the backend is initialized lazily, so this wins as

@@ -464,3 +464,100 @@ def test_chaos_maintain_subblock_is_validated():
     old = copy.deepcopy(GOOD)
     del old["serving"]["chaos"]["maintain"]
     assert validate_record(old) == []
+
+
+GOOD_MULTICHIP = {
+    "mode": "multichip",
+    "metric": "multichip_annotate_speedup_8dev",
+    "value": 1.9,
+    "unit": "x_vs_1dev",
+    "vs_baseline": 0.95,
+    "backend": "cpu",
+    "platform_pin": "cpu",
+    "multichip": {
+        "devices": [1, 2, 4, 8],
+        "cores": 2,
+        "label": "virtual-cpu host mesh (shared cores)",
+        "annotate": {
+            "rows": 524288, "width": 16, "speedup_at_max": 1.9,
+            "per_device": [
+                {"devices": d, "rows_per_sec": 1e6 * d, "seconds": 0.5,
+                 "speedup": float(d), "efficiency": 1.0,
+                 "byte_identical": True}
+                for d in (1, 2, 4, 8)
+            ],
+        },
+        "bulk_lookup": {
+            "store_rows": 2097152, "queries": 65536,
+            "speedup_at_max": 1.4,
+            "per_device": [
+                {"devices": d, "lookups_per_sec": 1e5 * d,
+                 "seconds": 0.4, "speedup": float(d),
+                 "efficiency": 1.0, "byte_identical": True}
+                for d in (1, 2, 4, 8)
+            ],
+        },
+    },
+}
+
+
+def test_multichip_record_validates():
+    assert validate_record(GOOD_MULTICHIP) == []
+
+
+def test_multichip_block_is_validated_strictly():
+    # byte_identical=false is a hard failure at ANY device count
+    rec = copy.deepcopy(GOOD_MULTICHIP)
+    rec["multichip"]["annotate"]["per_device"][2]["byte_identical"] = False
+    assert any("byte_identical" in e for e in validate_record(rec))
+    # a missing per-device throughput is a failure
+    rec = copy.deepcopy(GOOD_MULTICHIP)
+    del rec["multichip"]["bulk_lookup"]["per_device"][0]["lookups_per_sec"]
+    assert any("lookups_per_sec" in e for e in validate_record(rec))
+    # the honesty fields are required: cores + label + device list
+    for field in ("cores", "label", "devices"):
+        rec = copy.deepcopy(GOOD_MULTICHIP)
+        del rec["multichip"][field]
+        assert any(field in e for e in validate_record(rec)), field
+    # missing speedup_at_max fails
+    rec = copy.deepcopy(GOOD_MULTICHIP)
+    del rec["multichip"]["annotate"]["speedup_at_max"]
+    assert any("speedup_at_max" in e for e in validate_record(rec))
+    # a multichip-mode record with no block (and no error) fails
+    rec = copy.deepcopy(GOOD_MULTICHIP)
+    del rec["multichip"]
+    assert any("no" in e and "multichip" in e for e in validate_record(rec))
+    # ... unless it recorded an error (a failed run stays loadable)
+    rec["error"] = "RuntimeError: backend died"
+    assert validate_record(rec) == []
+    # a skipped curve (too few devices) is a legitimate record
+    rec = copy.deepcopy(GOOD_MULTICHIP)
+    rec["multichip"] = {"skipped": "only 1 CPU device"}
+    assert validate_record(rec) == []
+
+
+def test_multichip_block_inside_full_record_validates():
+    rec = copy.deepcopy(GOOD)
+    rec["multichip"] = copy.deepcopy(GOOD_MULTICHIP["multichip"])
+    assert validate_record(rec) == []
+    rec["multichip"]["bulk_lookup"]["per_device"][3]["byte_identical"] = False
+    assert any("byte_identical" in e for e in validate_record(rec))
+
+
+def test_multichip_dryrun_wrappers_validate(tmp_path):
+    # the historic MULTICHIP_r01–r05 shape stays loadable
+    wrapper = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+               "tail": "dryrun_multichip(8): ok\n"}
+    p = tmp_path / "MULTICHIP_r99.json"
+    p.write_text(json.dumps(wrapper))
+    assert validate_file(str(p)) == []
+    bad = dict(wrapper, ok="yes")
+    p.write_text(json.dumps(bad))
+    assert any("ok" in e for e in validate_file(str(p)))
+
+
+def test_checker_cli_covers_committed_multichip_records():
+    paths = sorted(glob.glob(os.path.join(ROOT, "MULTICHIP_*.json")))
+    assert len(paths) >= 5  # r01–r05 are committed history
+    for path in paths:
+        assert validate_file(path) == [], path

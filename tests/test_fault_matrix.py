@@ -1275,3 +1275,91 @@ def test_maintain_disk_guard_fault_flips_507_both_front_ends_and_clears(
         httpd.server_close()
         ctx.batcher.close()
         mem.wal.close(remove_if_empty=True)
+
+
+# ---------------------------------------------------------------------------
+# mesh.dispatch — a device failure inside the sharded mesh gather
+# (serve/mesh_exec).  The contract: the mesh breaker group absorbs it on
+# the byte-identical single-device path (never wrong bytes), repeated
+# failures trip the group open so the sharded attempt stops being paid,
+# and a half-open probe re-closes it once the device heals.
+
+
+def test_mesh_dispatch_raise_bulk_falls_back_byte_identical():
+    """mesh.dispatch (raise) during a bulk lookup: the answer bytes are
+    the single-device path's, the breaker's mesh group trips after the
+    threshold (no further sharded attempt fires while open), and the
+    cooled-down half-open probe re-closes it."""
+    from annotatedvdb_tpu.parallel.mesh import global_mesh
+    from annotatedvdb_tpu.serve import (
+        DeviceBreaker,
+        MeshExecutor,
+        QueryEngine,
+        StaticSnapshots,
+    )
+    from annotatedvdb_tpu.serve.mesh_exec import MESH_GROUP
+
+    mesh = global_mesh()
+    assert mesh is not None  # conftest forces the 8-device host platform
+    snaps = StaticSnapshots(_tiny_store())
+    plain = QueryEngine(snaps, region_cache_size=0)
+    clock = {"t": 0.0}
+    breaker = DeviceBreaker(cooldown_s=5.0, clock=lambda: clock["t"])
+    engine = QueryEngine(
+        snaps, region_cache_size=0, breaker=breaker,
+        mesh=MeshExecutor(mesh, breaker=breaker, bulk_min=0),
+    )
+    ids = ["3:10:A:C", "3:20:A:C", "3:30:A:C", "3:99:A:C"]
+    want = plain.lookup_many(ids)
+    assert engine.lookup_many(ids) == want  # mesh path agrees unarmed
+    faults.reset("mesh.dispatch:prob:1.0:raise")
+    try:
+        for _ in range(breaker.failure_threshold):
+            # every failing dispatch still answers, byte-identical
+            # (single-device fallback)
+            assert engine.lookup_many(ids) == want
+        assert breaker.state(MESH_GROUP) == "open"
+        # while tripped the sharded call is never attempted: the armed
+        # fault cannot fire
+        fired_before = faults.fired().get("mesh.dispatch", 0)
+        assert engine.lookup_many(ids) == want
+        assert faults.fired().get("mesh.dispatch", 0) == fired_before
+    finally:
+        faults.reset("")
+    # cooldown lapses, fault cleared: the half-open probe re-closes
+    clock["t"] = 10.0
+    assert engine.lookup_many(ids) == want
+    assert breaker.state(MESH_GROUP) == "closed"
+
+
+def test_mesh_dispatch_eio_panel_falls_back_byte_identical():
+    """mesh.dispatch (eio) during a region panel: the batch answers
+    byte-identically through the single-device spans path, and the
+    engine keeps serving mesh panels once the fault clears."""
+    from annotatedvdb_tpu.parallel.mesh import global_mesh
+    from annotatedvdb_tpu.serve import (
+        DeviceBreaker,
+        MeshExecutor,
+        QueryEngine,
+        StaticSnapshots,
+    )
+
+    mesh = global_mesh()
+    assert mesh is not None
+    snaps = StaticSnapshots(_tiny_store())
+    plain = QueryEngine(snaps, region_cache_size=0)
+    breaker = DeviceBreaker(cooldown_s=0.0)
+    engine = QueryEngine(
+        snaps, region_cache_size=0, breaker=breaker,
+        mesh=MeshExecutor(mesh, breaker=breaker, bulk_min=0),
+    )
+    specs = ["3:1-100", "3:5-25", "7:1-50"]
+    want = plain.regions_serve(specs).assemble()
+    assert engine.regions_serve(specs).assemble() == want
+    faults.reset("mesh.dispatch:1:eio")
+    try:
+        assert engine.regions_serve(specs).assemble() == want
+    finally:
+        faults.reset("")
+    # unarmed: the mesh panel path serves again, same bytes
+    assert engine.regions_serve(specs).assemble() == want

@@ -23,10 +23,14 @@ import pytest
 from annotatedvdb_tpu.ops import TWINS
 from annotatedvdb_tpu.ops.annotate import (
     annotate_kernel_jit,
+    annotate_kernel_mesh,
     annotate_kernel_np,
 )
 from annotatedvdb_tpu.ops.annotate_pallas import annotate_bin_pallas
-from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
+from annotatedvdb_tpu.ops.binindex import (
+    bin_index_kernel_jit,
+    bin_index_kernel_mesh,
+)
 from annotatedvdb_tpu.ops.cadd_join import (
     cadd_join_host,
     cadd_join_kernel,
@@ -37,14 +41,21 @@ from annotatedvdb_tpu.ops.dedup import (
     lookup_in_sorted_multi_np,
     lookup_in_sorted_np,
     mark_batch_duplicates_jit,
+    mark_batch_duplicates_mesh,
     mark_batch_duplicates_multi_jit,
     mark_batch_duplicates_multi_np,
     mark_batch_duplicates_np,
     mix_chrom_hash,
 )
-from annotatedvdb_tpu.ops.hashing import allele_hash_jit, allele_hash_np
+from annotatedvdb_tpu.ops.hashing import (
+    allele_hash_jit,
+    allele_hash_mesh,
+    allele_hash_np,
+)
 from annotatedvdb_tpu.ops.intervals import (
     bits_spans_kernel_jit,
+    bits_spans_stacked_host,
+    bits_spans_stacked_jit,
     interval_spans_host,
 )
 from annotatedvdb_tpu.ops.pack import (
@@ -340,6 +351,77 @@ def test_bits_spans_kernel_vs_host_twin():
     np.testing.assert_array_equal(np.asarray(d_level), h_level)
     np.testing.assert_array_equal(np.asarray(d_leaf), h_leaf)
     assert int(POS_SENTINEL) > 2_000_000  # inputs stayed in-range
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded kernel surfaces (mesh_pjit): same twins, sharded compute.
+# Each mesh surface is driven against ITS registered host twin on an
+# odd-sized batch (forces the pad-and-slice path) over the live mesh
+# (conftest forces an 8-virtual-device CPU backend).
+
+
+def test_annotate_kernel_mesh_vs_np_twin():
+    rng = np.random.default_rng(61)
+    pos, ref, alt, ref_len, alt_len = _allele_batch(rng, 333)
+    dev = annotate_kernel_mesh(pos, ref, alt, ref_len, alt_len)
+    host = annotate_kernel_np(pos, ref, alt, ref_len, alt_len)
+    assert set(dev) == set(host)
+    for key in dev:
+        np.testing.assert_array_equal(
+            np.asarray(dev[key]), np.asarray(host[key]), err_msg=key
+        )
+
+
+def test_allele_hash_mesh_vs_np_twin():
+    rng = np.random.default_rng(62)
+    _pos, ref, alt, ref_len, alt_len = _allele_batch(rng, 301)
+    dev = np.asarray(allele_hash_mesh(ref, alt, ref_len, alt_len))
+    host = allele_hash_np(ref, alt, ref_len, alt_len)
+    assert dev.dtype == host.dtype == np.uint32
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_bin_index_kernel_mesh_vs_oracle_twin():
+    rng = np.random.default_rng(63)
+    starts = rng.integers(1, 200_000_000, 203).astype(np.int32)
+    ends = (starts + rng.integers(0, 100_000, 203)).astype(np.int32)
+    level, leaf = bin_index_kernel_mesh(starts, ends)
+    level, leaf = np.asarray(level), np.asarray(leaf)
+    for i in range(starts.shape[0]):
+        want_level, want_leaf = closed_form_bin(int(starts[i]), int(ends[i]))
+        assert (int(level[i]), int(leaf[i])) == (want_level, want_leaf)
+
+
+def test_mark_batch_duplicates_mesh_vs_np_twin():
+    rng = np.random.default_rng(64)
+    pos, ref, alt, ref_len, alt_len = _allele_batch(rng, 229)
+    # plant duplicate runs so the global sharded sort has real work
+    pos[50:60] = pos[40]
+    ref[50:60] = ref[40]
+    alt[50:60] = alt[40]
+    ref_len[50:60] = ref_len[40]
+    alt_len[50:60] = alt_len[40]
+    h = allele_hash_np(ref, alt, ref_len, alt_len)
+    dev = np.asarray(
+        mark_batch_duplicates_mesh(pos, h, ref, alt, ref_len, alt_len)
+    )
+    host = mark_batch_duplicates_np(pos, h, ref, alt, ref_len, alt_len)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_bits_spans_stacked_vs_host_twin():
+    rng = np.random.default_rng(65)
+    b, r, q = 8, 256, 32
+    pos = np.sort(rng.integers(1, 2_000_000, (b, r)).astype(np.int32),
+                  axis=1)
+    pos[3, :] = POS_SENTINEL  # an empty (all-pad) group row
+    starts = rng.integers(1, 2_000_000, (b, q)).astype(np.int32)
+    ends = (starts + rng.integers(0, 50_000, (b, q))).astype(np.int32)
+    dev = bits_spans_stacked_jit(pos, starts, ends)
+    host = bits_spans_stacked_host(pos, starts, ends)
+    for d, h, name in zip(dev, host, ("lo", "hi", "level", "leaf")):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(h),
+                                      err_msg=name)
 
 
 # ---------------------------------------------------------------------------

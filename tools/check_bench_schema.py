@@ -20,9 +20,15 @@ have no ``end_to_end``; pre-PR1 records no ``stage_wall``; pre-PR2 records
 no ``queue_stalls``): required core fields must exist with the right
 types, every OPTIONAL section is validated strictly when present.
 
+``MULTICHIP_r*.json`` files are validated too: the historic dryrun
+wrappers (``{"n_devices", "rc", "ok", ...}``) stay loadable, and
+``--multichip`` records carry the strict ``multichip`` scaling block
+(``byte_identical`` REQUIRED true at every device count).
+
 Usage::
 
-    python tools/check_bench_schema.py [FILE ...]   # default: BENCH_*.json
+    python tools/check_bench_schema.py [FILE ...]   # default:
+                                        # BENCH_*.json + MULTICHIP_*.json
 """
 
 from __future__ import annotations
@@ -430,6 +436,84 @@ def _check_storage(st, where: str, errors: list) -> None:
         _check_autonomy(st["autonomy"], w, errors)
 
 
+def _check_multichip(mc, where: str, errors: list) -> None:
+    """The mesh scaling-curve block (``bench.py --multichip``): per-
+    device-count throughput + parallel efficiency for the annotate
+    pipeline and the sharded bulk lookup, with ``byte_identical``
+    REQUIRED true at EVERY device count — a curve whose sharded answers
+    drift from the single-device bytes is a broken build, not a data
+    point (the acked_missing precedent)."""
+    w = f"{where}.multichip"
+    if not isinstance(mc, dict):
+        errors.append(f"{w}: must be an object")
+        return
+    if "skipped" in mc:
+        if not isinstance(mc["skipped"], str):
+            errors.append(f"{w}.skipped: must be a string reason")
+        return
+    _check_fields(
+        mc,
+        {
+            "devices": lambda v: isinstance(v, list) and len(v) > 0
+            and all(_is_int(d) and d >= 1 for d in v),
+            "cores": _is_int,
+            "label": lambda v: isinstance(v, str),
+        },
+        w, errors, required=("devices", "cores", "label", "annotate",
+                             "bulk_lookup"),
+    )
+    for leg, rate_key in (("annotate", "rows_per_sec"),
+                          ("bulk_lookup", "lookups_per_sec")):
+        sub = mc.get(leg)
+        if not isinstance(sub, dict):
+            if leg in mc:
+                errors.append(f"{w}.{leg}: must be an object")
+            continue
+        lw = f"{w}.{leg}"
+        _check_fields(
+            sub,
+            {"speedup_at_max": _is_num,
+             "per_device": lambda v: isinstance(v, list) and len(v) > 0},
+            lw, errors, required=("per_device", "speedup_at_max"),
+        )
+        for i, entry in enumerate(sub.get("per_device") or []):
+            ew = f"{lw}.per_device[{i}]"
+            if not isinstance(entry, dict):
+                errors.append(f"{ew}: must be an object")
+                continue
+            _check_fields(
+                entry,
+                {"devices": _is_int, rate_key: _is_num,
+                 "seconds": _is_num, "speedup": _is_num,
+                 "efficiency": _is_num,
+                 "byte_identical": lambda v: isinstance(v, bool)},
+                ew, errors,
+                required=("devices", rate_key, "speedup",
+                          "byte_identical"),
+            )
+            if entry.get("byte_identical") is False:
+                errors.append(
+                    f"{ew}.byte_identical: the mesh path diverged from "
+                    "the single-device bytes — wrong answers are never a "
+                    "scaling data point"
+                )
+
+
+def _check_multichip_dryrun(obj: dict, name: str) -> list[str]:
+    """Historic MULTICHIP_r01–r05 records: the dryrun driver wrapper
+    (``{"n_devices", "rc", "ok", "skipped", "tail"}``) stays loadable."""
+    errors: list[str] = []
+    _check_fields(
+        obj,
+        {"n_devices": _is_int, "rc": _is_int,
+         "ok": lambda v: isinstance(v, bool),
+         "skipped": lambda v: isinstance(v, bool),
+         "tail": lambda v: isinstance(v, str)},
+        name, errors, required=("n_devices", "rc", "ok"),
+    )
+    return errors
+
+
 def _check_regions(rg: dict, where: str, errors: list) -> None:
     """The PR-8 batch-region-join leg: a ≥2k-interval panel answered
     device-batched (``POST /regions``) vs the sequential single-region
@@ -530,6 +614,23 @@ def validate_record(rec: dict, where: str = "record") -> list[str]:
             rec, {"platform_pin": lambda v: isinstance(v, str)},
             where, errors, required=("platform_pin",),
         )
+    elif rec.get("mode") == "multichip":
+        # --multichip scaling records: the MULTICHIP block is the payload
+        _check_fields(
+            rec,
+            {"metric": lambda v: isinstance(v, str), "value": _is_num,
+             "vs_baseline": _is_num,
+             "backend": lambda v: isinstance(v, str)},
+            where, errors,
+            required=("metric", "value", "vs_baseline", "backend"),
+        )
+        if "error" not in rec:
+            if "multichip" not in rec:
+                errors.append(f"{where}: multichip record carries no "
+                              "multichip block")
+            else:
+                _check_multichip(rec["multichip"], where, errors)
+        return errors
     else:
         _check_fields(
             rec,
@@ -565,6 +666,9 @@ def validate_record(rec: dict, where: str = "record") -> list[str]:
             f"{where}.qc_update", errors,
             required=("rows_per_sec", "seconds"),
         )
+    if "multichip" in rec and isinstance(rec["multichip"], dict) \
+            and "error" not in rec["multichip"]:
+        _check_multichip(rec["multichip"], where, errors)
     if "serving" in rec and isinstance(rec["serving"], dict) \
             and "error" not in rec["serving"]:
         _check_serving(rec["serving"], where, errors)
@@ -586,6 +690,9 @@ def validate_file(path: str) -> list[str]:
         return [f"{name}: unreadable ({err})"]
     if not isinstance(obj, dict):
         return [f"{name}: not a JSON object"]
+    if "n_devices" in obj and "parsed" not in obj:
+        # historic MULTICHIP_r01–r05 dryrun wrappers
+        return _check_multichip_dryrun(obj, name)
     if "parsed" in obj or "rc" in obj:  # driver wrapper
         errors: list[str] = []
         if obj.get("rc") == 0 and not isinstance(obj.get("parsed"), dict):
@@ -600,9 +707,10 @@ def validate_file(path: str) -> list[str]:
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = argv or sorted(
-        glob.glob(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "BENCH_*.json"))
+        glob.glob(os.path.join(root, "BENCH_*.json"))
+        + glob.glob(os.path.join(root, "MULTICHIP_*.json"))
     )
     if not paths:
         print("no BENCH_*.json files found", file=sys.stderr)

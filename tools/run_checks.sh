@@ -27,7 +27,11 @@
 #                    segment watermark trips, and daemon-driven
 #                    compaction converges read-amp back below the low
 #                    watermark with byte-identical reads
-#   8. chaos_soak --smoke — a 1-worker fleet under open-loop load with
+#   8. mesh_smoke — the mesh-native path: forced 4-device host mesh,
+#                    sharded load (placement block committed), and a
+#                    real fleet with AVDB_SERVE_MESH=1 answering every
+#                    query shape byte-identical to a mesh-off server
+#   9. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
 #
@@ -65,6 +69,9 @@ python "$root/tools/upsert_smoke.py" || rc=1
 
 echo "== maintain smoke ==" >&2
 python "$root/tools/maintain_smoke.py" || rc=1
+
+echo "== mesh smoke ==" >&2
+python "$root/tools/mesh_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1
