@@ -64,6 +64,14 @@ SHARED_HELPERS = frozenset({
     "readyz_payload",
     "point_preflight",
     "REGIONS_BODY_ERROR",
+    # the request-observability plane (PR 14): trace-id resolution/echo,
+    # the /metrics (+?fleet=1) body, the chaos gate, and the
+    # /debug/trace dump all live once in http.py
+    "resolve_trace_id",
+    "TRACE_HEADER",
+    "metrics_payload",
+    "debug_trace_payload",
+    "chaos_enabled_from_env",
 })
 
 #: literals shorter than this are grammar fragments (JSON keys, header

@@ -123,6 +123,212 @@ def _status(argv) -> int:
     return 0
 
 
+def _fmt_t(t: float) -> str:
+    import time as time_mod
+
+    return time_mod.strftime("%H:%M:%S", time_mod.localtime(t)) \
+        + f".{int((t % 1) * 1000):03d}"
+
+
+def _render_blackbox(meta: dict, events: list, limit: int) -> None:
+    """One harvested (or live-ring) black box to stderr: the lifecycle
+    timeline leading to death, then the final requests with their stage
+    breakdowns."""
+    lifecycle = [e for e in events if e.get("type") == "event"]
+    requests = [e for e in events if e.get("type") == "request"]
+    if meta:
+        import time as time_mod
+
+        when = time_mod.strftime(
+            "%Y-%m-%d %H:%M:%S", time_mod.localtime(meta.get("t", 0))
+        )
+        print(f"  worker {meta.get('worker')}: {meta.get('reason')} "
+              f"(harvested {when}, {meta.get('events')} event(s))",
+              file=sys.stderr)
+    print(f"  lifecycle ({len(lifecycle)} event(s)):", file=sys.stderr)
+    for e in lifecycle[-limit:]:
+        print(f"    {_fmt_t(e['t'])}  {e.get('name', '?'):<10} "
+              f"{e.get('detail', '')}", file=sys.stderr)
+    print(f"  last requests ({len(requests)} recorded):", file=sys.stderr)
+    for e in requests[-limit:]:
+        stages = e.get("stages") or {}
+        breakdown = " ".join(f"{k}={v}ms" for k, v in stages.items())
+        print(f"    {_fmt_t(e['t'])}  {e.get('kind', '?'):<7} "
+              f"{e.get('status', 0):<4} {e.get('ms', '?')}ms  "
+              f"trace={e.get('trace', '-')}  {breakdown}",
+              file=sys.stderr)
+
+
+def _flight(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor flight",
+        description="render the crash flight recorder: a SIGKILLed or "
+                    "wedge-killed worker's last requests and lifecycle "
+                    "events, harvested by the fleet supervisor into "
+                    "<store>/flight/ (live rings decode too)",
+    )
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--all", action="store_true",
+                    help="render every harvested black box, not just "
+                         "the newest")
+    ap.add_argument("--limit", type=int, default=20,
+                    help="events/requests shown per box (default 20)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    import os
+
+    from annotatedvdb_tpu.obs import flight as flight_mod
+
+    if not os.path.isdir(args.storeDir):
+        print(f"doctor flight: {args.storeDir}: not a directory",
+              file=sys.stderr)
+        return 2
+    boxes = flight_mod.list_blackboxes(args.storeDir)
+    harvested = boxes["harvested"] if args.all else boxes["harvested"][:1]
+    out = {"store_dir": args.storeDir, "harvested": [], "rings": []}
+    for path in harvested:
+        try:
+            data = flight_mod.load_harvest(path)
+        except (OSError, ValueError) as err:
+            print(f"doctor flight: {path}: unreadable ({err})",
+                  file=sys.stderr)
+            continue
+        out["harvested"].append({"path": path, **data})
+    for path in boxes["rings"]:
+        try:
+            decoded = flight_mod.decode_ring(path)
+        except (OSError, ValueError):
+            continue  # a live writer's ring mid-create: skip
+        out["rings"].append({"path": path, "events": decoded["events"]})
+    if not out["harvested"] and not out["rings"]:
+        print(f"doctor flight: {args.storeDir}: no flight data (no "
+              "harvested black box under flight/, no live rings) — the "
+              "serve fleet records one when AVDB_FLIGHT_EVENTS > 0",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=1))
+        return 0
+    print(f"flight: {args.storeDir}: "
+          f"{len(boxes['harvested'])} harvested black box(es), "
+          f"{len(out['rings'])} live ring(s)", file=sys.stderr)
+    for box in out["harvested"]:
+        print(f"== {box['path']}", file=sys.stderr)
+        _render_blackbox(box["meta"], box["events"], args.limit)
+    if not out["harvested"]:
+        # no harvest (single-process SIGKILL, or the supervisor died
+        # too): the live rings ARE the black box — decode them directly
+        for ring in out["rings"]:
+            print(f"== {ring['path']} (live ring)", file=sys.stderr)
+            _render_blackbox({}, ring["events"], args.limit)
+    return 0
+
+
+def _trace(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor trace",
+        description="merge the store's background-writer history (ledger "
+                    "run/compact/flush records) and the flight "
+                    "recorder's request/lifecycle timeline into ONE "
+                    "Chrome trace-event JSON — open it in Perfetto to "
+                    "see what the daemon was doing while p99 moved",
+    )
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the trace JSON here (default: stdout)")
+    args = ap.parse_args(argv)
+    import os
+
+    from annotatedvdb_tpu.obs import flight as flight_mod
+
+    lpath = os.path.join(args.storeDir, "ledger.jsonl")
+    if not os.path.isdir(args.storeDir):
+        print(f"doctor trace: {args.storeDir}: not a directory",
+              file=sys.stderr)
+        return 2
+    events: list[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0, "ts": 0,
+         "args": {"name": "avdb-store"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1, "ts": 0,
+         "args": {"name": "background (ledger)"}},
+    ]
+    times: list[float] = []
+
+    def emit(t: float, dur_s: float, name: str, tid: int, **extra):
+        times.append(t)
+        ev = {"ph": "X", "name": name, "pid": 1, "tid": tid,
+              "ts": t * 1e6, "dur": max(dur_s, 0.0) * 1e6}
+        if extra:
+            ev["args"] = extra
+        events.append(ev)
+
+    if os.path.exists(lpath):
+        from annotatedvdb_tpu.store.ledger import AlgorithmLedger
+
+        ledger = AlgorithmLedger(lpath, log=lambda m: None)
+        for rec in ledger.records():
+            kind = rec.get("type")
+            if kind not in ("run", "compact", "flush"):
+                continue
+            ts = float(rec.get("ts") or 0.0)
+            dur = float(rec.get("seconds") or 0.0)
+            # ledger stamps at APPEND time (the end): shift back by the
+            # recorded duration so the span covers the work
+            emit(ts - dur, dur, f"ledger.{kind}", 1,
+                 **{k: rec[k] for k in ("labels", "rows", "status")
+                    if k in rec})
+    boxes = flight_mod.list_blackboxes(args.storeDir)
+    tid = 2
+    for path in boxes["harvested"] + boxes["rings"]:
+        try:
+            if path.endswith(".jsonl"):
+                data = flight_mod.load_harvest(path)
+                evs, label = data["events"], os.path.basename(path)
+            else:
+                evs = flight_mod.decode_ring(path)["events"]
+                label = os.path.basename(path) + " (live)"
+        except (OSError, ValueError):
+            continue
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+            "ts": 0, "args": {"name": f"flight {label}"},
+        })
+        for e in evs:
+            t = float(e.get("t") or 0.0)
+            if e.get("type") == "request":
+                dur = float(e.get("ms") or 0.0) / 1000.0
+                emit(t - dur, dur, e.get("kind", "request"), tid,
+                     trace_id=e.get("trace"), status=e.get("status"))
+            else:
+                times.append(t)
+                events.append({
+                    "ph": "i", "name": e.get("name", "event"), "pid": 1,
+                    "tid": tid, "ts": t * 1e6, "s": "t",
+                    "args": {"detail": e.get("detail", "")},
+                })
+        tid += 1
+    if not times:
+        print(f"doctor trace: {args.storeDir}: nothing to render (no "
+              "ledger records, no flight data)", file=sys.stderr)
+        return 2
+    # rebase to the earliest event so Perfetto opens at t=0
+    base = min(times) * 1e6
+    for ev in events:
+        if ev.get("ph") != "M":
+            ev["ts"] = round(ev["ts"] - base, 1)
+    doc = json.dumps(
+        {"traceEvents": events, "displayTimeUnit": "ms"}
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc)
+        print(f"doctor trace: wrote {len(events)} event(s) to {args.out}",
+              file=sys.stderr)
+    else:
+        print(doc)
+    return 0
+
+
 def _compact(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="doctor compact",
@@ -245,6 +451,10 @@ def main(argv=None) -> int:
         return _compact(argv[1:])
     if argv and argv[0] == "status":
         return _status(argv[1:])
+    if argv and argv[0] == "flight":
+        return _flight(argv[1:])
+    if argv and argv[0] == "trace":
+        return _trace(argv[1:])
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--storeDir", required=True)

@@ -111,6 +111,8 @@ def _build_parser() -> argparse.ArgumentParser:
                         help=argparse.SUPPRESS)  # fleet-internal
     parser.add_argument("--_heartbeatFile", default=None,
                         help=argparse.SUPPRESS)  # fleet-internal (watchdog)
+    parser.add_argument("--_telemetryDir", default=None,
+                        help=argparse.SUPPRESS)  # fleet-internal (?fleet=1)
     parser.add_argument("--_forceHandoff", action="store_true",
                         help=argparse.SUPPRESS)  # tests: no-SO_REUSEPORT path
     return parser
@@ -295,6 +297,24 @@ def _run_single(args, log) -> int:
         print(f"serve: cannot start: {err}", file=sys.stderr)
         return 1
 
+    # crash flight recorder: this worker's mmap'd black box under
+    # <store>/flight/ — it survives SIGKILL, the supervisor harvests it
+    # on any death.  A recorder that cannot start must never block
+    # serving (observability is strictly best-effort).
+    flight = None
+    from annotatedvdb_tpu.obs import flight as flight_mod
+
+    if flight_mod.flight_events_from_env() > 0:
+        try:
+            flight = flight_mod.FlightRecorder(
+                flight_mod.ring_path(args.storeDir,
+                                     args._workerIndex or 0),
+                log=log,
+            )
+        except (OSError, ValueError) as err:
+            log(f"flight: recorder unavailable ({err}); serving without "
+                "a black box")
+
     memtable = None
     if _upserts_enabled(args):
         from annotatedvdb_tpu.serve.snapshot import MemtableSnapshots
@@ -339,7 +359,8 @@ def _run_single(args, log) -> int:
 
     if args.frontend == "threaded":
         return _run_threaded(args, manager, registry, residency, tracer,
-                             max_wait_s, log, memtable=memtable)
+                             max_wait_s, log, memtable=memtable,
+                             flight=flight)
 
     from annotatedvdb_tpu.serve.aio import build_aio_server
 
@@ -353,7 +374,8 @@ def _run_single(args, log) -> int:
             stream_threshold=args.streamThreshold,
             heartbeat_file=args._heartbeatFile,
             heartbeat_index=args._workerIndex or 0,
-            tracer=tracer, log=log,
+            tracer=tracer, log=log, flight=flight,
+            telemetry_dir=args._telemetryDir,
         )
     except (OSError, ValueError) as err:
         # unparseable AVDB_SERVE_* knob or unbindable address: same clean
@@ -433,6 +455,14 @@ def _run_single(args, log) -> int:
             # shutdown leaves no fsck warning (files WITH records stay —
             # they are the durability of unflushed acknowledged upserts)
             memtable.wal.close(remove_if_empty=True)
+        # uninstall the process-global background sink BEFORE closing the
+        # flight recorder it points at: a later store-layer operation in
+        # this process must not record into a dead context's ring
+        from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
+
+        reqtrace_mod.set_background_sink(None, None)
+        if flight is not None:
+            flight.close()
         _export(args, ctx.registry, tracer, log)
     return 0
 
@@ -450,7 +480,7 @@ def _worker_socket(args):
 
 
 def _run_threaded(args, manager, registry, residency, tracer,
-                  max_wait_s, log, memtable=None) -> int:
+                  max_wait_s, log, memtable=None, flight=None) -> int:
     """The PR-5 thread-per-connection server (byte-parity reference)."""
     from annotatedvdb_tpu.serve.http import build_server
 
@@ -460,7 +490,9 @@ def _run_threaded(args, manager, registry, residency, tracer,
             max_batch=args.maxBatch, max_wait_s=max_wait_s,
             max_queue=args.maxQueue, region_cache_size=args.regionCache,
             registry=registry, residency=residency, memtable=memtable,
-            tracer=tracer, log=log,
+            tracer=tracer, log=log, flight=flight,
+            telemetry_dir=args._telemetryDir,
+            worker_index=args._workerIndex or 0,
         )
     except (OSError, ValueError) as err:
         print(f"serve: cannot start: {err}", file=sys.stderr)
@@ -479,6 +511,11 @@ def _run_threaded(args, manager, registry, residency, tracer,
         ctx.batcher.close()
         if memtable is not None and memtable.wal is not None:
             memtable.wal.close(remove_if_empty=True)
+        from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
+
+        reqtrace_mod.set_background_sink(None, None)
+        if flight is not None:
+            flight.close()
         _export(args, ctx.registry, tracer, log)
     return 0
 

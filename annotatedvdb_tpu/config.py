@@ -181,6 +181,17 @@ ENV_VARS: dict = {
                        "(analysis/lockorder), cycles are potential "
                        "deadlocks, held time exports as "
                        "avdb_lock_held_seconds",
+    "AVDB_TRACE_SAMPLE": "fraction of requests recording per-stage span "
+                         "breakdowns into the span ring + "
+                         "avdb_stage_seconds (default 1.0; 0 disarms "
+                         "recording — trace ids still mint and echo)",
+    "AVDB_TRACE_SLOW_MS": "slow-request log threshold in ms: any request "
+                          "over it logs its full span breakdown (default "
+                          "0 = off)",
+    "AVDB_FLIGHT_EVENTS": "crash flight-recorder ring slots per worker "
+                          "(last-N request summaries + lifecycle events "
+                          "in an mmap'd file that survives SIGKILL; "
+                          "default 512, 0 disables)",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "

@@ -1,21 +1,30 @@
-"""Unified telemetry: metrics registry, host trace timeline, run ledger.
+"""Unified telemetry: metrics registry, host trace timeline, run ledger,
+request tracing, and the crash flight recorder.
 
-Three layers, one import surface:
+Five layers, one import surface:
 
 - :mod:`~annotatedvdb_tpu.obs.metrics` — thread-safe counters / gauges /
   fixed-bucket histograms with JSON-snapshot and Prometheus-textfile export
-  (``--metricsOut``);
+  (``--metricsOut``), plus the fleet snapshot merge (``?fleet=1``);
 - :mod:`~annotatedvdb_tpu.obs.trace` — Chrome trace-event host spans, one
   track per pipeline thread, Perfetto-mergeable with the ``jax.profiler``
   device trace (``--traceOut``);
-- :mod:`~annotatedvdb_tpu.obs.session` — the per-CLI lifecycle gluing both
-  to a load and appending the ``type: "run"`` ledger record.
+- :mod:`~annotatedvdb_tpu.obs.reqtrace` — request-scoped tracing: the
+  lock-free per-worker span ring, ``avdb_stage_seconds`` stage
+  histograms, the slow-request log, and the background-writer sink;
+- :mod:`~annotatedvdb_tpu.obs.flight` — the mmap'd crash flight recorder
+  (last-N request summaries + lifecycle events, SIGKILL-durable,
+  supervisor-harvested, ``doctor flight``);
+- :mod:`~annotatedvdb_tpu.obs.session` — the per-CLI lifecycle gluing
+  metrics+trace to a load and appending the ``type: "run"`` ledger
+  record.
 
 Backpressure gauges live with the queues themselves
 (:class:`annotatedvdb_tpu.utils.pipeline.BoundedStage` ``.stats``) and are
 exported through the session.
 """
 
+from annotatedvdb_tpu.obs.flight import FlightRecorder
 from annotatedvdb_tpu.obs.metrics import (
     CHUNK_ROW_EDGES,
     CHUNK_SECONDS_EDGES,
@@ -25,6 +34,7 @@ from annotatedvdb_tpu.obs.metrics import (
     LoadObserver,
     MetricsRegistry,
 )
+from annotatedvdb_tpu.obs.reqtrace import RequestTrace, TraceRecorder
 from annotatedvdb_tpu.obs.session import (
     ObsSession,
     add_obs_args,
@@ -37,11 +47,14 @@ __all__ = [
     "CHUNK_ROW_EDGES",
     "CHUNK_SECONDS_EDGES",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LoadObserver",
     "MetricsRegistry",
     "ObsSession",
+    "RequestTrace",
+    "TraceRecorder",
     "Tracer",
     "add_obs_args",
     "config_hash",

@@ -272,6 +272,94 @@ class MetricsRegistry:
         os.replace(tmp, path)
 
 
+def merge_snapshots(snaps: list) -> dict:
+    """Merge several registries' :meth:`MetricsRegistry.snapshot` dicts
+    into one fleet-wide view — the ``/metrics?fleet=1`` aggregation:
+
+    - **counters / histograms sum** (requests served by any worker are
+      requests served by the fleet; histogram counts add bucket-wise when
+      the edges agree, and a mismatched-edge series keeps the first
+      worker's view rather than inventing a hybrid);
+    - **gauges take the max** (queue depth, brownout level, resident
+      bytes: the fleet-level question is "how hot is the hottest
+      worker", and summing a level would be meaningless).
+    """
+    out: dict[str, list] = {}
+    index: dict[tuple, dict] = {}
+    for snap in snaps:
+        for name, entries in snap.items():
+            for e in entries:
+                key = (name, tuple(sorted((e.get("labels") or {}).items())))
+                have = index.get(key)
+                if have is None:
+                    have = index[key] = {
+                        "kind": e.get("kind"),
+                        "labels": dict(e.get("labels") or {}),
+                    }
+                    if e.get("kind") == "histogram":
+                        have["edges"] = list(e.get("edges") or [])
+                        have["counts"] = list(e.get("counts") or [])
+                        have["sum"] = float(e.get("sum") or 0.0)
+                        have["count"] = int(e.get("count") or 0)
+                    else:
+                        have["value"] = float(e.get("value") or 0.0)
+                    out.setdefault(name, []).append(have)
+                    continue
+                if have["kind"] != e.get("kind"):
+                    continue  # cross-worker kind clash: keep the first
+                if have["kind"] == "histogram":
+                    if list(e.get("edges") or []) != have["edges"]:
+                        continue
+                    counts = list(e.get("counts") or [])
+                    if len(counts) == len(have["counts"]):
+                        have["counts"] = [
+                            a + b for a, b in zip(have["counts"], counts)
+                        ]
+                    have["sum"] += float(e.get("sum") or 0.0)
+                    have["count"] += int(e.get("count") or 0)
+                elif have["kind"] == "counter":
+                    have["value"] += float(e.get("value") or 0.0)
+                else:  # gauge
+                    have["value"] = max(
+                        have["value"], float(e.get("value") or 0.0)
+                    )
+    return out
+
+
+def render_snapshot(snapshot: dict) -> str:
+    """Prometheus exposition text from a snapshot dict (the shape
+    :meth:`MetricsRegistry.snapshot` and :func:`merge_snapshots` emit) —
+    the fleet view renders from merged FILES, so rendering cannot go
+    through live metric objects."""
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        entries = snapshot[name]
+        if not entries:
+            continue
+        lines.append(f"# TYPE {name} {entries[0].get('kind')}")
+        for e in sorted(entries,
+                        key=lambda e: _label_str(e.get("labels"))):
+            labels = e.get("labels") or {}
+            if e.get("kind") == "histogram":
+                cum = 0
+                for edge, n in zip(e.get("edges") or [],
+                                   e.get("counts") or []):
+                    cum += n
+                    ls = _label_str(dict(labels, le=_fmt(edge)))
+                    lines.append(f"{name}_bucket{ls} {cum}")
+                ls = _label_str(dict(labels, le="+Inf"))
+                lines.append(f"{name}_bucket{ls} {e.get('count', 0)}")
+                ls = _label_str(labels)
+                lines.append(f"{name}_sum{ls} {_fmt(e.get('sum', 0.0))}")
+                lines.append(f"{name}_count{ls} {e.get('count', 0)}")
+            else:
+                lines.append(
+                    f"{name}{_label_str(labels)} "
+                    f"{_fmt(e.get('value', 0.0))}"
+                )
+    return "\n".join(lines) + "\n"
+
+
 class LoadObserver:
     """Chunk-granularity metrics adapter a loader carries as ``self.obs``.
 

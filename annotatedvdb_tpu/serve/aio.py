@@ -63,6 +63,7 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import unquote, urlparse
 
+from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
 from annotatedvdb_tpu.serve.batcher import QueueFull
 from annotatedvdb_tpu.serve.engine import (
@@ -82,14 +83,19 @@ from annotatedvdb_tpu.serve.http import (
     MSG_DEADLINE_ADMISSION,
     MSG_DEADLINE_EXECUTE,
     REGIONS_BODY_ERROR,
+    TRACE_HEADER,
     UPSERT_BODY_ERROR,
     UPSERT_ROUTE,
     ServeContext,
+    chaos_enabled_from_env,
+    debug_trace_payload,
     healthz_payload,
+    metrics_payload,
     parse_region_params,
     parse_regions_body,
     parse_upsert_body,
     readyz_payload,
+    resolve_trace_id,
     stats_payload,
 )
 from annotatedvdb_tpu.serve.fleet import HB_SLOT
@@ -168,6 +174,33 @@ def _error(status: int, message: str,
     return _resp(status, json.dumps({"error": message}), retry_after)
 
 
+_TRACE_HEADER_B = TRACE_HEADER.encode() + b": "
+
+
+def _add_trace(resp: bytes, trace_id: str | None) -> bytes:
+    """Splice the trace-id echo header into a fully-formed response —
+    one insertion after the status line, so every route's prebuilt bytes
+    gain the header without threading the id through ``_resp``'s thirty
+    call sites."""
+    if not trace_id:
+        return resp
+    i = resp.find(b"\r\n")
+    if i < 0:
+        return resp
+    return (resp[:i + 2] + _TRACE_HEADER_B + trace_id.encode("latin-1")
+            + b"\r\n" + resp[i + 2:])
+
+
+def _status_of(resp: bytes) -> int:
+    """The status code of a prebuilt response (``HTTP/1.1 NNN ...``) —
+    the writer finishes exec traces centrally, and the bytes already
+    know their status."""
+    try:
+        return int(resp[9:12])
+    except ValueError:
+        return 0
+
+
 class LoopBatcher:
     """Loop-native continuous batching: the asyncio twin of
     :class:`~annotatedvdb_tpu.serve.batcher.QueryBatcher`.
@@ -233,7 +266,8 @@ class LoopBatcher:
         return len(self._pending)
 
     def submit_future(self, variant_id: str,
-                      deadline_t: float | None = None) -> asyncio.Future:
+                      deadline_t: float | None = None,
+                      trace=None) -> asyncio.Future:
         """Enqueue one point query; returns the future of its JSON text
         (or None).  Admission/grammar contract of ``QueryBatcher``:
         ``QueueFull`` / ``QueryError`` raise synchronously.  A pending
@@ -250,7 +284,10 @@ class LoopBatcher:
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        self._pending.append((fut, variant_id, parsed, deadline_t))
+        self._pending.append((
+            fut, variant_id, parsed, deadline_t, trace,
+            time.perf_counter() if trace is not None else 0.0,
+        ))
         depth = len(self._pending)
         if depth > self._max_depth:
             self._max_depth = depth
@@ -289,7 +326,7 @@ class LoopBatcher:
         live = []
         shed = 0
         for item in batch:
-            fut, qid, _p, deadline_t = item
+            fut, qid, _p, deadline_t, _t, _e = item
             if deadline_t is not None and now >= deadline_t:
                 if not fut.done():
                     fut.set_exception(DeadlineExceeded(
@@ -304,6 +341,7 @@ class LoopBatcher:
         batch = live
         if not batch:
             return
+        t_exec = time.perf_counter()
         try:
             # crash point: the microbatch is assembled, nothing executed —
             # a failure here must fail exactly this batch's callers and
@@ -315,15 +353,21 @@ class LoopBatcher:
             )
             with span:
                 results = self.engine.lookup_many(
-                    [q for _f, q, _p, _d in batch],
-                    parsed=[p for _f, _q, p, _d in batch],
+                    [q for _f, q, _p, _d, _t, _e in batch],
+                    parsed=[p for _f, _q, p, _d, _t, _e in batch],
                 )
         except Exception as exc:
-            for fut, _q, _p, _d in batch:
+            for fut, _q, _p, _d, _t, _e in batch:
                 if not fut.done():
                     fut.set_exception(exc)
             return
-        for (fut, _q, _p, _d), result in zip(batch, results):
+        dt_device = time.perf_counter() - t_exec
+        for (fut, _q, _p, _d, trace, t_enq), result in zip(batch, results):
+            if trace is not None:
+                # queue-wait = enqueue -> drain; device = the microbatch's
+                # engine time, shared by every co-batched request
+                trace.add("queue", t_exec - t_enq)
+                trace.add("device", dt_device)
             if not fut.done():
                 fut.set_result(result)
         self._batches += 1
@@ -349,7 +393,7 @@ class LoopBatcher:
         loop has stopped (the futures' waiters are gone with it)."""
         self._closed = True
         pending, self._pending = self._pending, []
-        for fut, _q, _p, _d in pending:
+        for fut, _q, _p, _d, _t, _e in pending:
             try:
                 if not fut.done():
                     fut.cancel()
@@ -573,8 +617,21 @@ class AioServer:
                 self._hb_mm = None
         #: runtime fault arming (POST /_chaos) for the chaos harness —
         #: gated hard on the environment so the route does not exist on
-        #: a production server (404, byte-identical to any unknown route)
-        self._chaos_enabled = os.environ.get("AVDB_SERVE_CHAOS", "") == "1"
+        #: a production server (404, byte-identical to any unknown
+        #: route); resolved through the ONE shared reader (the AVDB802
+        #: contract — /debug/trace shares the same gate)
+        self._chaos_enabled = chaos_enabled_from_env()
+        #: fleet telemetry publishing: the maintenance tick schedules a
+        #: snapshot-file write (on the POOL — the loop never does file
+        #: I/O) so any sibling's /metrics?fleet=1 can sum this worker in
+        self._telemetry_last = 0.0
+        self._telemetry_inflight = False
+        self._telemetry_error_logged = False
+        #: flight flushes run from the tick on the POOL, never inline on
+        #: the loop (the whole point of buffering the request summaries)
+        self._flight_flush_inflight = False
+        if ctx.flight is not None:
+            ctx.flight_flush_inline = False
         #: arming generation: each /_chaos arm bumps it so a stale ttl
         #: timer can never disarm a NEWER arming's fault
         self._chaos_seq = 0
@@ -752,10 +809,74 @@ class AioServer:
                 # memtable age/size flush triggers (the flush itself runs
                 # on its own thread; this is one lock + compare)
                 self.ctx.maybe_flush_memtable()
+            with contextlib.suppress(Exception):
+                self._maybe_publish_telemetry()
+            with contextlib.suppress(Exception):
+                self._maybe_flush_flight()
         finally:
             # the next tick is unconditional: whatever one pass hit, the
             # heartbeat/brownout machinery must keep running
             self._loop.call_later(self.TICK_S, self._tick)
+
+    #: seconds between fleet-telemetry snapshot publishes
+    TELEMETRY_S = 1.0
+
+    def _maybe_publish_telemetry(self) -> None:
+        """Time-gated, one in flight: schedule this worker's metric
+        snapshot write onto the executor pool (the tick runs ON the
+        loop, where file I/O is banned)."""
+        tdir = self.ctx.telemetry_dir
+        if tdir is None or self._telemetry_inflight:
+            return
+        now = time.monotonic()
+        if now - self._telemetry_last < self.TELEMETRY_S:
+            return
+        self._telemetry_last = now
+        self._telemetry_inflight = True
+        fut = self._pool.submit(self._publish_telemetry)
+        fut.add_done_callback(
+            lambda _f: setattr(self, "_telemetry_inflight", False)
+        )
+
+    def _maybe_flush_flight(self) -> None:
+        """Drain the flight recorder's buffered request summaries on the
+        executor pool (one in flight at a time; the tick itself only
+        schedules)."""
+        flight = self.ctx.flight
+        if flight is None or self._flight_flush_inflight:
+            return
+        self._flight_flush_inflight = True
+
+        def run():
+            try:
+                flight.flush(limit=flight.FLUSH_BATCH)
+            finally:
+                self._flight_flush_inflight = False
+
+        self._pool.submit(run)
+
+    def _publish_telemetry(self) -> None:
+        """Pool half: atomically replace this worker's snapshot file —
+        a sibling scraping ``?fleet=1`` must never read a torn JSON."""
+        try:
+            path = os.path.join(
+                self.ctx.telemetry_dir,
+                f"worker-{self.ctx.worker_index}.json",
+            )
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({
+                    "index": self.ctx.worker_index,
+                    "pid": os.getpid(),
+                    "t": time.time(),
+                    "metrics": self.ctx.registry.snapshot(),
+                }, f)
+            os.replace(tmp, path)
+        except (OSError, ValueError, TypeError) as err:
+            if not self._telemetry_error_logged:
+                self._telemetry_error_logged = True
+                self.ctx.log(f"telemetry publish failed ({err}); "
+                             "fleet view will miss this worker")
 
     # -- connection handling ------------------------------------------------
 
@@ -897,11 +1018,13 @@ class AioServer:
             return
         kind = item[0]
         if kind == "point":
-            _k, fut, t0, vid, generation = item
-            out += await self._finish_point(fut, t0, vid, generation)
+            _k, fut, t0, vid, generation, tid, trace = item
+            out += await self._finish_point(fut, t0, vid, generation,
+                                            tid, trace)
             return
-        # ("exec", future, kind, t0): buffered bytes or a stream marker
-        _k, fut, qkind, t0 = item
+        # ("exec", future, kind, t0, tid, trace): buffered bytes or a
+        # stream marker
+        _k, fut, qkind, t0, tid, trace = item
         try:
             result = await fut
         except asyncio.CancelledError:
@@ -911,16 +1034,20 @@ class AioServer:
             self._settle_when_done(fut)
             raise
         if isinstance(result, bytes):
-            out += result
+            # the exec trace seals HERE, centrally: the bytes already
+            # know their status, so the work functions never fork on it
+            self.ctx.reqtrace.finish(trace, _status_of(result))
+            out += _add_trace(result, tid)
             return
         page = result[1]  # RegionPage or RegionsResult: same stream surface
         try:
             if out:  # ordering: everything before the stream goes first
                 writer.write(bytes(out))
                 out.clear()
-            await self._stream_region(writer, page)
+            await self._stream_region(writer, page, tid)
             self.ctx.observe(qkind, time.perf_counter() - t0,
                              rows=page.returned)
+            self.ctx.reqtrace.finish(trace, 200)
         finally:
             self.ctx.release()
 
@@ -941,6 +1068,8 @@ class AioServer:
             raise
         except Exception:
             return
+        # seal the abandoned request's trace (status 0 = undelivered)
+        self.ctx.reqtrace.finish(item[-1], 0)
         if not isinstance(result, bytes) and item[0] == "exec":
             self.ctx.release()  # undelivered stream: free its slot
 
@@ -953,8 +1082,8 @@ class AioServer:
                     self.ctx.release()
         fut.add_done_callback(settle)
 
-    async def _finish_point(self, fut, t0, vid: str,
-                            generation: int) -> bytes:
+    async def _finish_point(self, fut, t0, vid: str, generation: int,
+                            tid: str | None = None, trace=None) -> bytes:
         ctx = self.ctx
         try:
             # no wait_for wrapper (it costs a Task + timer per request):
@@ -964,16 +1093,28 @@ class AioServer:
             record = await fut
         except DeadlineExceeded as err:
             # the batcher shed it (and counted stage="batcher")
-            return _error(504, str(err))
+            ctx.reqtrace.finish(trace, 504)
+            return _add_trace(_error(504, str(err)), tid)
         except Exception as err:
             ctx.errored("point")
-            return _error(500, f"{type(err).__name__}: {err}")
+            ctx.reqtrace.finish(trace, 500)
+            return _add_trace(
+                _error(500, f"{type(err).__name__}: {err}"), tid
+            )
+        t_render = time.perf_counter()
         ctx.remember_point(generation, vid, record)
         if record is None:
             ctx.observe("point", time.perf_counter() - t0)
-            return _error(404, f"variant {vid!r} not in store")
+            ctx.reqtrace.finish(trace, 404)
+            return _add_trace(
+                _error(404, f"variant {vid!r} not in store"), tid
+            )
+        resp = _resp(200, record)
         ctx.observe("point", time.perf_counter() - t0, rows=1)
-        return _resp(200, record)
+        if trace is not None:
+            trace.add("render", time.perf_counter() - t_render)
+        ctx.reqtrace.finish(trace, 200)
+        return _add_trace(resp, tid)
 
     # -- routing ------------------------------------------------------------
 
@@ -1003,11 +1144,22 @@ class AioServer:
         return method, target, keep, http11, headers
 
     async def _route(self, reader, writer, head: bytes):
-        """One parsed request -> (queue item | None, keep_alive)."""
+        """One parsed request -> (queue item | None, keep_alive).  The
+        trace-id echo header splices into prebuilt byte responses HERE
+        (one insertion point); deferred items (point/exec tuples) carry
+        the id and the writer splices when their bytes materialize."""
+        item, keep, tid = await self._route_inner(reader, writer, head)
+        if isinstance(item, bytes):
+            item = _add_trace(item, tid)
+        return item, keep
+
+    async def _route_inner(self, reader, writer, head: bytes):
+        """The routing body: returns ``(item, keep_alive, trace_id)``."""
         ctx = self.ctx
         # fast path: the dominant serving request is a plain point GET on
         # a keep-alive connection; skip the full head parse for it (the
-        # governor, when on, needs headers — it takes the slow path)
+        # governor, when on, needs headers — it takes the slow path; so
+        # does a client-sent trace id, which must echo byte-identically)
         if self.governor is None and head.startswith(b"GET /variant/"):
             eol = head.find(b"\r\n")
             line = head[:eol]
@@ -1018,18 +1170,24 @@ class AioServer:
             # a client-sent deadline header likewise needs the real parse
             if line.endswith(b" HTTP/1.1") and b"?" not in line \
                     and b"connection:" not in hlow \
-                    and b"x-deadline-ms:" not in hlow:
+                    and b"x-deadline-ms:" not in hlow \
+                    and b"x-request-id:" not in hlow \
+                    and b"traceparent:" not in hlow:
                 vid = line[13:-9].decode("latin-1")
                 if "%" in vid:
                     vid = unquote(vid)
                 self._maybe_refresh_snapshot()
+                tid = resolve_trace_id(None, None)
                 return self._point_item(
-                    vid, self._default_deadline()
-                ), True
+                    vid, self._default_deadline(), tid
+                ), True, tid
         try:
             method, target, keep, http11, headers = self._parse_head(head)
         except ValueError as err:
-            return _error(400, str(err)), False
+            return _error(400, str(err)), False, None
+        tid = resolve_trace_id(
+            headers.get("traceparent"), headers.get("x-request-id")
+        )
         url = urlparse(target)
         path = unquote(url.path)
         self._maybe_refresh_snapshot()
@@ -1042,34 +1200,50 @@ class AioServer:
                     return _error(
                         429, "client over rate (point admission)",
                         retry_after=max(int(retry + 0.999), 1),
-                    ), keep
+                    ), keep, tid
                 return self._point_item(
-                    path[len("/variant/"):], deadline_t
-                ), keep
+                    path[len("/variant/"):], deadline_t, tid
+                ), keep, tid
             if path.startswith("/region/"):
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, MSG_BROWNOUT_REGION), keep
+                    return _error(503, MSG_BROWNOUT_REGION), keep, tid
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("region")
                     return _error(
                         429, "client over rate (region admission)",
                         retry_after=max(int(retry + 0.999), 1),
-                    ), keep
-                return self._region_item(path[len("/region/"):],
-                                         url.query, http11, deadline_t), keep
+                    ), keep, tid
+                return self._region_item(
+                    path[len("/region/"):], url.query, http11,
+                    deadline_t, tid,
+                ), keep, tid
             if path == "/healthz":
-                return _resp(200, healthz_payload(ctx)), keep
+                return _resp(200, healthz_payload(ctx)), keep, tid
             if path == "/readyz":
                 status, body = readyz_payload(ctx)
-                return _resp(status, body), keep
+                return _resp(status, body), keep, tid
             if path == "/metrics":
-                return _resp(200, ctx.registry.render_prometheus(),
-                             content_type=_CT_TEXT), keep
+                if "fleet" in (url.query or ""):
+                    # the fleet view reads sibling snapshot FILES — that
+                    # is executor work, never event-loop work
+                    fut = self._loop.run_in_executor(
+                        self._pool,
+                        lambda: _resp(200, metrics_payload(ctx, url.query),
+                                      content_type=_CT_TEXT),
+                    )
+                    return ("exec", fut, "metrics", time.perf_counter(),
+                            tid, None), keep, tid
+                return _resp(200, metrics_payload(ctx, url.query),
+                             content_type=_CT_TEXT), keep, tid
             if path == "/stats":
-                return _resp(200, stats_payload(ctx)), keep
-            return _error(404, f"no such route: {path}"), keep
+                return _resp(200, stats_payload(ctx)), keep, tid
+            if path == "/debug/trace" and ctx.debug_trace_enabled:
+                # chaos-gated like /_chaos: a production server 404s this
+                # byte-identically to any unknown route
+                return _resp(200, debug_trace_payload(ctx)), keep, tid
+            return _error(404, f"no such route: {path}"), keep, tid
         if method == "POST":
             try:
                 length = int(headers.get("content-length", 0))
@@ -1080,78 +1254,80 @@ class AioServer:
                 # the connection cannot be reused
                 if path == "/variants":
                     ctx.errored("bulk")
-                    return _error(400, BULK_BODY_ERROR), False
+                    return _error(400, BULK_BODY_ERROR), False, tid
                 if path == UPSERT_ROUTE:
                     ctx.errored("upsert")
-                    return _error(400, UPSERT_BODY_ERROR), False
+                    return _error(400, UPSERT_BODY_ERROR), False, tid
                 if path == "/regions":
                     ctx.errored("regions")
-                    return _error(400, REGIONS_BODY_ERROR), False
-                return _error(404, f"no such route: {path}"), False
+                    return _error(400, REGIONS_BODY_ERROR), False, tid
+                return _error(404, f"no such route: {path}"), False, tid
             if length < 0 or length > MAX_BODY:
                 return _error(
                     413, f"body too large (cap {MAX_BODY} bytes)"
-                ), False
+                ), False, tid
             try:
                 body = await reader.readexactly(length) if length else b""
             except asyncio.IncompleteReadError:
-                return None, False
+                return None, False, None
             if path == "/variants":
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, MSG_BROWNOUT_BULK), keep
+                    return _error(503, MSG_BROWNOUT_BULK), keep, tid
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("bulk")
                     return _error(
                         429, "client over rate (bulk admission)",
                         retry_after=max(int(retry + 0.999), 1),
-                    ), keep
+                    ), keep, tid
                 client = max_ids = None
                 if self.governor is not None:
                     client, weight = self._client_key(headers, writer)
                     max_ids = self.governor.bulk_budget(weight)
-                return self._bulk_item(body, client, max_ids, deadline_t), keep
+                return self._bulk_item(
+                    body, client, max_ids, deadline_t, tid
+                ), keep, tid
             if path == UPSERT_ROUTE:
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, MSG_BROWNOUT_UPSERT), keep
+                    return _error(503, MSG_BROWNOUT_UPSERT), keep, tid
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("upsert")
                     return _error(
                         429, "client over rate (upsert admission)",
                         retry_after=max(int(retry + 0.999), 1),
-                    ), keep
+                    ), keep, tid
                 client = max_ids = None
                 if self.governor is not None:
                     client, weight = self._client_key(headers, writer)
                     max_ids = self.governor.bulk_budget(weight)
                 return self._upsert_item(
-                    body, client, max_ids, deadline_t
-                ), keep
+                    body, client, max_ids, deadline_t, tid
+                ), keep, tid
             if path == "/regions":
                 if ctx.governor.shed_bulk():
                     ctx.brownout_shed()
-                    return _error(503, MSG_BROWNOUT_REGION), keep
+                    return _error(503, MSG_BROWNOUT_REGION), keep, tid
                 retry = self._admit_client(headers, writer)
                 if retry:
                     ctx.rejected("regions")
                     return _error(
                         429, "client over rate (region admission)",
                         retry_after=max(int(retry + 0.999), 1),
-                    ), keep
+                    ), keep, tid
                 client = max_ids = None
                 if self.governor is not None:
                     client, weight = self._client_key(headers, writer)
                     max_ids = self.governor.bulk_budget(weight)
                 return self._regions_item(
-                    body, http11, client, max_ids, deadline_t
-                ), keep
+                    body, http11, client, max_ids, deadline_t, tid
+                ), keep, tid
             if path == "/_chaos" and self._chaos_enabled:
-                return self._chaos_item(body), keep
-            return _error(404, f"no such route: {path}"), keep
-        return _error(501, f"method {method} not supported"), False
+                return self._chaos_item(body), keep, tid
+            return _error(404, f"no such route: {path}"), keep, tid
+        return _error(501, f"method {method} not supported"), False, tid
 
     def _default_deadline(self) -> float | None:
         """Absolute deadline from the configured default budget alone
@@ -1160,23 +1336,31 @@ class AioServer:
         d = self.ctx.default_deadline_s
         return time.monotonic() + d if d > 0 else None
 
-    def _point_item(self, variant_id: str, deadline_t: float | None = None):
+    def _point_item(self, variant_id: str, deadline_t: float | None = None,
+                    tid: str | None = None):
         ctx = self.ctx
         t0 = time.perf_counter()
+        trace = ctx.reqtrace.begin(tid, "point") if tid is not None else None
         action, payload = ctx.point_preflight(variant_id, deadline_t)
         if action == "shed":
+            ctx.reqtrace.finish(trace, 504)
             return _error(504, MSG_DEADLINE_ADMISSION)
         if action == "cached":
             if payload is None:
                 ctx.observe("point", time.perf_counter() - t0)
+                ctx.reqtrace.finish(trace, 404)
                 return _error(404, f"variant {variant_id!r} not in store")
             ctx.observe("point", time.perf_counter() - t0, rows=1)
+            ctx.reqtrace.finish(trace, 200)
             return _resp(200, payload)
         generation = payload
+        if trace is not None:
+            trace.add("admission", time.perf_counter() - t0)
         try:
             if self._loop_batcher:
                 # loop-native coalescing: no cross-thread handoffs
-                fut = ctx.batcher.submit_future(variant_id, deadline_t)
+                fut = ctx.batcher.submit_future(variant_id, deadline_t,
+                                                trace=trace)
             else:
                 # thread-based batcher: completions cross back through
                 # the (drain-batched) bridge
@@ -1188,18 +1372,21 @@ class AioServer:
 
                 ctx.batcher.submit_nowait(
                     variant_id, on_done, want_event=False,
-                    deadline_t=deadline_t,
+                    deadline_t=deadline_t, trace=trace,
                 )
         except QueueFull as err:
             ctx.rejected("point")
+            ctx.reqtrace.finish(trace, 429)
             return _error(429, str(err), retry_after=1)
         except QueryError as err:
             ctx.errored("point")
+            ctx.reqtrace.finish(trace, 400)
             return _error(400, str(err))
         except Exception as err:
             ctx.errored("point")
+            ctx.reqtrace.finish(trace, 500)
             return _error(500, f"{type(err).__name__}: {err}")
-        return ("point", fut, t0, variant_id, generation)
+        return ("point", fut, t0, variant_id, generation, tid, trace)
 
     def _chaos_item(self, body: bytes) -> bytes:
         """Runtime fault arming (``AVDB_SERVE_CHAOS=1`` only): the chaos
@@ -1238,7 +1425,8 @@ class AioServer:
 
     def _bulk_item(self, body: bytes, client: str | None = None,
                    max_ids: int | None = None,
-                   deadline_t: float | None = None):
+                   deadline_t: float | None = None,
+                   tid: str | None = None):
         ctx = self.ctx
         t0 = time.perf_counter()
         if deadline_t is not None and time.monotonic() >= deadline_t:
@@ -1247,16 +1435,17 @@ class AioServer:
         if not ctx.admit():
             ctx.rejected("bulk")
             return _error(429, MSG_CAPACITY_BULK, retry_after=1)
+        trace = ctx.reqtrace.begin(tid, "bulk") if tid is not None else None
         fut = self._loop.run_in_executor(
             self._pool, self._bulk_work, body, t0, client, max_ids,
-            deadline_t
+            deadline_t, trace
         )
-        return ("exec", fut, "bulk", t0)
+        return ("exec", fut, "bulk", t0, tid, trace)
 
     def _bulk_work(self, body: bytes, t0: float,
                    client: str | None = None,
                    max_ids: int | None = None,
-                   deadline_t: float | None = None) -> bytes:
+                   deadline_t: float | None = None, trace=None) -> bytes:
         """Executor half of a bulk request (parse, probe, render, account);
         never raises — errors become response bytes."""
         ctx = self.ctx
@@ -1265,6 +1454,10 @@ class AioServer:
                 # executor-queue lag ate the budget: shed BEFORE the probe
                 ctx.deadline_shed("execute")
                 return _error(504, MSG_DEADLINE_EXECUTE)
+            if trace is not None:
+                # admission = arrival -> this executor slot (pool wait
+                # included: that IS where an overloaded worker queues)
+                trace.add("admission", time.perf_counter() - t0)
             try:
                 parsed = json.loads(body or b"{}")
                 ids = parsed["ids"]
@@ -1292,26 +1485,35 @@ class AioServer:
                     self.governor.charge, client, float(len(ids) - 1)
                 )
             try:
-                results = ctx.engine.lookup_many(ids)
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    results = ctx.engine.lookup_many(ids)
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
             except QueryError as err:
                 ctx.errored("bulk")
                 return _error(400, str(err))
             except Exception as err:
                 ctx.errored("bulk")
                 return _error(500, f"{type(err).__name__}: {err}")
+            t_render = time.perf_counter()
             found = sum(1 for r in results if r is not None)
-            ctx.observe("bulk", time.perf_counter() - t0, rows=found)
-            return _resp(200, (
+            resp = _resp(200, (
                 f'{{"n":{len(results)},"found":{found},"results":['
                 + ",".join(r if r is not None else "null" for r in results)
                 + "]}"
             ))
+            ctx.observe("bulk", time.perf_counter() - t0, rows=found)
+            if trace is not None:
+                trace.add("render", time.perf_counter() - t_render)
+            return resp
         finally:
             ctx.release()
 
     def _upsert_item(self, body: bytes, client: str | None = None,
                      max_rows: int | None = None,
-                     deadline_t: float | None = None):
+                     deadline_t: float | None = None,
+                     tid: str | None = None):
         """Live write path: the bulk admission shape (slot + per-client
         budget); the WAL fsync runs on the executor pool — the ack
         barrier is blocking I/O and must never touch the event loop."""
@@ -1323,16 +1525,18 @@ class AioServer:
         if not ctx.admit():
             ctx.rejected("upsert")
             return _error(429, MSG_CAPACITY_UPSERT, retry_after=1)
+        trace = ctx.reqtrace.begin(tid, "upsert") if tid is not None \
+            else None
         fut = self._loop.run_in_executor(
             self._pool, self._upsert_work, body, t0, client, max_rows,
-            deadline_t
+            deadline_t, trace
         )
-        return ("exec", fut, "upsert", t0)
+        return ("exec", fut, "upsert", t0, tid, trace)
 
     def _upsert_work(self, body: bytes, t0: float,
                      client: str | None = None,
                      max_rows: int | None = None,
-                     deadline_t: float | None = None) -> bytes:
+                     deadline_t: float | None = None, trace=None) -> bytes:
         """Executor half of an upsert (parse, WAL append+fsync, memtable
         insert, ack) — the shared :meth:`ServeContext.upsert_execute`
         does the work; never raises — errors become response bytes."""
@@ -1343,7 +1547,10 @@ class AioServer:
                 # write (nothing durable happened, nothing acknowledged)
                 ctx.deadline_shed("execute")
                 return _error(504, MSG_DEADLINE_EXECUTE)
-            status, text, rows = ctx.upsert_execute(body, max_rows=max_rows)
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
+            status, text, rows = ctx.upsert_execute(body, max_rows=max_rows,
+                                                    trace=trace)
             if client is not None and rows > 1 and status == 200:
                 # admission spent ONE token; the other rows debit the
                 # bucket too (on the loop thread — the governor is
@@ -1365,7 +1572,8 @@ class AioServer:
 
     def _regions_item(self, body: bytes, http11: bool = True,
                       client: str | None = None, max_ids: int | None = None,
-                      deadline_t: float | None = None):
+                      deadline_t: float | None = None,
+                      tid: str | None = None):
         """Batch region join: the bulk admission shape (slot + per-client
         budget) with the region streaming shape (a panel whose total row
         count exceeds the threshold streams chunked)."""
@@ -1377,16 +1585,18 @@ class AioServer:
         if not ctx.admit():
             ctx.rejected("regions")
             return _error(429, MSG_CAPACITY_REGION, retry_after=1)
+        trace = ctx.reqtrace.begin(tid, "regions") if tid is not None \
+            else None
         fut = self._loop.run_in_executor(
             self._pool, self._regions_work, body, t0, http11, client,
-            max_ids, deadline_t
+            max_ids, deadline_t, trace
         )
-        return ("exec", fut, "regions", t0)
+        return ("exec", fut, "regions", t0, tid, trace)
 
     def _regions_work(self, body: bytes, t0: float, http11: bool = True,
                       client: str | None = None,
                       max_ids: int | None = None,
-                      deadline_t: float | None = None):
+                      deadline_t: float | None = None, trace=None):
         """Executor half of a batch-region request.  Returns response
         bytes, or ``("stream", RegionsResult)`` for a panel whose total
         rendered rows exceed the stream threshold — the writer streams
@@ -1399,6 +1609,8 @@ class AioServer:
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 ctx.deadline_shed("execute")
                 return _error(504, MSG_DEADLINE_EXECUTE)
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
             try:
                 specs, min_cadd, max_rank, limit, tokenize = \
                     parse_regions_body(body)
@@ -1426,13 +1638,17 @@ class AioServer:
                 if cap is not None:
                     # brownout level >= 1: bound per-interval render work
                     limit = min(limit, cap)
-                result = ctx.engine.regions_serve(
-                    specs,
-                    min_cadd=min_cadd,
-                    max_conseq_rank=max_rank,
-                    limit=limit,
-                    tokenize=tokenize,
-                )
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    result = ctx.engine.regions_serve(
+                        specs,
+                        min_cadd=min_cadd,
+                        max_conseq_rank=max_rank,
+                        limit=limit,
+                        tokenize=tokenize,
+                    )
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
             except QueryError as err:
                 ctx.errored("regions")
                 return _error(400, str(err))
@@ -1442,15 +1658,20 @@ class AioServer:
             if http11 and result.returned > self.stream_threshold:
                 stream_holds_slot = True
                 return ("stream", result)  # the writer releases that slot
+            t_render = time.perf_counter()
+            resp = _resp(200, result.assemble())
             ctx.observe("regions", time.perf_counter() - t0,
                         rows=result.returned)
-            return _resp(200, result.assemble())
+            if trace is not None:
+                trace.add("render", time.perf_counter() - t_render)
+            return resp
         finally:
             if not stream_holds_slot:
                 ctx.release()
 
     def _region_item(self, spec: str, query: str, http11: bool = True,
-                     deadline_t: float | None = None):
+                     deadline_t: float | None = None,
+                     tid: str | None = None):
         ctx = self.ctx
         t0 = time.perf_counter()
         if deadline_t is not None and time.monotonic() >= deadline_t:
@@ -1459,15 +1680,17 @@ class AioServer:
         if not ctx.admit():
             ctx.rejected("region")
             return _error(429, MSG_CAPACITY_REGION, retry_after=1)
+        trace = ctx.reqtrace.begin(tid, "region") if tid is not None \
+            else None
         fut = self._loop.run_in_executor(
             self._pool, self._region_work, spec, query, t0, http11,
-            deadline_t
+            deadline_t, trace
         )
-        return ("exec", fut, "region", t0)
+        return ("exec", fut, "region", t0, tid, trace)
 
     def _region_work(self, spec: str, query: str, t0: float,
                      http11: bool = True,
-                     deadline_t: float | None = None):
+                     deadline_t: float | None = None, trace=None):
         """Executor half of a region request.  Returns response bytes, or
         ``("stream", page)`` — the writer task then streams it chunked and
         releases the admission slot when the body is done.  A non-1.1
@@ -1480,6 +1703,8 @@ class AioServer:
             if deadline_t is not None and time.monotonic() >= deadline_t:
                 ctx.deadline_shed("execute")
                 return _error(504, MSG_DEADLINE_EXECUTE)
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
             try:
                 min_cadd, max_rank, limit, cursor = \
                     parse_region_params(query)
@@ -1487,16 +1712,20 @@ class AioServer:
                 if cap is not None:
                     # brownout level >= 1: bound per-request render work
                     limit = min(limit, cap)
-                kind, payload = ctx.engine.region_serve(
-                    spec,
-                    min_cadd=min_cadd,
-                    max_conseq_rank=max_rank,
-                    limit=limit,
-                    cursor=cursor,
-                    stream_threshold=(
-                        self.stream_threshold if http11 else None
-                    ),
-                )
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    kind, payload = ctx.engine.region_serve(
+                        spec,
+                        min_cadd=min_cadd,
+                        max_conseq_rank=max_rank,
+                        limit=limit,
+                        cursor=cursor,
+                        stream_threshold=(
+                            self.stream_threshold if http11 else None
+                        ),
+                    )
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
             except QueryError as err:
                 ctx.errored("region")
                 return _error(400, str(err))
@@ -1554,7 +1783,8 @@ class AioServer:
 
     # -- streaming ----------------------------------------------------------
 
-    async def _stream_region(self, writer, page) -> None:
+    async def _stream_region(self, writer, page,
+                             trace_id: str | None = None) -> None:
         """Chunked transfer of one RegionPage — or one RegionsResult,
         whose "rows" are whole per-interval envelopes (same
         prefix/rows/suffix surface): prefix, rows in
@@ -1568,8 +1798,11 @@ class AioServer:
         ``"truncated": true`` trailer field, and emit the terminating
         0-chunk — so the client holds valid JSON that SAYS it is partial
         instead of a connection reset it must guess about."""
+        head = _STATUS[200]
+        if trace_id:
+            head += _TRACE_HEADER_B + trace_id.encode("latin-1") + b"\r\n"
         writer.write(
-            _STATUS[200]
+            head
             + b"Content-Type: application/json\r\n"
             + b"Transfer-Encoding: chunked\r\n\r\n"
         )
@@ -1641,7 +1874,8 @@ def build_aio_server(store_dir: str | None = None, manager=None,
                      stream_threshold: int | None = None,
                      heartbeat_file: str | None = None,
                      heartbeat_index: int = 0,
-                     tracer=None, log=None) -> AioServer:
+                     tracer=None, log=None, flight=None,
+                     telemetry_dir: str | None = None) -> AioServer:
     """Wire manager -> engine -> batcher -> event-loop server (not yet
     serving; call ``serve_forever`` or ``start_background``).  The caller
     owns shutdown order: ``server.shutdown()`` then
@@ -1670,7 +1904,9 @@ def build_aio_server(store_dir: str | None = None, manager=None,
         max_queue=max_queue, tracer=tracer, registry=registry,
     )
     ctx = ServeContext(manager, engine, batcher, registry,
-                       memtable=memtable, log=log)
+                       memtable=memtable, log=log, flight=flight,
+                       telemetry_dir=telemetry_dir, tracer=tracer,
+                       worker_index=heartbeat_index)
     return AioServer(
         ctx, host=host, port=port, sock=sock, client_rate=client_rate,
         stream_threshold=stream_threshold,

@@ -105,10 +105,11 @@ class _Pending:
     bound: the drain sheds already-dead pendings before device work."""
 
     __slots__ = ("qid", "parsed", "result", "error", "done", "callback",
-                 "deadline_t")
+                 "deadline_t", "trace", "t_enq")
 
     def __init__(self, qid: str, parsed=None, callback=None,
-                 want_event: bool = True, deadline_t: float | None = None):
+                 want_event: bool = True, deadline_t: float | None = None,
+                 trace=None):
         self.qid = qid
         self.parsed = parsed  # submit-time parse, reused by the drain
         self.result = None
@@ -118,6 +119,11 @@ class _Pending:
         self.done = threading.Event() if want_event else None
         self.callback = callback
         self.deadline_t = deadline_t
+        #: request-trace scratchpad (obs/reqtrace.py) — the drain
+        #: attributes queue-wait and device time to it; None when the
+        #: request is unsampled (zero tracing work downstream)
+        self.trace = trace
+        self.t_enq = time.perf_counter() if trace is not None else 0.0
 
     def finish(self) -> None:
         """Publish the filled result/error to the waiter."""
@@ -182,7 +188,8 @@ class QueryBatcher:
         """Pending (undrained) queries — the admission gauge."""
         return self._q.qsize()
 
-    def submit(self, variant_id: str, deadline_t: float | None = None):
+    def submit(self, variant_id: str, deadline_t: float | None = None,
+               trace=None):
         """Enqueue one point query and block for its result (JSON text or
         None).  Raises :class:`QueueFull` at the admission bound,
         :class:`~annotatedvdb_tpu.serve.engine.QueryError` on bad grammar
@@ -191,7 +198,8 @@ class QueryBatcher:
         the request's budget lapses (the drain sheds the queued pending —
         its admission slot releases — and this caller stops waiting), or
         the drain's root cause."""
-        pending = self.submit_nowait(variant_id, deadline_t=deadline_t)
+        pending = self.submit_nowait(variant_id, deadline_t=deadline_t,
+                                     trace=trace)
         wait_s = self.timeout_s
         if deadline_t is not None:
             wait_s = min(wait_s, max(deadline_t - time.monotonic(), 0.0))
@@ -213,7 +221,8 @@ class QueryBatcher:
 
     def submit_nowait(self, variant_id: str, callback=None,
                       want_event: bool = True,
-                      deadline_t: float | None = None) -> _Pending:
+                      deadline_t: float | None = None,
+                      trace=None) -> _Pending:
         """Enqueue one point query WITHOUT blocking for the result: the
         admission/grammar contract of :meth:`submit` applies synchronously
         (``QueueFull`` / ``QueryError`` raise here, in the caller), then
@@ -234,7 +243,7 @@ class QueryBatcher:
                 f"serve queue full ({self.max_queue} pending queries)"
             )
         pending = _Pending(variant_id, parsed, callback, want_event,
-                           deadline_t)
+                           deadline_t, trace)
         self._q.put(pending)
         return pending
 
@@ -295,6 +304,7 @@ class QueryBatcher:
         batch = self._shed_expired(batch)
         if not batch:
             return
+        t_exec = time.perf_counter()
         try:
             # crash point: the microbatch is assembled, nothing executed —
             # a failure here must fail exactly this batch's callers and
@@ -314,7 +324,14 @@ class QueryBatcher:
                 pending.error = exc
                 pending.finish()
             return
+        dt_device = time.perf_counter() - t_exec
         for pending, result in zip(batch, results):
+            if pending.trace is not None:
+                # queue-wait = enqueue -> drain execution; device = the
+                # whole microbatch's engine time (co-batched requests
+                # share the span, the continuous-batching reality)
+                pending.trace.add("queue", t_exec - pending.t_enq)
+                pending.trace.add("device", dt_device)
             pending.result = result
             pending.finish()
         with self._lock:

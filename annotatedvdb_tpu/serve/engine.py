@@ -56,11 +56,13 @@ import json
 import os
 import re
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
 
 from annotatedvdb_tpu.loaders.lookup import identity_hashes
+from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.ops import intervals as interval_ops
 from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
 from annotatedvdb_tpu.oracle.binindex import closed_form_path
@@ -908,6 +910,7 @@ class QueryEngine:
                 lambda code: self._interval_index(snap, code),
             )
         for code, idxs in by_code.items():
+            t_group = time.perf_counter()
             index = indexes[code] = self._interval_index(snap, code)
             if index is None:
                 level[idxs], leaf[idxs] = interval_ops.bin_tokens_host(
@@ -926,6 +929,14 @@ class QueryEngine:
                 )
             lo[idxs], hi[idxs] = g_lo, g_hi
             level[idxs], leaf[idxs] = g_level, g_leaf
+            # per-group sub-span onto the request's trace (no-op outside
+            # an active trace): a panel's every interval shares the
+            # request's trace id, and the group split is where device
+            # time actually goes
+            reqtrace.span_active(
+                f"regions.chr{chromosome_label(code)}",
+                time.perf_counter() - t_group,
+            )
         no_filters = min_cadd is None and max_conseq_rank is None
         pages = []
         for i, (code, start, end) in enumerate(parsed):
@@ -997,6 +1008,7 @@ class QueryEngine:
         label = chromosome_label(code)
         level, leaf = _region_bin(start, end)
         shard = snap.store.shards.get(code)
+        t_page = time.perf_counter()
         paged = cursor is not None
         wkey = hit = None
         if paged:
@@ -1063,6 +1075,10 @@ class QueryEngine:
             # forever)
             if stop < total and stop > offset:
                 next_token = encode_cursor(snap.generation, stop, ckey)
+            # page sub-span: every page of a cursor walk attributes its
+            # scan to the walking request's trace id (no-op untraced)
+            reqtrace.span_active(f"region.chr{label}",
+                                 time.perf_counter() - t_page)
             return RegionPage(
                 shard, label, level, closed_form_path(label, level, leaf),
                 total, snap.generation, shown, f"{label}:{start}-{end}",
@@ -1070,6 +1086,8 @@ class QueryEngine:
             )
         stop = len(kept) if limit is None \
             else min(max(int(limit), 0), len(kept))
+        reqtrace.span_active(f"region.chr{label}",
+                             time.perf_counter() - t_page)
         return RegionPage(
             shard, label, level, closed_form_path(label, level, leaf),
             len(kept) if full_count is None else full_count,

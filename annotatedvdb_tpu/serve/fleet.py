@@ -42,6 +42,7 @@ unrecoverable by construction (a crash loop, not a crash test).
 from __future__ import annotations
 
 import contextlib
+import json
 import mmap
 import os
 import signal
@@ -51,6 +52,8 @@ import subprocess
 import sys
 import tempfile
 import time
+
+from annotatedvdb_tpu.obs import reqtrace
 
 #: one heartbeat slot per worker in the shared mmap'd file:
 #: ``(beat_time, p99_exceedance_ewma, brownout_level, queue_depth)``.
@@ -180,8 +183,37 @@ class ServeFleet:
         self.port = self._reserve.getsockname()[1]
         self._procs: dict[int, subprocess.Popen] = {}  # worker idx -> proc
         self._respawns: dict[int, int] = {}
+        self._respawns_total = 0  # never resets: the avdb_fleet_ series
         self._spawn_time: dict[int, float] = {}
+        self._wedged: set[int] = set()  # killed-by-watchdog markers
         self._stopping = False
+        # fleet telemetry plane: workers publish per-worker metric
+        # snapshot files here (their aio tick writes them) and the
+        # supervisor publishes fleet.json — any worker's
+        # /metrics?fleet=1 reads the directory and answers for the fleet
+        self._telemetry_dir = tempfile.mkdtemp(prefix="avdb_serve_tm_")
+        self._telemetry_last = 0.0
+        # crash flight recorder: the supervisor harvests a dead/wedged
+        # worker's mmap'd ring into <store>/flight/ and keeps its own
+        # ring for daemon/lifecycle events (observability failures are
+        # absorbed — the fleet serves with or without a black box)
+        from annotatedvdb_tpu.obs import flight as flight_mod
+
+        self._flight_enabled = flight_mod.flight_events_from_env() > 0
+        self._sup_flight = None
+        if self._flight_enabled:
+            try:
+                self._sup_flight = flight_mod.FlightRecorder(
+                    os.path.join(store_dir, flight_mod.FLIGHT_DIR,
+                                 "supervisor.ring"),
+                    log=self.log,
+                )
+                # daemon pass transitions / lifecycle events from THIS
+                # process land on the supervisor's ring
+                reqtrace.set_background_sink(None, self._sup_flight.event)
+            except OSError as err:
+                self.log(f"flight: supervisor ring unavailable ({err}); "
+                         "continuing without it")
 
     #: a worker that survived this long resets its rapid-death streak —
     #: backoff punishes crash LOOPS, not a long-lived worker's occasional
@@ -203,6 +235,7 @@ class ServeFleet:
             "--host", self.host, "--port", str(self.port),
             "--_workerIndex", str(index),
             "--_heartbeatFile", self._hb_path,
+            "--_telemetryDir", self._telemetry_dir,
         ]
         if not self.reuseport:
             cmd += ["--_listenFd", str(self._reserve.fileno())]
@@ -315,10 +348,17 @@ class ServeFleet:
             while not self._stopping:
                 time.sleep(0.1)
                 self._check_wedged()
+                self._publish_fleet_telemetry()
                 for i, proc in list(self._procs.items()):
                     rc = proc.poll()
                     if rc is None or self._stopping:
                         continue
+                    # harvest the black box FIRST: the respawn will
+                    # truncate the ring for its fresh incarnation
+                    reason = "wedged (watchdog SIGKILL)" \
+                        if i in self._wedged else f"died rc={rc}"
+                    self._wedged.discard(i)
+                    self._harvest_flight(i, reason)
                     lived = time.monotonic() - self._spawn_time.get(i, 0.0)
                     if lived >= self.HEALTHY_RUN_S:
                         self._respawns[i] = 0  # streak broken: healthy run
@@ -346,6 +386,7 @@ class ServeFleet:
                             and not self._stopping:
                         time.sleep(0.1)
                     if not self._stopping:
+                        self._respawns_total += 1
                         self._spawn(i, respawn=True)
             if daemon is not None:
                 # stop maintenance BEFORE draining workers: an in-flight
@@ -365,6 +406,62 @@ class ServeFleet:
                 self._hb_mm.close()
             with contextlib.suppress(OSError):
                 os.unlink(self._hb_path)
+            reqtrace.set_background_sink(None, None)
+            if self._sup_flight is not None:
+                self._sup_flight.close()
+            import shutil
+
+            shutil.rmtree(self._telemetry_dir, ignore_errors=True)
+
+    #: seconds between fleet.json publishes
+    TELEMETRY_S = 1.0
+
+    def _publish_fleet_telemetry(self) -> None:
+        """Atomically publish the supervisor's fleet facts (live worker
+        count, cumulative respawns, oldest worker age) next to the
+        workers' metric snapshots — the ``avdb_fleet_*`` series any
+        worker's ``?fleet=1`` scrape renders.  Best-effort: telemetry
+        must never stall the restart loop."""
+        now = time.monotonic()
+        if now - self._telemetry_last < self.TELEMETRY_S:
+            return
+        self._telemetry_last = now
+        live_ages = [
+            now - self._spawn_time.get(i, now)
+            for i, p in self._procs.items() if p.poll() is None
+        ]
+        doc = {
+            "t": time.time(),
+            "workers_live": len(live_ages),
+            "respawns_total": self._respawns_total,
+            "worker_age_seconds": round(max(live_ages, default=0.0), 3),
+        }
+        tmp = os.path.join(self._telemetry_dir,
+                           f".fleet.json.tmp{os.getpid()}")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, os.path.join(self._telemetry_dir, "fleet.json"))
+        except OSError as err:
+            self.log(f"fleet: telemetry publish failed ({err})")
+
+    def _harvest_flight(self, index: int, reason: str) -> None:
+        """Harvest a dead worker's flight ring into
+        ``<store>/flight/<ts>-w<idx>.jsonl``.  Every failure is absorbed
+        (incl. the ``obs.flight`` fault point): the black box must never
+        stall a respawn."""
+        if not self._flight_enabled:
+            return
+        from annotatedvdb_tpu.obs import flight as flight_mod
+
+        try:
+            flight_mod.harvest(
+                flight_mod.ring_path(self.store_dir, index),
+                self.store_dir, index, reason, log=self.log,
+            )
+        except Exception as err:
+            self.log(f"flight: harvest of worker {index} failed "
+                     f"({type(err).__name__}: {err}); continuing")
 
     def _check_wedged(self) -> None:
         """SIGKILL workers that are alive but stuck: a worker whose
@@ -390,6 +487,13 @@ class ServeFleet:
                     f"worker {i}: wedged (alive, no heartbeat for "
                     f"{stale:.1f}s > {self.wedge_timeout_s:.1f}s); killing"
                 )
+                # the death loop harvests the flight ring; this marker
+                # gives the harvest its honest reason
+                self._wedged.add(i)
+                if self._sup_flight is not None:
+                    self._sup_flight.event(
+                        "watchdog", f"worker {i} wedged; SIGKILL"
+                    )
                 self._hb_mm[i * HB_SLOT.size:(i + 1) * HB_SLOT.size] = \
                     b"\x00" * HB_SLOT.size
                 with contextlib.suppress(OSError):

@@ -38,7 +38,9 @@ background poller; client errors map to 400, admission to 429, absence to
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import re
 import threading
 import time
@@ -48,7 +50,9 @@ from urllib.parse import parse_qs, unquote, urlparse
 #: pulls "returned":N out of the region envelope prefix (fixed field order)
 _RETURNED_RE = re.compile(r'"returned":(\d+)')
 
+from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+from annotatedvdb_tpu.obs.reqtrace import TraceRecorder
 from annotatedvdb_tpu.serve import resilience
 from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
 from annotatedvdb_tpu.serve.engine import (
@@ -127,6 +131,84 @@ def stats_payload(ctx) -> str:
     if ctx.engine.mesh is not None:
         stats["mesh"] = ctx.engine.mesh.stats()
     return json.dumps(stats)
+
+
+#: the trace-id echo header BOTH front ends return on EVERY response —
+#: the one response-shaping constant of the request-tracing plane (the
+#: AVDB801 contract: serve/aio.py imports it, never re-spells it)
+TRACE_HEADER = "X-Request-Id"
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$"
+)
+_TRACE_ID_STRIP_RE = re.compile(r"[^0-9A-Za-z._:\-]")
+
+#: minted-id generator state: 96 random bits drawn ONCE per process + a
+#: 32-bit counter.  ``os.urandom`` per request would be a getrandom(2)
+#: syscall on the serving hot path (~9µs here, far worse on syscall-
+#: expensive sandboxes) — trace ids need uniqueness, not cryptographic
+#: freshness, and a counter under a process-unique prefix delivers that
+#: for sub-µs
+_MINT_PREFIX = os.urandom(12).hex()
+_MINT_SEQ = itertools.count(1)
+
+
+def resolve_trace_id(traceparent: str | None,
+                     x_request_id: str | None) -> str:
+    """The request's trace id — the ONE resolution both front ends share
+    (the :func:`parse_region_params` convention: the echoed header must
+    be byte-identical across front ends for the same request).
+
+    Preference order: a well-formed W3C ``traceparent`` contributes its
+    trace-id field; else a client ``X-Request-Id`` (sanitized to header-
+    safe characters, capped at 64) is adopted verbatim; else a fresh
+    128-bit hex id (96 process-unique bits + a counter — no syscall on
+    the hot path) is minted at admission."""
+    if traceparent:
+        m = _TRACEPARENT_RE.match(traceparent.strip().lower())
+        if m and m.group(1) != "0" * 32:
+            return m.group(1)
+    if x_request_id:
+        tid = _TRACE_ID_STRIP_RE.sub("", x_request_id.strip())[:64]
+        if tid:
+            return tid
+    return _MINT_PREFIX + format(next(_MINT_SEQ) & 0xFFFFFFFF, "08x")
+
+
+def chaos_enabled_from_env() -> bool:
+    """``AVDB_SERVE_CHAOS`` — gates the runtime fault-arming route
+    (``POST /_chaos``, aio only) AND the on-demand trace dump
+    (``GET /debug/trace``, both front ends).  Resolved HERE once (the
+    AVDB802 knob contract); on a production server both routes 404
+    byte-identically to any unknown route."""
+    return os.environ.get("AVDB_SERVE_CHAOS", "") == "1"
+
+
+def debug_trace_payload(ctx) -> str:
+    """The ``GET /debug/trace`` body — this worker's span ring as Chrome
+    trace-event JSON, merged with the PR-2 batcher tracer's drain spans
+    on one timebase when the server runs with ``--traceOut``.  Chaos-
+    gated like ``/_chaos`` (a trace dump is a debugging surface, not a
+    production route); shared by both front ends."""
+    tracer = ctx.tracer
+    base_ns = tracer._t0 if tracer is not None else ctx.reqtrace.t0_ns
+    events = ctx.reqtrace.chrome_events(base_ns=base_ns)
+    if tracer is not None:
+        events += tracer.events()
+    events.sort(key=lambda e: e.get("ts", 0))
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+
+
+def metrics_payload(ctx, query: str) -> str:
+    """The ``GET /metrics`` body — the ONE handler both front ends
+    share.  Plain scrape = this worker's registry; ``?fleet=1`` = the
+    fleet-wide view (workers' published snapshots summed/maxed, plus the
+    supervisor's ``avdb_fleet_*`` series), answered by WHICHEVER worker
+    the kernel handed the connection to."""
+    params = parse_qs(query or "")
+    if params.get("fleet", ["0"])[0] not in ("1", "true"):
+        return ctx.registry.render_prometheus()
+    return ctx.fleet_metrics()
 
 
 def parse_region_params(query: str):
@@ -308,13 +390,38 @@ def parse_regions_body(body: bytes):
 class ServeContext:
     """Everything a handler thread needs, shared across requests."""
 
+    #: published worker metric snapshots older than this are a dead
+    #: worker's leavings and drop out of the fleet view
+    FLEET_SNAPSHOT_TTL_S = 15.0
+
     def __init__(self, manager, engine: QueryEngine, batcher: QueryBatcher,
                  registry: MetricsRegistry, max_inflight: int | None = None,
-                 memtable=None, log=None):
+                 memtable=None, log=None, flight=None,
+                 telemetry_dir: str | None = None, tracer=None,
+                 worker_index: int = 0):
         self.manager = manager
         self.engine = engine
         self.batcher = batcher
         self.registry = registry
+        #: the observability plane: crash flight recorder (obs/flight.py,
+        #: None = disabled), the request-trace recorder (span ring +
+        #: avdb_stage_seconds + slow log), the PR-2 batcher tracer (for
+        #: the merged /debug/trace dump), and the fleet telemetry dir
+        #: workers publish metric snapshots into
+        self.flight = flight
+        self.tracer = tracer
+        self.telemetry_dir = telemetry_dir
+        self.worker_index = int(worker_index)
+        self.started_t = time.time()
+        self.debug_trace_enabled = chaos_enabled_from_env()
+        #: flight-recorder flush cadence: request summaries buffer (the
+        #: hot path never touches the mmap) and drain every FLUSH_S.  On
+        #: the threaded front end the flush rides request completions
+        #: (inline, time-gated); the aio front end clears this flag and
+        #: flushes from its maintenance tick via the executor pool — the
+        #: event loop never does the batch write
+        self.flight_flush_inline = True
+        self._flight_flush_last = 0.0
         #: the live write path (``store/memtable.py``), or None for the
         #: historical read-only server — the upsert route answers
         #: MSG_UPSERTS_DISABLED when unset
@@ -348,8 +455,19 @@ class ServeContext:
         #: threaded front end needs no extra thread
         self.governor = OverloadGovernor(
             depth_fn=batcher.depth, max_queue=batcher.max_queue,
-            registry=registry,
+            registry=registry, on_change=self._brownout_event,
         )
+        self.reqtrace = TraceRecorder(registry, log=self.log, flight=flight)
+        # background writers (memtable flushes, compaction groups, WAL
+        # rotations) join this worker's observability plane through the
+        # module sink — the store layer never imports serve code
+        reqtrace_mod.set_background_sink(
+            self.reqtrace.background,
+            flight.event if flight is not None else None,
+        )
+        if engine.breaker is not None and flight is not None:
+            # breaker trips / re-closes land on the flight timeline
+            engine.breaker.events = flight.event
         #: generation-keyed id -> record cache (the cache_first rung)
         self.point_cache = PointCache()
         self._m_inflight = registry.gauge(
@@ -442,6 +560,14 @@ class ServeContext:
         # also steps on its maintenance tick)
         self.governor.note_latency(seconds)
         self.governor.maybe_step()
+        if self.flight is not None and self.flight_flush_inline:
+            now = time.monotonic()
+            if now - self._flight_flush_last >= self.flight.FLUSH_S:
+                self._flight_flush_last = now
+                try:
+                    self.flight.flush(limit=self.flight.FLUSH_BATCH)
+                except Exception:  # avdb: noqa[AVDB602] -- the recorder already logs; a flush failure must never fail the request riding it
+                    pass
 
     def rejected(self, kind: str) -> None:
         self._kind[kind][3].inc()
@@ -454,6 +580,82 @@ class ServeContext:
     def request_deadline(self, header_value: str | None) -> float | None:
         """Absolute monotonic deadline for a request arriving now."""
         return resilience.deadline_at(header_value, self.default_deadline_s)
+
+    def _brownout_event(self, old: int, new: int) -> None:
+        """Brownout ladder transitions land on the flight timeline — the
+        black box's answer to "what was this worker shedding when it
+        died"."""
+        if self.flight is not None:
+            self.flight.event(
+                "brownout",
+                f"level {old}->{new} ({resilience.LEVEL_NAMES[new]})",
+            )
+
+    def fleet_metrics(self) -> str:
+        """The ``?fleet=1`` exposition body: this worker's live registry
+        merged with every sibling's published snapshot file (sum for
+        counters/histograms, max for gauges) plus the supervisor's
+        ``avdb_fleet_*`` series.  Outside a fleet the same surface
+        answers from the one process (workers_live 1) — the contract is
+        the VIEW, not the process count."""
+        from annotatedvdb_tpu.obs.metrics import (
+            merge_snapshots,
+            render_snapshot,
+        )
+
+        snaps = [self.registry.snapshot()]
+        info = None
+        now = time.time()
+        tdir = self.telemetry_dir
+        if tdir and os.path.isdir(tdir):
+            for fname in sorted(os.listdir(tdir)):
+                path = os.path.join(tdir, fname)
+                try:
+                    if fname == "fleet.json":
+                        with open(path) as f:
+                            doc = json.load(f)
+                        if now - float(doc.get("t", 0)) \
+                                <= self.FLEET_SNAPSHOT_TTL_S:
+                            # a dead supervisor's last facts must age out
+                            # exactly like a dead worker's snapshot — the
+                            # gauges exist to SURFACE that death
+                            info = doc
+                        continue
+                    if not (fname.startswith("worker-")
+                            and fname.endswith(".json")):
+                        continue
+                    with open(path) as f:
+                        doc = json.load(f)
+                    if int(doc.get("index", -1)) == self.worker_index:
+                        continue  # self: the live registry is fresher
+                    if now - float(doc.get("t", 0)) \
+                            > self.FLEET_SNAPSHOT_TTL_S:
+                        continue  # a dead worker's stale snapshot
+                    snaps.append(doc.get("metrics") or {})
+                except (OSError, ValueError, TypeError):
+                    continue  # torn publish race: skip, never fail a scrape
+        merged = merge_snapshots(snaps)
+        fleet = MetricsRegistry()
+        if info:
+            live = int(info.get("workers_live", 0))
+            respawns = int(info.get("respawns_total", 0))
+            age = float(info.get("worker_age_seconds", 0.0))
+        else:
+            live, respawns = 1, 0
+            age = now - self.started_t
+        fleet.gauge(
+            "avdb_fleet_workers_live",
+            "serve worker processes alive in the fleet",
+        ).set(live)
+        fleet.counter(
+            "avdb_fleet_respawns_total",
+            "worker respawns since the fleet supervisor started",
+        ).inc(respawns)
+        fleet.gauge(
+            "avdb_fleet_worker_age_seconds",
+            "age of the oldest live worker process",
+        ).set(round(age, 3))
+        return fleet.render_prometheus() + render_snapshot(merged)
 
     def deadline_shed(self, stage: str) -> None:
         self._m_deadline_shed[stage].inc()
@@ -506,7 +708,7 @@ class ServeContext:
     # -- upserts (the live write path) --------------------------------------
 
     def upsert_execute(self, body: bytes,
-                       max_rows: int | None = None):
+                       max_rows: int | None = None, trace=None):
         """The upsert decision+execution BOTH front ends share (the
         ``point_preflight`` convention: logic lives once, front ends only
         render).  Returns ``(status, json_body, rows_in_request)``.
@@ -546,7 +748,7 @@ class ServeContext:
         base = getattr(self.manager, "base", self.manager)
         try:
             accepted, shadowed, _wal_bytes = memtable.upsert(
-                base.current().store, parsed
+                base.current().store, parsed, trace=trace
             )
         except (ValueError, KeyError, TypeError) as err:
             self.errored("upsert")
@@ -696,6 +898,11 @@ class ServeHandler(BaseHTTPRequestHandler):
     server_version = "avdb-serve/1"
     protocol_version = "HTTP/1.1"
 
+    #: this request's resolved trace id (set at route entry, echoed on
+    #: every response — one handler instance serves one connection's
+    #: requests strictly in sequence, so an attribute is race-free)
+    _trace_id: str | None = None
+
     # -- plumbing -----------------------------------------------------------
 
     def log_message(self, format, *args):  # stdlib signature
@@ -707,6 +914,8 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
+        if self._trace_id is not None:
+            self.send_header(TRACE_HEADER, self._trace_id)
         if status in (429, 503):
             self.send_header("Retry-After", "1")
         self.end_headers()
@@ -724,6 +933,10 @@ class ServeHandler(BaseHTTPRequestHandler):
         ctx = self.server.ctx
         url = urlparse(self.path)
         path = unquote(url.path)
+        self._trace_id = resolve_trace_id(
+            self.headers.get("traceparent"),
+            self.headers.get(TRACE_HEADER),
+        )
         if path == "/healthz":
             ctx.refresh_snapshot()
             self._reply(200, healthz_payload(ctx))
@@ -738,11 +951,16 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._reply(status, body)
             return
         if path == "/metrics":
-            self._reply(200, ctx.registry.render_prometheus(),
+            self._reply(200, metrics_payload(ctx, url.query),
                         content_type="text/plain; version=0.0.4")
             return
         if path == "/stats":
             self._reply(200, stats_payload(ctx))
+            return
+        if path == "/debug/trace" and ctx.debug_trace_enabled:
+            # chaos-gated like /_chaos: on a production server this path
+            # 404s byte-identically to any unknown route
+            self._reply(200, debug_trace_payload(ctx))
             return
         if path.startswith("/variant/"):
             self._point(ctx, path[len("/variant/"):])
@@ -755,6 +973,10 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         ctx = self.server.ctx
         path = unquote(urlparse(self.path).path)
+        self._trace_id = resolve_trace_id(
+            self.headers.get("traceparent"),
+            self.headers.get(TRACE_HEADER),
+        )
         if path == "/variants":
             self._bulk(ctx)
             return
@@ -770,45 +992,61 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     def _point(self, ctx: ServeContext, variant_id: str) -> None:
         t0 = time.perf_counter()
+        trace = ctx.reqtrace.begin(self._trace_id, "point")
         ctx.refresh_snapshot()
         deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
         action, payload = ctx.point_preflight(variant_id, deadline_t)
         if action == "shed":
+            ctx.reqtrace.finish(trace, 504)
             self._error(504, MSG_DEADLINE_ADMISSION)
             return
         if action == "cached":
             if payload is None:
                 ctx.observe("point", time.perf_counter() - t0)
+                ctx.reqtrace.finish(trace, 404)
                 self._error(404, f"variant {variant_id!r} not in store")
             else:
                 ctx.observe("point", time.perf_counter() - t0, rows=1)
+                ctx.reqtrace.finish(trace, 200)
                 self._reply(200, payload)
             return
         generation = payload
+        if trace is not None:
+            trace.add("admission", time.perf_counter() - t0)
         try:
-            record = ctx.batcher.submit(variant_id, deadline_t=deadline_t)
+            record = ctx.batcher.submit(variant_id, deadline_t=deadline_t,
+                                        trace=trace)
         except QueueFull as err:
             ctx.rejected("point")
+            ctx.reqtrace.finish(trace, 429)
             self._error(429, str(err))
             return
         except DeadlineExceeded as err:
             # the batcher shed it (and counted stage="batcher")
+            ctx.reqtrace.finish(trace, 504)
             self._error(504, str(err))
             return
         except QueryError as err:
             ctx.errored("point")
+            ctx.reqtrace.finish(trace, 400)
             self._error(400, str(err))
             return
         except Exception as err:
             ctx.errored("point")
+            ctx.reqtrace.finish(trace, 500)
             self._error(500, f"{type(err).__name__}: {err}")
             return
+        t_render = time.perf_counter()
         ctx.remember_point(generation, variant_id, record)
         if record is None:
             ctx.observe("point", time.perf_counter() - t0)
+            ctx.reqtrace.finish(trace, 404)
             self._error(404, f"variant {variant_id!r} not in store")
             return
         ctx.observe("point", time.perf_counter() - t0, rows=1)
+        if trace is not None:
+            trace.add("render", time.perf_counter() - t_render)
+        ctx.reqtrace.finish(trace, 200)
         self._reply(200, record)
 
     def _bulk(self, ctx: ServeContext) -> None:
@@ -844,23 +1082,37 @@ class ServeHandler(BaseHTTPRequestHandler):
                 ctx.deadline_shed("execute")
                 self._error(504, MSG_DEADLINE_EXECUTE)
                 return
+            trace = ctx.reqtrace.begin(self._trace_id, "bulk")
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
             try:
-                results = ctx.engine.lookup_many(ids)
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    results = ctx.engine.lookup_many(ids)
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
             except QueryError as err:
                 ctx.errored("bulk")
+                ctx.reqtrace.finish(trace, 400)
                 self._error(400, str(err))
                 return
             except Exception as err:
                 ctx.errored("bulk")
+                ctx.reqtrace.finish(trace, 500)
                 self._error(500, f"{type(err).__name__}: {err}")
                 return
+            t_render = time.perf_counter()
             found = sum(1 for r in results if r is not None)
-            ctx.observe("bulk", time.perf_counter() - t0, rows=found)
-            self._reply(200, (
+            body = (
                 f'{{"n":{len(results)},"found":{found},"results":['
                 + ",".join(r if r is not None else "null" for r in results)
                 + "]}"
-            ))
+            )
+            ctx.observe("bulk", time.perf_counter() - t0, rows=found)
+            if trace is not None:
+                trace.add("render", time.perf_counter() - t_render)
+            ctx.reqtrace.finish(trace, 200)
+            self._reply(200, body)
         finally:
             ctx.release()
 
@@ -897,7 +1149,9 @@ class ServeHandler(BaseHTTPRequestHandler):
                 ctx.deadline_shed("execute")
                 self._error(504, MSG_DEADLINE_EXECUTE)
                 return
-            status, body, _rows = ctx.upsert_execute(raw)
+            trace = ctx.reqtrace.begin(self._trace_id, "upsert")
+            status, body, _rows = ctx.upsert_execute(raw, trace=trace)
+            ctx.reqtrace.finish(trace, status)
             self._reply(status, body)
             ctx.maybe_flush_memtable()
         finally:
@@ -937,29 +1191,43 @@ class ServeHandler(BaseHTTPRequestHandler):
                 ctx.deadline_shed("execute")
                 self._error(504, MSG_DEADLINE_EXECUTE)
                 return
+            trace = ctx.reqtrace.begin(self._trace_id, "regions")
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
             try:
                 cap = ctx.governor.region_limit_cap()
                 if cap is not None:
                     # brownout level >= 1: bound per-interval render work
                     limit = min(limit, cap)
-                result = ctx.engine.regions_serve(
-                    specs,
-                    min_cadd=min_cadd,
-                    max_conseq_rank=max_rank,
-                    limit=limit,
-                    tokenize=tokenize,
-                )
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    result = ctx.engine.regions_serve(
+                        specs,
+                        min_cadd=min_cadd,
+                        max_conseq_rank=max_rank,
+                        limit=limit,
+                        tokenize=tokenize,
+                    )
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
             except QueryError as err:
                 ctx.errored("regions")
+                ctx.reqtrace.finish(trace, 400)
                 self._error(400, str(err))
                 return
             except Exception as err:
                 ctx.errored("regions")
+                ctx.reqtrace.finish(trace, 500)
                 self._error(500, f"{type(err).__name__}: {err}")
                 return
+            t_render = time.perf_counter()
+            body = result.assemble()
             ctx.observe("regions", time.perf_counter() - t0,
                         rows=result.returned)
-            self._reply(200, result.assemble())
+            if trace is not None:
+                trace.add("render", time.perf_counter() - t_render)
+            ctx.reqtrace.finish(trace, 200)
+            self._reply(200, body)
         finally:
             ctx.release()
 
@@ -980,6 +1248,9 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         try:
             ctx.refresh_snapshot()
+            trace = ctx.reqtrace.begin(self._trace_id, "region")
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
             try:
                 min_cadd, max_rank, limit, cursor = \
                     parse_region_params(query)
@@ -987,19 +1258,25 @@ class ServeHandler(BaseHTTPRequestHandler):
                 if cap is not None:
                     # brownout level >= 1: bound per-request render work
                     limit = min(limit, cap)
-                text = ctx.engine.region(
-                    spec,
-                    min_cadd=min_cadd,
-                    max_conseq_rank=max_rank,
-                    limit=limit,
-                    cursor=cursor,
-                )
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    text = ctx.engine.region(
+                        spec,
+                        min_cadd=min_cadd,
+                        max_conseq_rank=max_rank,
+                        limit=limit,
+                        cursor=cursor,
+                    )
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
             except QueryError as err:
                 ctx.errored("region")
+                ctx.reqtrace.finish(trace, 400)
                 self._error(400, str(err))
                 return
             except Exception as err:
                 ctx.errored("region")
+                ctx.reqtrace.finish(trace, 500)
                 self._error(500, f"{type(err).__name__}: {err}")
                 return
             # the row count sits in the fixed-format envelope prefix —
@@ -1007,6 +1284,7 @@ class ServeHandler(BaseHTTPRequestHandler):
             m = _RETURNED_RE.search(text[:256])
             returned = int(m.group(1)) if m else 0
             ctx.observe("region", time.perf_counter() - t0, rows=returned)
+            ctx.reqtrace.finish(trace, 200)
             self._reply(200, text)
         finally:
             ctx.release()
@@ -1020,7 +1298,9 @@ def build_server(store_dir: str | None = None, manager=None,
                  region_cache_size: int | None = None,
                  registry: MetricsRegistry | None = None,
                  residency=None, memtable=None,
-                 tracer=None, log=None) -> ThreadingHTTPServer:
+                 tracer=None, log=None, flight=None,
+                 telemetry_dir: str | None = None,
+                 worker_index: int = 0) -> ThreadingHTTPServer:
     """Wire manager → engine → batcher → HTTP server (not yet serving; call
     ``serve_forever`` or run it on a thread).  The server carries its
     :class:`ServeContext` as ``httpd.ctx``; callers own shutdown order:
@@ -1052,5 +1332,7 @@ def build_server(store_dir: str | None = None, manager=None,
     httpd = ThreadingHTTPServer((host, port), ServeHandler)
     httpd.daemon_threads = True
     httpd.ctx = ServeContext(manager, engine, batcher, registry,
-                             memtable=memtable, log=log)
+                             memtable=memtable, log=log, flight=flight,
+                             telemetry_dir=telemetry_dir, tracer=tracer,
+                             worker_index=worker_index)
     return httpd

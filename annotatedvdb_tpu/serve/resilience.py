@@ -200,7 +200,7 @@ class OverloadGovernor:
     def __init__(self, depth_fn, max_queue: int,
                  p99_target_s: float | None = None, registry=None,
                  clock=time.monotonic, eval_interval_s: float | None = None,
-                 hold_s: float | None = None):
+                 hold_s: float | None = None, on_change=None):
         self._depth_fn = depth_fn
         self._max_queue = max(int(max_queue), 1)
         self.p99_target_s = (
@@ -213,6 +213,10 @@ class OverloadGovernor:
             else max(float(eval_interval_s), 0.0)
         )
         self.hold_s = self.HOLD_S if hold_s is None else max(float(hold_s), 0.0)
+        #: level-transition observer ``on_change(old, new)`` — the flight
+        #: recorder's brownout timeline; invoked OUTSIDE the lock and
+        #: never allowed to fail the evaluation that stepped the ladder
+        self.on_change = on_change
         self._lock = make_lock("serve.resilience.governor")
         #: guarded by self._lock
         self._level = LEVEL_NORMAL
@@ -275,10 +279,17 @@ class OverloadGovernor:
                     and now - self._last_change >= self.hold_s:
                 level -= 1
                 self._last_change = now
-            changed = level != self._level
+            old = self._level
+            changed = level != old
             self._level = level
-        if changed and self._m_level is not None:
-            self._m_level.set(level)
+        if changed:
+            if self._m_level is not None:
+                self._m_level.set(level)
+            if self.on_change is not None:
+                try:
+                    self.on_change(old, level)
+                except Exception:  # avdb: noqa[AVDB602] -- an observer must never fail the ladder evaluation it watches
+                    pass
         return level
 
     def force_level(self, level: int) -> None:
@@ -286,10 +297,16 @@ class OverloadGovernor:
         next hot/cool evaluation moves it again."""
         level = min(max(int(level), LEVEL_NORMAL), LEVEL_SHED_BULK)
         with self._lock:
+            old = self._level
             self._level = level
             self._last_change = self._clock()
         if self._m_level is not None:
             self._m_level.set(level)
+        if old != level and self.on_change is not None:
+            try:
+                self.on_change(old, level)
+            except Exception:  # avdb: noqa[AVDB602] -- an observer must never fail the ladder evaluation it watches
+                pass
 
     # -- level queries (the front ends' contract) ---------------------------
 
@@ -374,6 +391,10 @@ class DeviceBreaker:
                  cooldown_s: float | None = None,
                  failure_threshold: int | None = None):
         self.log = log if log is not None else (lambda msg: None)
+        #: lifecycle-event observer ``events(name, detail)`` — the flight
+        #: recorder's breaker timeline (ServeContext installs it);
+        #: invoked outside the lock, failures swallowed
+        self.events = None
         self._clock = clock
         self.cooldown_s = (
             self.COOLDOWN_S if cooldown_s is None else max(float(cooldown_s), 0.0)
@@ -501,6 +522,15 @@ class DeviceBreaker:
             )
             if self._m_trips is not None:
                 self._m_trips.inc()
+            if self.events is not None:
+                try:
+                    self.events(
+                        "breaker",
+                        f"group {code} tripped open "
+                        f"({type(exc).__name__})",
+                    )
+                except Exception:  # avdb: noqa[AVDB602] -- an observer must never fail the breaker transition it watches
+                    pass
         if self._m_open is not None:
             self._m_open.set(open_count)
 
@@ -519,6 +549,11 @@ class DeviceBreaker:
         if closed:
             self.log(f"breaker: chromosome group {code} re-closed "
                      "(half-open probe succeeded)")
+            if self.events is not None:
+                try:
+                    self.events("breaker", f"group {code} re-closed")
+                except Exception:  # avdb: noqa[AVDB602] -- an observer must never fail the breaker transition it watches
+                    pass
         if self._m_open is not None:
             self._m_open.set(open_count)
 

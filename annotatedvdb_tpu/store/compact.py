@@ -72,6 +72,7 @@ import zlib
 
 import numpy as np
 
+from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.store.variant_store import (
     _NUMERIC_COLUMNS,
     OBJECT_COLUMNS,
@@ -679,10 +680,16 @@ def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
             created.extend([tmp_npz, tmp_jsonl])
             log(f"compact: chr{label}: merging {entry['stems']} segment "
                 f"file(s) ({entry['bytes_before']} bytes)")
-            rec = _merge_label_to_temp(
-                store_dir, label, glists[label], width, integrity, verify,
-                tmp_npz, tmp_jsonl, chunk, cancel,
-            )
+            # background-track span per merged group: `doctor trace` and
+            # the worker span ring show what compaction was doing while
+            # p99 moved (no-op without a recorder in this process)
+            with reqtrace.background_span(
+                f"compact.chr{label}", stems=entry["stems"],
+            ):
+                rec = _merge_label_to_temp(
+                    store_dir, label, glists[label], width, integrity,
+                    verify, tmp_npz, tmp_jsonl, chunk, cancel,
+                )
             new_stems[label] = (sid, rec)
 
         # -- commit: rename temps, verify no loader preempted us, swap ------

@@ -38,6 +38,7 @@ import time
 
 import numpy as np
 
+from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.store.variant_store import (
     JSONB_COLUMNS,
     ChromosomeShard,
@@ -276,7 +277,7 @@ class Memtable:
     # -- write path ----------------------------------------------------------
 
     def upsert(self, base_store, parsed: list[dict],
-               durable: bool = True) -> tuple[int, int, int]:
+               durable: bool = True, trace=None) -> tuple[int, int, int]:
         """Apply one validated upsert batch; returns
         ``(accepted, shadowed, wal_bytes)``.
 
@@ -330,6 +331,10 @@ class Memtable:
                 wal_bytes = self.wal.append({
                     "rows": [parsed[i] for i in accepted_idx],
                 })
+                if trace is not None:
+                    # the durable-ack barrier's cost, attributed to the
+                    # acknowledging request (the wal_fsync trace stage)
+                    trace.add("wal_fsync", self.wal.last_fsync_s)
                 if self._m_wal_bytes is not None:
                     self._m_wal_bytes.inc(wal_bytes)
             for code, (idxs, rows, ref, alt, ann_cols) in built.items():
@@ -479,13 +484,17 @@ class Memtable:
                     raise
         t0 = time.perf_counter()
         try:
-            merged = {
-                code: Segment.merge_many(segs) if len(segs) > 1 else segs[0]
-                for code, segs in plan.items()
-            }
-            result = flush_segments(
-                self.store_dir, merged, self.width, log=self.log
-            )
+            with reqtrace.background_span(
+                "memtable.flush", groups=len(plan),
+            ):
+                merged = {
+                    code: Segment.merge_many(segs) if len(segs) > 1
+                    else segs[0]
+                    for code, segs in plan.items()
+                }
+                result = flush_segments(
+                    self.store_dir, merged, self.width, log=self.log
+                )
             if result["status"] != "flushed":
                 self.log(f"memtable flush aborted: {result.get('reason')}; "
                          "rows stay in the memtable (retry on next trigger)")

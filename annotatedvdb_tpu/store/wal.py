@@ -40,8 +40,10 @@ import json
 import os
 import re
 import struct
+import time
 import zlib
 
+from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.utils import faults
 from annotatedvdb_tpu.utils.locks import make_lock
 
@@ -109,6 +111,10 @@ class WriteAheadLog:
         self.name = name
         self.log = log if log is not None else (lambda msg: None)
         self._lock = make_lock("store.wal")
+        #: duration of the most recent append's fsync — the ack barrier's
+        #: cost, read by the memtable (under its own lock) to attribute
+        #: the ``wal_fsync`` trace stage
+        self.last_fsync_s = 0.0
         #: guarded by self._lock
         self._f = None
         existing = self.pending_files()
@@ -186,7 +192,12 @@ class WriteAheadLog:
             # or may not be durable, but the ack was never sent — replay
             # applies it in full or not at all, never a hybrid
             faults.fire("wal.fsync", f, tear_base=pre)
+            t_fsync = time.perf_counter()
             os.fsync(f.fileno())
+            # the ack barrier's cost, attributed to the acknowledging
+            # request's trace (single writer per worker: the caller reads
+            # it back under the memtable lock it already holds)
+            self.last_fsync_s = time.perf_counter() - t_fsync
         return len(frame)
 
     # -- rotation / discard (the flush protocol's WAL half) ------------------
@@ -209,6 +220,11 @@ class WriteAheadLog:
             # between rotation and the next append still leaves a
             # well-formed (empty) WAL rather than nothing
             self._create(self._seq)
+        # flight-recorder timeline: a rotation marks a flush interval
+        # boundary (no-op without a sink; never fails the rotation)
+        reqtrace.lifecycle_event(
+            "wal", f"rotated: sealed {self.name}.{sealed:06d}"
+        )
         return sealed
 
     def discard_sealed(self) -> int:

@@ -136,6 +136,12 @@ arming any other name is a ``ValueError`` at parse time):
                             breaker group must absorb it on the byte-
                             identical single-device path, never wrong
                             bytes
+``obs.flight``              per flight-recorder ring write
+                            (``obs.flight.FlightRecorder``) AND per
+                            supervisor harvest of a dead worker's ring —
+                            ``raise``/``eio`` must be ABSORBED both
+                            places: observability never takes down the
+                            serving (or respawn) path it records
 ======================== ====================================================
 
 **Process-death actions are subprocess-only.**  ``kill``/``torn_write``
@@ -191,6 +197,7 @@ POINTS = frozenset({
     "maintain.tick",
     "maintain.disk_guard",
     "mesh.dispatch",
+    "obs.flight",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

@@ -88,6 +88,19 @@ GOOD = {
                  ]},
             ],
         },
+        "observability": {
+            "offered_qps": 3600.0, "probe_achieved_qps": 7980.0,
+            "duration_s": 2.5, "conns": 8, "rounds": 5,
+            "armed": {"achieved_qps": 3590.0, "p99_ms": 12.4,
+                      "samples": [{"achieved_qps": 3591.0,
+                                   "p99_ms": 12.9}]},
+            "unarmed": {"achieved_qps": 3594.0, "p99_ms": 12.2,
+                        "samples": [{"achieved_qps": 3596.0,
+                                     "p99_ms": 12.0}]},
+            "overhead_qps": 0.0011, "overhead_p99": 0.0164,
+            "overhead_p99_ms": 0.2, "p99_abs_floor_ms": 2.0,
+            "max_overhead": 0.03, "within_bound": True,
+        },
         "mixed_workload": {
             "read_qps_target": 2000.0, "upserts_per_sec_target": 150.0,
             "duration_s": 6.0, "slo_p99_ms": 25.0, "conns": 8,
@@ -124,6 +137,9 @@ GOOD = {
             "maintain": {"high": 3, "low": 2, "passes": 20, "paused": 2,
                          "preempted": 1, "read_amp_end": 1,
                          "converged": True},
+            "flight": {"harvested_files": 2, "parse_failures": 0,
+                       "harvested_requests": 57, "breaker_events": 3,
+                       "brownout_events": 4},
         },
     },
     "storage": {
@@ -561,3 +577,66 @@ def test_checker_cli_covers_committed_multichip_records():
     assert len(paths) >= 5  # r01–r05 are committed history
     for path in paths:
         assert validate_file(path) == [], path
+
+
+def test_observability_block_is_validated_strictly():
+    """The tracing-overhead gate: overhead over the bound (or a false
+    within_bound) is a schema ERROR — the layer's cost is pinned by the
+    record, not by hope."""
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["observability"]["overhead_qps"]
+    assert any("overhead_qps" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["observability"]["armed"]
+    assert any("armed" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["observability"]["overhead_qps"] = 0.08  # > 3%
+    assert any("overhead bound" in e for e in validate_record(bad))
+    # p99 over the RATIO but under the absolute noise floor: tolerated
+    # (on a 10-40ms baseline 3% measures the container, not the code)
+    noisy = copy.deepcopy(GOOD)
+    noisy["serving"]["observability"]["overhead_p99"] = 0.08
+    noisy["serving"]["observability"]["overhead_p99_ms"] = 0.9
+    assert validate_record(noisy) == []
+    # p99 over the ratio AND over the floor: rejected
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["observability"]["overhead_p99"] = 0.31
+    bad["serving"]["observability"]["overhead_p99_ms"] = 8.2
+    assert any("noise floor" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["observability"]["within_bound"] = False
+    assert any("within_bound" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["observability"]["armed"] = {"p99_ms": 1.0}
+    assert any("achieved_qps" in e for e in validate_record(bad))
+    # historic records carry no observability block: still valid
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["observability"]
+    assert validate_record(old) == []
+    # a failed leg records {"error": ...} and stays loadable
+    failed = copy.deepcopy(GOOD)
+    failed["serving"]["observability"] = {"error": "worker died"}
+    assert validate_record(failed) == []
+
+
+def test_chaos_flight_subblock_is_validated():
+    """The black-box gates ride the chaos record: a missing harvest or a
+    parse failure is a schema error, and pre-PR-14 records (no flight
+    sub-block) stay valid."""
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["flight"]["harvested_files"] = 0
+    assert any("no black box was harvested" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["flight"]["parse_failures"] = 1
+    assert any("failed to parse" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["chaos"]["flight"]["harvested_requests"]
+    assert any("harvested_requests" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["chaos"]["flight"] = "yes"
+    assert any("flight: must be an object" in e
+               for e in validate_record(bad))
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["chaos"]["flight"]
+    assert validate_record(old) == []

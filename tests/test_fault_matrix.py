@@ -1363,3 +1363,197 @@ def test_mesh_dispatch_eio_panel_falls_back_byte_identical():
         faults.reset("")
     # unarmed: the mesh panel path serves again, same bytes
     assert engine.regions_serve(specs).assemble() == want
+
+
+# ---------------------------------------------------------------------------
+# obs.flight — the crash flight recorder (obs/flight.py).  Contract:
+# observability must NEVER take down serving — an injected failure inside
+# a ring write costs exactly that record, a failure inside the
+# supervisor's harvest costs exactly that harvest, and a REAL SIGKILL
+# through the serve CLI leaves a harvested black box holding the killed
+# worker's final requests.
+
+
+def test_obs_flight_ring_write_failure_absorbed_while_serving(tmp_path):
+    """obs.flight (raise) inside a request-summary write: the request
+    still answers 200, the failure is counted, recording continues."""
+    import threading
+    import urllib.request
+
+    from annotatedvdb_tpu.obs.flight import FlightRecorder, decode_ring
+    from annotatedvdb_tpu.serve.http import build_server
+
+    store_dir = str(tmp_path / "fstore")
+    _tiny_store().save(store_dir)
+    ring = str(tmp_path / "w0.ring")
+    flight = FlightRecorder(ring, slots=16, log=lambda m: None)
+    httpd = build_server(store_dir=store_dir, port=0, flight=flight)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        port = httpd.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.status
+
+        faults.reset("obs.flight:1:raise")
+        assert get("/variant/3:10:A:C") == 200  # the write failure is silent
+        faults.reset("")
+        assert get("/variant/3:20:A:C") == 200
+        assert flight.errors == 1
+        flight.flush()
+        reqs = [e for e in decode_ring(ring)["events"]
+                if e["type"] == "request"]
+        # exactly the injected record is missing; recording resumed
+        assert len(reqs) == 1
+    finally:
+        faults.reset("")
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.ctx.batcher.close()
+        flight.close()
+
+
+def test_obs_flight_harvest_failure_absorbed_by_supervisor(tmp_path):
+    """obs.flight (eio) inside the supervisor's harvest: the fleet's
+    absorb wrapper logs and continues — a broken black box must never
+    stall the respawn loop."""
+    from annotatedvdb_tpu.obs import flight as flight_mod
+    from annotatedvdb_tpu.serve.fleet import ServeFleet
+
+    store_dir = str(tmp_path / "hstore")
+    _tiny_store().save(store_dir)
+    ring = flight_mod.ring_path(store_dir, 0)
+    fr = flight_mod.FlightRecorder(ring, slots=8)
+    fr.request("abc", "point", 200, 0.001, [])
+    fr.close()
+    fleet = ServeFleet(store_dir, port=0, workers=1, log=lambda m: None)
+    try:
+        faults.reset("obs.flight:1:eio")
+        fleet._harvest_flight(0, "died rc=-9")  # absorbed, never raises
+        faults.reset("")
+        assert flight_mod.list_blackboxes(store_dir)["harvested"] == []
+        # unarmed: the same harvest lands
+        fleet._harvest_flight(0, "died rc=-9")
+        assert len(
+            flight_mod.list_blackboxes(store_dir)["harvested"]
+        ) == 1
+    finally:
+        faults.reset("")
+        fleet._reserve.close()
+        if fleet._sup_flight is not None:
+            fleet._sup_flight.close()
+        import shutil
+
+        from annotatedvdb_tpu.obs import reqtrace as _rt
+
+        _rt.set_background_sink(None, None)
+        shutil.rmtree(fleet._telemetry_dir, ignore_errors=True)
+        fleet._hb_mm.close()
+        os.unlink(fleet._hb_path)
+
+
+def test_obs_flight_sigkill_harvest_holds_final_requests(tmp_path):
+    """A REAL worker SIGKILL through the serve CLI: requests land on the
+    worker's mmap'd ring, the chaos route kills it mid-accept, and the
+    supervisor's harvest under <store>/flight/ holds the killed worker's
+    final request summaries — the black-box acceptance contract."""
+    import re
+    import subprocess
+    import urllib.request
+
+    from annotatedvdb_tpu.obs import flight as flight_mod
+
+    store_dir = str(tmp_path / "kstore")
+    _tiny_store().save(store_dir)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AVDB_SERVE_CHAOS="1",
+    )
+    env.pop("AVDB_FAULT", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", store_dir, "--port", "0", "--workers", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"http://([\d.]+):(\d+)", line)
+        assert m, f"no fleet address line: {line!r}"
+        host, port = m.group(1), int(m.group(2))
+
+        def get(path, timeout=5):
+            with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=timeout
+            ) as r:
+                return r.status, r.read()
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                if get("/healthz")[0] == 200:
+                    break
+            except OSError:
+                time.sleep(0.3)
+        # traffic both workers record (kernel round-robins accepts)
+        for i in range(40):
+            try:
+                get(f"/variant/3:{(i % 3 + 1) * 10}:A:C")
+            except OSError:
+                pass
+        # arm a kill in whichever worker answers: it dies mid-accept
+        body = json.dumps({"spec": "serve.accept:1:kill"}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/_chaos", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+        # trip it + wait for the supervisor to harvest and respawn
+        for _ in range(10):
+            try:
+                get("/variant/3:10:A:C", timeout=2)
+            except OSError:
+                pass
+            time.sleep(0.2)
+        harvested = []
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            harvested = flight_mod.list_blackboxes(store_dir)["harvested"]
+            if harvested:
+                break
+            time.sleep(0.5)
+        assert harvested, "the supervisor never harvested the killed " \
+                          "worker's flight ring"
+        data = flight_mod.load_harvest(harvested[0])
+        assert "died rc=-9" in data["meta"]["reason"]
+        reqs = [e for e in data["events"] if e["type"] == "request"]
+        assert reqs, "the harvested black box holds no request summaries"
+        assert any(e["kind"] == "point" and e["status"] == 200
+                   and e.get("stages") for e in reqs)
+        # the fleet telemetry plane on the REAL fleet: any worker's
+        # ?fleet=1 answers for the whole fleet, incl. the supervisor's
+        # respawn counter the kill just incremented (workers publish
+        # snapshots ~1 Hz; give the plane a moment to converge)
+        deadline = time.monotonic() + 30
+        fleet_ok = False
+        while time.monotonic() < deadline and not fleet_ok:
+            try:
+                _s, body = get("/metrics?fleet=1")
+                text = body.decode()
+                fleet_ok = ("avdb_fleet_workers_live 2" in text
+                            and "avdb_fleet_respawns_total 1" in text)
+            except OSError:
+                pass
+            if not fleet_ok:
+                time.sleep(0.5)
+        assert fleet_ok, "?fleet=1 never showed 2 live workers and the " \
+                         "respawn the kill caused"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    assert rc == 0, proc.stdout.read()[-2000:]

@@ -547,12 +547,12 @@ def test_abandoned_stream_items_release_admission_slots(store):
         assert ctx.admit()
         fut2 = loop.create_future()
         fut2.set_result(("stream", object()))
-        await server._settle(("exec", fut2, "region", 0.0))
+        await server._settle(("exec", fut2, "region", 0.0, None, None))
         assert ctx._inflight == 0
         # buffered results (bytes) released on the executor side: no-op
         fut3 = loop.create_future()
         fut3.set_result(b"HTTP/1.1 200 OK\r\n\r\n")
-        await server._settle(("exec", fut3, "bulk", 0.0))
+        await server._settle(("exec", fut3, "bulk", 0.0, None, None))
         assert ctx._inflight == 0
 
     asyncio.run(scenario())

@@ -22,7 +22,13 @@ What it asserts — the resilience layer's contract, not vibes:
    declared brownout contract;
 4. **clean recovery**: within a bounded window after the last fault the
    fleet reports breaker closed, brownout level 0, and ready on every
-   poll — and the sampled ids verify byte-exact again.
+   poll — and the sampled ids verify byte-exact again;
+5. **the black box landed** (full + soak): after the worker-SIGKILL and
+   wedge legs a harvested flight file exists under ``<store>/flight/``,
+   parses, and holds the killed worker's final request summaries; the
+   flight timeline (harvested + live rings) carries the breaker
+   transitions the EIO leg induced — and, in the soak, the brownout
+   transitions the latency windows induced.
 
 Modes:
 
@@ -147,14 +153,33 @@ def compact_live_store(store_dir: str) -> dict:
 def commit_new_generation(store_dir: str) -> None:
     """One real loader commit: append a row FAR from the sampled window
     (sampled point/region references stay byte-stable) and save — the
-    workers' snapshot TTL picks it up within a quarter second."""
+    workers' snapshot TTL picks it up within a quarter second.
+
+    The load retries on a torn view: in the soak the maintenance daemon
+    compacts CONCURRENTLY, and a fresh ``load()`` that parsed the
+    manifest right before the daemon's commit GC'd the replaced segment
+    files sees a missing file — the cooperative-reader answer is to
+    reload against the new manifest, exactly like the serve snapshot
+    path does."""
     import numpy as np
 
     from annotatedvdb_tpu.loaders.lookup import identity_hashes
     from annotatedvdb_tpu.store import VariantStore
     from annotatedvdb_tpu.types import encode_allele_array
 
-    store = VariantStore.load(store_dir)
+    store = None
+    for attempt in range(5):
+        try:
+            store = VariantStore.load(store_dir)
+            break
+        except (ValueError, FileNotFoundError) as err:
+            # StoreCorruptError is a ValueError: a racing daemon commit
+            # replaced the manifest under us — reload it
+            if attempt == 4:
+                raise
+            log(f"loader commit: torn read vs a concurrent compaction "
+                f"({type(err).__name__}); reloading")
+            time.sleep(0.5)
     width = store.width
     ref, ref_len = encode_allele_array(["A"], width)
     alt, alt_len = encode_allele_array(["T"], width)
@@ -612,11 +637,11 @@ def run(args) -> tuple[dict, list[str]]:
                 "serve.wedge:1:delay:30000 (watchdog SIGKILL)",
             ]
             at(2.0)
-            arm(host, port, "serve.batch:prob:0.2:delay:20", ttl_s=6.0)
+            arm_retry("serve.batch:prob:0.2:delay:20", ttl_s=6.0)
             at(8.0)
-            arm(host, port, "engine.device_probe:prob:1.0:eio", ttl_s=2.0)
+            arm_retry("engine.device_probe:prob:1.0:eio", ttl_s=2.0)
             at(12.0)
-            arm(host, port, "snapshot.swap:1:raise")
+            arm_retry("snapshot.swap:1:raise")
             commit_new_generation(store_dir)
             log("committed a new store generation under the armed swap")
             at(14.5)
@@ -646,9 +671,12 @@ def run(args) -> tuple[dict, list[str]]:
                     f"{compact_result['files_after']} segment file(s) "
                     "under live serve load")
             at(16.0)
-            arm(host, port, "serve.accept:1:kill")
+            arm_retry("serve.accept:1:kill")
             at(22.0)
-            arm(host, port, "serve.wedge:1:delay:30000")
+            # bounded retry here matters most: this arm can land on the
+            # very worker the kill above is taking down (RemoteDisconnected
+            # mid-arm), and a 40s full run must not abort on it
+            arm_retry("serve.wedge:1:delay:30000")
             last_fault_rel = 22.0
         faults_armed = schedule_desc
 
@@ -760,6 +788,78 @@ def run(args) -> tuple[dict, list[str]]:
                 f"{preempted} preempted, read-amp end {amp} "
                 f"(converged={converged})")
 
+        # -- flight-recorder gates (full + soak: the kill/wedge legs) -------
+        flight_stats = None
+        if not args.smoke:
+            from annotatedvdb_tpu.obs import flight as flight_mod
+
+            boxes = flight_mod.list_blackboxes(store_dir)
+            harvested = []
+            parse_failures = 0
+            for p in boxes["harvested"]:
+                try:
+                    harvested.append(flight_mod.load_harvest(p))
+                except Exception as err:
+                    parse_failures += 1
+                    log(f"flight: harvested file {p} unreadable ({err})")
+            all_events = [e for d in harvested for e in d["events"]]
+            harvested_requests = sum(
+                1 for e in all_events if e.get("type") == "request"
+            )
+            for p in boxes["rings"]:
+                # the LIVE workers' rings join the timeline check: events
+                # induced after the kills (late brownout windows) live
+                # there, and the mmap'd file reads fine while they serve
+                try:
+                    all_events += flight_mod.decode_ring(p)["events"]
+                except Exception as err:
+                    log(f"flight: live ring {p} unreadable ({err})")
+            breaker_ev = sum(
+                1 for e in all_events
+                if e.get("type") == "event" and e.get("name") == "breaker"
+            )
+            brownout_ev = sum(
+                1 for e in all_events
+                if e.get("type") == "event" and e.get("name") == "brownout"
+            )
+            flight_stats = {
+                "harvested_files": len(boxes["harvested"]),
+                "parse_failures": int(parse_failures),
+                "harvested_requests": int(harvested_requests),
+                "breaker_events": int(breaker_ev),
+                "brownout_events": int(brownout_ev),
+            }
+            if not boxes["harvested"]:
+                violations.append(
+                    "no harvested flight file after the worker-SIGKILL "
+                    "and wedge legs — the black box never landed"
+                )
+            if parse_failures:
+                violations.append(
+                    f"{parse_failures} harvested flight file(s) failed "
+                    "to parse"
+                )
+            if boxes["harvested"] and harvested_requests < 1:
+                violations.append(
+                    "harvested flight rings hold no request summaries — "
+                    "the killed worker's final requests were lost"
+                )
+            if breaker_ev < 1:
+                violations.append(
+                    "flight timeline holds no breaker transition (the "
+                    "device-EIO leg tripped one; the black box missed it)"
+                )
+            if args.soak and brownout_ev < 1:
+                violations.append(
+                    "flight timeline holds no brownout transition (the "
+                    "latency windows stepped the ladder; the black box "
+                    "missed it)"
+                )
+            log(f"flight: {flight_stats['harvested_files']} harvested "
+                f"file(s), {harvested_requests} request summar(ies), "
+                f"{breaker_ev} breaker / {brownout_ev} brownout "
+                "transition(s) on the timeline")
+
         # -- aggregate + judge ----------------------------------------------
         status_counts: dict[str, int] = dict(checker.status_counts)
         errors = transport = 0
@@ -860,6 +960,8 @@ def run(args) -> tuple[dict, list[str]]:
             record["upserts"] = upsert_stats
         if maintain_stats is not None:
             record["maintain"] = maintain_stats
+        if flight_stats is not None:
+            record["flight"] = flight_stats
         if compact_result is not None:
             record["compact"] = {
                 "status": str(compact_result.get("status")),

@@ -189,6 +189,9 @@ def _check_serving(sv, where: str, errors: list) -> None:
         _check_regions(sv["regions"], w, errors)
     if "open_loop" in sv:
         _check_open_loop(sv["open_loop"], w, errors)
+    if "observability" in sv and isinstance(sv["observability"], dict) \
+            and "error" not in sv["observability"]:
+        _check_observability(sv["observability"], w, errors)
     if "mixed_workload" in sv and isinstance(sv["mixed_workload"], dict) \
             and "error" not in sv["mixed_workload"]:
         _check_mixed_workload(sv["mixed_workload"], w, errors)
@@ -251,6 +254,68 @@ def _check_mixed_workload(mx: dict, where: str, errors: list) -> None:
                 errors.append(f"{w}.upserts: ack_p99_ms below ack_p50_ms")
 
 
+def _check_observability(ob: dict, where: str, errors: list) -> None:
+    """The tracing-overhead gate: the open-loop headline re-run with the
+    request-observability plane armed vs unarmed.  The overhead is
+    REQUIRED at/below ``max_overhead`` (3%) on sustained QPS, and on p99
+    either at/below the same ratio or under the recorded absolute noise
+    floor (``p99_abs_floor_ms`` — on a 10-40ms baseline a 3% relative
+    bound measures the container, not the code) — a record whose tracing
+    costs more is a broken record, exactly like a lost acknowledged
+    upsert."""
+    w = f"{where}.observability"
+    _check_fields(
+        ob,
+        {
+            "offered_qps": _is_num, "duration_s": _is_num,
+            "conns": _is_int, "rounds": _is_int,
+            "probe_achieved_qps": lambda v: v is None or _is_num(v),
+            "overhead_qps": _is_num, "overhead_p99": _is_num,
+            "overhead_p99_ms": _is_num, "p99_abs_floor_ms": _is_num,
+            "max_overhead": _is_num,
+            "within_bound": lambda v: isinstance(v, bool),
+        },
+        w, errors,
+        required=("offered_qps", "armed", "unarmed", "overhead_qps",
+                  "overhead_p99", "max_overhead", "within_bound"),
+    )
+    for side in ("armed", "unarmed"):
+        sd = ob.get(side)
+        if sd is None:
+            continue
+        if not isinstance(sd, dict):
+            errors.append(f"{w}.{side}: must be an object")
+            continue
+        _check_fields(
+            sd,
+            {"achieved_qps": _is_num, "p99_ms": _is_num,
+             "samples": lambda v: isinstance(v, list)},
+            f"{w}.{side}", errors, required=("achieved_qps", "p99_ms"),
+        )
+    bound = ob.get("max_overhead")
+    if _is_num(bound):
+        if _is_num(ob.get("overhead_qps")) and ob["overhead_qps"] > bound:
+            errors.append(
+                f"{w}.overhead_qps: {ob['overhead_qps']} exceeds the "
+                f"{bound} overhead bound — tracing is too expensive"
+            )
+        floor = ob.get("p99_abs_floor_ms")
+        if _is_num(ob.get("overhead_p99")) and ob["overhead_p99"] > bound \
+                and not (_is_num(floor)
+                         and _is_num(ob.get("overhead_p99_ms"))
+                         and ob["overhead_p99_ms"] <= floor):
+            errors.append(
+                f"{w}.overhead_p99: {ob['overhead_p99']} exceeds the "
+                f"{bound} bound and the absolute delta is over the "
+                "noise floor — tracing is too expensive"
+            )
+    if ob.get("within_bound") is False:
+        errors.append(
+            f"{w}.within_bound: the tracing plane failed its own "
+            "overhead gate"
+        )
+
+
 def _check_chaos(ch: dict, where: str, errors: list) -> None:
     """The PR-7 chaos/soak certification block: fault schedule + error
     budgets + recovery evidence from ``tools/chaos_soak.py``."""
@@ -310,6 +375,33 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
                     and ch["upserts"]["missing"] != 0:
                 errors.append(
                     f"{w}.upserts.missing: acknowledged-write loss"
+                )
+    if "flight" in ch:
+        # the crash-flight-recorder gates (full + soak schedules): a
+        # harvested black box must exist after the kill/wedge legs,
+        # parse, and hold the killed worker's final requests
+        if not isinstance(ch["flight"], dict):
+            errors.append(f"{w}.flight: must be an object")
+        else:
+            fl = ch["flight"]
+            _check_fields(
+                fl,
+                {"harvested_files": _is_int, "parse_failures": _is_int,
+                 "harvested_requests": _is_int, "breaker_events": _is_int,
+                 "brownout_events": _is_int},
+                f"{w}.flight", errors,
+                required=("harvested_files", "harvested_requests"),
+            )
+            if _is_int(fl.get("harvested_files")) \
+                    and fl["harvested_files"] < 1:
+                errors.append(
+                    f"{w}.flight.harvested_files: no black box was "
+                    "harvested after the kill/wedge legs"
+                )
+            if _is_int(fl.get("parse_failures")) and fl["parse_failures"]:
+                errors.append(
+                    f"{w}.flight.parse_failures: harvested flight "
+                    "file(s) failed to parse"
                 )
     if "maintain" in ch:
         # the long-autonomy soak's daemon observables (--soak only):
