@@ -57,6 +57,8 @@ HINT_803 = ("route this surface through the shared helper on both front "
 SHARED_HELPERS = frozenset({
     "parse_region_params",
     "parse_regions_body",
+    "parse_stats_body",
+    "STATS_BODY_ERROR",
     "parse_upsert_body",
     "upsert_execute",
     "healthz_payload",

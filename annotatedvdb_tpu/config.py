@@ -143,6 +143,14 @@ ENV_VARS: dict = {
                                      "engages (default 32; smaller groups "
                                      "take the byte-identical host path, "
                                      "0 sends every group to the device)",
+    "AVDB_SERVE_STATS_MAX": "max query intervals per POST /stats/region "
+                            "analytics batch (default 4096; over-cap "
+                            "batches are 400)",
+    "AVDB_SERVE_STATS_DEVICE_MIN": "min intervals per chromosome group "
+                                   "before the fused stats kernel engages "
+                                   "(default 16; smaller panels take the "
+                                   "byte-identical host twin, 0 sends "
+                                   "every group to the device)",
     "AVDB_SERVE_WORKERS": "serve fleet size: N>1 runs N worker processes "
                           "sharing the port and one readonly store "
                           "generation (default 1)",

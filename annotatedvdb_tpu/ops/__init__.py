@@ -49,6 +49,10 @@ TWINS: dict = {
     "ops.pack.pack_outputs_jit": "ops.pack.pack_outputs_np",
     "ops.pack.inflate_alleles_jit": "ops.pack.inflate_alleles_np",
     "ops.pack.pack_vep_outputs_jit": "ops.pack.pack_vep_outputs_np",
+    # fused analytics kernels (ops/stats.py): integer-only segmented
+    # reductions, so the twins are byte-exact by construction
+    "ops.stats.stats_panel_kernel_jit": "ops.stats.stats_panel_host",
+    "ops.stats.windowed_stats_kernel_jit": "ops.stats.windowed_stats_host",
 }
 
 __all__ = ["annotate_kernel", "bin_index_kernel", "LEAF_SIZE",

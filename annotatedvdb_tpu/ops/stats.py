@@ -1,0 +1,562 @@
+"""Fused on-device analytics: segmented aggregation over interval spans.
+
+The reference answers every analytical question — allele-frequency
+rollups, score distributions, per-bin summaries — with Postgres
+aggregates over the JSONB columns: every row ships to the host, every
+request re-parses the sidecar.  GenPIP (arXiv 2209.08600) and Endeavor's
+batched PairHMM (arXiv 2606.25738) make the opposite argument this
+subsystem implements: keep the whole analysis device-resident and FUSED.
+Rows never leave the device; a panel of query intervals is answered by
+ONE kernel call per chromosome group that fuses the BITS span search
+(``ops/intervals``) with segmented reductions over pre-decoded feature
+columns.
+
+**Fixed-point, bit-sliced, byte-exact.**  The device/host twin contract
+(``ops.TWINS``) demands byte-identical answers from the jitted kernel
+and its numpy twin — and float reductions cannot promise that (XLA owns
+the association order, and jax runs 32-bit here).  So feature values are
+decoded ONCE per (store generation, chromosome) into **int32 fixed
+point** (``AF_SCALE``/``CADD_SCALE``, missing = ``STATS_MISSING``), and
+every reduction is integer:
+
+- histograms / rank rollups: bucket one-hots, ``int32`` prefix-summed,
+  gathered at the span end-points — exact counts;
+- sums (for means): **bit-sliced summation** — the value's
+  ``SUM_BITS`` bits prefix-sum as separate int32 lanes (each lane's
+  cumsum is bounded by the row count, so int32 can never overflow), and
+  the int64 recombination ``sum = Σ lane_b << b`` happens on the host
+  (:func:`lanes_to_sums`).  Integer addition is associative, so the
+  kernel and the twin agree bit for bit by construction.
+
+The prefix-sum-then-gather shape means a Q-interval panel costs one
+O(K) pass over the column plus O(Q) gathers — not O(Q·K) masked
+reductions — and overlapping intervals share the same cumulants.
+Working set: the transient cumulant tensors are
+``K x (2·SUM_BITS + |AF bins| + |CADD bins| + RANK_BUCKETS)`` int32
+(~300 B/row); callers bound K per call (one chromosome group).
+
+Two jitted kernels, each with a registered numpy twin:
+
+- :func:`stats_panel_kernel` — spans + AF spectrum + CADD histogram +
+  consequence-rank rollup, fused (cohort allele-frequency aggregation
+  and score distributions in one call);
+- :func:`windowed_stats_kernel` — the segmented scan keyed on the
+  interval spans: each interval subdivides into ``windows`` equal bins
+  and reports per-window row counts and CADD cumulants (the per-bin
+  summary-stat mode).
+
+Shapes pad to powers of two (the ``interval_spans`` discipline) so
+drifting panel sizes reuse one traced program.  The host-side decode
+(:func:`feature_values`), the quantile/mean derivation
+(:func:`hist_quantiles`, :func:`lanes_to_sums`), and the per-interval
+envelope builder (:func:`interval_summary`) live here too — serving,
+``doctor profile``, and the bench reference all consume the SAME
+definitions, so their answers can only agree.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from annotatedvdb_tpu.ops.intervals import clamped_queries
+from annotatedvdb_tpu.utils.arrays import POS_SENTINEL, pad_pow2
+
+#: fixed-point scales: allele frequencies quantize to 1e-6 (fp <= 1e6),
+#: CADD phred to 1e-3 (phred clamps to [0, ~2097] — far above any real
+#: score).  Both stay under 2**SUM_BITS so the bit-sliced sum is exact.
+AF_SCALE = 1_000_000
+CADD_SCALE = 1_000
+
+#: bits per fixed-point value in the sliced summation (values clamp to
+#: FP_CAP at decode time; each bit lane's int32 cumsum is bounded by the
+#: row count, so the kernel is overflow-free for any K < 2**31)
+SUM_BITS = 21
+FP_CAP = (1 << SUM_BITS) - 1
+
+#: the missing-value sentinel of every int32 feature column (decode
+#: clamps real values to >= 0, so the sign bit IS the missing flag)
+STATS_MISSING = -1
+
+#: cohort-max allele-frequency spectrum edges (fractions; the standard
+#: rare/low/common banding) — fixed-point int32, ``len - 1`` bins;
+#: values outside the range clamp into the boundary bins
+AF_EDGES_FP = np.asarray(
+    [0, 10, 100, 1_000, 5_000, 10_000, 50_000,
+     100_000, 250_000, 500_000, 1_000_000],
+    np.int32,
+)
+
+#: CADD-phred histogram edges (phred units x CADD_SCALE)
+CADD_EDGES_FP = np.asarray(
+    [0, 1_000, 5_000, 10_000, 15_000, 20_000, 25_000,
+     30_000, 40_000, 50_000, 100_000],
+    np.int32,
+)
+
+#: consequence-rank rollup buckets: ADSP ranks are small positive ints;
+#: anything at/above the cap counts in the last bucket
+RANK_BUCKETS = 32
+
+#: windowed-mode bound: windows are rendered arrays, and each distinct
+#: count is one traced program
+MAX_WINDOWS = 64
+
+
+# ---------------------------------------------------------------------------
+# device kernels (jnp) — integer-only, so the numpy twins are byte-exact
+
+
+def _cum0(x):
+    """Prefix-sum along axis 0 with a leading zero row: ``out[hi] -
+    out[lo]`` is the [lo, hi) segment total."""
+    zero = jnp.zeros((1,) + x.shape[1:], x.dtype)
+    return jnp.concatenate([zero, jnp.cumsum(x, axis=0, dtype=x.dtype)])
+
+
+def _lane_bits(v, mask):
+    """[K, SUM_BITS] int32 bit planes of ``v`` where ``mask`` (else 0)."""
+    shifts = jnp.arange(SUM_BITS, dtype=jnp.int32)
+    bits = (v[:, None] >> shifts[None, :]) & 1
+    return jnp.where(mask[:, None], bits, 0).astype(jnp.int32)
+
+
+def _bucket_onehot(v, mask, edges):
+    """[K, B] int32 one-hots of ``v``'s histogram bucket where ``mask``.
+    Out-of-range values clamp into the boundary bins."""
+    nbins = int(edges.shape[0]) - 1
+    bucket = jnp.clip(
+        jnp.searchsorted(jnp.asarray(edges, jnp.int32), v, side="right") - 1,
+        0, nbins - 1,
+    )
+    onehot = bucket[:, None] == jnp.arange(nbins, dtype=bucket.dtype)[None, :]
+    return jnp.where(mask[:, None], onehot, False).astype(jnp.int32)
+
+
+def stats_panel_kernel(pos, af, cadd, rank, starts, ends):
+    """The fused analytics panel for one chromosome group.
+
+    ``pos`` [K] — the group's position-sorted deduplicated coordinates
+    (the serve engine's interval index, sentinel-padded);
+    ``af``/``cadd``/``rank`` [K] int32 — fixed-point feature columns
+    aligned to ``pos`` (``STATS_MISSING`` = absent annotation);
+    ``starts``/``ends`` [Q] int32 — clamped 1-based inclusive intervals.
+
+    Fuses the BITS span search with every segmented reduction: returns
+    ``(lo, hi, af_lanes [Q,SUM_BITS], af_hist [Q,B_af],
+    cadd_lanes [Q,SUM_BITS], cadd_hist [Q,B_cadd],
+    rank_counts [Q,RANK_BUCKETS])`` — all int32, all exact.  ``hi - lo``
+    is the per-interval row count, a histogram's row-sum its present
+    count, and :func:`lanes_to_sums` recombines the bit lanes into the
+    exact int64 sums on the host."""
+    pos = pos.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    ends = ends.astype(jnp.int32)
+    lo = jnp.searchsorted(pos, starts, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(pos, ends, side="right").astype(jnp.int32)
+
+    def feature(v, edges):
+        v = v.astype(jnp.int32)
+        mask = v >= 0
+        cum_lanes = _cum0(_lane_bits(v, mask))
+        cum_hist = _cum0(_bucket_onehot(v, mask, edges))
+        return cum_lanes[hi] - cum_lanes[lo], cum_hist[hi] - cum_hist[lo]
+
+    af_lanes, af_hist = feature(af, AF_EDGES_FP)
+    cadd_lanes, cadd_hist = feature(cadd, CADD_EDGES_FP)
+    rank = rank.astype(jnp.int32)
+    rmask = rank >= 0
+    rbucket = jnp.clip(rank, 0, RANK_BUCKETS - 1)
+    ronehot = jnp.where(
+        rmask[:, None],
+        rbucket[:, None] == jnp.arange(RANK_BUCKETS,
+                                       dtype=rbucket.dtype)[None, :],
+        False,
+    ).astype(jnp.int32)
+    cum_rank = _cum0(ronehot)
+    rank_counts = cum_rank[hi] - cum_rank[lo]
+    return lo, hi, af_lanes, af_hist, cadd_lanes, cadd_hist, rank_counts
+
+
+stats_panel_kernel_jit = jax.jit(stats_panel_kernel)
+
+
+def windowed_stats_kernel(pos, cadd, starts, ends, windows: int):
+    """Per-bin summary stats: the segmented scan keyed on interval spans.
+
+    Each query interval subdivides into ``windows`` equal-width bins
+    (integer boundary arithmetic — ``b_w = start + q·w + (r·w)//W`` with
+    ``q, r = divmod(span, W)``, overflow-free and exactly
+    ``start + (span·w)//W``), and one searchsorted over the boundary
+    matrix plus cumulant gathers report per-window ``counts`` (rows),
+    ``present`` (rows carrying a CADD score) and ``lanes`` (bit-sliced
+    CADD sums) — the windowed distribution a density/coverage track
+    renders from.  ``windows`` is static (one traced program per
+    count)."""
+    pos = pos.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    ends = ends.astype(jnp.int32)
+    w = jnp.arange(windows + 1, dtype=jnp.int32)
+    span = ends - starts + 1
+    q, r = span // windows, span % windows
+    bounds = (starts[:, None] + q[:, None] * w[None, :]
+              + (r[:, None] * w[None, :]) // windows)
+    idx = jnp.searchsorted(
+        pos, bounds.reshape(-1), side="left"
+    ).reshape(bounds.shape).astype(jnp.int32)
+    counts = idx[:, 1:] - idx[:, :-1]
+    cadd = cadd.astype(jnp.int32)
+    mask = cadd >= 0
+    cum_n = _cum0(mask.astype(jnp.int32))
+    cum_lanes = _cum0(_lane_bits(cadd, mask))
+    present = cum_n[idx[:, 1:]] - cum_n[idx[:, :-1]]
+    lanes = cum_lanes[idx[:, 1:]] - cum_lanes[idx[:, :-1]]
+    return counts, present, lanes
+
+
+windowed_stats_kernel_jit = jax.jit(
+    windowed_stats_kernel, static_argnames="windows"
+)
+
+
+# ---------------------------------------------------------------------------
+# numpy twins — the same integer arithmetic, byte-identical by construction
+
+
+def _cum0_np(x):
+    zero = np.zeros((1,) + x.shape[1:], x.dtype)
+    return np.concatenate([zero, np.cumsum(x, axis=0, dtype=x.dtype)])
+
+
+def _lane_bits_np(v, mask):
+    shifts = np.arange(SUM_BITS, dtype=np.int32)
+    bits = (v[:, None] >> shifts[None, :]) & 1
+    return np.where(mask[:, None], bits, 0).astype(np.int32)
+
+
+def _bucket_onehot_np(v, mask, edges):
+    nbins = int(edges.shape[0]) - 1
+    bucket = np.clip(
+        np.searchsorted(edges, v, side="right") - 1, 0, nbins - 1
+    )
+    onehot = bucket[:, None] == np.arange(nbins, dtype=bucket.dtype)[None, :]
+    return np.where(mask[:, None], onehot, False).astype(np.int32)
+
+
+def stats_panel_host(pos, af, cadd, rank, starts, ends):
+    """Numpy twin of :func:`stats_panel_kernel` — the registered host
+    fallback (``ops.TWINS``): the same clamped int32 inputs through the
+    same integer prefix-sum/gather definitions."""
+    pos = np.asarray(pos, np.int32)
+    starts, ends = clamped_queries(starts, ends)
+    lo = np.searchsorted(pos, starts, side="left").astype(np.int32)
+    hi = np.searchsorted(pos, ends, side="right").astype(np.int32)
+
+    def feature(v, edges):
+        v = np.asarray(v, np.int32)
+        mask = v >= 0
+        cum_lanes = _cum0_np(_lane_bits_np(v, mask))
+        cum_hist = _cum0_np(_bucket_onehot_np(v, mask, edges))
+        return cum_lanes[hi] - cum_lanes[lo], cum_hist[hi] - cum_hist[lo]
+
+    af_lanes, af_hist = feature(af, AF_EDGES_FP)
+    cadd_lanes, cadd_hist = feature(cadd, CADD_EDGES_FP)
+    rank = np.asarray(rank, np.int32)
+    rmask = rank >= 0
+    rbucket = np.clip(rank, 0, RANK_BUCKETS - 1)
+    ronehot = np.where(
+        rmask[:, None],
+        rbucket[:, None] == np.arange(RANK_BUCKETS,
+                                      dtype=rbucket.dtype)[None, :],
+        False,
+    ).astype(np.int32)
+    cum_rank = _cum0_np(ronehot)
+    rank_counts = cum_rank[hi] - cum_rank[lo]
+    return lo, hi, af_lanes, af_hist, cadd_lanes, cadd_hist, rank_counts
+
+
+def windowed_stats_host(pos, cadd, starts, ends, windows: int):
+    """Numpy twin of :func:`windowed_stats_kernel` (``ops.TWINS``)."""
+    pos = np.asarray(pos, np.int32)
+    starts, ends = clamped_queries(starts, ends)
+    w = np.arange(windows + 1, dtype=np.int32)
+    span = ends - starts + 1
+    q, r = span // windows, span % windows
+    bounds = (starts[:, None] + q[:, None] * w[None, :]
+              + (r[:, None] * w[None, :]) // windows)
+    idx = np.searchsorted(
+        pos, bounds.reshape(-1), side="left"
+    ).reshape(bounds.shape).astype(np.int32)
+    counts = idx[:, 1:] - idx[:, :-1]
+    cadd = np.asarray(cadd, np.int32)
+    mask = cadd >= 0
+    cum_n = _cum0_np(mask.astype(np.int32))
+    cum_lanes = _cum0_np(_lane_bits_np(cadd, mask))
+    present = cum_n[idx[:, 1:]] - cum_n[idx[:, :-1]]
+    lanes = cum_lanes[idx[:, 1:]] - cum_lanes[idx[:, :-1]]
+    return counts, present, lanes
+
+
+# ---------------------------------------------------------------------------
+# device entry points (padding discipline of ``interval_spans``)
+
+
+def stats_panel(pos, af, cadd, rank, starts, ends, *, padded: bool = False):
+    """Run the fused panel kernel once: clamp queries, pad rows/queries
+    to pow2 capacities (rows with the position sentinel + MISSING
+    features, queries with zeros — their garbage outputs slice away),
+    return numpy outputs.  ``padded=True`` marks the row-side arrays as
+    already padded device residents (the serve engine uploads each
+    generation's columns once)."""
+    starts, ends = clamped_queries(starts, ends)
+    nq = starts.shape[0]
+    if padded:
+        pos_p, af_p, cadd_p, rank_p = pos, af, cadd, rank
+    else:
+        pos_p = pad_pow2(np.asarray(pos, np.int32), POS_SENTINEL)
+        af_p = pad_pow2(np.asarray(af, np.int32), STATS_MISSING)
+        cadd_p = pad_pow2(np.asarray(cadd, np.int32), STATS_MISSING)
+        rank_p = pad_pow2(np.asarray(rank, np.int32), STATS_MISSING)
+    out = stats_panel_kernel_jit(
+        pos_p, af_p, cadd_p, rank_p, pad_pow2(starts, 0), pad_pow2(ends, 0)
+    )
+    return tuple(np.asarray(o)[:nq] for o in out)
+
+
+def windowed_stats(pos, cadd, starts, ends, windows: int, *,
+                   padded: bool = False):
+    """Run the windowed kernel once (same padding discipline)."""
+    starts, ends = clamped_queries(starts, ends)
+    nq = starts.shape[0]
+    if padded:
+        pos_p, cadd_p = pos, cadd
+    else:
+        pos_p = pad_pow2(np.asarray(pos, np.int32), POS_SENTINEL)
+        cadd_p = pad_pow2(np.asarray(cadd, np.int32), STATS_MISSING)
+    out = windowed_stats_kernel_jit(
+        pos_p, cadd_p, pad_pow2(starts, 0), pad_pow2(ends, 0),
+        windows=int(windows),
+    )
+    return tuple(np.asarray(o)[:nq] for o in out)
+
+
+# ---------------------------------------------------------------------------
+# host-side decode: JSONB sidecar values -> fixed-point feature scalars
+
+
+def _plain(v):
+    """A stored JSONB value as a plain mapping (or None).  ``RawJson``
+    values parse FRESH and are discarded — decoding a whole column must
+    not pin a parsed tree per row onto the shared instances (the reason
+    RawJson exists)."""
+    if v is None:
+        return None
+    if isinstance(v, dict):
+        return v
+    text = getattr(v, "text", None)  # RawJson duck-type: no store import
+    if text is not None:
+        try:
+            v = json.loads(text)
+        except ValueError:
+            return None
+        return v if isinstance(v, dict) else None
+    return v if isinstance(v, dict) else None
+
+
+def _num(x):
+    """The filter rule's numeric check: int/float, never bool."""
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _fp(value: float, scale: int) -> int:
+    return min(max(int(round(value * scale)), 0), FP_CAP)
+
+
+def feature_values(cadd_obj, af_obj, ms_obj):
+    """Decode one row's analytics features from its raw JSONB values.
+
+    Returns ``(cadd_f, rank_f, af_fp, cadd_fp, rank_i)``:
+
+    - ``cadd_f``/``rank_f`` — float64 (NaN = missing): the EXACT values
+      the reference's ``(col->>'x')::numeric`` filters compare, shared
+      with the serve engine's ``min_cadd``/``max_conseq_rank`` path;
+    - ``af_fp``/``cadd_fp``/``rank_i`` — int32 fixed point for the
+      kernels (``STATS_MISSING`` = absent).  The AF feature is the
+      **cohort-max** allele frequency: the largest numeric leaf of the
+      ``allele_frequencies`` object (one level of source nesting deep),
+      clamped to [0, 1] — the banding a rare-variant filter actually
+      keys on.
+    """
+    cadd_f = float("nan")
+    cadd_fp = STATS_MISSING
+    obj = _plain(cadd_obj)
+    if obj is not None:
+        v = obj.get("CADD_phred")
+        if _num(v):
+            cadd_f = float(v)
+            cadd_fp = _fp(max(float(v), 0.0), CADD_SCALE)
+    rank_f = float("nan")
+    rank_i = STATS_MISSING
+    obj = _plain(ms_obj)
+    if obj is not None:
+        v = obj.get("rank")
+        if _num(v):
+            rank_f = float(v)
+            rank_i = min(max(int(v), 0), RANK_BUCKETS - 1)
+    af_fp = STATS_MISSING
+    obj = _plain(af_obj)
+    if obj is not None:
+        best = None
+        for v in obj.values():
+            if _num(v):
+                if best is None or v > best:
+                    best = v
+            elif isinstance(v, dict):
+                for vv in v.values():
+                    if _num(vv) and (best is None or vv > best):
+                        best = vv
+        if best is not None:
+            af_fp = _fp(min(max(float(best), 0.0), 1.0), AF_SCALE)
+    return cadd_f, rank_f, af_fp, cadd_fp, rank_i
+
+
+# ---------------------------------------------------------------------------
+# derivation + rendering: kernel outputs -> the served summary values
+# (serving, doctor profile, and the bench reference all call THESE, so
+# a "byte-identity verdict" compares numbers produced by one code path)
+
+
+def lanes_to_sums(lanes) -> np.ndarray:
+    """Bit-lane counts -> exact int64 sums (``Σ lane_b << b``)."""
+    lanes = np.asarray(lanes, np.int64)
+    weights = np.int64(1) << np.arange(SUM_BITS, dtype=np.int64)
+    return (lanes * weights).sum(axis=-1)
+
+
+def _mean(total_fp: int, present: int, scale: int):
+    if present <= 0:
+        return None
+    return round(int(total_fp) / (int(present) * scale), 9)
+
+
+def hist_quantiles(hist_row, edges_fp, scale: int, qs=(50, 90, 99)):
+    """Approximate quantiles from exact histogram counts: the target
+    rank's bin, linearly interpolated within it — deterministic integer
+    inputs, so every consumer derives the identical float."""
+    hist_row = np.asarray(hist_row, np.int64)
+    n = int(hist_row.sum())
+    out = {}
+    if n == 0:
+        return {f"p{q}": None for q in qs}
+    cum = np.cumsum(hist_row)
+    for q in qs:
+        target = -(-n * q // 100)  # ceil(n*q/100), pure int
+        b = int(np.searchsorted(cum, target, side="left"))
+        before = int(cum[b - 1]) if b else 0
+        lo_e, hi_e = int(edges_fp[b]), int(edges_fp[b + 1])
+        within = (target - before) / int(hist_row[b])
+        out[f"p{q}"] = round((lo_e + (hi_e - lo_e) * within) / scale, 6)
+    return out
+
+
+#: the metric families a stats request may select (render-side only —
+#: the fused kernel always computes the full panel in one call)
+STATS_METRICS = ("af", "cadd", "conseq")
+
+
+def summary_from_totals(count: int, af_sum: int, af_hist, cadd_sum: int,
+                        cadd_hist, rank_counts, metrics=STATS_METRICS,
+                        windows_block=None) -> dict:
+    """One summary dict from exact integer totals — THE envelope shape
+    ``POST /stats/region``, ``doctor profile`` and the bench reference
+    all render through (present counts derive from the histograms, which
+    clamp every present value into a bin)."""
+    out: dict = {"count": int(count)}
+    if "af" in metrics:
+        hist = np.asarray(af_hist, np.int64)
+        present = int(hist.sum())
+        out["af"] = {
+            "present": present,
+            "mean": _mean(int(af_sum), present, AF_SCALE),
+            "spectrum": [int(c) for c in hist],
+        }
+    if "cadd" in metrics:
+        hist = np.asarray(cadd_hist, np.int64)
+        present = int(hist.sum())
+        out["cadd"] = {
+            "present": present,
+            "mean": _mean(int(cadd_sum), present, CADD_SCALE),
+            "histogram": [int(c) for c in hist],
+            "quantiles": hist_quantiles(hist, CADD_EDGES_FP, CADD_SCALE),
+        }
+    if "conseq" in metrics:
+        counts = np.asarray(rank_counts, np.int64)
+        out["conseq"] = {
+            "present": int(counts.sum()),
+            "ranks": {str(r): int(c) for r, c in enumerate(counts) if c},
+        }
+    if windows_block is not None:
+        out["windows"] = windows_block
+    return out
+
+
+def interval_summary(count: int, af_lanes, af_hist, cadd_lanes, cadd_hist,
+                     rank_counts, metrics=STATS_METRICS,
+                     windows_block=None) -> dict:
+    """One interval's summary dict from its kernel-output rows:
+    recombine the bit lanes, then render through
+    :func:`summary_from_totals`."""
+    return summary_from_totals(
+        count, int(lanes_to_sums(af_lanes)), af_hist,
+        int(lanes_to_sums(cadd_lanes)), cadd_hist, rank_counts,
+        metrics, windows_block,
+    )
+
+
+def column_totals(values, edges):
+    """(present, exact_sum, hist) of one fixed-point column chunk on the
+    host — the ``doctor profile`` accumulator unit, the SAME clamped
+    bucketing the kernels apply."""
+    v = np.asarray(values, np.int64)
+    v = v[v >= 0]
+    nbins = int(np.asarray(edges).shape[0]) - 1
+    bucket = np.clip(
+        np.searchsorted(np.asarray(edges, np.int64), v, side="right") - 1,
+        0, nbins - 1,
+    )
+    hist = np.bincount(bucket, minlength=nbins).astype(np.int64)
+    return int(v.shape[0]), int(v.sum()), hist
+
+
+def rank_totals(ranks):
+    """Clamped consequence-rank bucket counts of one column chunk."""
+    r = np.asarray(ranks, np.int64)
+    r = np.clip(r[r >= 0], 0, RANK_BUCKETS - 1)
+    return np.bincount(r, minlength=RANK_BUCKETS).astype(np.int64)
+
+
+def windows_summary(counts_row, present_row, lanes_row) -> dict:
+    """One interval's windowed block from its kernel-output rows."""
+    sums = lanes_to_sums(lanes_row)
+    return {
+        "n": int(np.asarray(counts_row).shape[0]),
+        "counts": [int(c) for c in np.asarray(counts_row)],
+        "cadd_present": [int(p) for p in np.asarray(present_row)],
+        "cadd_mean": [
+            _mean(int(s), int(p), CADD_SCALE)
+            for s, p in zip(sums, np.asarray(present_row))
+        ],
+    }
+
+
+def edges_payload() -> dict:
+    """The bin-edge declaration rendered once per response, so a client
+    can label the spectrum/histogram arrays without guessing."""
+    return {
+        "af": [round(int(e) / AF_SCALE, 6) for e in AF_EDGES_FP],
+        "cadd": [round(int(e) / CADD_SCALE, 3) for e in CADD_EDGES_FP],
+        "rank_buckets": RANK_BUCKETS,
+    }

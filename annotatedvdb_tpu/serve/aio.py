@@ -76,13 +76,17 @@ from annotatedvdb_tpu.serve.http import (
     BULK_BODY_ERROR,
     MSG_BROWNOUT_BULK,
     MSG_BROWNOUT_REGION,
+    MSG_BROWNOUT_STATS,
     MSG_BROWNOUT_UPSERT,
     MSG_CAPACITY_BULK,
     MSG_CAPACITY_REGION,
+    MSG_CAPACITY_STATS,
     MSG_CAPACITY_UPSERT,
     MSG_DEADLINE_ADMISSION,
     MSG_DEADLINE_EXECUTE,
     REGIONS_BODY_ERROR,
+    STATS_BODY_ERROR,
+    STATS_ROUTE,
     TRACE_HEADER,
     UPSERT_BODY_ERROR,
     UPSERT_ROUTE,
@@ -93,6 +97,7 @@ from annotatedvdb_tpu.serve.http import (
     metrics_payload,
     parse_region_params,
     parse_regions_body,
+    parse_stats_body,
     parse_upsert_body,
     readyz_payload,
     resolve_trace_id,
@@ -1261,6 +1266,9 @@ class AioServer:
                 if path == "/regions":
                     ctx.errored("regions")
                     return _error(400, REGIONS_BODY_ERROR), False, tid
+                if path == STATS_ROUTE:
+                    ctx.errored("stats")
+                    return _error(400, STATS_BODY_ERROR), False, tid
                 return _error(404, f"no such route: {path}"), False, tid
             if length < 0 or length > MAX_BODY:
                 return _error(
@@ -1323,6 +1331,24 @@ class AioServer:
                     max_ids = self.governor.bulk_budget(weight)
                 return self._regions_item(
                     body, http11, client, max_ids, deadline_t, tid
+                ), keep, tid
+            if path == STATS_ROUTE:
+                if ctx.governor.shed_bulk():
+                    ctx.brownout_shed()
+                    return _error(503, MSG_BROWNOUT_STATS), keep, tid
+                retry = self._admit_client(headers, writer)
+                if retry:
+                    ctx.rejected("stats")
+                    return _error(
+                        429, "client over rate (stats admission)",
+                        retry_after=max(int(retry + 0.999), 1),
+                    ), keep, tid
+                client = max_ids = None
+                if self.governor is not None:
+                    client, weight = self._client_key(headers, writer)
+                    max_ids = self.governor.bulk_budget(weight)
+                return self._stats_item(
+                    body, client, max_ids, deadline_t, tid
                 ), keep, tid
             if path == "/_chaos" and self._chaos_enabled:
                 return self._chaos_item(body), keep, tid
@@ -1668,6 +1694,86 @@ class AioServer:
         finally:
             if not stream_holds_slot:
                 ctx.release()
+
+    def _stats_item(self, body: bytes, client: str | None = None,
+                    max_ids: int | None = None,
+                    deadline_t: float | None = None,
+                    tid: str | None = None):
+        """Analytics panel: the bulk admission shape (slot + per-client
+        budget); bodies are summaries, so there is no streaming shape."""
+        ctx = self.ctx
+        t0 = time.perf_counter()
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
+        if not ctx.admit():
+            ctx.rejected("stats")
+            return _error(429, MSG_CAPACITY_STATS, retry_after=1)
+        trace = ctx.reqtrace.begin(tid, "stats") if tid is not None \
+            else None
+        fut = self._loop.run_in_executor(
+            self._pool, self._stats_work, body, t0, client, max_ids,
+            deadline_t, trace
+        )
+        return ("exec", fut, "stats", t0, tid, trace)
+
+    def _stats_work(self, body: bytes, t0: float,
+                    client: str | None = None,
+                    max_ids: int | None = None,
+                    deadline_t: float | None = None, trace=None) -> bytes:
+        """Executor half of a stats request (parse, fused panel, render,
+        account); never raises — errors become response bytes."""
+        ctx = self.ctx
+        try:
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                ctx.deadline_shed("execute")
+                return _error(504, MSG_DEADLINE_EXECUTE)
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
+            try:
+                specs, metrics, windows = parse_stats_body(body)
+            except QueryError as err:
+                ctx.errored("stats")
+                return _error(400, str(err))
+            if max_ids is not None and len(specs) > max_ids:
+                # the bounded-debt contract of bulk /variants: a panel
+                # the bucket could never repay within MAX_DEBT_S is
+                # rejected before any scan runs
+                ctx.rejected("stats")
+                return _error(429, (
+                    f"stats batch of {len(specs)} exceeds client rate "
+                    f"budget ({max_ids} intervals); split the request"
+                ), retry_after=1)
+            if client is not None and len(specs) > 1:
+                # admission spent ONE token; the other intervals debit
+                # the bucket too (on the loop thread — the governor is
+                # single-threaded by construction)
+                self._loop.call_soon_threadsafe(
+                    self.governor.charge, client, float(len(specs) - 1)
+                )
+            try:
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    result = ctx.engine.stats_serve(
+                        specs, metrics=metrics, windows=windows,
+                    )
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
+            except QueryError as err:
+                ctx.errored("stats")
+                return _error(400, str(err))
+            except Exception as err:
+                ctx.errored("stats")
+                return _error(500, f"{type(err).__name__}: {err}")
+            t_render = time.perf_counter()
+            resp = _resp(200, result.assemble())
+            ctx.observe("stats", time.perf_counter() - t0,
+                        rows=result.returned)
+            if trace is not None:
+                trace.add("render", time.perf_counter() - t_render)
+            return resp
+        finally:
+            ctx.release()
 
     def _region_item(self, spec: str, query: str, http11: bool = True,
                      deadline_t: float | None = None,

@@ -95,6 +95,32 @@ def resolve_regions_knobs(regions_max, device_min):
     return max(int(regions_max), 1), max(int(device_min), 0)
 
 
+def resolve_stats_knobs(stats_max, device_min):
+    """The analytics-panel knobs, resolved in ONE place (the
+    :func:`resolve_batch_knobs` contract — both front ends and the
+    engine must see identical env defaults):
+
+    - ``AVDB_SERVE_STATS_MAX``        — max query intervals per
+      ``POST /stats/region`` batch (default 4096; an over-cap batch is
+      a 400, never an unbounded device call);
+    - ``AVDB_SERVE_STATS_DEVICE_MIN`` — min intervals per chromosome
+      group before the fused stats kernel engages (default 16: smaller
+      panels take the byte-identical host twin — a stats panel already
+      amortizes its prefix sums over the whole group, so the dispatch
+      pays off earlier than the span search's 32; 0 sends every group
+      to the device).
+    """
+    if stats_max is None:
+        stats_max = int(
+            os.environ.get("AVDB_SERVE_STATS_MAX", "") or 4096
+        )
+    if device_min is None:
+        device_min = int(
+            os.environ.get("AVDB_SERVE_STATS_DEVICE_MIN", "") or 16
+        )
+    return max(int(stats_max), 1), max(int(device_min), 0)
+
+
 class _Pending:
     """One caller's query in flight: the drain thread fills ``result`` or
     ``error`` then sets ``done`` (the Event publishes the write).  An

@@ -64,6 +64,7 @@ import numpy as np
 from annotatedvdb_tpu.loaders.lookup import identity_hashes
 from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.ops import intervals as interval_ops
+from annotatedvdb_tpu.ops import stats as stats_ops
 from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
 from annotatedvdb_tpu.oracle.binindex import closed_form_path
 from annotatedvdb_tpu.store.variant_store import (
@@ -487,6 +488,143 @@ class IntervalIndex:
         self._dev_pos = None
 
 
+class StatsColumns:
+    """One chromosome group's decoded analytics feature columns, aligned
+    row-for-row to its :class:`IntervalIndex`.
+
+    The JSONB sidecar is decoded ONCE per (store generation, chromosome)
+    — ``feature_values`` walks every index row exactly one time — into:
+
+    - ``cadd_f``/``rank_f`` float64 (NaN = missing): the exact values the
+      ``min_cadd``/``max_conseq_rank`` filters compare, so the serving
+      filter path stops re-parsing sidecar JSON per row per request (the
+      old ``_ann_number``-per-row hot spot) while staying byte-identical
+      to the scalar ``_passes`` definition;
+    - ``af_fp``/``cadd_fp``/``rank_i`` int32 fixed point
+      (``ops.stats.STATS_MISSING`` = absent): the stats kernels' inputs.
+
+    Because the columns align to the index (position-sorted, first-wins
+    deduplicated, memtable overlay segments included), a BITS span over
+    the index IS a slice of these columns — filters vectorize and the
+    fused stats kernel reduces over them directly.  ``device()`` uploads
+    the sentinel-padded kernel columns once per generation (the
+    ``IntervalIndex.device_pos`` discipline; same pow2 capacity, so the
+    traced program is shared)."""
+
+    __slots__ = ("cadd_f", "rank_f", "af_fp", "cadd_fp", "rank_i", "_dev")
+
+    def __init__(self, cadd_f, rank_f, af_fp, cadd_fp, rank_i):
+        self.cadd_f = cadd_f
+        self.rank_f = rank_f
+        self.af_fp = af_fp
+        self.cadd_fp = cadd_fp
+        self.rank_i = rank_i
+        self._dev = None
+
+    @classmethod
+    def build(cls, shard, index: "IntervalIndex") -> "StatsColumns":
+        n = index.n
+        cadd_f = np.full(n, np.nan, np.float64)
+        rank_f = np.full(n, np.nan, np.float64)
+        af_fp = np.full(n, stats_ops.STATS_MISSING, np.int32)
+        cadd_fp = np.full(n, stats_ops.STATS_MISSING, np.int32)
+        rank_i = np.full(n, stats_ops.STATS_MISSING, np.int32)
+        si, jj = index.si, index.jj
+        # group index rows per segment in ONE stable sort + run split —
+        # a per-segment boolean scan would be O(segments x rows), which
+        # on an overlay-heavy pre-compaction shard is minutes of pure
+        # grouping before any decode
+        order = np.argsort(si, kind="stable")
+        run_starts = np.nonzero(
+            np.diff(si[order], prepend=si[order[0]] - 1 if order.size
+                    else 0)
+        )[0]
+        for r, lo in enumerate(run_starts.tolist()):
+            hi = run_starts[r + 1] if r + 1 < len(run_starts) \
+                else order.shape[0]
+            s = int(si[order[lo]])
+            seg = shard.segments[s]
+            cadd_col = seg.obj["cadd_scores"]
+            af_col = seg.obj["allele_frequencies"]
+            ms_col = seg.obj["adsp_most_severe_consequence"]
+            if cadd_col is None and af_col is None and ms_col is None:
+                continue  # nothing annotated: the columns stay MISSING
+            for t in order[lo:hi].tolist():
+                j = int(jj[t])
+                cf, rf, afp, cfp, ri = stats_ops.feature_values(
+                    cadd_col[j] if cadd_col is not None else None,
+                    af_col[j] if af_col is not None else None,
+                    ms_col[j] if ms_col is not None else None,
+                )
+                cadd_f[t] = cf
+                rank_f[t] = rf
+                af_fp[t] = afp
+                cadd_fp[t] = cfp
+                rank_i[t] = ri
+        return cls(cadd_f, rank_f, af_fp, cadd_fp, rank_i)
+
+    def device(self):
+        """The sentinel-padded kernel columns on device (uploaded once;
+        a failure propagates — the caller falls back host-side and feeds
+        the circuit breaker)."""
+        if self._dev is None:
+            import jax
+
+            from annotatedvdb_tpu.utils.arrays import pad_pow2
+
+            self._dev = tuple(
+                jax.device_put(pad_pow2(a, stats_ops.STATS_MISSING))
+                for a in (self.af_fp, self.cadd_fp, self.rank_i)
+            )
+        return self._dev
+
+    def device_bytes(self) -> int:
+        """Bytes the retained device copies occupy (0 when none): three
+        pow2-padded int32 columns — the INDEX_DEVICE_BYTES ledger's unit,
+        same accessor contract as ``IntervalIndex.device_bytes``."""
+        if self._dev is None:
+            return 0
+        from annotatedvdb_tpu.utils.arrays import next_pow2
+
+        return 3 * next_pow2(int(self.af_fp.shape[0])) * 4
+
+    def drop_device(self) -> None:
+        """Forget a (possibly half-built) device copy after a failed
+        kernel call or a budget eviction — host arrays stay, answers
+        stay byte-identical."""
+        self._dev = None
+
+
+class StatsResult:
+    """One prepared analytics answer: per-interval summary dicts in
+    request order, wrapped as ``{"n", "generation", "metrics", "bins",
+    "results"}``.  ``assemble()`` is the ONE renderer both front ends
+    buffer from (stats bodies are summaries — kilobytes, never
+    row-materializing — so there is no streaming shape)."""
+
+    __slots__ = ("generation", "metrics", "entries")
+
+    def __init__(self, generation: int, metrics, entries: list):
+        self.generation = generation
+        self.metrics = list(metrics)
+        self.entries = entries
+
+    @property
+    def returned(self) -> int:
+        """Summary rows rendered (one per interval) — the metrics row
+        count."""
+        return len(self.entries)
+
+    def assemble(self) -> str:
+        return json.dumps({
+            "n": len(self.entries),
+            "generation": self.generation,
+            "metrics": self.metrics,
+            "bins": stats_ops.edges_payload(),
+            "results": self.entries,
+        }, separators=(",", ":"))
+
+
 class RegionsResult:
     """One prepared batch-region answer: per-interval envelopes (each a
     :class:`RegionPage`, byte-identical to its single-``region()`` call)
@@ -551,22 +689,39 @@ class QueryEngine:
     #: generation swap naturally ages the old entries out of the LRU)
     INDEX_CACHE = 64
     #: byte ceiling on RETAINED device copies of interval indexes (the
-    #: BITS kernel's search arrays, which live OUTSIDE the residency
-    #: manager's ``--hbmBudget`` plan): beyond it the least-recently-used
-    #: indexes drop their device copy — host arrays stay, answers are
-    #: byte-identical, only the re-upload cost returns.  Without this a
-    #: 64-entry count bound could pin 64 x chromosome-sized position
-    #: arrays of HBM on a large store.
+    #: BITS kernel's search arrays) AND stats feature columns (the fused
+    #: analytics kernel's inputs, ~3x the position bytes per group) —
+    #: all of which live OUTSIDE the residency manager's ``--hbmBudget``
+    #: plan: beyond it the least-recently-used entries drop their device
+    #: copy — host arrays stay, answers are byte-identical, only the
+    #: re-upload cost returns.  Without this the count-bounded caches
+    #: could pin dozens of chromosome-sized arrays of HBM on a large
+    #: store.
     INDEX_DEVICE_BYTES = 256 << 20
+
+    #: retained stats feature-column sets (one per (generation,
+    #: chromosome), the INDEX_CACHE discipline; ~33 bytes/row each).
+    #: Sized like INDEX_CACHE — a human store loads ~24 chromosome
+    #: groups, and a cross-chromosome filtered workload cycling past the
+    #: cap would re-pay the full-chromosome sidecar decode per request
+    STATS_CACHE = 64
 
     def __init__(self, snapshots, registry=None,
                  region_cache_size: int | None = None, residency=None,
                  breaker=None, regions_max: int | None = None,
-                 regions_device_min: int | None = None, mesh=None):
-        from annotatedvdb_tpu.serve.batcher import resolve_regions_knobs
+                 regions_device_min: int | None = None, mesh=None,
+                 stats_max: int | None = None,
+                 stats_device_min: int | None = None):
+        from annotatedvdb_tpu.serve.batcher import (
+            resolve_regions_knobs,
+            resolve_stats_knobs,
+        )
 
         self.snapshots = snapshots
         self.residency = residency
+        self.stats_max, self.stats_device_min = resolve_stats_knobs(
+            stats_max, stats_device_min
+        )
         #: mesh executor (serve/mesh_exec.MeshExecutor) or None — when set,
         #: bulk lookups and region panels collapse to ONE sharded call
         #: each; every mesh miss/failure falls back to the single-device
@@ -600,6 +755,10 @@ class QueryEngine:
         #: guarded by self._cache_lock; (generation, code) ->
         #: :class:`IntervalIndex` (the BITS search database per group)
         self._index_cache: OrderedDict = OrderedDict()
+        #: guarded by self._cache_lock; (generation, code) ->
+        #: :class:`StatsColumns` (sidecar features decoded ONCE per
+        #: generation — shared by stats kernels and region filters)
+        self._stats_cache: OrderedDict = OrderedDict()
         #: guarded by self._cache_lock; id(index) -> (index, bytes) for
         #: indexes holding a device copy — the INDEX_DEVICE_BYTES ledger
         self._index_device: OrderedDict = OrderedDict()
@@ -958,13 +1117,15 @@ class QueryEngine:
                 kept = list(zip(index.si[i_lo:i_lo + take].tolist(),
                                 index.jj[i_lo:i_lo + take].tolist()))
             else:
-                kept = list(zip(index.si[i_lo:i_hi].tolist(),
-                                index.jj[i_lo:i_hi].tolist()))
-                kept = [
-                    (si, j) for si, j in kept
-                    if self._passes(shard.segments[si], j,
-                                    min_cadd, max_conseq_rank)
-                ]
+                # filters vectorize over the cached feature columns —
+                # never a per-row sidecar parse (semantics pinned
+                # byte-identical to the scalar _passes definition)
+                sel = self._filter_span(
+                    snap, code, index, i_lo, i_hi, min_cadd,
+                    max_conseq_rank,
+                )
+                kept = list(zip(index.si[sel].tolist(),
+                                index.jj[sel].tolist()))
                 count = len(kept)
             stop = len(kept) if limit is None \
                 else min(max(int(limit), 0), len(kept))
@@ -996,6 +1157,231 @@ class QueryEngine:
                 "count": (hi - lo).tolist(),
             }
         return RegionsResult(pages, tokens)
+
+    # -- analytics (the fused stats panel) -----------------------------------
+
+    def stats_serve(self, specs: list, metrics=None,
+                    windows: int | None = None,
+                    host_only: bool = False) -> StatsResult:
+        """On-device analytics over a batch of ``chr:start-end`` intervals:
+        ONE fused kernel call per touched chromosome group answers the
+        whole panel — per-interval row count, cohort-max allele-frequency
+        spectrum + mean, CADD-phred histogram/mean/quantiles, and the
+        consequence-rank rollup — over the generation's cached feature
+        columns (memtable overlay rows ride the interval index, first-wins
+        like every read path).
+
+        ``metrics`` selects rendered sections (default all of
+        ``ops.stats.STATS_METRICS``; the kernel always computes the full
+        panel — selection is render-side, so one traced program serves
+        every request shape).  ``windows=W`` adds the per-bin summary
+        block: each interval subdivided into W equal windows with
+        per-window row counts and CADD means (the segmented scan keyed on
+        the interval spans).  ``host_only=True`` — or an open circuit
+        breaker — pins the reductions to the byte-identical numpy twins.
+        Grammar is validated up front: one bad spec fails the CALL with
+        :class:`QueryError` (the bulk contract)."""
+        if len(specs) > self.stats_max:
+            raise QueryError(
+                f"stats batch of {len(specs)} exceeds the "
+                f"{self.stats_max}-interval cap (AVDB_SERVE_STATS_MAX); "
+                "split the request"
+            )
+        if metrics is None:
+            metrics = list(stats_ops.STATS_METRICS)
+        else:
+            if not isinstance(metrics, (list, tuple)) or not metrics or \
+                    any(m not in stats_ops.STATS_METRICS for m in metrics):
+                raise QueryError(
+                    "stats metrics must be a non-empty subset of: "
+                    + ", ".join(stats_ops.STATS_METRICS)
+                )
+            metrics = list(metrics)
+        if windows is not None:
+            windows = int(windows)
+            if not 1 <= windows <= stats_ops.MAX_WINDOWS:
+                raise QueryError(
+                    f"stats windows must be in [1, {stats_ops.MAX_WINDOWS}]"
+                )
+        parsed = [parse_region(s) for s in specs]
+        snap = self.snapshots.current()
+        if self.residency is not None:
+            self.residency.govern(snap)
+        # crash point: the panel is parsed, nothing executed — a failure
+        # here must fail exactly this request's caller (HTTP 500) and
+        # leave the engine answering the next panel byte-identically
+        faults.fire("serve.stats")
+        by_code: dict[int, list[int]] = {}
+        for i, (code, _s, _e) in enumerate(parsed):
+            by_code.setdefault(code, []).append(i)
+        entries: list = [None] * len(parsed)
+        for code, idxs in by_code.items():
+            t_group = time.perf_counter()
+            starts = [parsed[i][1] for i in idxs]
+            ends = [parsed[i][2] for i in idxs]
+            index = self._interval_index(snap, code)
+            if index is None:
+                # unloaded/empty chromosome: the zero-row reductions (the
+                # host twin over empty columns keeps every shape exact)
+                empty = np.empty(0, np.int32)
+                panel = stats_ops.stats_panel_host(
+                    empty, empty, empty, empty, starts, ends
+                )
+                wins = stats_ops.windowed_stats_host(
+                    empty, empty, starts, ends, windows
+                ) if windows is not None else None
+            else:
+                feats = self._stats_features(snap, code, index)
+                panel = self._stats_panel(
+                    code, index, feats, starts, ends, host_only
+                )
+                wins = self._stats_windows(
+                    code, index, feats, starts, ends, windows, host_only
+                ) if windows is not None else None
+            lo, hi, af_l, af_h, c_l, c_h, rk = panel
+            for k, i in enumerate(idxs):
+                block = stats_ops.windows_summary(
+                    wins[0][k], wins[1][k], wins[2][k]
+                ) if wins is not None else None
+                code_i, start, end = parsed[i]
+                entries[i] = {
+                    "region": f"{chromosome_label(code_i)}:{start}-{end}",
+                    **stats_ops.interval_summary(
+                        int(hi[k] - lo[k]), af_l[k], af_h[k], c_l[k],
+                        c_h[k], rk[k], metrics, block,
+                    ),
+                }
+            # per-group sub-span onto the request's trace (no-op outside
+            # an active trace) — the group split is where device time goes
+            reqtrace.span_active(
+                f"stats.chr{chromosome_label(code)}",
+                time.perf_counter() - t_group,
+            )
+        return StatsResult(snap.generation, metrics, entries)
+
+    def _stats_features(self, snap, code: int,
+                        index: IntervalIndex) -> StatsColumns:
+        """The (generation, chromosome) feature columns, decoded lazily
+        and LRU-retained — builds coalesce under the index build lock
+        (a decode is a full-column sidecar walk; N concurrent misses
+        must not pay it N times)."""
+        key = (snap.generation, code)
+        with self._cache_lock:
+            feats = self._stats_cache.get(key)
+            if feats is not None:
+                self._stats_cache.move_to_end(key)
+                return feats
+        with self._index_build_lock:
+            with self._cache_lock:
+                feats = self._stats_cache.get(key)
+                if feats is not None:
+                    self._stats_cache.move_to_end(key)
+                    return feats
+            feats = StatsColumns.build(snap.store.shards.get(code), index)
+            evicted: list[StatsColumns] = []
+            with self._cache_lock:
+                self._stats_cache[key] = feats
+                while len(self._stats_cache) > self.STATS_CACHE:
+                    _k, old = self._stats_cache.popitem(last=False)
+                    # the device-byte ledger must not keep an evicted
+                    # column set (and its HBM copies) alive behind the
+                    # cache's back — the _index_cache discipline
+                    if self._index_device.pop(id(old), None) is not None:
+                        evicted.append(old)
+        for old in evicted:
+            old.drop_device()
+        return feats
+
+    def _filter_span(self, snap, code: int, index: IntervalIndex,
+                     i_lo: int, i_hi: int, min_cadd, max_conseq_rank):
+        """Index positions of ``[i_lo, i_hi)`` passing the annotation
+        filters — one vectorized compare over the cached feature columns
+        instead of a JSON decode per row per request.  NaN (missing
+        annotation) never satisfies a predicate, exactly like the scalar
+        :meth:`_passes` definition (the reference's
+        ``WHERE (col->>'x')::numeric`` NULL semantics)."""
+        feats = self._stats_features(snap, code, index)
+        keep = np.ones(i_hi - i_lo, bool)
+        with np.errstate(invalid="ignore"):  # NaN compares are the point
+            if min_cadd is not None:
+                keep &= feats.cadd_f[i_lo:i_hi] >= min_cadd
+            if max_conseq_rank is not None:
+                keep &= feats.rank_f[i_lo:i_hi] <= max_conseq_rank
+        return np.nonzero(keep)[0] + i_lo
+
+    def _device_stats(self, index: IntervalIndex, feats: StatsColumns,
+                      starts, ends):
+        """One fused stats-panel kernel call on device (test seam:
+        monkeypatch to model a failing device)."""
+        af, cadd, rank = feats.device()
+        return stats_ops.stats_panel(
+            index.device_pos(), af, cadd, rank, starts, ends, padded=True
+        )
+
+    def _device_windows(self, index: IntervalIndex, feats: StatsColumns,
+                        starts, ends, windows: int):
+        """One windowed-scan kernel call on device (test seam)."""
+        _af, cadd, _rank = feats.device()
+        return stats_ops.windowed_stats(
+            index.device_pos(), cadd, starts, ends, windows, padded=True
+        )
+
+    def _stats_panel(self, code: int, index: IntervalIndex,
+                     feats: StatsColumns, starts, ends, host_only: bool):
+        """The fused panel for one group (breaker-guarded device
+        dispatch; byte-identical host twin otherwise)."""
+        return self._stats_guarded(
+            code, index, feats, len(starts), host_only,
+            lambda: self._device_stats(index, feats, starts, ends),
+            lambda: stats_ops.stats_panel_host(
+                index.pos, feats.af_fp, feats.cadd_fp, feats.rank_i,
+                starts, ends,
+            ),
+        )
+
+    def _stats_windows(self, code: int, index: IntervalIndex,
+                       feats: StatsColumns, starts, ends, windows: int,
+                       host_only: bool):
+        """The windowed scan for one group (same guard)."""
+        return self._stats_guarded(
+            code, index, feats, len(starts), host_only,
+            lambda: self._device_windows(index, feats, starts, ends,
+                                         windows),
+            lambda: stats_ops.windowed_stats_host(
+                index.pos, feats.cadd_fp, starts, ends, windows
+            ),
+        )
+
+    def _stats_guarded(self, code: int, index: IntervalIndex,
+                       feats: StatsColumns, n_queries: int,
+                       host_only: bool, device_fn, host_fn):
+        """The ONE stats device-dispatch guard: the kernel runs when the
+        batch is worth a dispatch and the group's circuit breaker allows
+        it, the byte-identical numpy twin otherwise.  A device failure
+        feeds the breaker and drops BOTH retained device copies (index
+        position array + feature columns) with their ledger entries —
+        one failure path to maintain, not one per kernel."""
+        breaker = self.breaker
+        if (not host_only
+                and n_queries >= self.stats_device_min
+                and (breaker is None or breaker.allow_device(code))):
+            try:
+                out = device_fn()
+            except Exception as exc:
+                index.drop_device()
+                feats.drop_device()
+                with self._cache_lock:
+                    self._index_device.pop(id(index), None)
+                    self._index_device.pop(id(feats), None)
+                if breaker is not None:
+                    breaker.record_failure(code, exc)
+            else:
+                if breaker is not None:
+                    breaker.record_success(code)
+                self._note_index_device(index)
+                self._note_index_device(feats)
+                return out
+        return host_fn()
 
     #: distinct in-flight cursor walks whose match lists stay cached
     #: (two compact int64 arrays per walk, LRU; stale generations age out)
@@ -1031,23 +1417,28 @@ class QueryEngine:
                     index, code, [start], [end], host_only
                 )
                 i_lo, i_hi = int(lo[0]), int(hi[0])
-                if not paged and min_cadd is None \
-                        and max_conseq_rank is None:
-                    # dedup'd span width IS the count; no filter pass and
-                    # no walk cache to fill — materialize only the rows
-                    # that will render
-                    full_count = i_hi - i_lo
-                    take = full_count if limit is None \
-                        else min(max(int(limit), 0), full_count)
-                    i_hi = i_lo + take
-                kept = list(zip(index.si[i_lo:i_hi].tolist(),
-                                index.jj[i_lo:i_hi].tolist()))
-            if min_cadd is not None or max_conseq_rank is not None:
-                kept = [
-                    (si, j) for si, j in kept
-                    if self._passes(shard.segments[si], j,
-                                    min_cadd, max_conseq_rank)
-                ]
+                if min_cadd is not None or max_conseq_rank is not None:
+                    # filters vectorize over the cached feature columns
+                    # (decoded once per generation) — the per-row
+                    # sidecar-parse hot spot is gone; semantics pinned
+                    # byte-identical to the scalar _passes definition
+                    sel = self._filter_span(
+                        snap, code, index, i_lo, i_hi, min_cadd,
+                        max_conseq_rank,
+                    )
+                    kept = list(zip(index.si[sel].tolist(),
+                                    index.jj[sel].tolist()))
+                else:
+                    if not paged:
+                        # dedup'd span width IS the count; no filter pass
+                        # and no walk cache to fill — materialize only
+                        # the rows that will render
+                        full_count = i_hi - i_lo
+                        take = full_count if limit is None \
+                            else min(max(int(limit), 0), full_count)
+                        i_hi = i_lo + take
+                    kept = list(zip(index.si[i_lo:i_hi].tolist(),
+                                    index.jj[i_lo:i_hi].tolist()))
             if paged:
                 # without this an N-page walk re-runs the full region
                 # scan + filter pass per page (O(N x region) for what the
@@ -1170,14 +1561,16 @@ class QueryEngine:
                 return out
         return interval_ops.interval_spans_host(index.pos, starts, ends)
 
-    def _note_index_device(self, index: IntervalIndex) -> None:
-        """Account the index's retained device copy against
+    def _note_index_device(self, index) -> None:
+        """Account a retained device copy — an :class:`IntervalIndex`
+        position array OR a :class:`StatsColumns` feature set (both
+        expose ``device_bytes``/``drop_device``) — against
         ``INDEX_DEVICE_BYTES``, evicting the least-recently-used copies
-        past the ceiling (the just-used index always stays)."""
+        past the ceiling (the just-used entry always stays)."""
         nbytes = index.device_bytes()
         if not nbytes:
             return
-        evicted: list[IntervalIndex] = []
+        evicted: list = []
         with self._cache_lock:
             self._index_device[id(index)] = (index, nbytes)
             self._index_device.move_to_end(id(index))

@@ -342,8 +342,52 @@ MSG_BROWNOUT_BULK = (
 MSG_BROWNOUT_REGION = (
     "brownout: region reads shed (point reads keep serving)"
 )
+MSG_BROWNOUT_STATS = (
+    "brownout: analytics queries shed (point reads keep serving)"
+)
 MSG_CAPACITY_BULK = "server at capacity (bulk admission bound)"
 MSG_CAPACITY_REGION = "server at capacity (region admission bound)"
+MSG_CAPACITY_STATS = "server at capacity (stats admission bound)"
+
+#: the analytics route path — shared so the two front ends' routing
+#: cannot drift (the UPSERT_ROUTE convention)
+STATS_ROUTE = "/stats/region"
+
+#: the one grammar message for a malformed /stats/region body
+STATS_BODY_ERROR = (
+    'stats body must be {"regions": ["chr:start-end", ...]} with '
+    'optional "metrics" (a non-empty subset of ["af", "cadd", '
+    '"conseq"]) and integer "windows"'
+)
+
+
+def parse_stats_body(body: bytes):
+    """``(specs, metrics, windows)`` from a ``POST /stats/region`` JSON
+    body — the ONE parsing contract both front ends share (the
+    :func:`parse_region_params` convention).  Shape/type errors raise
+    :class:`QueryError` here; value-level grammar (per-spec region
+    syntax, unknown metric names, the windows range) is validated by the
+    engine, which fails the one caller the same way."""
+    try:
+        obj = json.loads(body or b"{}")
+    except ValueError:
+        raise QueryError(STATS_BODY_ERROR) from None
+    if not isinstance(obj, dict):
+        raise QueryError(STATS_BODY_ERROR)
+    specs = obj.get("regions")
+    if not isinstance(specs, list) \
+            or not all(isinstance(s, str) for s in specs):
+        raise QueryError(STATS_BODY_ERROR)
+    metrics = obj.get("metrics")
+    if metrics is not None and (
+            not isinstance(metrics, list)
+            or not all(isinstance(m, str) for m in metrics)):
+        raise QueryError(STATS_BODY_ERROR)
+    windows = obj.get("windows")
+    if windows is not None and (isinstance(windows, bool)
+                                or not isinstance(windows, int)):
+        raise QueryError(f"bad stats field windows={windows!r}")
+    return specs, metrics, windows
 
 
 def parse_regions_body(body: bytes):
@@ -521,7 +565,8 @@ class ServeContext:
         # key assembly) is measurable at serving QPS, so the hot path
         # indexes a dict instead of re-registering per request
         self._kind = {}
-        for kind in ("point", "bulk", "region", "regions", "upsert"):
+        for kind in ("point", "bulk", "region", "regions", "stats",
+                     "upsert"):
             labels = {"kind": kind}
             self._kind[kind] = (
                 registry.counter(
@@ -986,6 +1031,9 @@ class ServeHandler(BaseHTTPRequestHandler):
         if path == "/regions":
             self._regions(ctx)
             return
+        if path == STATS_ROUTE:
+            self._stats(ctx)
+            return
         self._error(404, f"no such route: {path}")
 
     # -- query kinds --------------------------------------------------------
@@ -1223,6 +1271,74 @@ class ServeHandler(BaseHTTPRequestHandler):
             t_render = time.perf_counter()
             body = result.assemble()
             ctx.observe("regions", time.perf_counter() - t0,
+                        rows=result.returned)
+            if trace is not None:
+                trace.add("render", time.perf_counter() - t_render)
+            ctx.reqtrace.finish(trace, 200)
+            self._reply(200, body)
+        finally:
+            ctx.release()
+
+    def _stats(self, ctx: ServeContext) -> None:
+        """Analytics panel: the bulk admission shape of ``_regions``
+        (brownout shed, deadline at admission AND before execution,
+        inflight slot, 429), execution through the engine's fused stats
+        path.  Bodies are summaries — never row-materializing — so the
+        response always buffers."""
+        t0 = time.perf_counter()
+        if ctx.governor.shed_bulk():
+            ctx.brownout_shed()
+            self._error(503, MSG_BROWNOUT_STATS)
+            return
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
+            return
+        if not ctx.admit():
+            ctx.rejected("stats")
+            self._error(429, MSG_CAPACITY_STATS)
+            return
+        try:
+            ctx.refresh_snapshot()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                specs, metrics, windows = parse_stats_body(raw)
+            except (ValueError, QueryError) as err:
+                ctx.errored("stats")
+                self._error(400, str(err) if isinstance(err, QueryError)
+                            else STATS_BODY_ERROR)
+                return
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                # body read/queueing ate the budget: shed BEFORE the scan
+                ctx.deadline_shed("execute")
+                self._error(504, MSG_DEADLINE_EXECUTE)
+                return
+            trace = ctx.reqtrace.begin(self._trace_id, "stats")
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
+            try:
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    result = ctx.engine.stats_serve(
+                        specs, metrics=metrics, windows=windows,
+                    )
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
+            except QueryError as err:
+                ctx.errored("stats")
+                ctx.reqtrace.finish(trace, 400)
+                self._error(400, str(err))
+                return
+            except Exception as err:
+                ctx.errored("stats")
+                ctx.reqtrace.finish(trace, 500)
+                self._error(500, f"{type(err).__name__}: {err}")
+                return
+            t_render = time.perf_counter()
+            body = result.assemble()
+            ctx.observe("stats", time.perf_counter() - t0,
                         rows=result.returned)
             if trace is not None:
                 trace.add("render", time.perf_counter() - t_render)

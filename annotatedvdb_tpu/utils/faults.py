@@ -57,6 +57,12 @@ arming any other name is a ``ValueError`` at parse time):
                             nothing executed; a failure must fail exactly
                             this batch's caller (HTTP 500) and leave the
                             engine serving the next batch
+``serve.stats``             per analytics panel in ``serve.engine``
+                            (``stats_serve``) — the panel is parsed,
+                            nothing executed; a failure must fail exactly
+                            this request's caller (HTTP 500) and leave
+                            the engine answering the next panel
+                            byte-identically
 ``snapshot.swap``           in ``serve.snapshot`` after the new generation
                             loaded but before the atomic swap — a failure
                             must leave the old pinned generation serving
@@ -181,6 +187,7 @@ POINTS = frozenset({
     "ingest.chunk",
     "serve.batch",
     "serve.regions",
+    "serve.stats",
     "serve.accept",
     "serve.worker",
     "serve.wedge",

@@ -1615,6 +1615,226 @@ def bench_serve_regions(store_dir: str, ids: list,
         server.ctx.batcher.close()
 
 
+def bench_serve_stats(n_rows: int = 60_000, n_intervals: int = 1024,
+                      window_bp: int = 4_000, batch_size: int = 256,
+                      point_probes: int = 400) -> dict:
+    """The on-device analytics leg: an annotated synth store served live,
+    a panel of ``n_intervals`` windows summarized two ways —
+
+    - **sequential host scan** (the pre-analytics access pattern the
+      reference's Postgres aggregates imply): one keep-alive
+      ``GET /region`` per interval shipping every row to the client,
+      which parses the sidecar JSON and aggregates in Python;
+    - **batched device stats** (``POST /stats/region`` in ``batch_size``
+      chunks): the fused kernel path over the pre-decoded feature
+      columns.
+
+    Byte-identity verdict: every batched per-interval summary must equal
+    the summary REBUILT from the sequential leg's rows through the same
+    shared helpers (``ops.stats.feature_values`` /
+    ``summary_from_totals``) — same numbers from two independent data
+    paths.  A point-read p99 probe brackets the stats legs
+    (``point_read.parity_ok``): resident analytics state must not move
+    the point path (a generous noise bound — this box swings 2-3x)."""
+    import http.client
+
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.ops import stats as stats_ops
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    work = tempfile.mkdtemp(prefix="avdb_stats_bench_")
+    server = None
+    try:
+        store_dir = os.path.join(work, "store")
+        width = 8
+        store = VariantStore(width=width)
+        bases = ("A", "C", "G", "T")
+        refs = [bases[i % 4] for i in range(n_rows)]
+        alts = [bases[(i + 1) % 4] for i in range(n_rows)]
+        ref, ref_len = encode_allele_array(refs, width)
+        alt, alt_len = encode_allele_array(alts, width)
+        h = identity_hashes(width, ref, alt, ref_len, alt_len, refs, alts)
+        pos = np.arange(1_000, 1_000 + 61 * n_rows, 61, np.int32)[:n_rows]
+        store.shard(8).append(
+            {"pos": pos, "h": h, "ref_len": ref_len, "alt_len": alt_len},
+            ref, alt,
+            annotations={
+                "cadd_scores": [
+                    {"CADD_phred": float(i % 400) / 10.0}
+                    if i % 3 else None for i in range(n_rows)
+                ],
+                "allele_frequencies": [
+                    {"GnomAD": {"af": (i % 1000) / 1000.0}}
+                    if i % 2 else None for i in range(n_rows)
+                ],
+                "adsp_most_severe_consequence": [
+                    {"rank": i % 25} if i % 4 else None
+                    for i in range(n_rows)
+                ],
+            },
+        )
+        store.save(store_dir)
+        server = build_aio_server(store_dir=store_dir, port=0)
+        server.start_background()
+        host, port = server.server_address[:2]
+
+        def request(conn, method, path, body=None):
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"}
+                         if body else {})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+
+        rng = random.Random(0x57A75)
+        lo_pos, hi_pos = int(pos[0]), int(pos[-1])
+        span = max(hi_pos - lo_pos - window_bp, 1)
+        specs = []
+        for _ in range(n_intervals):
+            start = lo_pos + rng.randrange(span)
+            specs.append(f"8:{start}-{start + window_bp - 1}")
+        point_ids = [
+            f"8:{int(pos[i])}:{refs[i]}:{alts[i]}"
+            for i in rng.sample(range(n_rows), min(point_probes, n_rows))
+        ]
+
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+
+        def point_p99() -> float:
+            lat = []
+            for vid in point_ids:
+                t1 = time.perf_counter()
+                status, _b = request(conn, "GET", f"/variant/{vid}")
+                lat.append(time.perf_counter() - t1)
+                if status != 200:
+                    raise RuntimeError(f"point probe {vid}: {status}")
+            return float(np.percentile(np.asarray(lat) * 1000.0, 99))
+
+        # warmup OUTSIDE the clocks: route code, the interval-index and
+        # feature-column builds, and the kernel traces are one-time costs
+        request(conn, "GET", f"/region/{specs[0]}?limit=100000")
+        request(conn, "POST", "/stats/region", json.dumps(
+            {"regions": specs[:batch_size]}
+        ))
+        settle()
+        p99_before = point_p99()
+
+        settle()
+        # sequential host scan: rows to the client, JSON parse + Python
+        # aggregation per interval
+        ref_entries = []
+        seq_lat = []
+        t0 = time.perf_counter()
+        for spec in specs:
+            t1 = time.perf_counter()
+            status, body = request(
+                conn, "GET", f"/region/{spec}?limit=100000"
+            )
+            if status != 200:
+                raise RuntimeError(f"sequential region {spec}: {status}")
+            doc = json.loads(body)
+            if doc["count"] != doc["returned"]:
+                raise RuntimeError(f"{spec}: rows truncated")
+            af_fp, cadd_fp, rank_i = [], [], []
+            for rec in doc["variants"]:
+                ann = rec["annotations"]
+                _cf, _rf, a, c, r = stats_ops.feature_values(
+                    ann.get("cadd_scores"),
+                    ann.get("allele_frequencies"),
+                    ann.get("adsp_most_severe_consequence"),
+                )
+                af_fp.append(a)
+                cadd_fp.append(c)
+                rank_i.append(r)
+            _p, af_sum, af_hist = stats_ops.column_totals(
+                np.asarray(af_fp or [-1], np.int64), stats_ops.AF_EDGES_FP
+            )
+            _p, cadd_sum, cadd_hist = stats_ops.column_totals(
+                np.asarray(cadd_fp or [-1], np.int64),
+                stats_ops.CADD_EDGES_FP,
+            )
+            ranks = stats_ops.rank_totals(
+                np.asarray(rank_i or [-1], np.int64)
+            )
+            ref_entries.append({
+                "region": spec,
+                **stats_ops.summary_from_totals(
+                    doc["count"], af_sum, af_hist, cadd_sum, cadd_hist,
+                    ranks,
+                ),
+            })
+            seq_lat.append(time.perf_counter() - t1)
+        seq_dt = max(time.perf_counter() - t0, 1e-9)
+
+        settle()
+        # batched device stats: the fused kernel path
+        got_entries = []
+        batch_lat = []
+        t0 = time.perf_counter()
+        for off in range(0, n_intervals, batch_size):
+            chunk = specs[off:off + batch_size]
+            t1 = time.perf_counter()
+            status, body = request(conn, "POST", "/stats/region",
+                                   json.dumps({"regions": chunk}))
+            batch_lat.append(time.perf_counter() - t1)
+            if status != 200:
+                raise RuntimeError(f"stats batch at {off}: {status}")
+            got_entries.extend(json.loads(body)["results"])
+        batch_dt = max(time.perf_counter() - t0, 1e-9)
+
+        mismatches = sum(
+            1 for got, want in zip(got_entries, ref_entries)
+            if got != want
+        )
+
+        settle()
+        p99_after = point_p99()
+        conn.close()
+
+        seq_ms = np.asarray(seq_lat) * 1000.0
+        bat_ms = np.asarray(batch_lat) * 1000.0
+        seq_ips = n_intervals / seq_dt
+        bat_ips = n_intervals / batch_dt
+        ratio = p99_after / max(p99_before, 1e-9)
+        return {
+            "intervals": n_intervals,
+            "window_bp": window_bp,
+            "batch_size": batch_size,
+            "store_rows": n_rows,
+            "byte_identical": mismatches == 0,
+            "mismatches": mismatches,
+            "sequential": {
+                "intervals_per_sec": round(seq_ips, 1),
+                "p50_ms": round(float(np.percentile(seq_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(seq_ms, 99)), 3),
+                "seconds": round(seq_dt, 3),
+            },
+            "batched": {
+                "intervals_per_sec": round(bat_ips, 1),
+                "calls": len(batch_lat),
+                "p50_ms": round(float(np.percentile(bat_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(bat_ms, 99)), 3),
+                "seconds": round(batch_dt, 3),
+            },
+            "speedup": round(bat_ips / seq_ips, 2),
+            "point_read": {
+                "p99_ms_before": round(p99_before, 3),
+                "p99_ms_after": round(p99_after, 3),
+                "ratio": round(ratio, 3),
+                # generous noise bound: the box swings 2-3x on minute
+                # timescales, and sub-ms baselines amplify ratios
+                "parity_ok": bool(p99_after <= max(p99_before * 2.5,
+                                                   p99_before + 5.0)),
+            },
+        }
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.ctx.batcher.close()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_multichip_virtual(n_devices: int = 8):
     """Mesh insert-step timing on a VIRTUAL n-device CPU mesh — a labeled
     scaling datapoint (reshard + annotate + dedup + membership as one mesh
@@ -2028,6 +2248,13 @@ def serve_only():
             serving["regions"] = bench_serve_regions(store_dir, ids)
         except Exception as exc:  # the legs after it must still record
             serving["regions"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]
+            }
+        settle()
+        try:
+            serving["stats"] = bench_serve_stats()
+        except Exception as exc:  # the legs after it must still record
+            serving["stats"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:300]
             }
         settle()

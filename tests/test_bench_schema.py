@@ -68,6 +68,18 @@ GOOD = {
             "count_only": {"intervals_per_sec": 52000.0, "seconds": 0.04,
                            "speedup": 61.2},
         },
+        "stats": {
+            "intervals": 1024, "window_bp": 4000, "batch_size": 256,
+            "store_rows": 60000, "byte_identical": True, "mismatches": 0,
+            "sequential": {"intervals_per_sec": 133.1, "p50_ms": 6.4,
+                           "p99_ms": 20.5, "seconds": 7.69},
+            "batched": {"intervals_per_sec": 2204.3, "calls": 4,
+                        "p50_ms": 106.2, "p99_ms": 132.2,
+                        "seconds": 0.47},
+            "speedup": 16.56,
+            "point_read": {"p99_ms_before": 19.8, "p99_ms_after": 16.0,
+                           "ratio": 0.81, "parity_ok": True},
+        },
         "open_loop": {
             "slo_p99_ms": 25.0, "conns": 8, "duration_s": 2.5,
             "max_sustainable_qps": 11800.0,
@@ -236,6 +248,49 @@ def test_regions_block_is_validated_strictly():
     # a failed leg records its error and stays loadable
     failed = copy.deepcopy(GOOD)
     failed["serving"]["regions"] = {"error": "server did not start"}
+    assert validate_record(failed) == []
+
+
+def test_stats_block_is_validated_strictly():
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["stats"]["speedup"]
+    assert any("speedup" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["stats"]["batched"]["intervals_per_sec"]
+    assert any("intervals_per_sec" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["stats"]["byte_identical"] = "yes"  # bool, not str
+    assert any("byte_identical" in e for e in validate_record(bad))
+
+    # byte identity is a correctness contract, REQUIRED true: summaries
+    # are deterministic integer aggregations, a divergence is wrong
+    # answers (the acked_missing precedent), never measurement noise
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["stats"]["byte_identical"] = False
+    assert any("wrong answers" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["stats"]["sequential"]["p99_ms"] = 0.5  # below p50
+    assert any("p99_ms below p50_ms" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["stats"]["intervals"] = 0
+    assert any("positive" in e for e in validate_record(bad))
+
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["stats"]["point_read"]["parity_ok"]
+    assert any("parity_ok" in e for e in validate_record(bad))
+
+    # a serving block WITHOUT stats stays valid (r01-r10-era records)
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["stats"]
+    assert validate_record(old) == []
+
+    # a failed leg records its error and stays loadable
+    failed = copy.deepcopy(GOOD)
+    failed["serving"]["stats"] = {"error": "server did not start"}
     assert validate_record(failed) == []
 
 

@@ -311,6 +311,31 @@ def test_serve_regions_raise_fails_only_that_batch_and_recovers():
     assert [p.assemble() for p in got.pages] == want
 
 
+def test_serve_stats_raise_and_eio_fail_only_that_request_and_recover():
+    """An injected fault in the analytics drain (serve.stats raise/eio)
+    must fail exactly that panel's caller — the front ends map it to one
+    500 — and leave the engine answering the next panel byte-identically
+    (incl. after an EIO, the transient-device shape the stats breaker
+    fallback also absorbs)."""
+    from annotatedvdb_tpu.serve import QueryEngine, StaticSnapshots
+    from annotatedvdb_tpu.utils.faults import InjectedFault
+
+    engine = QueryEngine(StaticSnapshots(_tiny_store()), region_cache_size=0)
+    specs = ["3:1-100", "3:5-25"]
+    want = engine.stats_serve(specs).assemble()
+    try:
+        faults.reset("serve.stats:1:raise")
+        with pytest.raises(InjectedFault):
+            engine.stats_serve(specs)
+        faults.reset("serve.stats:1:eio")
+        with pytest.raises(OSError):
+            engine.stats_serve(specs)
+    finally:
+        faults.reset("")
+    # the engine survived both failed panels: same panel, same bytes
+    assert engine.stats_serve(specs).assemble() == want
+
+
 def test_snapshot_swap_raise_keeps_old_generation_serving(tmp_path):
     """A fault between loading the new generation and swapping the pin
     (snapshot.swap:1:raise) must leave the OLD generation serving; an

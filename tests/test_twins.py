@@ -58,6 +58,13 @@ from annotatedvdb_tpu.ops.intervals import (
     bits_spans_stacked_jit,
     interval_spans_host,
 )
+from annotatedvdb_tpu.ops.stats import (
+    STATS_MISSING,
+    stats_panel_host,
+    stats_panel_kernel_jit,
+    windowed_stats_host,
+    windowed_stats_kernel_jit,
+)
 from annotatedvdb_tpu.ops.pack import (
     encode_alleles_nibble,
     inflate_alleles_jit,
@@ -351,6 +358,43 @@ def test_bits_spans_kernel_vs_host_twin():
     np.testing.assert_array_equal(np.asarray(d_level), h_level)
     np.testing.assert_array_equal(np.asarray(d_leaf), h_leaf)
     assert int(POS_SENTINEL) > 2_000_000  # inputs stayed in-range
+
+
+def _stats_columns(rng, m):
+    pos = np.sort(rng.integers(1, 2_000_000, m)).astype(np.int32)
+    af = rng.integers(STATS_MISSING, 1_000_001, m).astype(np.int32)
+    cadd = rng.integers(STATS_MISSING, 100_001, m).astype(np.int32)
+    rank = rng.integers(STATS_MISSING, 40, m).astype(np.int32)
+    return pos, af, cadd, rank
+
+
+def test_stats_panel_kernel_vs_host_twin():
+    """The fused analytics panel: integer-only reductions, so the twin
+    is byte-exact (the deeper battery lives in tests/test_stats.py)."""
+    rng = np.random.default_rng(42)
+    pos, af, cadd, rank = _stats_columns(rng, 512)
+    q = 64
+    starts = rng.integers(1, 2_000_000, q).astype(np.int32)
+    ends = (starts + rng.integers(0, 50_000, q)).astype(np.int32)
+    dev = stats_panel_kernel_jit(pos, af, cadd, rank, starts, ends)
+    host = stats_panel_host(pos, af, cadd, rank, starts, ends)
+    for d, h, name in zip(dev, host, ("lo", "hi", "af_lanes", "af_hist",
+                                      "cadd_lanes", "cadd_hist", "ranks")):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(h),
+                                      err_msg=name)
+
+
+def test_windowed_stats_kernel_vs_host_twin():
+    rng = np.random.default_rng(43)
+    pos, _af, cadd, _rank = _stats_columns(rng, 509)
+    q = 48
+    starts = rng.integers(1, 2_000_000, q).astype(np.int32)
+    ends = (starts + rng.integers(0, 50_000, q)).astype(np.int32)
+    dev = windowed_stats_kernel_jit(pos, cadd, starts, ends, windows=6)
+    host = windowed_stats_host(pos, cadd, starts, ends, 6)
+    for d, h, name in zip(dev, host, ("counts", "present", "lanes")):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(h),
+                                      err_msg=name)
 
 
 # ---------------------------------------------------------------------------

@@ -116,10 +116,21 @@ def build_store(store_dir: str, n: int = 4000):
     store.shard(8).append(
         {"pos": pos, "h": h, "ref_len": ref_len, "alt_len": alt_len},
         ref, alt,
-        annotations={"cadd_scores": [
-            {"CADD_phred": float(i % 40)} if i % 2 else None
-            for i in range(n)
-        ]},
+        annotations={
+            "cadd_scores": [
+                {"CADD_phred": float(i % 40)} if i % 2 else None
+                for i in range(n)
+            ],
+            # AF + consequence material so the stats leg's envelopes
+            # aggregate something on every metric family
+            "allele_frequencies": [
+                {"GnomAD": {"af": (i % 200) / 200.0}} if i % 3 else None
+                for i in range(n)
+            ],
+            "adsp_most_severe_consequence": [
+                {"rank": i % 12} if i % 4 else None for i in range(n)
+            ],
+        },
     )
     store.save(store_dir)
     ids = [f"8:{int(p)}:{r}:{a}" for p, r, a in zip(pos, refs, alts)]
@@ -202,6 +213,21 @@ def get(host: str, port: int, path: str, timeout: float = 5.0):
         with urllib.request.urlopen(
             f"http://{host}:{port}{path}", timeout=timeout
         ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def post(host: str, port: int, path: str, payload, timeout: float = 5.0):
+    """(status, body_text) for one JSON POST; transport failures raise
+    OSError."""
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, r.read().decode()
     except urllib.error.HTTPError as err:
         return err.code, err.read().decode()
@@ -314,6 +340,67 @@ class UpsertDriver(threading.Thread):
                 self.errors += 1
             k += 1
         conn.close()
+
+
+#: strips the one legitimately-varying field of a stats envelope before
+#: the byte compare: the scripted commit/compaction/upsert legs all land
+#: OUTSIDE the panel's span, so the aggregation bytes are invariant
+#: across generations — only the generation number moves
+_GEN_RE = re.compile(r'"generation":\d+')
+
+
+class StatsDriver(threading.Thread):
+    """Analytics panels under chaos (full schedule): keep-alive
+    ``POST /stats/region`` of a fixed panel at a steady rate through the
+    injected-latency window, the device-EIO burst, the armed snapshot
+    swap, the online compaction pass, and the worker SIGKILL.  Every 200
+    must reproduce the pre-chaos reference envelope byte-for-byte once
+    the generation field is scrubbed.  Sheds and transport failures are
+    bounded behavior (their own buckets); wrong bytes are the one
+    unforgivable outcome."""
+
+    def __init__(self, host: str, port: int, panel: list, reference: str,
+                 t_start: float, start_rel: float, stop_rel: float,
+                 interval_s: float = 0.15):
+        super().__init__(name="chaos-stats", daemon=True)
+        self.host, self.port = host, port
+        self.panel = panel
+        self.reference = reference
+        self.t_start = t_start
+        self.start_rel, self.stop_rel = start_rel, stop_rel
+        self.interval_s = interval_s
+        self.requests = 0
+        self.ok = 0
+        self.wrong_bytes = 0
+        self.transport_errors = 0
+        self.status_counts: dict[str, int] = {}
+        self.mismatches: list[str] = []
+
+    def run(self) -> None:
+        delay = self.t_start + self.start_rel - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        stop_t = self.t_start + self.stop_rel
+        payload = {"regions": self.panel, "windows": 4}
+        while time.monotonic() < stop_t:
+            self.requests += 1
+            try:
+                status, body = post(self.host, self.port, "/stats/region",
+                                    payload)
+            except OSError:
+                # a chaos kill ate the connection: bounded, not wrong
+                self.transport_errors += 1
+            else:
+                key = str(status)
+                self.status_counts[key] = self.status_counts.get(key, 0) + 1
+                if status == 200:
+                    if _GEN_RE.sub('"generation":0', body) == self.reference:
+                        self.ok += 1
+                    else:
+                        self.wrong_bytes += 1
+                        if len(self.mismatches) < 3:
+                            self.mismatches.append(f"got {body[:160]!r}")
+            time.sleep(self.interval_s)
 
 
 def verify_acked_upserts(host: str, port: int, acked: list,
@@ -533,6 +620,16 @@ def run(args) -> tuple[dict, list[str]]:
         status, _ = get(host, port, f"/region/{region_spec}?limit=50")
         if status != 200:
             raise RuntimeError(f"reference region -> {status}")
+        stats_panel = ["8:1000-40000", "8:40001-200000", "8:1000-380000"]
+        stats_ref = None
+        if not args.smoke and not args.soak:
+            # analytics reference: the generation-scrubbed envelope every
+            # later 200 on the stats leg must reproduce byte-for-byte
+            status, body = post(host, port, "/stats/region",
+                                {"regions": stats_panel, "windows": 4})
+            if status != 200:
+                raise RuntimeError(f"reference stats -> {status}")
+            stats_ref = _GEN_RE.sub('"generation":0', body)
 
         blobs = [
             (f"GET /variant/{i} HTTP/1.1\r\nHost: c\r\n\r\n").encode()
@@ -567,6 +664,16 @@ def run(args) -> tuple[dict, list[str]]:
 
         compact_result = None
         upserts = None
+        stats_leg = None
+        if stats_ref is not None:
+            # the stats leg spans the device-EIO burst, the armed swap +
+            # real commit, the online compaction, AND the worker SIGKILL
+            # (full-schedule times: EIO t=8, kill t=16, wedge t=22)
+            stats_leg = StatsDriver(
+                host, port, stats_panel, stats_ref, t_start,
+                start_rel=6.0, stop_rel=min(26.0, duration_s - 5.0),
+            )
+            stats_leg.start()
         if not args.smoke:
             # durable writes run across the chaos: in full mode t=8-20
             # (device EIO, armed swap + real commit, online compaction,
@@ -710,6 +817,31 @@ def run(args) -> tuple[dict, list[str]]:
                 log(f"upserts: {len(upserts.acked)} acked, 0 lost "
                     f"(verified in {verify_s}s), "
                     f"{upserts.errors} unacknowledged attempts")
+
+        stats_stats = None
+        if stats_leg is not None:
+            stats_leg.join(timeout=15)
+            stats_stats = {
+                "requests": int(stats_leg.requests),
+                "ok": int(stats_leg.ok),
+                "wrong_bytes": int(stats_leg.wrong_bytes),
+                "transport_errors": int(stats_leg.transport_errors),
+                "status_counts": dict(stats_leg.status_counts),
+            }
+            if stats_leg.wrong_bytes:
+                violations.append(
+                    f"{stats_leg.wrong_bytes} WRONG-BYTE stats envelopes "
+                    f"under chaos: {stats_leg.mismatches}"
+                )
+            elif stats_leg.ok < 1:
+                violations.append(
+                    "stats leg never landed a 200 through the chaos "
+                    "window (the analytics path was never exercised)"
+                )
+            else:
+                log(f"stats: {stats_leg.ok} byte-exact envelopes / "
+                    f"{stats_leg.requests} panels through the chaos "
+                    f"window ({stats_leg.transport_errors} transport)")
 
         # -- recovery: bounded window after the last fault ------------------
         recovered = False
@@ -958,6 +1090,8 @@ def run(args) -> tuple[dict, list[str]]:
         }
         if upsert_stats is not None:
             record["upserts"] = upsert_stats
+        if stats_stats is not None:
+            record["stats"] = stats_stats
         if maintain_stats is not None:
             record["maintain"] = maintain_stats
         if flight_stats is not None:

@@ -187,6 +187,9 @@ def _check_serving(sv, where: str, errors: list) -> None:
     if "regions" in sv and isinstance(sv["regions"], dict) \
             and "error" not in sv["regions"]:
         _check_regions(sv["regions"], w, errors)
+    if "stats" in sv and isinstance(sv["stats"], dict) \
+            and "error" not in sv["stats"]:
+        _check_stats(sv["stats"], w, errors)
     if "open_loop" in sv:
         _check_open_loop(sv["open_loop"], w, errors)
     if "observability" in sv and isinstance(sv["observability"], dict) \
@@ -375,6 +378,25 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
                     and ch["upserts"]["missing"] != 0:
                 errors.append(
                     f"{w}.upserts.missing: acknowledged-write loss"
+                )
+    if "stats" in ch:
+        # the analytics-under-chaos leg (full schedule only): panel
+        # envelopes byte-verified — generation-scrubbed — through the
+        # device-EIO burst and the worker SIGKILL
+        if not isinstance(ch["stats"], dict):
+            errors.append(f"{w}.stats: must be an object")
+        else:
+            _check_fields(
+                ch["stats"],
+                {"requests": _is_int, "ok": _is_int,
+                 "wrong_bytes": _is_int, "transport_errors": _is_int},
+                f"{w}.stats", errors, required=("requests", "wrong_bytes"),
+            )
+            if _is_int(ch["stats"].get("wrong_bytes")) \
+                    and ch["stats"]["wrong_bytes"]:
+                errors.append(
+                    f"{w}.stats.wrong_bytes: analytics envelopes "
+                    "diverged under chaos"
                 )
     if "flight" in ch:
         # the crash-flight-recorder gates (full + soak schedules): a
@@ -643,6 +665,63 @@ def _check_regions(rg: dict, where: str, errors: list) -> None:
             errors.append(f"{w}.{leg}: p99_ms below p50_ms")
     if _is_int(rg.get("intervals")) and rg["intervals"] <= 0:
         errors.append(f"{w}.intervals: must be positive")
+
+
+def _check_stats(sg: dict, where: str, errors: list) -> None:
+    """The on-device analytics leg: a panel summarized batched
+    (``POST /stats/region``) vs the sequential per-row host scan, with a
+    byte-identity verdict that is REQUIRED true (the summaries are
+    deterministic integer aggregations — a mismatch is wrong answers,
+    not noise; the ``acked_missing`` precedent) and a point-read p99
+    parity probe bracketing the legs."""
+    w = f"{where}.stats"
+    _check_fields(
+        sg,
+        {
+            "intervals": _is_int, "window_bp": _is_int,
+            "batch_size": _is_int, "store_rows": _is_int,
+            "mismatches": _is_int,
+            "byte_identical": lambda v: isinstance(v, bool),
+            "speedup": _is_num,
+            "sequential": lambda v: isinstance(v, dict),
+            "batched": lambda v: isinstance(v, dict),
+            "point_read": lambda v: isinstance(v, dict),
+        },
+        w, errors,
+        required=("intervals", "sequential", "batched", "speedup",
+                  "byte_identical"),
+    )
+    if sg.get("byte_identical") is False:
+        errors.append(
+            f"{w}.byte_identical: batched stats diverged from the "
+            "sequential host-scan reference — wrong answers, not noise"
+        )
+    for leg in ("sequential", "batched"):
+        sub = sg.get(leg)
+        if not isinstance(sub, dict):
+            continue
+        _check_fields(
+            sub,
+            {"intervals_per_sec": _is_num, "seconds": _is_num,
+             "p50_ms": _is_num, "p99_ms": _is_num, "calls": _is_int},
+            f"{w}.{leg}", errors,
+            required=("intervals_per_sec", "seconds"),
+        )
+        if _is_num(sub.get("p50_ms")) and _is_num(sub.get("p99_ms")) \
+                and sub["p99_ms"] < sub["p50_ms"]:
+            errors.append(f"{w}.{leg}: p99_ms below p50_ms")
+    if _is_int(sg.get("intervals")) and sg["intervals"] <= 0:
+        errors.append(f"{w}.intervals: must be positive")
+    pr = sg.get("point_read")
+    if isinstance(pr, dict):
+        _check_fields(
+            pr,
+            {"p99_ms_before": _is_num, "p99_ms_after": _is_num,
+             "ratio": _is_num,
+             "parity_ok": lambda v: isinstance(v, bool)},
+            f"{w}.point_read", errors,
+            required=("p99_ms_before", "p99_ms_after", "parity_ok"),
+        )
 
 
 def _check_open_loop(ol, where: str, errors: list) -> None:

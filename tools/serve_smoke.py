@@ -122,7 +122,21 @@ def _drive_routes(port: int, n: int, check) -> str:
           and rec.get("tokens", {}).get("count", [0])[0] > 0, body[:200])
     status, body = _post(port, "/regions", {"regions": ["8:9-3"]})
     check("regions 400", status == 400, body[:200])
-    return region_body
+    # analytics: the fused stats panel answers summaries (counts, CADD
+    # histogram, windowed scan) and both front ends must render them
+    # byte-identically (the returned blob joins the parity compare)
+    status, stats_body = _post(port, "/stats/region",
+                               {"regions": specs, "windows": 4})
+    rec = json.loads(stats_body) if status == 200 else {}
+    first = (rec.get("results") or [{}])[0]
+    check("stats batch", status == 200 and rec.get("n") == 3
+          and first.get("count", 0) > 0
+          and first.get("cadd", {}).get("present", 0) > 0
+          and len(first.get("windows", {}).get("counts", [])) == 4,
+          stats_body[:200])
+    status, body = _post(port, "/stats/region", {"regions": "junk"})
+    check("stats 400", status == 400, body[:200])
+    return region_body + stats_body
 
 
 def main() -> int:
@@ -159,7 +173,7 @@ def main() -> int:
             check(f"aio {label}", ok, detail)
         )
         check("aio parity", aio_region == threaded_region,
-              "region bodies differ between front ends")
+              "region/stats bodies differ between front ends")
         # aio-only surfaces: chunked streaming (threshold 4 forces it)
         # and cursor paging
         status, body = _get(aport, "/region/8:1-100000?limit=20")
@@ -207,7 +221,7 @@ def main() -> int:
             print(f"serve_smoke FAIL {f}", file=sys.stderr)
         return 1
     print(f"serve_smoke: ok ({n} rows; threaded + aio front ends, "
-          "streaming and paging answered)", file=sys.stderr)
+          "streaming, paging and stats answered)", file=sys.stderr)
     return 0
 
 
