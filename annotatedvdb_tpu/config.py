@@ -50,6 +50,17 @@ ENV_VARS: dict = {
                         "thread (default 1: async writer stage)",
     "AVDB_INGEST_ENGINE": "auto (default) | native | python — VCF tokenizer "
                           "selection (python captures reject content)",
+    "AVDB_INGEST_CHUNK_ROWS": "rows per ingest chunk (default: the "
+                              "loader's batch_size; a malformed value "
+                              "fails the entry point)",
+    "AVDB_INGEST_PREFETCH_DEPTH": "chunks the ingest scanner may run "
+                                  "ahead of the pipeline (default 2; "
+                                  "bounds staging memory to O(depth) "
+                                  "chunks)",
+    "AVDB_INGEST_SHUFFLE_SEED": "arms shuffled chunk scheduling with this "
+                                "seed (unset = strict source order; the "
+                                "resequencer keeps the stored bytes "
+                                "identical either way)",
     "AVDB_NATIVE_VEP": "0 disables the native VEP JSON transform",
     "AVDB_NATIVE_CADD": "0 disables the native CADD table scanner",
     "AVDB_PACK_TRANSPORT": "0 disables nibble-packed allele upload and "
@@ -202,6 +213,8 @@ ENV_VARS: dict = {
                           "default 512, 0 disables)",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
+    "AVDB_BENCH_E2E_RUNS": "median-of-N run count for the end-to-end load "
+                           "bench leg (default 5)",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "
                            "(default 3)",
     "AVDB_BENCH_RETRY_REASON": "internal: set by bench.py when it re-execs "

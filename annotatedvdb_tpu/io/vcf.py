@@ -188,6 +188,71 @@ def parse_freq(info: dict, n_alts: int) -> list:
     return out
 
 
+# population-name charset whose json.dumps rendering is the name verbatim
+# between quotes (printable ASCII, no '"'/'\\', nothing ensure_ascii would
+# escape); anything else takes the exact json.dumps fallback
+_FREQ_KEY_RE = _re.compile(r"[A-Za-z0-9 _.,:/|\-]+\Z", _re.ASCII)
+
+
+def freq_sidecar(info_str: str, n_alts: int) -> list:
+    """Per-alt FREQ sidecar as stored-JSONB text, straight from the raw
+    INFO span — the ingest half of the zero-copy sidecar discipline.
+
+    Returns a list of ``RawJson``/None, one per alt, where each text is
+    byte-identical to ``json.dumps(parse_freq(parse_info(info_str), n)[i])``
+    — the exact bytes ``store.variant_store.sidecar_line`` would have
+    written for the dict (default separators, default ``allow_nan``).  The
+    loader carries these through staging untouched and the segment writer
+    splices them verbatim, so FREQ never round-trips through a Python dict
+    per row (pinned by
+    ``tests/test_ingest_spine.py::test_freq_sidecar_parity``).
+
+    Only the FREQ token is extracted (last one wins — dict semantics);
+    the full INFO dict is never built.  A FREQ value that numeric-coerces
+    under ``parse_info`` necessarily lacks ':' and yields empty
+    populations either way, so raw-token extraction is parity-exact."""
+    from annotatedvdb_tpu.store.variant_store import RawJson
+
+    s = info_str.replace("\\x2c", ",").replace("\\x59", "/").replace("#", ":")
+    raw = None
+    for item in s.split(";"):
+        if item.startswith("FREQ="):
+            raw = item[5:]
+    if raw is None:
+        return [None] * n_alts
+    pops = {}
+    for pop in raw.split("|"):
+        if ":" in pop:
+            name, freqs = pop.split(":", 1)
+            pops[name] = freqs.split(",")
+    if not pops:
+        return [None] * n_alts
+    keys = {
+        name: (f'"{name}"' if _FREQ_KEY_RE.match(name)
+               else json.dumps(name))
+        for name in pops
+    }
+    out = []
+    for alt_index in range(1, n_alts + 1):
+        parts = []
+        for name, values in pops.items():
+            if alt_index < len(values) and values[alt_index] not in (".", "0"):
+                v = values[alt_index]
+                if _INT_RE.match(v):
+                    val = str(int(v))
+                elif _FLOAT_RE.match(v) and math.isfinite(fv := float(v)):
+                    # repr IS json.dumps' float rendering; the isfinite
+                    # guard routes overflow ('1e400') to the fallback,
+                    # which emits Infinity exactly like the dict path
+                    # (sidecar_line's json.dumps keeps default allow_nan)
+                    val = repr(fv)
+                else:
+                    val = json.dumps(to_numeric(v))
+                parts.append(f'{keys[name]}: {{"gmaf": {val}}}')
+        out.append(RawJson("{" + ", ".join(parts) + "}") if parts else None)
+    return out
+
+
 @dataclass
 class VcfChunk:
     """One ingest batch: device arrays + host sidecar (aligned by row).
@@ -249,9 +314,6 @@ class VcfChunk:
     #: chunks — consumers fall back to the device/numpy hash.  Over-width
     #: rows still need the host full-string re-hash, same as every engine.
     h_native: np.ndarray | None = None
-
-
-_SCAN_DONE = object()
 
 
 class VcfBatchReader:
@@ -341,7 +403,9 @@ class VcfBatchReader:
             faults.fire("ingest.chunk")
             yield chunk
 
-    def iter_prefetched(self, depth: int = 2, timer=None):
+    def iter_prefetched(self, depth: int = 2, timer=None,
+                        shuffle_seed: int | None = None,
+                        tagged: bool = False):
         """Chunk iterator with the scan on a background ingest thread.
 
         The tokenizer fills chunk *N+1* while the consumer still holds
@@ -353,25 +417,21 @@ class VcfBatchReader:
         ownership per fill, ``native/vcf.py``) and sidecar columns only
         reference immutable window bytes.
 
+        ``tagged`` yields ``(seq, chunk)`` pairs; ``shuffle_seed`` (with
+        ``tagged``) arms the spine's shuffled chunk scheduling — see
+        :class:`~annotatedvdb_tpu.io.prefetch.ChunkPrefetcher`.  The
+        default form yields chunks in source order, unchanged.
+
         ``timer``: optional :class:`~annotatedvdb_tpu.utils.profiling.StageTimer`;
         scan time is attributed to its ``ingest`` stage *on the ingest
-        thread* (busy time, not consumer wall).  Returns a
-        :class:`~annotatedvdb_tpu.utils.pipeline.BoundedStage` — callers
-        that stop early must ``close()`` it."""
-        from annotatedvdb_tpu.utils.pipeline import BoundedStage
+        thread* (busy time, not consumer wall).  Callers that stop early
+        must ``close()`` the returned prefetcher."""
+        from annotatedvdb_tpu.io.prefetch import ChunkPrefetcher
 
-        source = iter(self)
-        if timer is not None:
-            def timed(it=source):
-                while True:
-                    with timer.stage("ingest"):
-                        chunk = next(it, _SCAN_DONE)
-                    if chunk is _SCAN_DONE:
-                        return
-                    yield chunk
-
-            source = timed()
-        return BoundedStage(source, depth=depth, name="vcf-ingest")
+        return ChunkPrefetcher(
+            self, depth=depth, shuffle_seed=shuffle_seed, tagged=tagged,
+            timer=timer, name="vcf-ingest",
+        )
 
     def _iter_python(self) -> Iterator[VcfChunk]:
         rows: list = []

@@ -110,6 +110,9 @@ class TpuCaddUpdater:
         self.timer = StageTimer()
         #: chunk-granularity metrics hook (ObsSession.attach)
         self.obs = None
+        #: backpressure accounting for the scan-prefetch boundary
+        #: (utils.pipeline.merge_stage_stats; exported by ObsSession)
+        self.queue_stalls: dict = {}
         # --logAfter cadence over score-table rows scanned (the CADD
         # analog of the VCF loaders' input-line cadence)
         from annotatedvdb_tpu.utils.logging import ProgressCadence
@@ -247,15 +250,24 @@ class TpuCaddUpdater:
                         "python" if self._budget.max_errors >= 0 else "auto"
                     ),
                 )
+                # table streaming rides the ingest-prefetch spine
+                # (io/prefetch.py): the tokenizer scans blocks
+                # AVDB_INGEST_PREFETCH_DEPTH ahead on its own thread while
+                # this thread joins — sequential (untagged), since the
+                # join consumes per-chromosome blocks in table order
+                from annotatedvdb_tpu.io.prefetch import ChunkPrefetcher
+                from annotatedvdb_tpu.utils.pipeline import merge_stage_stats
+
                 stop = False
-                blocks = iter(reader.blocks_all())
-                while True:
-                    with self.timer.stage("scan"):
-                        item = next(blocks, None)
-                    if item is None:
-                        break
-                    code, block = item
-                    if code in states:
+                blocks = ChunkPrefetcher(
+                    reader.blocks_all(), timer=self.timer, stage="scan",
+                    name="cadd-scan",
+                )
+                try:
+                    for item in blocks:
+                        code, block = item
+                        if code not in states:
+                            continue
                         n_rows = int(getattr(block, "n", 0) or 0)
                         with self.timer.stage("join", items=n_rows):
                             if mesh_ctx is not None:
@@ -277,6 +289,11 @@ class TpuCaddUpdater:
                         if test:
                             stop = True
                             break
+                finally:
+                    # settle the scan thread promptly (a test-mode break or
+                    # join failure must not leave it streaming the table)
+                    blocks.close()
+                    merge_stage_stats(self.queue_stalls, "scan", blocks.stats)
                 if mesh_ctx is not None:
                     self._flush_mesh(states, mesh_ctx)
                 with self.timer.stage("finalize"):

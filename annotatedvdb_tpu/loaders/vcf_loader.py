@@ -184,9 +184,15 @@ class TpuVcfLoader:
         # counters + stage rates every N input lines (the reference's
         # --logAfter cadence, ``load_vcf_file.py:29-47``); None = quiet
         from annotatedvdb_tpu.utils.logging import ProgressCadence
-        from annotatedvdb_tpu.utils.profiling import StageTimer
+        from annotatedvdb_tpu.utils.profiling import DeviceOccupancy, StageTimer
 
         self._cadence = ProgressCadence(self.log, log_after)
+        #: union coverage of per-chunk device in-flight windows (reset per
+        #: file by load_file); ``device_idle_fraction`` is the last file's
+        #: 1 − busy/wall headline — the bench's proof the device stopped
+        #: being idle-dominant
+        self._occ = DeviceOccupancy()
+        self.device_idle_fraction: float | None = None
         # async store pipeline: built segments queue to a single writer
         # thread (append -> persist -> checkpoint -> cascade merge) while
         # the main thread runs the next chunk's device work.  Entries are
@@ -256,16 +262,9 @@ class TpuVcfLoader:
 
     def _merge_stage_stats(self, name: str, stats) -> None:
         """Fold one BoundedStage's StageStats into the cumulative table."""
-        rec = self._stall_rec(name)
-        d = stats.as_dict()
-        rec["items"] += d["items"]
-        rec["producer_block_s"] = round(
-            rec["producer_block_s"] + d["producer_block_s"], 4
-        )
-        rec["consumer_wait_s"] = round(
-            rec["consumer_wait_s"] + d["consumer_wait_s"], 4
-        )
-        rec["max_depth"] = max(rec["max_depth"], d["max_depth"])
+        from annotatedvdb_tpu.utils.pipeline import merge_stage_stats
+
+        merge_stage_stats(self.queue_stalls, name, stats)
 
     @property
     def is_adsp(self) -> bool:
@@ -330,11 +329,19 @@ class TpuVcfLoader:
         ctx = _LoadCtx(alg_id, commit, resume_line, mapping_fh, fail_at,
                        persist, path, async_store, test)
         try:
+            from annotatedvdb_tpu.io.prefetch import ingest_chunk_rows
             from annotatedvdb_tpu.ops.pack import transport_wanted
+            from annotatedvdb_tpu.utils.profiling import DeviceOccupancy
 
+            # fresh occupancy + stage baselines: this file's device-idle
+            # headline and per-stage obs export must not absorb earlier
+            # files loaded through the same loader instance
+            self._occ = DeviceOccupancy()
+            wall0 = self.timer.wall_seconds
+            stage0 = self.timer.as_dict()
             reader = VcfBatchReader(
                 path,
-                batch_size=self.batch_size,
+                batch_size=ingest_chunk_rows(self.batch_size),
                 width=self.store.width,
                 chromosome_map=self.chromosome_map,
                 # the mesh path never uploads packed alleles, and on CPU
@@ -353,6 +360,17 @@ class TpuVcfLoader:
                 else:
                     self._run_serial(reader, ctx)
                 self._drain_inflight()
+            self.device_idle_fraction = self._occ.idle_fraction(
+                self.timer.wall_seconds - wall0
+            )
+            if self.obs is not None:
+                # per-stage busy-seconds deltas for THIS file, plus the
+                # device-idle gauge, onto the obs plane
+                after = self.timer.as_dict()
+                for name, rec in after.items():
+                    prev = stage0.get(name, {}).get("seconds", 0.0)
+                    self.obs.stage_seconds(name, rec["seconds"] - prev)
+                self.obs.device_idle(self.device_idle_fraction)
             self.ledger.finish(alg_id, dict(self.counters))
             # terminal counter line: short files (ending between cadences)
             # must still log their totals
@@ -406,39 +424,56 @@ class TpuVcfLoader:
     def _run_overlapped(self, reader: VcfBatchReader, ctx) -> None:
         """Overlapped streaming executor: ingest thread -> dispatch thread
         -> this (process) thread -> store-writer thread, each boundary a
-        bounded in-order queue.
+        bounded queue.
 
         Stage roles: the INGEST thread runs the tokenizer scan (the C call
         releases the GIL, so it genuinely overlaps host numpy work);
         DISPATCH pads/assembles host arrays and enqueues the annotate+hash
         programs (async dispatch returns before execution); PROCESS forces
         chunk results one step behind dispatch, runs dedup/membership, and
-        builds segments; the writer thread appends + persists.  Counters
-        are only ever mutated here on the process thread, in chunk order —
-        serial/overlapped parity is structural, not incidental."""
-        resume_line = ctx.resume_line
-        from annotatedvdb_tpu.utils.pipeline import BoundedStage
+        builds segments; the writer thread appends + persists.
 
+        Chunks travel seq-tagged: the prefetcher may emit them SHUFFLED
+        (``AVDB_INGEST_SHUFFLE_SEED``, ``io/prefetch.py``) and dispatch is
+        order-independent, but a :class:`Resequencer` restores source
+        order before this consumer — so counters, identity first-wins,
+        checkpoint cursors, and ``--maxErrors`` accounting all apply in
+        chunk order regardless of schedule.  Serial/overlapped (and
+        shuffled/in-order) parity is structural, not incidental."""
+        resume_line = ctx.resume_line
+        from annotatedvdb_tpu.io.prefetch import (
+            ingest_prefetch_depth,
+            ingest_shuffle_seed,
+        )
+        from annotatedvdb_tpu.utils.pipeline import BoundedStage, Resequencer
+
+        depth = ingest_prefetch_depth(self.PIPELINE_DEPTH)
         ingest = reader.iter_prefetched(
-            depth=self.PIPELINE_DEPTH, timer=self.timer
+            depth=depth, timer=self.timer,
+            shuffle_seed=ingest_shuffle_seed(), tagged=True,
         )
         dispatch = BoundedStage(
             ingest,
-            fn=lambda chunk: self._dispatch_entry(
-                self._entry_from_chunk(chunk, resume_line)
+            fn=lambda tagged: (
+                tagged[0],
+                self._dispatch_entry(
+                    self._entry_from_chunk(tagged[1], resume_line)
+                ),
             ),
-            depth=self.PIPELINE_DEPTH,
+            depth=depth,
             name="vcf-dispatch",
         )
         tracer = self.timer.tracer
+        entries = Resequencer(dispatch)
         try:
-            for entry in dispatch:
+            for entry in entries:
                 if tracer is not None:
                     # queue-depth gauge samples, one counter track per
                     # boundary (per CHUNK, so ~zero cost)
                     tracer.counter(
                         "queue_depth", ingest=ingest.depth(),
                         dispatch=dispatch.depth(),
+                        resequencer=entries.held(),
                         store_writer=len(self._inflight),
                     )
                 if self._consume_entry(entry, ctx):
@@ -482,15 +517,6 @@ class TpuVcfLoader:
             ),
             "malformed": chunk.counters.get("malformed", 0),
         }
-        if delta["malformed"] and not self._rejects_captured:
-            # native tokenizer: malformed lines were counted without
-            # content — budget-check them here (raising past --maxErrors
-            # travels the pipeline to the consumer like any stage error)
-            self._reject_uncaptured(
-                delta["malformed"],
-                "malformed VCF line(s); native engine captured no content "
-                "— re-run with AVDB_INGEST_ENGINE=python to quarantine them",
-            )
         needs_dispatch = True
         if chunk.batch.n == 0:
             needs_dispatch = False  # trailing counters-only chunk
@@ -508,6 +534,9 @@ class TpuVcfLoader:
         if needs_dispatch:
             with self.timer.stage("dispatch"):
                 handles = self._dispatch_chunk(chunk)
+            # device in-flight window opens at enqueue; _process_chunk
+            # closes it when the results are forced (DeviceOccupancy)
+            handles["t0"] = time.perf_counter()
         return chunk, handles, delta
 
     def _consume_entry(self, entry: tuple, ctx: "_LoadCtx") -> bool:
@@ -520,6 +549,16 @@ class TpuVcfLoader:
         t_chunk = time.perf_counter() if self.obs is not None else 0.0
         for key, v in delta.items():
             self.counters[key] = self.counters.get(key, 0) + v
+        if delta["malformed"] and not self._rejects_captured:
+            # native tokenizer: malformed lines were counted without
+            # content — budget-check them HERE, on the process thread in
+            # chunk order, so --maxErrors trips at the same input line no
+            # matter how the prefetcher scheduled the chunks
+            self._reject_uncaptured(
+                delta["malformed"],
+                "malformed VCF line(s); native engine captured no content "
+                "— re-run with AVDB_INGEST_ENGINE=python to quarantine them",
+            )
         if handles is None:
             # resume-replayed / counters-only chunks are NOT observed:
             # avdb_rows_total means rows actually processed (the update
@@ -1039,12 +1078,11 @@ class TpuVcfLoader:
                 from annotatedvdb_tpu.ops.pack import unpack_outputs
 
                 cols = unpack_outputs(handles["packed"].result())
-                h_p = cols["h"].copy()
+                h_p = cols["h"]
                 host_rows = cols["host_fallback"][:n]
             elif handles.get("h_host") is not None:
-                # tokenizer-computed hash: no device fetch to force (the
-                # over-width re-hash below still applies, so copy first)
-                h_p = handles["h_host"].copy()
+                # tokenizer-computed hash: no device fetch to force
+                h_p = handles["h_host"]
                 host_rows = np.asarray(ann_p.host_fallback)[:n]
                 cols = None
             else:
@@ -1055,9 +1093,14 @@ class TpuVcfLoader:
             # from the original strings so identity never collides on a
             # shared prefix.  (In-batch dedup happens on host, inside the
             # per-chromosome identity sort below, so the corrected hashes
-            # are always the ones deduped on.)
-            for i in np.where(host_rows)[0]:
-                h_p[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
+            # are always the ones deduped on.)  Copy-on-write: the common
+            # all-short chunk reads the tokenizer/unpack buffer directly,
+            # only a chunk that actually re-hashes pays for a private copy
+            fb = np.where(host_rows)[0]
+            if fb.size:
+                h_p = h_p.copy()
+                for i in fb:
+                    h_p[i] = _fnv32_str(chunk.refs[i], chunk.alts[i])
             h = h_p[:n]
             if cols is not None:
                 ann = _slim_annotated(
@@ -1066,6 +1109,12 @@ class TpuVcfLoader:
                 )
             else:
                 ann = self._fetch_annotations(ann_p, n, host_rows)
+        t0 = handles.get("t0")
+        if t0 is not None:
+            # close this chunk's device in-flight window (opened at
+            # dispatch enqueue); the synchronous _load_chunk path carries
+            # no t0 and records nothing
+            self._occ.record(t0, time.perf_counter())
         # replayed rows within a partially-committed chunk
         replay = chunk.line_number <= resume_line
 
@@ -1096,12 +1145,40 @@ class TpuVcfLoader:
                 if rows.size == 0:
                     continue
                 key = combined_key(batch.pos[rows], h[rows])
-                # position-sorted sources arrive key-sorted already (ties
-                # broken by hash are the only exception): detect in O(n)
-                # and skip the O(n log n) argsort + gathers
-                if rows.size > 1 and not bool((key[1:] >= key[:-1]).all()):
-                    order = np.argsort(key, kind="stable")
-                    rows, key = rows[order], key[order]
+                # position-sorted sources arrive key-sorted already: detect
+                # violations in O(n).  Any position inversion IS a key
+                # inversion (key = pos<<32 | h and h < 2^32), so when every
+                # violation sits between EQUAL positions the disorder is
+                # purely hash ties at multi-allelic sites — repair just
+                # those runs instead of re-sorting the whole chunk (the
+                # steady state of a sorted source drops from O(n log n)
+                # back to O(n))
+                if rows.size > 1:
+                    viol = np.flatnonzero(key[1:] < key[:-1])
+                    if viol.size:
+                        pos_r = batch.pos[rows]
+                        if bool((pos_r[viol] == pos_r[viol + 1]).all()):
+                            # position is then globally non-decreasing, so
+                            # only the equal-pos runs holding a violation
+                            # need repair.  One stable argsort over ALL
+                            # their rows at once is exact: runs are
+                            # maximal, pos forms the key's high bits, so
+                            # keys from distinct runs never interleave and
+                            # the sort decomposes per-run.  Everything here
+                            # is a vector pass — no per-site Python loop.
+                            run_id = np.empty(pos_r.size, np.int64)
+                            run_id[0] = 0
+                            np.cumsum(pos_r[1:] != pos_r[:-1],
+                                      out=run_id[1:])
+                            dirty = np.zeros(int(run_id[-1]) + 1, np.bool_)
+                            dirty[run_id[viol]] = True
+                            idx = np.flatnonzero(dirty[run_id])
+                            order = np.argsort(key[idx], kind="stable")
+                            rows[idx] = rows[idx][order]
+                            key[idx] = key[idx][order]
+                        else:
+                            order = np.argsort(key, kind="stable")
+                            rows, key = rows[order], key[order]
                 if rows.size > 1:
                     cand = np.where(key[1:] == key[:-1])[0]
                     if cand.size:
@@ -1119,10 +1196,12 @@ class TpuVcfLoader:
                             rows, key = rows[keep], key[keep]
                 segs = self._membership_segments(int(code))
                 if self.skip_existing and segs:
-                    qpos, qh = batch.pos[rows], h[rows]
-                    qref, qalt = batch.ref[rows], batch.alt[rows]
-                    qrl, qal = batch.ref_len[rows], batch.alt_len[rows]
-                    found = np.zeros(rows.size, np.bool_)
+                    # probe columns materialize only if a probe actually
+                    # fires: monotonic loads prune every segment on key
+                    # range alone, and gathering the two [N, W] allele
+                    # matrices up front would copy ~25MB per chunk just to
+                    # throw it away
+                    qref = found = None
                     for seg in segs:
                         # range pruning: monotonic loads probe only the
                         # (usually zero) segments overlapping this chunk's
@@ -1130,12 +1209,19 @@ class TpuVcfLoader:
                         if (seg.n == 0 or seg.key_max < key[0]
                                 or seg.key_min > key[-1]):
                             continue
-                        if found.all():
+                        if qref is None:
+                            qpos, qh = batch.pos[rows], h[rows]
+                            qref, qalt = batch.ref[rows], batch.alt[rows]
+                            qrl = batch.ref_len[rows]
+                            qal = batch.alt_len[rows]
+                            found = np.zeros(rows.size, np.bool_)
+                        elif found.all():
                             break
                         f, _ = seg.probe(key, qpos, qh, qref, qalt, qrl, qal)
                         found |= f
-                    self.counters["duplicates"] += int(found.sum())
-                    rows = rows[~found]
+                    if found is not None:
+                        self.counters["duplicates"] += int(found.sum())
+                        rows = rows[~found]
                 if rows.size:
                     insert_rows.append(rows)
 

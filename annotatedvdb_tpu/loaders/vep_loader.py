@@ -121,6 +121,9 @@ class TpuVepLoader:
         self.timer = StageTimer()
         #: chunk-granularity metrics hook (ObsSession.attach)
         self.obs = None
+        #: backpressure accounting for the ingest-prefetch boundary
+        #: (utils.pipeline.merge_stage_stats; exported by ObsSession)
+        self.queue_stalls: dict = {}
         self._blob: bytes | None = None      # native rank-table serialization
         self._blob_version = -1
         from annotatedvdb_tpu.utils.quarantine import ErrorBudget
@@ -391,32 +394,52 @@ class TpuVepLoader:
 
         # binary chunked read, flushed per block of complete lines (the
         # transformer takes raw bytes; only rare Python-fallback docs are
-        # ever re-materialized as line strings)
-        stop = False
+        # ever re-materialized as line strings).  The read + line-split
+        # runs on the ingest-prefetch spine (io/prefetch.py): the scanner
+        # stays AVDB_INGEST_PREFETCH_DEPTH blocks ahead of the transformer
+        # on its own thread, sequential (untagged) — VEP updates are
+        # order-bearing end to end
+        from annotatedvdb_tpu.io.prefetch import ChunkPrefetcher
+
         with self.timer.wall(), _open_bytes(path) as fh:
-            tail = b""
-            while not stop:
-                with self.timer.stage("ingest"):
+
+            def blocks():
+                tail = b""
+                while True:
                     block = fh.read(4 << 20)
-                if not block:
-                    break
-                block = tail + block
-                cut = block.rfind(b"\n")
-                if cut < 0:
-                    tail = block
-                    continue
-                timed_flush(block[:cut + 1])
-                tail = block[cut + 1:]
-                if test:
-                    stop = True
-                    # one-batch smoke runs must still cover a SMALL file
-                    # completely: if nothing follows, the unterminated
-                    # final line belongs to this (only) batch
-                    if not fh.read(1) and tail.strip():
-                        timed_flush(tail + b"\n")
-                        tail = b""
-            if not stop and tail.strip():
-                timed_flush(tail + b"\n")
+                    if not block:
+                        break
+                    block = tail + block
+                    cut = block.rfind(b"\n")
+                    if cut < 0:
+                        tail = block
+                        continue
+                    yield block[:cut + 1]
+                    tail = block[cut + 1:]
+                    if test:
+                        # one-batch smoke runs must still cover a SMALL
+                        # file completely: if nothing follows, the
+                        # unterminated final line belongs to this (only)
+                        # batch
+                        if not fh.read(1) and tail.strip():
+                            yield tail + b"\n"
+                        return
+                if tail.strip():
+                    yield tail + b"\n"
+
+            pre = ChunkPrefetcher(
+                blocks(), timer=self.timer, name="vep-ingest"
+            )
+            try:
+                for text in pre:
+                    timed_flush(text)
+            finally:
+                # settle the prefetch thread before fh leaves scope (an
+                # aborted update must not leave it mid-read)
+                pre.close()
+                from annotatedvdb_tpu.utils.pipeline import merge_stage_stats
+
+                merge_stage_stats(self.queue_stalls, "ingest", pre.stats)
         added = self.parser.ranker.added[n_added_before:]
         if added:
             self.log(f"added {len(added)} new consequence combos: {added}")

@@ -244,7 +244,7 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
     which also makes chunks safe to pass to another pipeline thread
     (``VcfBatchReader.iter_prefetched``).  Sidecar columns are lazy views
     over the immutable window bytes."""
-    from annotatedvdb_tpu.io.vcf import VcfChunk, parse_freq, parse_info
+    from annotatedvdb_tpu.io.vcf import VcfChunk, freq_sidecar, parse_info
 
     batch = VariantBatch(
         chrom=arrays.chrom[:n],
@@ -309,13 +309,29 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
 
     def info_at(i):
         if identity_only or int(info_len[i]) <= 0:
-            return {}, [None] * int(n_alts[i])
+            return {}
         key = int(line_no[i])
         hit = line_cache.get(key)
         if hit is None:
-            info = parse_info(span(info_off, info_len, i))
-            hit = line_cache[key] = (info, parse_freq(info, int(n_alts[i])))
+            hit = line_cache[key] = parse_info(span(info_off, info_len, i))
         return hit
+
+    # FREQ decodes once per source line straight to stored-JSONB text
+    # (io.vcf.freq_sidecar) — the zero-copy sidecar path: no full INFO
+    # dict build, no per-row freq dict; staging carries the RawJson and
+    # the segment writer splices its text verbatim
+    freq_cache: dict = {}
+
+    def freq_at(i):
+        if not has_freq[i] or identity_only or int(info_len[i]) <= 0:
+            return None
+        key = int(line_no[i])
+        hit = freq_cache.get(key)
+        if hit is None:
+            hit = freq_cache[key] = freq_sidecar(
+                span(info_off, info_len, i), int(n_alts[i])
+            )
+        return hit[int(alt_index[i])]
 
     def ref_snp_at(i):
         # substring rule first, exactly like the Python reader / reference
@@ -323,7 +339,7 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         vid = span(id_off, id_len, i)
         if "rs" in vid:
             return vid
-        info = info_at(i)[0]
+        info = info_at(i)
         if "RS" in info:
             return "rs" + str(info["RS"])
         return None
@@ -347,14 +363,12 @@ def chunk_from_native(arrays: _Arrays, n: int, window: bytes, base: int,
         ref_snp=LazyColumn(n, ref_snp_at),
         variant_id=LazyColumn(n, variant_id_at),
         is_multi_allelic=arrays.multi[:n].astype(bool),
-        frequencies=LazyColumn(n, lambda i: (
-            # the tokenizer pre-flags FREQ-bearing rows, so FREQ-less rows
-            # (the vast majority) skip the full INFO parse
-            info_at(i)[1][int(alt_index[i])] if has_freq[i] else None
-        )),
+        # the tokenizer pre-flags FREQ-bearing rows, so FREQ-less rows
+        # (the vast majority) skip even the FREQ-token scan
+        frequencies=LazyColumn(n, freq_at),
         has_freq=has_freq,
-        rs_position=LazyColumn(n, lambda i: info_at(i)[0].get("RSPOS")),
-        info=LazyColumn(n, lambda i: info_at(i)[0]),
+        rs_position=LazyColumn(n, lambda i: info_at(i).get("RSPOS")),
+        info=LazyColumn(n, lambda i: info_at(i)),
         info_raw=LazyColumn(
             n, lambda i: (
                 # identity_only parity with info_at: both INFO views must

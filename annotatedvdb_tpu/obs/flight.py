@@ -95,7 +95,9 @@ class FlightRecorder:
     gate failed on exactly it.  ``request`` therefore appends a raw
     tuple to a bounded deque (sub-µs, thread-safe) and :meth:`flush` —
     called on the aio maintenance tick via the executor pool, time-gated
-    on the threaded front end's request completions, and by
+    on the threaded front end's request completions, by a per-recorder
+    background thread every :data:`FLUSH_S` (a burst followed by silence
+    must not strand its tail in the buffer forever), and by
     :meth:`close` — drains it to the mmap.  Serving-side flushes CAP the
     batch at :data:`FLUSH_BATCH` records: an uncapped drain is a
     multi-ms GIL burst, and the overhead gate showed exactly that burst
@@ -152,6 +154,30 @@ class FlightRecorder:
         self._mm = mmap.mmap(self._f.fileno(), size)
         HEADER.pack_into(self._mm, 0, MAGIC, VERSION, self.slots,
                          self.event_slots)
+        #: background flusher: the front ends' flushes are gated on
+        #: request COMPLETIONS, so a traffic burst followed by silence
+        #: used to leave its whole tail buffered indefinitely — a worker
+        #: SIGKILLed while idle lost exactly the history the black box
+        #: exists to keep.  This thread bounds the at-risk window to
+        #: ~FLUSH_S regardless of traffic.
+        self._closed = False
+        self._flush_stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="avdb-flight-flush", daemon=True
+        )
+        self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while not self._flush_stop.wait(self.FLUSH_S):
+            if self._closed:
+                return
+            if self._pending:
+                try:
+                    self.flush(self.FLUSH_BATCH)
+                except Exception:
+                    # same absorb contract as _write: the black box must
+                    # never take down (or noisily haunt) its process
+                    return
 
     # -- write side ---------------------------------------------------------
 
@@ -259,6 +285,12 @@ class FlightRecorder:
         return self._errors
 
     def close(self) -> None:
+        self._closed = True
+        self._flush_stop.set()
+        try:
+            self._flusher.join(timeout=1.0)
+        except RuntimeError:
+            pass
         try:
             self.flush()
         except Exception:  # avdb: noqa[AVDB602] -- best-effort final drain; close must always release the mapping

@@ -370,7 +370,8 @@ class LoadObserver:
     """
 
     def __init__(self, reg: MetricsRegistry, loader: str):
-        labels = {"loader": loader}
+        self._reg = reg
+        self._labels = labels = {"loader": loader}
         self.chunks = reg.counter(
             "avdb_chunks_total", "pipeline chunks processed", labels
         )
@@ -385,6 +386,8 @@ class LoadObserver:
             "avdb_chunk_seconds", CHUNK_SECONDS_EDGES,
             "process-thread seconds per chunk", labels,
         )
+        self._stage_seconds: dict = {}  # stage name -> labeled counter
+        self._device_idle = None
 
     def chunk(self, rows: int, seconds: float | None = None) -> None:
         self.chunks.inc()
@@ -393,3 +396,29 @@ class LoadObserver:
             self.chunk_rows.observe(rows)
         if seconds is not None:
             self.chunk_seconds.observe(seconds)
+
+    def stage_seconds(self, stage: str, seconds: float) -> None:
+        """Per-stage busy-seconds export (``avdb_load_stage_seconds``) —
+        loaders push their StageTimer deltas once per load, never per
+        chunk, so the series cost is O(stages)."""
+        if seconds <= 0:
+            return
+        c = self._stage_seconds.get(stage)
+        if c is None:
+            c = self._stage_seconds[stage] = self._reg.counter(
+                "avdb_load_stage_seconds",
+                "busy seconds per load-pipeline stage",
+                dict(self._labels, stage=stage),
+            )
+        c.inc(seconds)
+
+    def device_idle(self, fraction: float) -> None:
+        """Device-idle fraction of the latest load (gauge; the in-flight-
+        window approximation from ``utils.profiling.DeviceOccupancy``)."""
+        if self._device_idle is None:
+            self._device_idle = self._reg.gauge(
+                "avdb_load_device_idle_fraction",
+                "1 - device in-flight coverage / load wall-clock",
+                self._labels,
+            )
+        self._device_idle.set(max(0.0, min(1.0, float(fraction))))

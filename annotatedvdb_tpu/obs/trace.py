@@ -40,8 +40,15 @@ class Tracer:
         self._lock = threading.Lock()
         #: guarded by self._lock
         self._events: list[dict] = []
-        #: guarded by self._lock
-        self._threads_seen: set[int] = set()
+        #: ident -> (synthetic tid, thread name), guarded by self._lock.
+        #: Synthetic tids because ``threading.get_ident`` values are
+        #: REUSED once a thread exits: the lazily-spawned store-writer
+        #: often inherits the ident of the already-finished ingest
+        #: thread, and keying tracks on the raw ident silently merged
+        #: the two.  A name change on a known ident means a new thread
+        #: generation — it gets a fresh track.
+        self._tracks: dict[int, tuple[int, str]] = {}
+        self._next_tid = 1
         self.pid = os.getpid()
         with self._lock:
             self._events.append({
@@ -53,17 +60,20 @@ class Tracer:
         return (time.perf_counter_ns() - self._t0) / 1000.0
 
     def _emit(self, ev: dict) -> None:
-        tid = threading.get_ident()
-        ev["pid"] = self.pid
-        ev["tid"] = tid
+        ident = threading.get_ident()
+        name = threading.current_thread().name
         with self._lock:
-            if tid not in self._threads_seen:
-                self._threads_seen.add(tid)
+            track = self._tracks.get(ident)
+            if track is None or track[1] != name:
+                track = (self._next_tid, name)
+                self._next_tid += 1
+                self._tracks[ident] = track
                 self._events.append({
                     "ph": "M", "name": "thread_name", "pid": self.pid,
-                    "tid": tid, "ts": 0,
-                    "args": {"name": threading.current_thread().name},
+                    "tid": track[0], "ts": 0, "args": {"name": name},
                 })
+            ev["pid"] = self.pid
+            ev["tid"] = track[0]
             self._events.append(ev)
 
     def begin(self, name: str, **args) -> None:

@@ -1366,7 +1366,16 @@ class VariantStore:
             "format": 3, "width": self.width, "store_uid": self._uid,
             "shards": {},
         }
+        from annotatedvdb_tpu.parallel.mesh import placement_hint
+
+        placement = placement_hint()
         adopted_rows: dict[str, int] = {}
+        # ---- decision pass: walk shards in the LEGACY sorted-code order,
+        # allocating seg ids and manifest groups exactly as the historical
+        # single-pass save did (the manifest stays byte-identical), but
+        # DEFER the physical writes so the write pass below can reorder
+        # them by mesh placement without perturbing id allocation
+        pending_writes: list[tuple[int, str, int, "Segment"]] = []
         for code, shard in sorted(self.shards.items()):
             label = chromosome_label(code)
             groups = []
@@ -1375,6 +1384,7 @@ class VariantStore:
                     [f"chr{label}.{sid:06d}" for sid in seg.backing]
                     if seg.backing else []
                 )
+                ids = list(seg.backing) if seg.backing else []
                 if (seg.dirty or not stems or not trusted
                         # a clean segment saved to a DIFFERENT directory
                         # earlier: its files aren't here (or are another
@@ -1393,15 +1403,32 @@ class VariantStore:
                     self._next_seg_id += 1
                     self._my_sids.add(sid)
                     stems = [f"chr{label}.{sid:06d}"]
-                    self._integrity[stems[0]] = self._write_segment(
-                        path, stems[0], seg
-                    )
-                    seg.backing = [sid]
-                    seg.dirty = False
+                    ids = [sid]
+                    pending_writes.append((int(code), stems[0], sid, seg))
                 for stem in stems:
                     live_files.update({stem + ".npz", stem + ".ann.jsonl"})
-                groups.append(list(seg.backing))
+                groups.append(ids)
             manifest["shards"][label] = groups
+        # ---- write pass: the physical segment writes.  With a mesh
+        # configured (AVDB_MESH_SHAPE) they run in PLACEMENT order —
+        # grouped by owning device, chromosomes in code order within a
+        # device — so a bulk save streams each device's working set
+        # contiguously (sequential layout for the per-device readers that
+        # mmap these files, and a natural prefix order for device-at-a-
+        # time restores).  Without a mesh this is exactly the legacy
+        # sorted-code order.  Either way the decision pass already fixed
+        # ids and manifest bytes, so the READ path sees a byte-identical
+        # store regardless of write order (tests/test_ingest_spine.py).
+        if pending_writes and placement is not None:
+            dev_of = placement["groups"]
+            n_dev = int(placement["devices"])
+            pending_writes.sort(key=lambda t: (
+                dev_of.get(chromosome_label(t[0]), n_dev), t[0]
+            ))  # stable: within a chromosome, segment order is preserved
+        for _code, stem, sid, seg in pending_writes:
+            self._integrity[stem] = self._write_segment(path, stem, seg)
+            seg.backing = [sid]
+            seg.dirty = False
         # append adopted groups AFTER this store's own (they are the
         # NEWER writes: first-wins ordering on disk matches the overlay
         # their writer served), carrying their integrity records
@@ -1457,9 +1484,8 @@ class VariantStore:
         # byte).  Deterministic on env + content only, never on jax state:
         # save() must not initialize a backend.  Compaction and the flush
         # writer copy the whole manifest dict, so the block survives both.
-        from annotatedvdb_tpu.parallel.mesh import placement_hint
-
-        placement = placement_hint()
+        # (``placement`` was resolved above — it also ordered the segment
+        # write pass.)
         if placement is not None:
             manifest["mesh_placement"] = placement
         # atomic swap: a PROCESS crash mid-save must leave the previous

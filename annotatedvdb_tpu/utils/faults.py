@@ -48,6 +48,10 @@ arming any other name is a ``ValueError`` at parse time):
 ``egress.flush``            per COPY-file write in ``io.pg_egress``
 ``ingest.chunk``            per parsed chunk handed to a loader (fires on
                             the ingest thread under the overlapped pipeline)
+``ingest.prefetch``         per chunk scheduled by the ingest prefetcher
+                            (``io.prefetch.ChunkPrefetcher``) — on the
+                            prefetch thread, after the scan, before the
+                            chunk enters the bounded queue
 ``serve.batch``             per batcher drain in ``serve.batcher`` — just
                             before the coalesced microbatch executes (fires
                             on the batcher thread; every caller of the batch
@@ -185,6 +189,7 @@ POINTS = frozenset({
     "ledger.append",
     "egress.flush",
     "ingest.chunk",
+    "ingest.prefetch",
     "serve.batch",
     "serve.regions",
     "serve.stats",

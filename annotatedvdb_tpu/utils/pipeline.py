@@ -16,6 +16,12 @@ Contract:
 - ``close()`` stops the producer promptly even mid-``put`` (the put loop
   polls a stop event), drains, and joins — safe to call repeatedly, so the
   executor's ``finally`` can always tear the pipeline down.
+
+:class:`Resequencer` is the companion adapter for the spine's shuffled
+chunk scheduling (``io/prefetch.py``): stages stay FIFO, but a producer
+may TAG items ``(seq, item)`` and emit them out of source order; the
+resequencer restores order at the boundary where ordering starts to
+matter (identity first-wins, checkpoints).
 """
 
 from __future__ import annotations
@@ -61,6 +67,26 @@ class StageStats:
             "consumer_wait_s": round(self.consumer_wait_s, 4),
             "max_depth": self.max_depth,
         }
+
+
+def merge_stage_stats(table: dict, name: str, stats: "StageStats") -> None:
+    """Fold one settled boundary's :class:`StageStats` into a cumulative
+    ``queue_stalls`` table (the per-loader dicts the obs layer exports and
+    ``utils.profiling.stall_summary`` renders) — loads accumulate across
+    files, so the table sums rather than replaces."""
+    rec = table.setdefault(name, {
+        "items": 0, "producer_block_s": 0.0, "consumer_wait_s": 0.0,
+        "max_depth": 0,
+    })
+    d = stats.as_dict()
+    rec["items"] += d["items"]
+    rec["producer_block_s"] = round(
+        rec["producer_block_s"] + d["producer_block_s"], 4
+    )
+    rec["consumer_wait_s"] = round(
+        rec["consumer_wait_s"] + d["consumer_wait_s"], 4
+    )
+    rec["max_depth"] = max(rec["max_depth"], d["max_depth"])
 
 
 class _StageError:
@@ -237,3 +263,55 @@ class BoundedStage:
                 deadline = time.monotonic() + timeout
             elif time.monotonic() >= deadline:
                 return False
+
+
+_MISSING = object()
+
+
+class Resequencer:
+    """Restore source order over a ``(seq, item)`` stream.
+
+    The ingest spine's shuffled chunk scheduling
+    (``io/prefetch.py``) lets order-independent stages (device dispatch)
+    run chunks out of source order; everything order-bearing — identity
+    first-wins, checkpoint cursor monotonicity, ``--maxErrors``
+    accounting — sits downstream of this adapter, which holds early
+    arrivals and releases items strictly by ascending ``seq``.  Retention
+    is bounded by the producer's shuffle window (O(depth) items), so the
+    pipeline's memory bound survives resequencing.
+
+    ``seq`` values must be exactly ``start, start+1, ...`` with no gaps —
+    the prefetcher tags every scheduled chunk, including zero-row ones.
+    ``held()`` exposes the current out-of-order retention (a gauge).
+    """
+
+    __slots__ = ("_source", "_next", "_held", "max_held")
+
+    def __init__(self, source, start: int = 0):
+        self._source = source
+        self._next = start
+        self._held: dict = {}
+        self.max_held = 0
+
+    def held(self) -> int:
+        return len(self._held)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            item = self._held.pop(self._next, _MISSING)
+            if item is not _MISSING:
+                self._next += 1
+                return item
+            # StopIteration (and any upstream stage error) propagates; a
+            # complete stream can never end with held items because seqs
+            # are gapless, so nothing is silently dropped here
+            seq, payload = next(self._source)
+            if seq == self._next:
+                self._next += 1
+                return payload
+            self._held[seq] = payload
+            if len(self._held) > self.max_held:
+                self.max_held = len(self._held)

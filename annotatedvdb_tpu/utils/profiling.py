@@ -148,6 +148,59 @@ class StageTimer:
         return out
 
 
+class DeviceOccupancy:
+    """Union coverage of per-chunk device in-flight windows.
+
+    Each dispatched chunk contributes the interval [dispatch-enqueue,
+    results-forced] — the window in which that chunk's device programs can
+    be executing.  The union of those intervals over the load, divided by
+    the load's wall-clock, approximates device occupancy from the host
+    side without a profiler attach; ``idle_fraction`` is its complement —
+    the headline the bench's ``device_idle_fraction`` reports.  It is an
+    in-flight-window approximation (the window includes queue wait, so it
+    over-counts busy and the reported idle is a LOWER bound on true device
+    idleness); its job is trend-grade proof that the device is no longer
+    idle-dominant, not a cycle count.
+
+    ``record`` is called from one thread (the process stage) in
+    force-completion order; intervals may still START out of order under
+    shuffled scheduling, so starts are clamped to the high-water mark of
+    closed coverage (never double-counted)."""
+
+    __slots__ = ("busy_s", "_start", "_end")
+
+    def __init__(self):
+        self.busy_s = 0.0
+        self._start = None  # currently-open merged interval
+        self._end = 0.0
+
+    def record(self, t0: float, t1: float) -> None:
+        if t1 <= t0:
+            return
+        if self._start is None:
+            self._start, self._end = t0, t1
+            return
+        if t0 <= self._end:  # overlaps/extends the open interval
+            if t1 > self._end:
+                self._end = t1
+        else:  # gap: close the open interval, start a new one
+            self.busy_s += self._end - self._start
+            self._start = max(t0, self._end)
+            self._end = t1
+
+    def total(self) -> float:
+        """Union busy seconds recorded so far."""
+        if self._start is None:
+            return self.busy_s
+        return self.busy_s + (self._end - self._start)
+
+    def idle_fraction(self, wall_seconds: float) -> float:
+        """1 − busy/wall, clamped to [0, 1]; 0.0 when no wall elapsed."""
+        if wall_seconds <= 0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - self.total() / wall_seconds))
+
+
 def stall_summary(queue_stalls: dict, wall_seconds: float | None = None) -> str:
     """Human line for the backpressure accounting
     (:class:`annotatedvdb_tpu.utils.pipeline.StageStats` dicts keyed by

@@ -218,7 +218,7 @@ def bench_end_to_end(metrics_out: str | None = None,
         store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
         ledger = AlgorithmLedger(os.path.join(work, "ledger.jsonl"))
         loader = TpuVcfLoader(
-            store, ledger, datasource="dbSNP", batch_size=1 << 17,
+            store, ledger, datasource="dbSNP", batch_size=1 << 18,
             log=lambda *a: None,
         )
         # --metrics-out / --trace-out: full telemetry capture of the
@@ -232,7 +232,7 @@ def bench_end_to_end(metrics_out: str | None = None,
 
             obs_session = ObsSession(
                 "bench-e2e", vcf,
-                {"rows": E2E_ROWS, "batch_size": 1 << 17,
+                {"rows": E2E_ROWS, "batch_size": 1 << 18,
                  "pipeline": os.environ.get("AVDB_PIPELINE", "overlapped")},
                 metrics_out=metrics_out, trace_out=trace_out,
             )
@@ -240,19 +240,49 @@ def bench_end_to_end(metrics_out: str | None = None,
         loader.warmup()  # steady-state measurement: compile outside the clock
         from annotatedvdb_tpu.utils.profiling import device_trace
 
-        settle()  # the 67MB synth VCF was just written: drain writeback
-        # AVDB_PROFILE=<dir> captures an XLA trace of the measured load;
-        # the clock sits INSIDE the trace context so profiler start/flush
-        # never skews the reported rate
-        with device_trace(os.environ.get("AVDB_PROFILE")):
-            t0 = time.perf_counter()
-            counters = loader.load_file(
-                vcf, commit=True,
-                # durable per-checkpoint persistence (incremental saves)
-                persist=lambda: store.save(store_dir),
-            )
-            store.save(store_dir)
-            dt = time.perf_counter() - t0
+        # median_headline policy, same as the VEP sub-leg: the measured
+        # load runs AVDB_BENCH_E2E_RUNS times (run 0 is canonical — its
+        # store feeds the VEP leg and wears the obs capture; later runs
+        # are fresh throwaway stores) and the headline is the median run.
+        # A single sample on the shared host read ±25% run to run.
+        n_e2e = max(1, int(os.environ.get("AVDB_BENCH_E2E_RUNS", "5")))
+        e2e_rates: list = []
+        e2e_samples: list = []
+        for run in range(n_e2e):
+            if run:
+                r_store = VariantStore(width=DEFAULT_ALLELE_WIDTH)
+                r_loader = TpuVcfLoader(
+                    r_store, ledger, datasource="dbSNP",
+                    batch_size=1 << 18, log=lambda *a: None,
+                )
+                r_loader.warmup()
+                r_dir = os.path.join(work, f"vdb.s{run}")
+            else:
+                r_store, r_loader, r_dir = store, loader, store_dir
+            settle()  # drain writeback (synth VCF / prior run's store)
+            # AVDB_PROFILE=<dir> captures an XLA trace of the canonical
+            # load; the clock sits INSIDE the trace context so profiler
+            # start/flush never skews the reported rate
+            with device_trace(
+                os.environ.get("AVDB_PROFILE") if run == 0 else None
+            ):
+                t0 = time.perf_counter()
+                counters_r = r_loader.load_file(
+                    vcf, commit=True,
+                    # durable per-checkpoint persistence (incremental)
+                    persist=lambda: r_store.save(r_dir),
+                )
+                r_store.save(r_dir)
+                dt_r = time.perf_counter() - t0
+            e2e_rates.append(round(counters_r["variant"] / dt_r, 1))
+            e2e_samples.append((dt_r, r_loader.device_idle_fraction))
+            if run == 0:
+                counters = counters_r
+        vps = median_headline(e2e_rates)
+        # the median run's own wall/idle back the headline (best and
+        # worst stay visible in the ``runs`` list)
+        mid = min(range(n_e2e), key=lambda i: abs(e2e_rates[i] - vps))
+        dt, idle_fraction = e2e_samples[mid]
         if obs_session is not None:
             # exports happen OUTSIDE the measured window
             obs_session.finish(ledger, counters, store=store)
@@ -287,12 +317,25 @@ def bench_end_to_end(metrics_out: str | None = None,
         vep_dt = n_vep / vep_rps
 
         return {
-            "variants_per_sec": counters["variant"] / dt,
+            "variants_per_sec": vps,
+            "runs": e2e_rates,
             "variants": counters["variant"],
             "duplicates": counters["duplicates"],
             "seconds": round(dt, 2),
             "vcf_mb": round(vcf_bytes / 1e6, 1),
             "mb_per_sec": round(vcf_bytes / 1e6 / dt, 1),
+            # spine-v2 marker: records produced by the chunked-prefetch
+            # ingest spine (io/prefetch.py).  The schema checker requires
+            # device_idle_fraction + stage detail when this key is present
+            # (pre-spine BENCH history keeps validating without them)
+            "ingest_spine": 2,
+            # 1 − (union of device in-flight windows / wall): the proof
+            # the measured rate is not an idle-device artifact
+            # (utils.profiling.DeviceOccupancy; lower bound on true idle)
+            "device_idle_fraction": round(
+                idle_fraction if idle_fraction is not None else 0.0, 4
+            ),
+            "shuffle_seed": os.environ.get("AVDB_INGEST_SHUFFLE_SEED"),
             "stages": loader.timer.as_dict(),
             # wall vs per-stage busy time: the overlapped executor runs
             # ingest/dispatch/process/store-writer concurrently, so busy

@@ -1,0 +1,343 @@
+"""The overlapped ingest spine (``io/prefetch.py``): knob validation,
+shuffled chunk scheduling, zero-copy FREQ sidecars, and mesh-placement-
+ordered segment writes.
+
+The load-bearing contract: a load whose chunks were scheduled in a seeded
+RANDOM order (``AVDB_INGEST_SHUFFLE_SEED``) must persist a store
+byte-identical to the strict-source-order load — the Resequencer restores
+chunk order before any order-bearing work, so identity first-wins, counters
+and checkpoint cursors cannot tell the schedules apart.  Same story one
+layer down: ``save()`` reordering physical segment writes by mesh placement
+(``AVDB_MESH_SHAPE``) must leave manifest and segment bytes untouched.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from annotatedvdb_tpu.io.prefetch import (
+    ChunkPrefetcher,
+    ingest_chunk_rows,
+    ingest_prefetch_depth,
+    ingest_shuffle_seed,
+)
+from annotatedvdb_tpu.io.vcf import freq_sidecar, parse_freq, parse_info
+from annotatedvdb_tpu.loaders import TpuVcfLoader
+from annotatedvdb_tpu.store import AlgorithmLedger, VariantStore
+from annotatedvdb_tpu.utils.pipeline import Resequencer
+
+from tests.test_pipeline_modes import (
+    COUNTER_KEYS,
+    _persisted_bytes,
+    _run_load,
+    _write_vcf,
+)
+
+
+# ---------------------------------------------------------------------------
+# knob validation (the parse_bytes precedent: loud, never a silent fallback)
+
+
+def test_ingest_knobs_default_when_unset(monkeypatch):
+    for name in ("AVDB_INGEST_CHUNK_ROWS", "AVDB_INGEST_PREFETCH_DEPTH",
+                 "AVDB_INGEST_SHUFFLE_SEED"):
+        monkeypatch.delenv(name, raising=False)
+    assert ingest_chunk_rows(4096) == 4096
+    assert ingest_chunk_rows() is None
+    assert ingest_prefetch_depth() == 2
+    assert ingest_shuffle_seed() is None
+    # empty string == unset (a cleared shell export must not explode)
+    monkeypatch.setenv("AVDB_INGEST_PREFETCH_DEPTH", "  ")
+    assert ingest_prefetch_depth(3) == 3
+
+
+def test_ingest_knobs_parse_and_reject_loudly(monkeypatch):
+    monkeypatch.setenv("AVDB_INGEST_CHUNK_ROWS", "8192")
+    monkeypatch.setenv("AVDB_INGEST_PREFETCH_DEPTH", "5")
+    monkeypatch.setenv("AVDB_INGEST_SHUFFLE_SEED", "0")
+    assert ingest_chunk_rows(1) == 8192
+    assert ingest_prefetch_depth() == 5
+    assert ingest_shuffle_seed() == 0  # seed 0 is a real seed, not "unset"
+
+    monkeypatch.setenv("AVDB_INGEST_CHUNK_ROWS", "lots")
+    with pytest.raises(ValueError, match="AVDB_INGEST_CHUNK_ROWS"):
+        ingest_chunk_rows(1)
+    monkeypatch.setenv("AVDB_INGEST_CHUNK_ROWS", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        ingest_chunk_rows(1)
+    monkeypatch.setenv("AVDB_INGEST_PREFETCH_DEPTH", "-2")
+    with pytest.raises(ValueError, match="AVDB_INGEST_PREFETCH_DEPTH"):
+        ingest_prefetch_depth()
+    monkeypatch.setenv("AVDB_INGEST_SHUFFLE_SEED", "1.5")
+    with pytest.raises(ValueError, match="AVDB_INGEST_SHUFFLE_SEED"):
+        ingest_shuffle_seed()
+
+
+# ---------------------------------------------------------------------------
+# ChunkPrefetcher / Resequencer mechanics
+
+
+def test_prefetcher_untagged_preserves_order():
+    src = list(range(57))
+    pre = ChunkPrefetcher(iter(src), depth=3)
+    assert list(pre) == src
+
+
+def test_prefetcher_shuffle_requires_tagging():
+    with pytest.raises(ValueError, match="tagged"):
+        ChunkPrefetcher(iter([1, 2]), depth=2, shuffle_seed=7)
+
+
+def test_prefetcher_shuffled_schedule_is_seeded_and_complete():
+    src = list(range(101))
+    runs = []
+    for _ in range(2):
+        pre = ChunkPrefetcher(iter(src), depth=4, shuffle_seed=123,
+                              tagged=True)
+        runs.append(list(pre))
+    # deterministic replay of the SAME shuffled schedule...
+    assert runs[0] == runs[1]
+    # ...that is a true permutation (nothing lost, nothing duplicated),
+    # tags matching payloads
+    assert sorted(runs[0]) == [(i, i) for i in src]
+    assert [seq for seq, _ in runs[0]] != src  # it actually shuffled
+    # and the Resequencer restores source order exactly
+    pre = ChunkPrefetcher(iter(src), depth=4, shuffle_seed=123, tagged=True)
+    assert list(Resequencer(pre)) == src
+
+
+def test_prefetcher_block_shuffle_bounds_resequencer_held():
+    """Shuffling permutes disjoint bounded blocks, so the resequencer's
+    held dict — the memory cost of out-of-order arrival — is HARD-bounded
+    at block−1 chunks, never an unbounded pile."""
+    depth = 3
+    pre = ChunkPrefetcher(iter(range(200)), depth=depth, shuffle_seed=9,
+                          tagged=True)
+    rs = Resequencer(pre)
+    assert list(rs) == list(range(200))
+    assert rs.max_held <= max(2, depth) - 1
+
+
+def test_prefetcher_propagates_source_error_and_closes():
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("scan exploded")
+
+    pre = ChunkPrefetcher(boom(), depth=2)
+    got = []
+    with pytest.raises(RuntimeError, match="scan exploded"):
+        for x in pre:
+            got.append(x)
+    assert got == [1, 2]
+    assert pre.close()
+
+
+# ---------------------------------------------------------------------------
+# zero-copy FREQ sidecars
+
+
+FREQ_CASES = [
+    ("RS=1;FREQ=GnomAD:0.9,0.001", 1),
+    ("FREQ=GnomAD:0.5,0.25", 2),  # n_alts > provided freqs
+    ("FREQ=TOPMED:.,0.1|GnomAD:0.5,0.25", 1),  # '.' -> None
+    ("FREQ=TOPMED:0,0.1", 1),  # "0" excluded by string-compare
+    ("FREQ=TOPMED:0.0,0.1", 1),  # "0.0" NOT excluded
+    ("FREQ=A B:0.1|dbGaP\\x2cX:0.2", 1),  # space + scrubbed comma in name
+    ("FREQ=Ké:0.25", 1),  # non-ASCII population name -> \u escapes
+    ("FREQ=X:1e400", 1),  # overflows to inf; json allow_nan renders it
+    ("FREQ=X:3", 1),  # integer-form frequency
+    ("FREQ=X:0.1;FREQ=Y:0.2", 1),  # duplicate FREQ key: LAST wins
+    ("FREQ=X:0.1|X:0.2", 1),  # duplicate population: last wins, first slot
+    ("FREQ=1000Genomes:0.993611,0.006389|Chileans:0.925926,0.074074", 2),
+    ("FREQ=bad", 1),  # no ':' -> no pops at all
+    ("RS=5", 1),  # no FREQ
+    ("", 1),
+    ("FREQ=GnomAD#0.3", 1),  # '#' scrubs to ':'
+]
+
+
+@pytest.mark.parametrize("info,n_alts", FREQ_CASES)
+def test_freq_sidecar_matches_dict_path_bytes(info, n_alts):
+    """freq_sidecar's RawJson text must be byte-identical to what
+    sidecar_line would have serialized for the parse_freq dict — the whole
+    zero-copy discipline rests on this equality."""
+    want = parse_freq(parse_info(info), n_alts)
+    got = freq_sidecar(info, n_alts)
+    assert len(got) == len(want) == n_alts
+    for g, w in zip(got, want):
+        if w is None:
+            assert g is None
+        else:
+            assert g.text == json.dumps(w)
+            assert g == w  # RawJson mapping equality with the dict
+
+
+def test_freq_sidecar_lazy_equivalence_roundtrip():
+    # FREQ slot 0 is the REF frequency; alts take slots 1..n
+    got = freq_sidecar("FREQ=GnomAD:0.9,0.25,0.001", 2)
+    # RawJson parses lazily but reads like the dict
+    assert got[0]["GnomAD"] == {"gmaf": 0.25}
+    assert math.isclose(got[1]["GnomAD"]["gmaf"], 0.001)
+
+
+# ---------------------------------------------------------------------------
+# shuffled scheduling end-to-end: byte-identical stores
+
+
+def test_shuffled_load_store_byte_identical(tmp_path, monkeypatch):
+    vcf = str(tmp_path / "multi.vcf")
+    _write_vcf(vcf)
+    monkeypatch.delenv("AVDB_INGEST_SHUFFLE_SEED", raising=False)
+    c_seq, _, store_seq, loader_seq, dir_seq = _run_load(
+        tmp_path, vcf, "overlapped", monkeypatch, "seq"
+    )
+    monkeypatch.setenv("AVDB_INGEST_SHUFFLE_SEED", "1234")
+    c_sh, _, store_sh, loader_sh, dir_sh = _run_load(
+        tmp_path, vcf, "overlapped", monkeypatch, "sh"
+    )
+    loader_seq.close(), loader_sh.close()
+    assert {k: c_seq.get(k) for k in COUNTER_KEYS} == \
+           {k: c_sh.get(k) for k in COUNTER_KEYS}
+    assert c_seq["duplicates"] > 0 and c_seq["malformed"] > 0
+    assert store_seq.n == store_sh.n
+    files_seq, files_sh = _persisted_bytes(dir_seq), _persisted_bytes(dir_sh)
+    assert list(files_seq) == list(files_sh)
+    for name in files_seq:
+        assert files_seq[name] == files_sh[name], f"{name} bytes diverge"
+    # the idle-fraction headline is recorded and sane
+    assert 0.0 <= loader_sh.device_idle_fraction <= 1.0
+
+
+def test_shuffled_load_identical_under_mesh_write_order(tmp_path,
+                                                        monkeypatch):
+    """Same identity with mesh-placement-ordered segment writes active:
+    AVDB_MESH_SHAPE reorders save()'s physical writes AND the prefetcher
+    shuffles the schedule, yet bytes match a strict-order load saved under
+    the same placement."""
+    vcf = str(tmp_path / "mesh.vcf")
+    _write_vcf(vcf, n_lines=1200)
+    monkeypatch.setenv("AVDB_MESH_SHAPE", "2")
+    monkeypatch.delenv("AVDB_INGEST_SHUFFLE_SEED", raising=False)
+    c_seq, _, _, loader_seq, dir_seq = _run_load(
+        tmp_path, vcf, "overlapped", monkeypatch, "mseq"
+    )
+    monkeypatch.setenv("AVDB_INGEST_SHUFFLE_SEED", "42")
+    c_sh, _, _, loader_sh, dir_sh = _run_load(
+        tmp_path, vcf, "overlapped", monkeypatch, "msh"
+    )
+    loader_seq.close(), loader_sh.close()
+    assert {k: c_seq.get(k) for k in COUNTER_KEYS} == \
+           {k: c_sh.get(k) for k in COUNTER_KEYS}
+    files_seq, files_sh = _persisted_bytes(dir_seq), _persisted_bytes(dir_sh)
+    assert list(files_seq) == list(files_sh)
+    for name in files_seq:
+        assert files_seq[name] == files_sh[name], f"{name} bytes diverge"
+    # the advisory placement actually landed in the manifest
+    manifest = json.loads(files_sh["manifest.json"])
+    assert manifest.get("mesh_placement", {}).get("devices") == 2
+
+
+def test_max_errors_exact_under_shuffled_decode(tmp_path, monkeypatch):
+    """--maxErrors must trip at the same rejected-row count no matter how
+    the prefetcher scheduled the chunks: the budget check runs on the
+    consumer in resequenced chunk order."""
+    from annotatedvdb_tpu.utils.quarantine import ErrorBudgetExceeded
+
+    vcf = str(tmp_path / "bad.vcf")
+    with open(vcf, "w") as fh:
+        fh.write("##fileformat=VCFv4.2\n"
+                 "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        for k in range(3000):
+            if k % 500 == 250:  # 6 malformed lines, spread across chunks
+                fh.write(f"1\tnot_a_pos_{k}\t.\tA\tC\t.\t.\t.\n")
+            else:
+                fh.write(f"1\t{1000 + 3 * k}\trs{k}\tA\tC\t.\t.\tRS={k}\n")
+
+    counts = {}
+    for tag, seed in (("seq", None), ("sh", "77")):
+        monkeypatch.setenv("AVDB_PIPELINE", "overlapped")
+        if seed is None:
+            monkeypatch.delenv("AVDB_INGEST_SHUFFLE_SEED", raising=False)
+        else:
+            monkeypatch.setenv("AVDB_INGEST_SHUFFLE_SEED", seed)
+        store = VariantStore(width=49)
+        ledger = AlgorithmLedger(str(tmp_path / f"led.{tag}.jsonl"))
+        loader = TpuVcfLoader(store, ledger, batch_size=256,
+                              log=lambda *a: None, max_errors=3)
+        with pytest.raises(ErrorBudgetExceeded):
+            loader.load_file(vcf, commit=False)
+        loader.close()
+        counts[tag] = loader._budget.count
+    assert counts["seq"] == counts["sh"] == 4  # trips on the 4th reject
+
+
+# ---------------------------------------------------------------------------
+# mesh-placement segment write order
+
+
+def _multi_chrom_store(codes=(1, 2, 3, 10, 23)):
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    store = VariantStore(width=8)
+    ref, ref_len = encode_allele_array(["A", "A"], 8)
+    alt, alt_len = encode_allele_array(["C", "C"], 8)
+    for code in codes:
+        store.shard(code).append(
+            {"pos": np.asarray([10, 20], np.int32),
+             "h": identity_hashes(8, ref, alt, ref_len, alt_len),
+             "ref_len": ref_len, "alt_len": alt_len},
+            ref, alt,
+        )
+    return store
+
+
+def test_save_writes_segments_in_placement_order(tmp_path, monkeypatch):
+    from annotatedvdb_tpu.parallel.mesh import placement_hint
+    from annotatedvdb_tpu.store.variant_store import chromosome_label
+
+    monkeypatch.setenv("AVDB_MESH_SHAPE", "2")
+    placement = placement_hint()
+    assert placement is not None and placement["devices"] == 2
+
+    orig = VariantStore._write_segment
+    order: list[str] = []
+
+    def spy(path, stem, seg):
+        order.append(stem)
+        return orig(path, stem, seg)
+
+    monkeypatch.setattr(VariantStore, "_write_segment", staticmethod(spy))
+    codes = (1, 2, 3, 10, 23)
+    store = _multi_chrom_store(codes)
+    d = str(tmp_path / "placed")
+    store.save(d)
+
+    assert len(order) == len(codes)
+    devs = [placement["groups"][stem.split(".")[0][3:]] for stem in order]
+    # grouped by owning device, never interleaved
+    assert devs == sorted(devs), f"write order not placement-grouped: " \
+                                 f"{list(zip(order, devs))}"
+    assert len(set(devs)) == 2  # the fixture really spans both devices
+
+    # and the manifest's LOGICAL layout is the legacy sorted-code order —
+    # identical (mesh_placement block aside) to a save with no mesh at all
+    with open(os.path.join(d, "manifest.json")) as f:
+        placed = json.load(f)
+    monkeypatch.delenv("AVDB_MESH_SHAPE")
+    store2 = _multi_chrom_store(codes)
+    d2 = str(tmp_path / "flat")
+    store2.save(d2)
+    with open(os.path.join(d2, "manifest.json")) as f:
+        flat = json.load(f)
+    placed.pop("mesh_placement")
+    for m in (placed, flat):
+        m.pop("store_uid")
+    assert placed == flat
+    # flat save writes in sorted-code order (the legacy invariant)
+    labels = [chromosome_label(c) for c in codes]
+    assert [s.split(".")[0][3:] for s in order[len(codes):]] == labels

@@ -161,6 +161,38 @@ def test_tracer_spans_threads_and_save(tmp_path):
     _check_trace_events(doc["traceEvents"])
 
 
+def test_tracer_ident_reuse_gets_own_track(monkeypatch):
+    """OS thread idents are recycled: the lazily-spawned store-writer
+    routinely inherits the exited ingest thread's ident, and keying
+    tracks on the raw ident silently merged the two threads into one
+    misnamed track (the CLI trace then showed no writer lane at all).
+    A reused ident under a NEW thread name must open a fresh track."""
+    tracer = Tracer(process_name="test-proc")
+    monkeypatch.setattr(threading, "get_ident", lambda: 4242)
+    names = iter(["avdb-vcf-ingest", "avdb-vcf-ingest", "avdb-store_0"])
+
+    class _T:
+        def __init__(self, name):
+            self.name = name
+
+    monkeypatch.setattr(
+        threading, "current_thread", lambda: _T(next(names))
+    )
+    tracer.begin("ingest")
+    tracer.end("ingest")  # same name: stays on the first track
+    tracer.begin("append")  # same ident, new name: must NOT merge
+    metas = {
+        e["args"]["name"]: e["tid"] for e in tracer.events()
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert set(metas) >= {"avdb-vcf-ingest", "avdb-store_0"}
+    assert metas["avdb-vcf-ingest"] != metas["avdb-store_0"]
+    by_track = {
+        e["tid"]: e["name"] for e in tracer.events() if e["ph"] == "B"
+    }
+    assert by_track[metas["avdb-store_0"]] == "append"
+
+
 def test_stage_timer_mirrors_spans_to_tracer():
     from annotatedvdb_tpu.utils.profiling import StageTimer
 

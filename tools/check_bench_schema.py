@@ -118,6 +118,14 @@ def _check_end_to_end(e2e, where: str, errors: list) -> None:
         errors.append(f"{where}: end_to_end must be an object")
         return
     w = f"{where}.end_to_end"
+    # spine-v2 records ("ingest_spine": 2, the chunked-prefetch loader)
+    # must PROVE the device was not idle-dominant: device_idle_fraction
+    # and the per-stage breakdown are required, not optional.  Historic
+    # pre-spine records keep validating against the relaxed core schema.
+    spine_v2 = e2e.get("ingest_spine") == 2
+    required = ["variants_per_sec", "variants", "seconds", "stages"]
+    if spine_v2:
+        required += ["device_idle_fraction", "stage_wall"]
     _check_fields(
         e2e,
         {
@@ -125,10 +133,21 @@ def _check_end_to_end(e2e, where: str, errors: list) -> None:
             "duplicates": _is_int, "seconds": _is_num, "vcf_mb": _is_num,
             "mb_per_sec": _is_num,
             "pipeline": lambda v: isinstance(v, str),
+            "device_idle_fraction": _is_num,
+            "ingest_spine": _is_int,
+            # median_headline sampling: every measured run's rate
+            "runs": lambda v: isinstance(v, list)
+            and all(_is_num(x) for x in v),
         },
         w, errors,
-        required=("variants_per_sec", "variants", "seconds", "stages"),
+        required=tuple(required),
     )
+    if spine_v2 and _is_num(e2e.get("device_idle_fraction")):
+        f = e2e["device_idle_fraction"]
+        if not (0.0 <= f <= 1.0):
+            errors.append(
+                f"{w}.device_idle_fraction: {f} outside [0, 1]"
+            )
     if "stages" in e2e:
         _check_stages(e2e["stages"], w, errors)
     if "stage_wall" in e2e:

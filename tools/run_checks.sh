@@ -31,7 +31,11 @@
 #                    sharded load (placement block committed), and a
 #                    real fleet with AVDB_SERVE_MESH=1 answering every
 #                    query shape byte-identical to a mesh-off server
-#   9. chaos_soak --smoke — a 1-worker fleet under open-loop load with
+#   9. ingest_smoke — the overlapped ingest spine: synthetic VCF loaded
+#                    serial vs shuffled-overlapped vs mesh-placement
+#                    write order, all three byte-identical, deep fsck
+#                    clean
+#  10. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
 #
@@ -72,6 +76,9 @@ python "$root/tools/maintain_smoke.py" || rc=1
 
 echo "== mesh smoke ==" >&2
 python "$root/tools/mesh_smoke.py" || rc=1
+
+echo "== ingest smoke ==" >&2
+python "$root/tools/ingest_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1
