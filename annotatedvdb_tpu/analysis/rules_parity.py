@@ -74,6 +74,10 @@ SHARED_HELPERS = frozenset({
     "metrics_payload",
     "debug_trace_payload",
     "chaos_enabled_from_env",
+    # the health plane (PR 17): the /alerts and /metrics/history bodies
+    # live once in http.py — both front ends only render
+    "alerts_payload",
+    "metrics_history_payload",
 })
 
 #: literals shorter than this are grammar fragments (JSON keys, header

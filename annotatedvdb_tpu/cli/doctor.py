@@ -20,6 +20,8 @@ Usage:
     python -m annotatedvdb_tpu doctor status --storeDir ./vdb [--json]
     python -m annotatedvdb_tpu doctor profile --storeDir ./vdb \
         [--out report.json] [--chunkRows N]
+    python -m annotatedvdb_tpu doctor slo --storeDir ./vdb \
+        [--all] [--fast S] [--slow S] [--burn X] [--json]
     python -m annotatedvdb_tpu doctor replay-rejects \
         --rejects ./vdb/quarantine/x.vcf.rejects.jsonl --out fixed.vcf
 
@@ -223,6 +225,90 @@ def _flight(argv) -> int:
         for ring in out["rings"]:
             print(f"== {ring['path']} (live ring)", file=sys.stderr)
             _render_blackbox({}, ring["events"], args.limit)
+    return 0
+
+
+def _slo(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor slo",
+        description="replay the SLO burn-rate state machine over metrics "
+                    "time-series history under <store>/history/ — "
+                    "harvested from dead workers by the fleet supervisor, "
+                    "or persisted live by the serving health plane — and "
+                    "report what fired, when, and how hot the error "
+                    "budget burned",
+    )
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--all", action="store_true",
+                    help="replay every harvested history file, not just "
+                         "the newest (live mirrors always replay)")
+    ap.add_argument("--fast", type=float, default=None, metavar="S",
+                    help="fast burn window seconds (default: "
+                         "AVDB_SLO_FAST_S or 60)")
+    ap.add_argument("--slow", type=float, default=None, metavar="S",
+                    help="slow burn window seconds (default: "
+                         "AVDB_SLO_SLOW_S or 300)")
+    ap.add_argument("--burn", type=float, default=None, metavar="X",
+                    help="burn-rate threshold both windows must exceed "
+                         "(default: AVDB_SLO_BURN or 2.0)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    import os
+
+    from annotatedvdb_tpu.obs import timeseries
+    from annotatedvdb_tpu.obs.slo import replay_history
+
+    if not os.path.isdir(args.storeDir):
+        print(f"doctor slo: {args.storeDir}: not a directory",
+              file=sys.stderr)
+        return 2
+    files = timeseries.list_history(args.storeDir)
+    paths = (files["harvested"] if args.all else files["harvested"][:1]) \
+        + files["live"]
+    out = {"store_dir": args.storeDir, "replays": []}
+    for path in paths:
+        try:
+            doc = timeseries.load_history(path)
+            replay = replay_history(
+                doc.get("samples") or [], fast_s=args.fast,
+                slow_s=args.slow, burn_threshold=args.burn,
+            )
+        except (OSError, ValueError) as err:
+            print(f"doctor slo: {path}: cannot replay ({err})",
+                  file=sys.stderr)
+            continue
+        out["replays"].append({
+            "path": path,
+            "worker": doc.get("worker"),
+            "harvested": doc.get("harvested"),
+            **replay,
+        })
+    if not out["replays"]:
+        print(f"doctor slo: {args.storeDir}: no time-series history (no "
+              "harvested files or live mirrors under history/) — serve "
+              "workers record one while AVDB_OBS_TICK_S and "
+              "AVDB_OBS_HISTORY_S are > 0", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out, indent=1))
+        return 0
+    print(f"slo: {args.storeDir}: {len(out['replays'])} history "
+          f"replay(s)", file=sys.stderr)
+    for rep in out["replays"]:
+        h = rep.get("harvested") or {}
+        why = f" — harvested: {h.get('reason')}" if h else " (live mirror)"
+        print(f"== {rep['path']}{why}", file=sys.stderr)
+        print(f"  worker {rep['worker']}: {rep['ticks']} tick(s) over "
+              f"{rep['span_s']}s", file=sys.stderr)
+        for a in rep["alerts"]:
+            mb = rep["max_burn"].get(a["slo"])
+            print(f"    {a['slo']:<16} {a['state']:<9} max burn "
+                  f"{mb if mb is not None else '-'} "
+                  f"(fired {a['fired_total']} time(s))", file=sys.stderr)
+        for ep in rep["episodes"]:
+            print(f"    {_fmt_t(ep['t'])}  {ep['slo']}: {ep['from']} -> "
+                  f"{ep['to']} (burn fast={ep['burn_fast']} "
+                  f"slow={ep['burn_slow']})", file=sys.stderr)
     return 0
 
 
@@ -644,6 +730,8 @@ def main(argv=None) -> int:
         return _flight(argv[1:])
     if argv and argv[0] == "trace":
         return _trace(argv[1:])
+    if argv and argv[0] == "slo":
+        return _slo(argv[1:])
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--storeDir", required=True)

@@ -315,6 +315,29 @@ def _run_single(args, log) -> int:
             log(f"flight: recorder unavailable ({err}); serving without "
                 "a black box")
 
+    # health plane: the metrics time-series ring + SLO burn-rate alerts
+    # (obs/slo.py), persisted under <store>/history/ so the supervisor
+    # can harvest a dead worker's history like its flight ring.  Knob
+    # typos fail startup loudly (the *_from_env contract); a plane that
+    # resolves to disabled (tick or retention 0) stays None — serving is
+    # never gated on its observer.
+    health = None
+    from annotatedvdb_tpu.obs.slo import HealthPlane
+    from annotatedvdb_tpu.obs.timeseries import (
+        obs_history_from_env,
+        obs_tick_from_env,
+    )
+
+    try:
+        if obs_tick_from_env() > 0 and obs_history_from_env() > 0:
+            health = HealthPlane(
+                registry, store_dir=args.storeDir,
+                worker=args._workerIndex or 0, log=log,
+            )
+    except ValueError as err:
+        print(f"serve: cannot start: {err}", file=sys.stderr)
+        return 1
+
     memtable = None
     if _upserts_enabled(args):
         from annotatedvdb_tpu.serve.snapshot import MemtableSnapshots
@@ -360,7 +383,7 @@ def _run_single(args, log) -> int:
     if args.frontend == "threaded":
         return _run_threaded(args, manager, registry, residency, tracer,
                              max_wait_s, log, memtable=memtable,
-                             flight=flight)
+                             flight=flight, health=health)
 
     from annotatedvdb_tpu.serve.aio import build_aio_server
 
@@ -376,6 +399,7 @@ def _run_single(args, log) -> int:
             heartbeat_index=args._workerIndex or 0,
             tracer=tracer, log=log, flight=flight,
             telemetry_dir=args._telemetryDir,
+            health=health,
         )
     except (OSError, ValueError) as err:
         # unparseable AVDB_SERVE_* knob or unbindable address: same clean
@@ -463,6 +487,10 @@ def _run_single(args, log) -> int:
         reqtrace_mod.set_background_sink(None, None)
         if flight is not None:
             flight.close()
+        if health is not None:
+            # forced final persist: a clean shutdown leaves the full
+            # history tail on disk for doctor slo
+            health.close()
         _export(args, ctx.registry, tracer, log)
     return 0
 
@@ -480,7 +508,8 @@ def _worker_socket(args):
 
 
 def _run_threaded(args, manager, registry, residency, tracer,
-                  max_wait_s, log, memtable=None, flight=None) -> int:
+                  max_wait_s, log, memtable=None, flight=None,
+                  health=None) -> int:
     """The PR-5 thread-per-connection server (byte-parity reference)."""
     from annotatedvdb_tpu.serve.http import build_server
 
@@ -493,6 +522,7 @@ def _run_threaded(args, manager, registry, residency, tracer,
             tracer=tracer, log=log, flight=flight,
             telemetry_dir=args._telemetryDir,
             worker_index=args._workerIndex or 0,
+            health=health,
         )
     except (OSError, ValueError) as err:
         print(f"serve: cannot start: {err}", file=sys.stderr)
@@ -516,6 +546,8 @@ def _run_threaded(args, manager, registry, residency, tracer,
         reqtrace_mod.set_background_sink(None, None)
         if flight is not None:
             flight.close()
+        if health is not None:
+            health.close()
         _export(args, ctx.registry, tracer, log)
     return 0
 

@@ -211,6 +211,31 @@ ENV_VARS: dict = {
                           "(last-N request summaries + lifecycle events "
                           "in an mmap'd file that survives SIGKILL; "
                           "default 512, 0 disables)",
+    "AVDB_OBS_TICK_S": "seconds between metrics time-series snapshots in "
+                       "the health plane's history ring (default 1.0; 0 "
+                       "disables the ring AND the SLO alert plane riding "
+                       "it; malformed values fail startup)",
+    "AVDB_OBS_HISTORY_S": "time-series history retention per worker in "
+                          "seconds (default 300; 0 disables; the ring "
+                          "persists to <store>/history/ for supervisor "
+                          "harvest and doctor slo)",
+    "AVDB_SLO_FAST_S": "fast SLO burn-rate window in seconds (default "
+                       "60): proves a breach is happening NOW; both "
+                       "windows must burn past AVDB_SLO_BURN to alert",
+    "AVDB_SLO_SLOW_S": "slow (confirming) SLO burn-rate window in "
+                       "seconds (default 300; must be >= the fast "
+                       "window): proves a breach is sustained",
+    "AVDB_SLO_BURN": "burn-rate threshold both SLO windows must exceed "
+                     "for an alert to breach (default 2.0 = spending "
+                     "error budget twice as fast as the objective "
+                     "allows)",
+    "AVDB_SLO_AVAIL_TARGET": "availability SLO objective as a fraction "
+                             "in (0, 1) (default 0.999; the error "
+                             "budget is 1 - target)",
+    "AVDB_SLO_LOAD_FLOOR": "load-pipeline variants/sec floor SLO "
+                           "(default 0 = declared but dormant; alerts "
+                           "when the windowed avdb_rows_total rate "
+                           "drops below it)",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
     "AVDB_BENCH_E2E_RUNS": "median-of-N run count for the end-to-end load "

@@ -46,6 +46,38 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
+def bucket_quantile(edges, counts, count, q: float) -> float | None:
+    """Quantile estimate from fixed histogram buckets (the Prometheus
+    ``histogram_quantile`` interpolation): locate the bucket holding rank
+    ``q*count`` and interpolate linearly inside it.  Works on the
+    ``{"edges", "counts", "count"}`` triple every histogram snapshot
+    carries, so the time-series ring can estimate quantiles from
+    persisted snapshot DELTAS without live metric objects.
+
+    Returns None for an empty histogram (no rank to locate).  A rank
+    landing in the open-ended +Inf tail returns the highest finite edge —
+    the honest answer is "at least this", and a finite number keeps SLO
+    arithmetic total.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    edges = tuple(float(e) for e in edges)
+    counts = [int(c) for c in counts]
+    count = int(count)
+    if count <= 0 or not edges or len(counts) != len(edges) + 1:
+        return None
+    rank = q * count
+    cum = 0
+    for i, n in enumerate(counts[:-1]):
+        prev_cum = cum
+        cum += n
+        if cum >= rank and n > 0:
+            hi = edges[i]
+            lo = edges[i - 1] if i > 0 else min(0.0, edges[0])
+            return lo + (hi - lo) * ((rank - prev_cum) / n)
+    return edges[-1]
+
+
 def _label_str(labels: dict | None) -> str:
     if not labels:
         return ""
@@ -166,6 +198,14 @@ class Histogram:
                 "sum": self._sum,
                 "count": self._count,
             }
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-interpolated quantile estimate (see
+        :func:`bucket_quantile`); None while the histogram is empty."""
+        snap = self.snapshot()
+        return bucket_quantile(
+            snap["edges"], snap["counts"], snap["count"], q
+        )
 
     def render(self, lines: list) -> None:
         snap = self.snapshot()

@@ -81,6 +81,7 @@ from annotatedvdb_tpu.serve.http import (
     MSG_CAPACITY_BULK,
     MSG_CAPACITY_REGION,
     MSG_CAPACITY_STATS,
+    HISTORY_ROUTE,
     MSG_CAPACITY_UPSERT,
     MSG_DEADLINE_ADMISSION,
     MSG_DEADLINE_EXECUTE,
@@ -91,9 +92,11 @@ from annotatedvdb_tpu.serve.http import (
     UPSERT_BODY_ERROR,
     UPSERT_ROUTE,
     ServeContext,
+    alerts_payload,
     chaos_enabled_from_env,
     debug_trace_payload,
     healthz_payload,
+    metrics_history_payload,
     metrics_payload,
     parse_region_params,
     parse_regions_body,
@@ -637,6 +640,11 @@ class AioServer:
         self._flight_flush_inflight = False
         if ctx.flight is not None:
             ctx.flight_flush_inline = False
+        #: health-plane ticks likewise run from the tick on the POOL
+        #: (the persist half is file I/O, banned on the loop)
+        self._health_tick_inflight = False
+        if ctx.health is not None:
+            ctx.health_tick_inline = False
         #: arming generation: each /_chaos arm bumps it so a stale ttl
         #: timer can never disarm a NEWER arming's fault
         self._chaos_seq = 0
@@ -818,6 +826,8 @@ class AioServer:
                 self._maybe_publish_telemetry()
             with contextlib.suppress(Exception):
                 self._maybe_flush_flight()
+            with contextlib.suppress(Exception):
+                self._maybe_tick_health()
         finally:
             # the next tick is unconditional: whatever one pass hit, the
             # heartbeat/brownout machinery must keep running
@@ -857,6 +867,26 @@ class AioServer:
                 flight.flush(limit=flight.FLUSH_BATCH)
             finally:
                 self._flight_flush_inflight = False
+
+        self._pool.submit(run)
+
+    def _maybe_tick_health(self) -> None:
+        """Health-plane tick (time-series sample + SLO evaluation +
+        history persist) on the executor pool — the persist half is file
+        I/O, banned on the loop.  One in flight; the plane's own
+        ``due()`` gates the cadence, and ``tick()`` absorbs its own
+        failures."""
+        health = self.ctx.health
+        if health is None or self._health_tick_inflight \
+                or not health.due():
+            return
+        self._health_tick_inflight = True
+
+        def run():
+            try:
+                health.tick()
+            finally:
+                self._health_tick_inflight = False
 
         self._pool.submit(run)
 
@@ -1244,6 +1274,29 @@ class AioServer:
                              content_type=_CT_TEXT), keep, tid
             if path == "/stats":
                 return _resp(200, stats_payload(ctx)), keep, tid
+            if path == "/alerts":
+                if "fleet" in (url.query or ""):
+                    # the fleet view reads sibling history FILES — that
+                    # is executor work, never event-loop work
+                    fut = self._loop.run_in_executor(
+                        self._pool,
+                        lambda: _resp(200, alerts_payload(ctx, url.query)),
+                    )
+                    return ("exec", fut, "alerts", time.perf_counter(),
+                            tid, None), keep, tid
+                return _resp(200, alerts_payload(ctx, url.query)), keep, tid
+            if path == HISTORY_ROUTE:
+                # even the solo view walks the whole ring deriving
+                # rates/quantiles per sample — executor work like the
+                # fleet file reads, never event-loop work
+                fut = self._loop.run_in_executor(
+                    self._pool,
+                    lambda: _resp(
+                        200, metrics_history_payload(ctx, url.query)
+                    ),
+                )
+                return ("exec", fut, "history", time.perf_counter(),
+                        tid, None), keep, tid
             if path == "/debug/trace" and ctx.debug_trace_enabled:
                 # chaos-gated like /_chaos: a production server 404s this
                 # byte-identically to any unknown route
@@ -1981,7 +2034,8 @@ def build_aio_server(store_dir: str | None = None, manager=None,
                      heartbeat_file: str | None = None,
                      heartbeat_index: int = 0,
                      tracer=None, log=None, flight=None,
-                     telemetry_dir: str | None = None) -> AioServer:
+                     telemetry_dir: str | None = None,
+                     health=None) -> AioServer:
     """Wire manager -> engine -> batcher -> event-loop server (not yet
     serving; call ``serve_forever`` or ``start_background``).  The caller
     owns shutdown order: ``server.shutdown()`` then
@@ -2012,7 +2066,7 @@ def build_aio_server(store_dir: str | None = None, manager=None,
     ctx = ServeContext(manager, engine, batcher, registry,
                        memtable=memtable, log=log, flight=flight,
                        telemetry_dir=telemetry_dir, tracer=tracer,
-                       worker_index=heartbeat_index)
+                       worker_index=heartbeat_index, health=health)
     return AioServer(
         ctx, host=host, port=port, sock=sock, client_rate=client_rate,
         stream_threshold=stream_threshold,

@@ -214,6 +214,29 @@ class ServeFleet:
             except OSError as err:
                 self.log(f"flight: supervisor ring unavailable ({err}); "
                          "continuing without it")
+        # the health plane's knobs resolve NOW, for the same reason as
+        # disk_reserve above: a typo'd AVDB_OBS_*/AVDB_SLO_* must fail
+        # fleet startup (rc 1), not crash every spawned worker in a loop.
+        # The supervisor also harvests dead workers' history mirrors, so
+        # it needs the enablement fact itself.
+        from annotatedvdb_tpu.obs.slo import (
+            slo_avail_target_from_env,
+            slo_burn_from_env,
+            slo_load_floor_from_env,
+            slo_slow_window_from_env,
+        )
+        from annotatedvdb_tpu.obs.timeseries import (
+            obs_history_from_env,
+            obs_tick_from_env,
+        )
+
+        self._history_enabled = (
+            obs_tick_from_env() > 0 and obs_history_from_env() > 0
+        )
+        slo_slow_window_from_env()  # also validates AVDB_SLO_FAST_S
+        slo_burn_from_env()
+        slo_avail_target_from_env()
+        slo_load_floor_from_env()
 
     #: a worker that survived this long resets its rapid-death streak —
     #: backoff punishes crash LOOPS, not a long-lived worker's occasional
@@ -359,6 +382,7 @@ class ServeFleet:
                         if i in self._wedged else f"died rc={rc}"
                     self._wedged.discard(i)
                     self._harvest_flight(i, reason)
+                    self._harvest_history(i, reason)
                     lived = time.monotonic() - self._spawn_time.get(i, 0.0)
                     if lived >= self.HEALTHY_RUN_S:
                         self._respawns[i] = 0  # streak broken: healthy run
@@ -461,6 +485,24 @@ class ServeFleet:
             )
         except Exception as err:
             self.log(f"flight: harvest of worker {index} failed "
+                     f"({type(err).__name__}: {err}); continuing")
+
+    def _harvest_history(self, index: int, reason: str) -> None:
+        """Harvest a dead worker's time-series history mirror into
+        ``<store>/history/<ms>-w<idx>.json`` for ``doctor slo``.  Every
+        failure is absorbed (incl. the ``obs.tick`` fault point): the
+        health plane must never stall a respawn."""
+        if not self._history_enabled:
+            return
+        from annotatedvdb_tpu.obs import timeseries
+
+        try:
+            timeseries.harvest(
+                timeseries.history_path(self.store_dir, index),
+                self.store_dir, index, reason, log=self.log,
+            )
+        except Exception as err:
+            self.log(f"timeseries: harvest of worker {index} failed "
                      f"({type(err).__name__}: {err}); continuing")
 
     def _check_wedged(self) -> None:

@@ -53,6 +53,8 @@ _RETURNED_RE = re.compile(r'"returned":(\d+)')
 from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
 from annotatedvdb_tpu.obs.reqtrace import TraceRecorder
+from annotatedvdb_tpu.obs.slo import worst_of
+from annotatedvdb_tpu.obs.timeseries import derive_series, load_history
 from annotatedvdb_tpu.serve import resilience
 from annotatedvdb_tpu.serve.batcher import QueryBatcher, QueueFull
 from annotatedvdb_tpu.serve.engine import (
@@ -99,6 +101,13 @@ def healthz_payload(ctx) -> str:
         "breaker_open": len(
             ctx.engine.breaker.open_groups()
         ) if ctx.engine.breaker is not None else 0,
+        # the alert plane's one-glance summary: how many SLOs are
+        # firing, and the worst alert state ("disabled" when the health
+        # plane is off — absence must be distinguishable from health)
+        "alerts_firing": ctx.health.slos.firing()
+        if ctx.health is not None else 0,
+        "alerts": ctx.health.slos.worst_state()
+        if ctx.health is not None else "disabled",
     })
 
 
@@ -209,6 +218,141 @@ def metrics_payload(ctx, query: str) -> str:
     if params.get("fleet", ["0"])[0] not in ("1", "true"):
         return ctx.registry.render_prometheus()
     return ctx.fleet_metrics()
+
+
+def _fleet_wanted(query: str) -> bool:
+    return parse_qs(query or "").get("fleet", ["0"])[0] in ("1", "true")
+
+
+def _health_sibling_docs(ctx) -> dict:
+    """Sibling workers' persisted health documents for the ``?fleet=1``
+    alert/history views, keyed by worker index: the live ``w*.ts.json``
+    mirrors under ``<store>/history``, TTL-aged exactly like the fleet
+    metric snapshots (a dead worker's last mirror must age out — its
+    HARVESTED history is ``doctor slo``'s business, not the live view's).
+    Self is excluded; the live plane is fresher."""
+    h = ctx.health
+    docs: dict[int, dict] = {}
+    if h is None or h.ring.path is None:
+        return docs
+    d = os.path.dirname(h.ring.path)
+    now = time.time()
+    if os.path.isdir(d):
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".ts.json"):
+                continue
+            try:
+                doc = load_history(os.path.join(d, fname))
+            except (OSError, ValueError, TypeError):
+                continue  # torn persist race: skip, never fail a read
+            idx = int(doc.get("worker", -1))
+            if idx == ctx.worker_index:
+                continue  # self: the live plane is fresher
+            if now - float(doc.get("t", 0)) > ctx.FLEET_SNAPSHOT_TTL_S:
+                continue  # a dead worker's stale mirror
+            docs[idx] = doc
+    return docs
+
+
+def alerts_payload(ctx, query: str) -> str:
+    """The ``GET /alerts`` body — the ONE builder both front ends share
+    (the parity contract).  Plain = this worker's live SLO alert states;
+    ``?fleet=1`` = per-worker states (self live, siblings from their
+    persisted history mirrors, which carry the alert rows), rolled up
+    into a fleet-wide ``firing`` count and worst ``state``."""
+    h = ctx.health
+
+    def solo() -> dict:
+        if h is None or not h.enabled:
+            return {"enabled": False, "worker": ctx.worker_index,
+                    "state": "disabled", "firing": 0, "alerts": []}
+        return {
+            "enabled": True,
+            "worker": ctx.worker_index,
+            "state": h.slos.worst_state(),
+            "firing": h.slos.firing(),
+            "burn_threshold": h.slos.burn_threshold,
+            "windows": {"fast_s": h.slos.fast_s, "slow_s": h.slos.slow_s},
+            "alerts": h.slos.alerts(),
+        }
+
+    me = solo()
+    if not _fleet_wanted(query):
+        return json.dumps(me)
+    workers = {str(ctx.worker_index): me}
+    for idx, doc in _health_sibling_docs(ctx).items():
+        rows = doc.get("alerts") or []
+        workers[str(idx)] = {
+            "enabled": True,
+            "worker": idx,
+            "state": worst_of(a.get("state", "ok") for a in rows),
+            "firing": int(doc.get("firing") or 0),
+            "alerts": rows,
+        }
+    return json.dumps({
+        "fleet": True,
+        "firing": sum(w["firing"] for w in workers.values()),
+        "state": worst_of(w["state"] for w in workers.values()
+                          if w["state"] != "disabled"),
+        "workers": workers,
+    })
+
+
+#: the history route spelling, single-sourced for both front ends
+HISTORY_ROUTE = "/metrics/history"
+
+
+def metrics_history_payload(ctx, query: str) -> str:
+    """The ``GET /metrics/history`` body — the time-series ring rendered
+    as derived series (counters as per-interval rates, histograms as
+    rate + p50/p99).  ``?window=S`` trims to the trailing S seconds (an
+    unparsable value is ignored — a read surface does not 400 on a
+    sloppy dashboard); ``?fleet=1`` = per-worker documents, self live
+    and siblings from their persisted mirrors."""
+    h = ctx.health
+    params = parse_qs(query or "")
+    try:
+        window = float(params.get("window", [""])[0])
+    except (ValueError, IndexError):
+        window = 0.0
+
+    def trim(samples: list) -> list:
+        if window <= 0 or len(samples) < 2:
+            return samples
+        cutoff = float(samples[-1]["t"]) - window
+        return [s for s in samples if float(s["t"]) >= cutoff]
+
+    def render(worker: int, tick_s, history_s, samples: list) -> dict:
+        samples = trim(samples)
+        return {
+            "enabled": True,
+            "worker": worker,
+            "tick_s": tick_s,
+            "history_s": history_s,
+            "samples": len(samples),
+            "span_s": round(
+                float(samples[-1]["t"]) - float(samples[0]["t"]), 3
+            ) if len(samples) >= 2 else 0.0,
+            "series": derive_series(samples),
+        }
+
+    def solo() -> dict:
+        if h is None or not h.enabled:
+            return {"enabled": False, "worker": ctx.worker_index,
+                    "samples": 0, "span_s": 0.0, "series": []}
+        return render(ctx.worker_index, h.ring.tick_s, h.ring.history_s,
+                      h.ring.samples())
+
+    me = solo()
+    if not _fleet_wanted(query):
+        return json.dumps(me)
+    workers = {str(ctx.worker_index): me}
+    for idx, doc in _health_sibling_docs(ctx).items():
+        workers[str(idx)] = render(
+            idx, doc.get("tick_s"), doc.get("history_s"),
+            doc.get("samples") or [],
+        )
+    return json.dumps({"fleet": True, "workers": workers})
 
 
 def parse_region_params(query: str):
@@ -442,7 +586,7 @@ class ServeContext:
                  registry: MetricsRegistry, max_inflight: int | None = None,
                  memtable=None, log=None, flight=None,
                  telemetry_dir: str | None = None, tracer=None,
-                 worker_index: int = 0):
+                 worker_index: int = 0, health=None):
         self.manager = manager
         self.engine = engine
         self.batcher = batcher
@@ -455,6 +599,14 @@ class ServeContext:
         self.flight = flight
         self.tracer = tracer
         self.telemetry_dir = telemetry_dir
+        #: the health plane (obs/slo.HealthPlane, None = disabled): the
+        #: metrics time-series ring + SLO burn-rate evaluator.  Ticking
+        #: mirrors the flight-flush split below: the threaded front end
+        #: ticks inline (time-gated, riding request completions and
+        #: health polls); the aio front end clears health_tick_inline
+        #: and ticks from its maintenance loop via the executor pool
+        self.health = health
+        self.health_tick_inline = True
         self.worker_index = int(worker_index)
         self.started_t = time.time()
         self.debug_trace_enabled = chaos_enabled_from_env()
@@ -613,6 +765,9 @@ class ServeContext:
                     self.flight.flush(limit=self.flight.FLUSH_BATCH)
                 except Exception:  # avdb: noqa[AVDB602] -- the recorder already logs; a flush failure must never fail the request riding it
                     pass
+        if self.health is not None and self.health_tick_inline \
+                and self.health.due():
+            self.health.tick()  # absorbs its own failures (obs/slo.py)
 
     def rejected(self, kind: str) -> None:
         self._kind[kind][3].inc()
@@ -899,6 +1054,12 @@ class ServeContext:
         maintenance tick)."""
         self.governor.maybe_step()
         self.maybe_flush_memtable()
+        # the health plane ticks off probes too: an idle (or drained)
+        # threaded worker completes no requests, and its alert states
+        # must still advance — resolution especially
+        if self.health is not None and self.health_tick_inline \
+                and self.health.due():
+            self.health.tick()
         if getattr(self.manager, "swapping", False):
             return False, "snapshot swap in progress"
         if self.governor.shed_bulk():
@@ -1001,6 +1162,12 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         if path == "/stats":
             self._reply(200, stats_payload(ctx))
+            return
+        if path == "/alerts":
+            self._reply(200, alerts_payload(ctx, url.query))
+            return
+        if path == HISTORY_ROUTE:
+            self._reply(200, metrics_history_payload(ctx, url.query))
             return
         if path == "/debug/trace" and ctx.debug_trace_enabled:
             # chaos-gated like /_chaos: on a production server this path
@@ -1416,7 +1583,7 @@ def build_server(store_dir: str | None = None, manager=None,
                  residency=None, memtable=None,
                  tracer=None, log=None, flight=None,
                  telemetry_dir: str | None = None,
-                 worker_index: int = 0) -> ThreadingHTTPServer:
+                 worker_index: int = 0, health=None) -> ThreadingHTTPServer:
     """Wire manager → engine → batcher → HTTP server (not yet serving; call
     ``serve_forever`` or run it on a thread).  The server carries its
     :class:`ServeContext` as ``httpd.ctx``; callers own shutdown order:
@@ -1450,5 +1617,5 @@ def build_server(store_dir: str | None = None, manager=None,
     httpd.ctx = ServeContext(manager, engine, batcher, registry,
                              memtable=memtable, log=log, flight=flight,
                              telemetry_dir=telemetry_dir, tracer=tracer,
-                             worker_index=worker_index)
+                             worker_index=worker_index, health=health)
     return httpd

@@ -152,6 +152,13 @@ arming any other name is a ``ValueError`` at parse time):
                             ``raise``/``eio`` must be ABSORBED both
                             places: observability never takes down the
                             serving (or respawn) path it records
+``obs.tick``                per health-plane tick (``obs.timeseries``):
+                            the registry snapshot, the atomic history
+                            persist, AND the supervisor's history
+                            harvest — ``raise``/``eio`` must be ABSORBED
+                            everywhere (logged once, next tick runs):
+                            the maintenance chains hosting the tick and
+                            the respawn loop never die of their observer
 ======================== ====================================================
 
 **Process-death actions are subprocess-only.**  ``kill``/``torn_write``
@@ -210,6 +217,7 @@ POINTS = frozenset({
     "maintain.disk_guard",
     "mesh.dispatch",
     "obs.flight",
+    "obs.tick",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

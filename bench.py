@@ -788,16 +788,17 @@ def bench_serve_open_loop(store_dir: str, ids: list,
 P99_ABS_FLOOR_MS = 2.0
 
 
-def bench_observability(store_dir: str, ids: list,
-                        offered_qps: float | None = None,
-                        duration_s: float = 2.5, conns: int = 8,
-                        rounds: int = 5, max_overhead: float = 0.03):
-    """Tracing-overhead gate: the open-loop headline re-run with the
-    request-observability plane fully ARMED (span recording on every
-    request, slow-log threshold set, flight recorder on) vs fully
-    UNARMED (``AVDB_TRACE_SAMPLE=0``, ``AVDB_FLIGHT_EVENTS=0``) —
-    REQUIRED by the schema to cost <= ``max_overhead`` on sustained QPS
-    and p99, so the layer's price is pinned forever.
+def _overhead_gate(store_dir: str, ids: list, armed_env: dict,
+                   unarmed_env: dict, offered_qps: float | None = None,
+                   duration_s: float = 2.5, conns: int = 8,
+                   rounds: int = 5, max_overhead: float = 0.03,
+                   sample_route: str | None = None):
+    """The paired armed/unarmed overhead methodology shared by the
+    tracing gate (:func:`bench_observability`) and the health-plane gate
+    (:func:`bench_slo_overhead`): two live servers differing ONLY by
+    ``armed_env``/``unarmed_env``, alternating adjacent-in-time rounds,
+    median-of-paired-ratios verdict with re-measures and the absolute
+    p99 noise floor.
 
     Both servers stay alive for the whole leg and rounds alternate
     armed/unarmed (the idle one costs only its 4 Hz maintenance tick):
@@ -820,7 +821,11 @@ def bench_observability(store_dir: str, ids: list,
     10-40ms baselines a 3% relative bound is 0.3-1.2ms — below this
     container's own round-to-round spread — so the gate passes when the
     ratio holds OR the median paired delta sits under the floor, and
-    records both numbers so the judgment is auditable."""
+    records both numbers so the judgment is auditable.
+
+    ``sample_route`` (when given) is fetched once from the ARMED server
+    after the last round and recorded verbatim — the gate's record then
+    carries proof the armed surface actually answered."""
     import re as re_mod
     import signal
     import statistics
@@ -857,8 +862,6 @@ def bench_observability(store_dir: str, ids: list,
                 time.sleep(0.2)
         return proc, host, port
 
-    armed_env = {"AVDB_TRACE_SAMPLE": "1", "AVDB_TRACE_SLOW_MS": "250"}
-    unarmed_env = {"AVDB_TRACE_SAMPLE": "0", "AVDB_FLIGHT_EVENTS": "0"}
     samples = {"armed": [], "unarmed": []}
     procs = []
     try:
@@ -946,6 +949,13 @@ def bench_observability(store_dir: str, ids: list,
             run_round()
             med = medians()
             over_qps, over_p99, p99_delta_ms = overheads(med)
+        sample_body = None
+        if sample_route is not None:
+            host, port = servers["armed"]
+            with urllib.request.urlopen(
+                f"http://{host}:{port}{sample_route}", timeout=5
+            ) as r:
+                sample_body = json.loads(r.read().decode())
     finally:
         for proc in procs:
             proc.send_signal(signal.SIGTERM)
@@ -954,7 +964,7 @@ def bench_observability(store_dir: str, ids: list,
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 proc.kill()
-    return {
+    out = {
         "offered_qps": offered_qps,
         "probe_achieved_qps": probe_qps,
         "duration_s": duration_s,
@@ -977,6 +987,51 @@ def bench_observability(store_dir: str, ids: list,
         "max_overhead": max_overhead,
         "within_bound": bool(verdict(over_qps, over_p99, p99_delta_ms)),
     }
+    if sample_body is not None:
+        out["alerts_sample"] = sample_body
+    return out
+
+
+def bench_observability(store_dir: str, ids: list,
+                        offered_qps: float | None = None,
+                        duration_s: float = 2.5, conns: int = 8,
+                        rounds: int = 5, max_overhead: float = 0.03):
+    """Tracing-overhead gate: the open-loop headline re-run with the
+    request-observability plane fully ARMED (span recording on every
+    request, slow-log threshold set, flight recorder on) vs fully
+    UNARMED (``AVDB_TRACE_SAMPLE=0``, ``AVDB_FLIGHT_EVENTS=0``) —
+    REQUIRED by the schema to cost <= ``max_overhead`` on sustained QPS
+    and p99, so the layer's price is pinned forever.  Methodology in
+    :func:`_overhead_gate`."""
+    return _overhead_gate(
+        store_dir, ids,
+        armed_env={"AVDB_TRACE_SAMPLE": "1", "AVDB_TRACE_SLOW_MS": "250"},
+        unarmed_env={"AVDB_TRACE_SAMPLE": "0", "AVDB_FLIGHT_EVENTS": "0"},
+        offered_qps=offered_qps, duration_s=duration_s, conns=conns,
+        rounds=rounds, max_overhead=max_overhead,
+    )
+
+
+def bench_slo_overhead(store_dir: str, ids: list,
+                       offered_qps: float | None = None,
+                       duration_s: float = 2.5, conns: int = 8,
+                       rounds: int = 5, max_overhead: float = 0.03):
+    """Health-plane overhead gate: the same paired methodology as
+    :func:`bench_observability`, armed = the metrics history ring + SLO
+    burn-rate evaluation at their DEFAULT cadence (1 s tick, 300 s
+    retention) vs unarmed = the plane disabled (``AVDB_OBS_TICK_S=0``).
+    REQUIRED by the schema to cost <= ``max_overhead`` on sustained QPS
+    and p99 — the alert plane must be cheap enough to never turn off.
+    The armed server's ``/alerts`` body is sampled after the last round
+    (``alerts_sample``) so the record proves the plane was live, not
+    just enabled."""
+    return _overhead_gate(
+        store_dir, ids,
+        armed_env={"AVDB_OBS_TICK_S": "1.0", "AVDB_OBS_HISTORY_S": "300"},
+        unarmed_env={"AVDB_OBS_TICK_S": "0"},
+        offered_qps=offered_qps, duration_s=duration_s, conns=conns,
+        rounds=rounds, max_overhead=max_overhead, sample_route="/alerts",
+    )
 
 
 def bench_serve_mixed_workload(store_dir: str, ids: list,
@@ -2307,6 +2362,13 @@ def serve_only():
             serving["observability"] = bench_observability(store_dir, ids)
         except Exception as exc:  # the legs after it must still record
             serving["observability"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]
+            }
+        settle()
+        try:
+            serving["slo"] = bench_slo_overhead(store_dir, ids)
+        except Exception as exc:  # the legs after it must still record
+            serving["slo"] = {
                 "error": f"{type(exc).__name__}: {exc}"[:300]
             }
         settle()

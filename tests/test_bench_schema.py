@@ -113,6 +113,34 @@ GOOD = {
             "overhead_p99_ms": 0.2, "p99_abs_floor_ms": 2.0,
             "max_overhead": 0.03, "within_bound": True,
         },
+        "slo": {
+            "offered_qps": 3600.0, "probe_achieved_qps": 7973.0,
+            "duration_s": 2.5, "conns": 8, "rounds": 5,
+            "armed": {"achieved_qps": 3582.0, "p99_ms": 9.3,
+                      "samples": [{"achieved_qps": 3582.0,
+                                   "p99_ms": 9.3}]},
+            "unarmed": {"achieved_qps": 3589.0, "p99_ms": 7.9,
+                        "samples": [{"achieved_qps": 3589.0,
+                                     "p99_ms": 7.9}]},
+            "overhead_qps": 0.0019, "overhead_p99": 0.0182,
+            "overhead_p99_ms": 1.46, "p99_abs_floor_ms": 2.0,
+            "max_overhead": 0.03, "within_bound": True,
+            "alerts_sample": {
+                "enabled": True, "worker": 0, "state": "ok",
+                "firing": 0, "burn_threshold": 2.0,
+                "windows": {"fast_s": 60.0, "slow_s": 300.0},
+                "alerts": [
+                    {"slo": "availability", "kind": "availability",
+                     "state": "ok", "burn_fast": 0.0, "burn_slow": 0.0,
+                     "threshold": 2.0, "since": None, "fired_total": 0,
+                     "target": 0.999},
+                    {"slo": "point_read_p99", "kind": "latency",
+                     "state": "ok", "burn_fast": 0.0, "burn_slow": 0.0,
+                     "threshold": 2.0, "since": None, "fired_total": 0,
+                     "target_ms": 250.0, "objective": 0.99},
+                ],
+            },
+        },
         "mixed_workload": {
             "read_qps_target": 2000.0, "upserts_per_sec_target": 150.0,
             "duration_s": 6.0, "slo_p99_ms": 25.0, "conns": 8,
@@ -672,6 +700,121 @@ def test_observability_block_is_validated_strictly():
     failed = copy.deepcopy(GOOD)
     failed["serving"]["observability"] = {"error": "worker died"}
     assert validate_record(failed) == []
+
+
+def test_slo_block_is_validated_strictly():
+    """The health-plane overhead gate rides the same armed/unarmed
+    contract as tracing, PLUS the alerts_sample proof: a record claiming
+    the gate ran without showing a live /alerts body is rejected."""
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["slo"]["overhead_qps"]
+    assert any("slo" in e and "overhead_qps" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["slo"]["overhead_qps"] = 0.08  # > 3%
+    assert any("health plane is too expensive" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["slo"]["within_bound"] = False
+    assert any("failed its own overhead gate" in e
+               for e in validate_record(bad))
+    # the liveness proof: sample required, must be enabled, must carry
+    # well-formed SLO rows
+    bad = copy.deepcopy(GOOD)
+    del bad["serving"]["slo"]["alerts_sample"]
+    assert any("alerts_sample" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["slo"]["alerts_sample"]["enabled"] = False
+    assert any("health plane was off" in e for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["slo"]["alerts_sample"]["alerts"] = []
+    assert any("at least one declared SLO row" in e
+               for e in validate_record(bad))
+    bad = copy.deepcopy(GOOD)
+    bad["serving"]["slo"]["alerts_sample"]["alerts"][0]["state"] = "broken"
+    assert any("valid state" in e for e in validate_record(bad))
+    # p99 over the ratio but under the absolute floor: tolerated, same
+    # container-noise escape the tracing gate carries
+    noisy = copy.deepcopy(GOOD)
+    noisy["serving"]["slo"]["overhead_p99"] = 0.08
+    noisy["serving"]["slo"]["overhead_p99_ms"] = 0.9
+    assert validate_record(noisy) == []
+    # pre-PR-17 records carry no slo block: still valid; a failed leg
+    # records {"error": ...} and stays loadable
+    old = copy.deepcopy(GOOD)
+    del old["serving"]["slo"]
+    assert validate_record(old) == []
+    failed = copy.deepcopy(GOOD)
+    failed["serving"]["slo"] = {"error": "worker died"}
+    assert validate_record(failed) == []
+
+
+def test_bench_regress_watchdog_verdicts(tmp_path):
+    """The regression watchdog: newest-vs-trailing-median on every
+    tracked headline, with the thin-history escape and both exit-code
+    contracts (1 = regression, 2 = no usable history)."""
+    import subprocess
+
+    from check_bench_regress import evaluate_history, load_records
+
+    def rec(n, qps, p99, value=250000.0):
+        return {
+            "n": n,
+            "parsed": {
+                "metric": "end_to_end", "unit": "variants/sec",
+                "value": value,
+                "serving": {"qps": qps, "p99_ms": p99},
+            },
+        }
+
+    history = [rec(i, 3000.0 + 10 * i, 10.0) for i in range(1, 6)]
+    ok = evaluate_history(history + [rec(6, 2900.0, 11.0)])
+    assert ok["regressions"] == 0
+    by_name = {c["series"]: c for c in ok["checks"]}
+    assert by_name["serving.qps"]["verdict"] == "ok"
+    assert by_name["serving.p99_ms"]["verdict"] == "ok"
+    # a halved qps and a >2x p99 both trip
+    regressed = evaluate_history(history + [rec(6, 100.0, 99.0)])
+    names = {c["series"]: c["verdict"] for c in regressed["checks"]}
+    assert names["serving.qps"] == "regression"
+    assert names["serving.p99_ms"] == "regression"
+    assert regressed["regressions"] >= 2
+    # single-point series: thin, never a regression
+    thin = evaluate_history([rec(1, 3000.0, 10.0)])
+    assert thin["regressions"] == 0
+    assert thin["thin"] == len(thin["checks"])
+    # a serving error row carries no benchmark fact
+    errored = [rec(1, 3000.0, 10.0)]
+    errored[0]["parsed"]["serving"]["error"] = "died"
+    assert all(not c["series"].startswith("serving.")
+               for c in evaluate_history(errored)["checks"])
+    # CLI contract: regression -> 1, empty dir -> 2, clean history -> 0
+    bench_dir = tmp_path / "hist"
+    bench_dir.mkdir()
+    tool = os.path.join(ROOT, "tools", "check_bench_regress.py")
+    assert subprocess.run(
+        [sys.executable, tool, "--dir", str(bench_dir)],
+        capture_output=True,
+    ).returncode == 2
+    for i, doc in enumerate(history + [rec(6, 100.0, 10.0)], start=1):
+        (bench_dir / f"BENCH_r{i:02d}.json").write_text(json.dumps(doc))
+    assert subprocess.run(
+        [sys.executable, tool, "--dir", str(bench_dir)],
+        capture_output=True,
+    ).returncode == 1
+    (bench_dir / "BENCH_r06.json").write_text(
+        json.dumps(rec(6, 2900.0, 11.0))
+    )
+    assert subprocess.run(
+        [sys.executable, tool, "--dir", str(bench_dir)],
+        capture_output=True,
+    ).returncode == 0
+    # unreadable + parsed-null records are skipped, not fatal
+    (bench_dir / "BENCH_r00.json").write_text("{not json")
+    (bench_dir / "BENCH_r07.json").write_text(json.dumps(
+        {"n": 7, "parsed": None}
+    ))
+    assert len(load_records(str(bench_dir))) == 6
 
 
 def test_chaos_flight_subblock_is_validated():

@@ -1601,3 +1601,160 @@ def test_obs_flight_sigkill_harvest_holds_final_requests(tmp_path):
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=60)
     assert rc == 0, proc.stdout.read()[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# obs.tick — the health plane's fault point (obs/timeseries.py,
+# obs/slo.py).  Same contract as obs.flight: observability must NEVER
+# take down serving — a failing snapshot costs one tick, a failing
+# mirror write costs one persist (the previous file survives tmp+rename),
+# a failing supervisor harvest costs exactly that harvest.
+
+
+@pytest.mark.parametrize("fault", [
+    "obs.tick:1:raise",
+    "obs.tick:1:eio",
+])
+def test_obs_tick_sample_fault_absorbed_ring_continues(tmp_path, fault):
+    """An injected failure inside the snapshot costs one tick: absorbed,
+    logged once, counted — and the NEXT tick samples normally."""
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.obs.timeseries import TimeSeriesRing
+
+    logs: list = []
+    ring = TimeSeriesRing(
+        MetricsRegistry(), worker=0,
+        path=str(tmp_path / "w0.ts.json"),
+        tick_s=0.01, history_s=60.0, log=logs.append,
+    )
+    faults.reset(fault)
+    try:
+        assert ring.tick() is False  # absorbed, not raised
+        assert ring.errors == 1
+        assert any("tick failed" in m for m in logs), logs
+        assert ring.samples() == []
+        # nth=1 consumed: the next tick runs normally
+        assert ring.tick() is True
+        assert len(ring.samples()) == 1
+    finally:
+        faults.reset("")
+
+
+def test_obs_tick_persist_fault_keeps_previous_mirror(tmp_path):
+    """A failing mirror write costs one persist: the sample still lands
+    in the ring and the previously persisted file stays readable (the
+    write is tmp+rename)."""
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.obs.timeseries import (
+        TimeSeriesRing,
+        load_history,
+    )
+
+    ring = TimeSeriesRing(
+        MetricsRegistry(), worker=0,
+        path=str(tmp_path / "w0.ts.json"),
+        tick_s=0.01, history_s=60.0, log=lambda m: None,
+    )
+    ring.sample()
+    ring.persist(force=True)
+    assert len(load_history(ring.path)["samples"]) == 1
+    # fire #1 passes the sample, fire #2 dies inside the persist
+    # (re-open the PERSIST_S gate so the tick actually attempts it)
+    ring._last_persist = -1e9
+    faults.reset("obs.tick:2:eio")
+    try:
+        assert ring.tick() is False
+        assert ring.errors == 1
+        assert len(ring.samples()) == 2  # the sample half landed
+        # the previous mirror is intact — no torn document
+        assert len(load_history(ring.path)["samples"]) == 1
+    finally:
+        faults.reset("")
+    ring.persist(force=True)  # unarmed: the mirror catches up
+    assert len(load_history(ring.path)["samples"]) == 2
+
+
+def test_obs_tick_fault_while_serving_requests_still_answer(tmp_path):
+    """obs.tick (raise) under the threaded front end's inline driver:
+    the request that carried the dying tick still answers 200, the
+    failure is counted, and the next due tick samples normally."""
+    import threading
+    import urllib.request
+
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.obs.slo import HealthPlane
+    from annotatedvdb_tpu.serve.http import build_server
+
+    store_dir = str(tmp_path / "hstore")
+    _tiny_store().save(store_dir)
+    registry = MetricsRegistry()
+    health = HealthPlane(registry, store_dir=store_dir, worker=0,
+                         tick_s=0.01, history_s=60.0)
+    httpd = build_server(store_dir=store_dir, port=0, registry=registry,
+                        health=health)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        port = httpd.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.status
+
+        faults.reset("obs.tick:1:raise")
+        assert get("/variant/3:10:A:C") == 200  # the tick died silently
+        faults.reset("")
+        assert health.errors == 1
+        time.sleep(0.02)  # past the tick gate
+        assert get("/variant/3:20:A:C") == 200
+        assert len(health.ring.samples()) >= 1  # recording resumed
+    finally:
+        faults.reset("")
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.ctx.batcher.close()
+
+
+def test_obs_tick_harvest_failure_absorbed_by_supervisor(tmp_path):
+    """obs.tick (eio) inside the supervisor's history harvest: the
+    fleet's absorb wrapper logs and continues — a broken history file
+    must never stall the respawn loop."""
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.obs.timeseries import (
+        TimeSeriesRing,
+        history_path,
+        list_history,
+    )
+    from annotatedvdb_tpu.serve.fleet import ServeFleet
+
+    store_dir = str(tmp_path / "hstore2")
+    _tiny_store().save(store_dir)
+    ring = TimeSeriesRing(
+        MetricsRegistry(), worker=0, path=history_path(store_dir, 0),
+        tick_s=1.0, history_s=60.0,
+    )
+    ring.sample()
+    ring.persist(force=True)
+    fleet = ServeFleet(store_dir, port=0, workers=1, log=lambda m: None)
+    try:
+        faults.reset("obs.tick:1:eio")
+        fleet._harvest_history(0, "died rc=-9")  # absorbed, never raises
+        faults.reset("")
+        assert list_history(store_dir)["harvested"] == []
+        # unarmed: the same harvest lands, reason stamped in
+        fleet._harvest_history(0, "died rc=-9")
+        assert len(list_history(store_dir)["harvested"]) == 1
+    finally:
+        faults.reset("")
+        fleet._reserve.close()
+        if fleet._sup_flight is not None:
+            fleet._sup_flight.close()
+        import shutil
+
+        from annotatedvdb_tpu.obs import reqtrace as _rt
+
+        _rt.set_background_sink(None, None)
+        shutil.rmtree(fleet._telemetry_dir, ignore_errors=True)
+        fleet._hb_mm.close()
+        os.unlink(fleet._hb_path)

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Bench regression watchdog: the newest BENCH record vs its own history.
+
+``run_bench.sh`` appends one ``BENCH_r<NN>.json`` per run; this check
+reads the whole series and compares the NEWEST point of each tracked
+headline against the TRAILING MEDIAN of up to ``--window`` prior points
+(median, not mean — one outlier run must not poison the baseline, and
+the recorded history is genuinely noisy across machines):
+
+- ``parsed.serving.qps`` — sustained point-read throughput; regression =
+  newest below ``--qps-drop`` x median (default 0.5: a halving pages,
+  machine-to-machine noise does not);
+- ``parsed.serving.p99_ms`` — tail latency; regression = newest above
+  ``--p99-rise`` x median (default 2.0);
+- per-metric ``variants/sec`` values (the load-pipeline headlines,
+  grouped by ``parsed.metric`` name so different benchmarks never
+  compare against each other) — regression = newest below
+  ``--qps-drop`` x median.
+
+A series needs the newest point plus at least one prior to judge;
+anything thinner is reported as ``thin`` and skipped (exit 0 — a young
+history is not a regression).  Chained into ``tools/run_checks.sh`` and
+importable by ``doctor``/tests (:func:`evaluate_history`).
+
+Exit codes: 0 = no regression, 1 = regression, 2 = no usable history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+
+#: newest qps below this fraction of the trailing median = regression
+DEFAULT_QPS_DROP = 0.5
+
+#: newest p99 above this multiple of the trailing median = regression
+DEFAULT_P99_RISE = 2.0
+
+#: prior points the trailing median draws from
+DEFAULT_WINDOW = 5
+
+
+def load_records(bench_dir: str) -> list:
+    """Every parseable ``BENCH_r*.json`` under ``bench_dir``, oldest
+    first (the ``r<NN>`` naming sorts chronologically).  Unreadable or
+    ``parsed: null`` records are skipped — a failed run carries no
+    benchmark fact."""
+    records = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) \
+                or not isinstance(doc.get("parsed"), dict):
+            continue
+        doc["_path"] = path
+        records.append(doc)
+    return records
+
+
+def _series(records: list) -> dict:
+    """``{series_name: [(run_n, value), ...]}`` oldest first for every
+    tracked headline."""
+    out: dict[str, list] = {}
+    for doc in records:
+        parsed = doc["parsed"]
+        n = int(doc.get("n") or 0)
+        srv = parsed.get("serving")
+        if isinstance(srv, dict) and not srv.get("error"):
+            for key, name in (("qps", "serving.qps"),
+                              ("p99_ms", "serving.p99_ms")):
+                v = srv.get(key)
+                if isinstance(v, (int, float)) and v > 0:
+                    out.setdefault(name, []).append((n, float(v)))
+        if parsed.get("unit") == "variants/sec" and parsed.get("metric"):
+            v = parsed.get("value")
+            if isinstance(v, (int, float)) and v > 0:
+                out.setdefault(
+                    f"{parsed['metric']} (variants/sec)", []
+                ).append((n, float(v)))
+    return out
+
+
+def evaluate_history(records: list, window: int = DEFAULT_WINDOW,
+                     qps_drop: float = DEFAULT_QPS_DROP,
+                     p99_rise: float = DEFAULT_P99_RISE) -> dict:
+    """The whole judgment, pure (tests and ``doctor`` import this):
+    ``{"checks": [...], "regressions": N, "thin": N}`` where each check
+    row carries the series name, newest value, trailing median, bound,
+    and verdict (``ok`` / ``regression`` / ``thin``)."""
+    checks = []
+    for name, points in sorted(_series(records).items()):
+        newest_n, newest = points[-1]
+        priors = [v for _n, v in points[:-1]][-max(int(window), 1):]
+        row = {"series": name, "run": newest_n, "newest": round(newest, 3),
+               "priors": len(priors)}
+        if not priors:
+            row.update(verdict="thin", median=None, bound=None)
+            checks.append(row)
+            continue
+        med = statistics.median(priors)
+        row["median"] = round(med, 3)
+        if name == "serving.p99_ms":
+            bound = med * float(p99_rise)
+            verdict = "regression" if newest > bound else "ok"
+        else:
+            bound = med * float(qps_drop)
+            verdict = "regression" if newest < bound else "ok"
+        row.update(bound=round(bound, 3), verdict=verdict)
+        checks.append(row)
+    return {
+        "checks": checks,
+        "regressions": sum(
+            1 for c in checks if c["verdict"] == "regression"
+        ),
+        "thin": sum(1 for c in checks if c["verdict"] == "thin"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare the newest BENCH record's headlines against "
+                    "the trailing median of the recorded history"
+    )
+    ap.add_argument("--dir", default=None,
+                    help="directory holding BENCH_r*.json "
+                         "(default: the repo root)")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help=f"prior runs in the trailing median "
+                         f"(default {DEFAULT_WINDOW})")
+    ap.add_argument("--qps-drop", type=float, default=DEFAULT_QPS_DROP,
+                    dest="qps_drop",
+                    help="throughput regression bound: newest < this "
+                         f"fraction of the median (default "
+                         f"{DEFAULT_QPS_DROP})")
+    ap.add_argument("--p99-rise", type=float, default=DEFAULT_P99_RISE,
+                    dest="p99_rise",
+                    help="latency regression bound: newest > this "
+                         f"multiple of the median (default "
+                         f"{DEFAULT_P99_RISE})")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    bench_dir = args.dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    records = load_records(bench_dir)
+    if not records:
+        print(f"check_bench_regress: {bench_dir}: no parseable "
+              "BENCH_r*.json history", file=sys.stderr)
+        return 2
+    report = evaluate_history(records, window=args.window,
+                              qps_drop=args.qps_drop,
+                              p99_rise=args.p99_rise)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        for c in report["checks"]:
+            if c["verdict"] == "thin":
+                detail = "no prior runs to compare"
+            elif c["series"] == "serving.p99_ms":
+                detail = (f"newest {c['newest']} vs median {c['median']} "
+                          f"(bound <= {c['bound']})")
+            else:
+                detail = (f"newest {c['newest']} vs median {c['median']} "
+                          f"(bound >= {c['bound']})")
+            print(f"check_bench_regress: [{c['verdict']:>10}] "
+                  f"{c['series']} (run {c['run']}, {c['priors']} "
+                  f"prior(s)): {detail}", file=sys.stderr)
+    if report["regressions"]:
+        print(f"check_bench_regress: {report['regressions']} "
+              "regression(s) against the trailing median",
+              file=sys.stderr)
+        return 1
+    print(f"check_bench_regress: OK ({len(report['checks'])} series, "
+          f"{report['thin']} thin)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -214,6 +214,9 @@ def _check_serving(sv, where: str, errors: list) -> None:
     if "observability" in sv and isinstance(sv["observability"], dict) \
             and "error" not in sv["observability"]:
         _check_observability(sv["observability"], w, errors)
+    if "slo" in sv and isinstance(sv["slo"], dict) \
+            and "error" not in sv["slo"]:
+        _check_slo(sv["slo"], w, errors)
     if "mixed_workload" in sv and isinstance(sv["mixed_workload"], dict) \
             and "error" not in sv["mixed_workload"]:
         _check_mixed_workload(sv["mixed_workload"], w, errors)
@@ -285,7 +288,47 @@ def _check_observability(ob: dict, where: str, errors: list) -> None:
     bound measures the container, not the code) — a record whose tracing
     costs more is a broken record, exactly like a lost acknowledged
     upsert."""
-    w = f"{where}.observability"
+    _check_overhead_gate(ob, f"{where}.observability", errors, "tracing")
+
+
+def _check_slo(ob: dict, where: str, errors: list) -> None:
+    """The health-plane overhead gate: same armed/unarmed contract as
+    the tracing gate (the metrics history ring + SLO burn evaluation at
+    default cadence must also cost <= 3%), PLUS the ``alerts_sample``
+    proof — the armed server's live ``/alerts`` body with at least one
+    declared SLO row, so the record shows the plane was evaluating, not
+    merely enabled."""
+    w = f"{where}.slo"
+    _check_overhead_gate(ob, w, errors, "health plane")
+    sample = ob.get("alerts_sample")
+    if sample is None:
+        errors.append(f"{w}.alerts_sample: required (the armed /alerts "
+                      "body proves the plane was live)")
+        return
+    if not isinstance(sample, dict):
+        errors.append(f"{w}.alerts_sample: must be an object")
+        return
+    if sample.get("enabled") is not True:
+        errors.append(f"{w}.alerts_sample.enabled: must be true — the "
+                      "armed server's health plane was off")
+    rows = sample.get("alerts")
+    if not isinstance(rows, list) or not rows:
+        errors.append(f"{w}.alerts_sample.alerts: at least one declared "
+                      "SLO row required")
+        return
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict) or not row.get("slo") \
+                or row.get("state") not in ("ok", "pending", "firing",
+                                            "resolved"):
+            errors.append(f"{w}.alerts_sample.alerts[{i}]: needs a slo "
+                          "name and a valid state")
+
+
+def _check_overhead_gate(ob: dict, w: str, errors: list,
+                         plane: str) -> None:
+    """The shared armed-vs-unarmed overhead record shape (tracing and
+    health-plane gates emit the same block from the same bench
+    machinery)."""
     _check_fields(
         ob,
         {
@@ -319,7 +362,7 @@ def _check_observability(ob: dict, where: str, errors: list) -> None:
         if _is_num(ob.get("overhead_qps")) and ob["overhead_qps"] > bound:
             errors.append(
                 f"{w}.overhead_qps: {ob['overhead_qps']} exceeds the "
-                f"{bound} overhead bound — tracing is too expensive"
+                f"{bound} overhead bound — {plane} is too expensive"
             )
         floor = ob.get("p99_abs_floor_ms")
         if _is_num(ob.get("overhead_p99")) and ob["overhead_p99"] > bound \
@@ -329,12 +372,11 @@ def _check_observability(ob: dict, where: str, errors: list) -> None:
             errors.append(
                 f"{w}.overhead_p99: {ob['overhead_p99']} exceeds the "
                 f"{bound} bound and the absolute delta is over the "
-                "noise floor — tracing is too expensive"
+                f"noise floor — {plane} is too expensive"
             )
     if ob.get("within_bound") is False:
         errors.append(
-            f"{w}.within_bound: the tracing plane failed its own "
-            "overhead gate"
+            f"{w}.within_bound: the {plane} failed its own overhead gate"
         )
 
 

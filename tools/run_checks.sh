@@ -38,6 +38,13 @@
 #  10. chaos_soak --smoke — a 1-worker fleet under open-loop load with
 #                    injected drain latency + a device-EIO breaker trip:
 #                    zero wrong bytes, bounded errors, clean recovery
+#  11. slo_smoke    — the alert plane end to end: induced latency via the
+#                    /_chaos delay lever walks the point-read p99 SLO
+#                    ok -> pending -> firing, the lever disarms, and the
+#                    alert resolves through the clear-tick hysteresis
+#  12. check_bench_regress — the newest committed BENCH record's
+#                    headlines (serving qps/p99, load variants/sec)
+#                    against the trailing median of their own history
 #
 # Exit: 0 all clean, 1 any check found problems.
 
@@ -82,6 +89,12 @@ python "$root/tools/ingest_smoke.py" || rc=1
 
 echo "== chaos smoke ==" >&2
 python "$root/tools/chaos_soak.py" --smoke || rc=1
+
+echo "== slo smoke ==" >&2
+python "$root/tools/slo_smoke.py" || rc=1
+
+echo "== bench regression watchdog ==" >&2
+python "$root/tools/check_bench_regress.py" || rc=1
 
 if [ "$rc" -eq 0 ]; then
     echo "run_checks: all checks clean" >&2

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""SLO alert smoke-check: a real fire -> resolve cycle in ~15 seconds.
+
+Stands up the aio serving front end with the health plane armed, then
+walks the alert lifecycle the way an operator would see it:
+
+1. clean baseline — ``/alerts`` answers with every SLO ``ok``;
+2. induced latency — the ``/_chaos`` delay lever
+   (``serve.batch:prob:1.0:delay:120``) pushes every point read past the
+   50 ms p99 target, both burn windows breach, and the
+   ``point_read_p99`` alert walks ok -> pending -> firing (visible on
+   ``/alerts``, ``/healthz`` and the ``avdb_slo_burn_rate`` /
+   ``avdb_alerts_firing`` Prometheus series);
+3. load removed — the lever disarms, the windows drain, and the alert
+   resolves after the clear-tick hysteresis.
+
+The latency SLO target is pinned via an explicit spec (50 ms) instead of
+``AVDB_SERVE_BROWNOUT_P99_MS`` so the smoke never races the brownout
+governor's cache-first level: the lever delays the batch drain, the
+governor stays quiet at its default 250 ms target, and the only plane
+reacting is the one under test.
+
+Part of ``tools/run_checks.sh``.  Exit codes: 0 clean, 1 smoke failure,
+2 internal error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+# pin CPU before anything imports jax (same discipline as the other
+# smokes), and open the chaos gate before serve modules resolve it
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+os.environ["AVDB_SERVE_CHAOS"] = "1"
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: induced batch-drain delay — comfortably past the 50 ms SLO target but
+#: nowhere near the 250 ms brownout default
+DELAY_SPEC = "serve.batch:prob:1.0:delay:120"
+
+#: p99 target the smoke's latency SLO judges against (seconds; sits on a
+#: QUERY_SECONDS_EDGES bucket edge so fraction_above needs no
+#: interpolation)
+TARGET_S = 0.05
+
+#: alert-plane cadence: tight windows so fire and resolve both land
+#: inside the smoke budget (pending = 2 ticks, clear = 3 ticks)
+TICK_S = 0.25
+FAST_S = 1.0
+SLOW_S = 2.0
+
+FIRE_DEADLINE_S = 10.0
+RESOLVE_DEADLINE_S = 14.0
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def _post(port: int, path: str, payload) -> tuple[int, str]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def _alert(port: int, name: str) -> dict:
+    """The named SLO's row from ``/alerts`` ({} when unanswerable)."""
+    status, body = _get(port, "/alerts")
+    if status != 200:
+        return {}
+    try:
+        rows = json.loads(body).get("alerts") or []
+    except ValueError:
+        return {}
+    for row in rows:
+        if row.get("slo") == name:
+            return row
+    return {}
+
+
+def _await_state(port: int, name: str, wanted, deadline_s: float) -> dict:
+    """Poll ``/alerts`` until the named SLO reaches one of ``wanted``;
+    returns the final row either way (the caller judges)."""
+    deadline = time.monotonic() + deadline_s
+    row = {}
+    while time.monotonic() < deadline:
+        row = _alert(port, name)
+        if row.get("state") in wanted:
+            return row
+        time.sleep(0.2)
+    return row
+
+
+def main() -> int:
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.obs.slo import HealthPlane, SloSpec
+    from annotatedvdb_tpu.serve.aio import build_aio_server
+    from serve_smoke import _build_store
+
+    work = tempfile.mkdtemp(prefix="avdb_slo_smoke_")
+    store_dir = os.path.join(work, "store")
+    aio = None
+    stop = threading.Event()
+    failures: list[str] = []
+    drive_errors: list[str] = []
+
+    def check(label: str, ok: bool, detail: str = "") -> None:
+        if not ok:
+            failures.append(f"{label}: {detail}"[:300])
+
+    try:
+        _build_store(store_dir)
+        registry = MetricsRegistry()
+        specs = [
+            SloSpec(
+                "availability", "availability",
+                "non-error answer fraction", target=0.999,
+            ),
+            SloSpec(
+                "point_read_p99", "latency",
+                "point-read p99 vs the smoke's pinned 50 ms target",
+                metric="avdb_query_seconds", labels={"kind": "point"},
+                target_s=TARGET_S, objective=0.99,
+            ),
+        ]
+        health = HealthPlane(
+            registry, store_dir=store_dir, worker=0, specs=specs,
+            tick_s=TICK_S, history_s=60.0, fast_s=FAST_S, slow_s=SLOW_S,
+            burn_threshold=2.0,
+        )
+        aio = build_aio_server(
+            store_dir=store_dir, port=0, registry=registry, health=health
+        )
+        aio.start_background()
+        port = aio.server_address[1]
+
+        # open-loop point-read driver: the alert plane only judges real
+        # traffic, so requests flow through every phase
+        def drive():
+            # failed reads are part of the experiment (they feed the
+            # availability SLO) — count them, report once at teardown
+            while not stop.is_set():
+                try:
+                    _get(port, "/variant/8:1000:A:G")
+                except Exception as exc:
+                    drive_errors.append(repr(exc))
+                time.sleep(0.005)
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+
+        # -- phase 1: clean baseline ------------------------------------
+        time.sleep(4 * TICK_S)
+        status, body = _get(port, "/alerts")
+        rec = json.loads(body) if status == 200 else {}
+        check("alerts route", status == 200 and rec.get("enabled") is True,
+              body[:200])
+        row = _alert(port, "point_read_p99")
+        check("baseline ok", row.get("state") == "ok", json.dumps(row))
+
+        # -- phase 2: induced latency -> the alert fires ----------------
+        status, body = _post(
+            port, "/_chaos", {"spec": DELAY_SPEC, "ttl_s": 30}
+        )
+        check("chaos armed", status == 200
+              and json.loads(body).get("armed") == DELAY_SPEC, body[:200])
+        row = _await_state(
+            port, "point_read_p99", ("firing",), FIRE_DEADLINE_S
+        )
+        check("alert fired", row.get("state") == "firing", json.dumps(row))
+        check("burn past threshold",
+              (row.get("burn_fast") or 0) > (row.get("threshold") or 2.0),
+              json.dumps(row))
+        status, body = _get(port, "/healthz")
+        rec = json.loads(body) if status == 200 else {}
+        check("healthz mirrors firing",
+              status == 200 and rec.get("alerts_firing", 0) >= 1
+              and rec.get("alerts") == "firing", body[:200])
+        status, body = _get(port, "/metrics")
+        check("burn-rate series exported", status == 200
+              and "avdb_slo_burn_rate" in body
+              and "avdb_alerts_firing" in body, body[:200])
+
+        # -- phase 3: load removed -> the alert resolves ----------------
+        status, body = _post(port, "/_chaos", {"spec": ""})
+        check("chaos disarmed", status == 200, body[:200])
+        row = _await_state(
+            port, "point_read_p99", ("resolved",), RESOLVE_DEADLINE_S
+        )
+        check("alert resolved", row.get("state") == "resolved",
+              json.dumps(row))
+        check("fired_total recorded", row.get("fired_total", 0) >= 1,
+              json.dumps(row))
+
+        # the history ring recorded the whole episode
+        status, body = _get(port, "/metrics/history")
+        rec = json.loads(body) if status == 200 else {}
+        check("history recorded", status == 200
+              and rec.get("samples", 0) >= 2
+              and len(rec.get("series") or []) > 0, body[:200])
+    except Exception as exc:
+        check("startup", False, repr(exc))
+    finally:
+        stop.set()
+        if aio is not None:
+            try:
+                _post(aio.server_address[1], "/_chaos", {"spec": ""})
+            except Exception as exc:
+                # best-effort disarm on a server already going down
+                print(f"slo_smoke: teardown disarm failed: {exc!r}",
+                      file=sys.stderr)
+            aio.shutdown()
+            aio.ctx.batcher.close()
+        shutil.rmtree(work, ignore_errors=True)
+    if drive_errors:
+        print(f"slo_smoke: driver saw {len(drive_errors)} failed read(s) "
+              f"(last: {drive_errors[-1]})", file=sys.stderr)
+    if failures:
+        for f in failures:
+            print(f"slo_smoke FAIL {f}", file=sys.stderr)
+        return 1
+    print("slo_smoke: ok (point_read_p99 walked ok -> firing -> resolved "
+          "under the /_chaos delay lever)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
