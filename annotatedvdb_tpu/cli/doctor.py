@@ -22,6 +22,7 @@ Usage:
         [--out report.json] [--chunkRows N]
     python -m annotatedvdb_tpu doctor slo --storeDir ./vdb \
         [--all] [--fast S] [--slow S] [--burn X] [--json]
+    python -m annotatedvdb_tpu doctor promote --storeDir ./follower [--json]
     python -m annotatedvdb_tpu doctor replay-rejects \
         --rejects ./vdb/quarantine/x.vcf.rejects.jsonl --out fixed.vcf
 
@@ -602,6 +603,43 @@ def _profile(argv) -> int:
     return 0
 
 
+def _promote(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor promote",
+        description="fail a replication follower over to leader: seal the "
+                    "tailed WAL prefix by replaying it into segments, bump "
+                    "the manifest's fencing epoch (so the deposed leader's "
+                    "next flush aborts instead of committing), and clear "
+                    "the follower's bootstrap cursor — after exit 0 the "
+                    "store serves writable (`serve --upserts`) and the old "
+                    "leader is fenced out",
+    )
+    ap.add_argument("--storeDir", required=True)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    from annotatedvdb_tpu.store.replication import ReplError, promote
+
+    log = (lambda m: None) if args.json else (
+        lambda m: print(m, file=sys.stderr)
+    )
+    try:
+        report = promote(args.storeDir, log=log)
+    except (ReplError, OSError, ValueError) as err:
+        print(f"doctor promote: {type(err).__name__}: {err} "
+              "(store unchanged up to the failed step; re-run after "
+              "`doctor --storeDir ...`)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"doctor promote: {args.storeDir}: {report['status']} at "
+              f"fencing epoch {report['epoch']} ({report['rows']} tailed "
+              f"row(s) sealed into segments) — start `serve --upserts` "
+              f"here; the deposed leader's flushes now abort as fenced",
+              file=sys.stderr)
+    return 0
+
+
 def _compact(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="doctor compact",
@@ -732,6 +770,8 @@ def main(argv=None) -> int:
         return _trace(argv[1:])
     if argv and argv[0] == "slo":
         return _slo(argv[1:])
+    if argv and argv[0] == "promote":
+        return _promote(argv[1:])
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--storeDir", required=True)

@@ -59,6 +59,15 @@ def _build_parser() -> argparse.ArgumentParser:
                              "/variants/upsert with a per-worker "
                              "write-ahead log, replayed on start "
                              "(default: AVDB_SERVE_UPSERTS or off)")
+    parser.add_argument("--follow", default=None, metavar="LEADER-URL",
+                        help="run as a replication follower: bootstrap a "
+                             "consistent snapshot cut from the leader's "
+                             "/repl surface into --storeDir, tail its "
+                             "WAL/ledger stream, and serve bounded-"
+                             "staleness reads (/readyz answers 503 past "
+                             "AVDB_REPL_MAX_LAG_S; writes answer 403 with "
+                             "the leader's location); fail over with "
+                             "'doctor promote'")
     parser.add_argument("--maintain", action="store_true",
                         default=None,
                         help="arm the autonomous maintenance daemon in "
@@ -170,6 +179,10 @@ def _knob_args(args, workers: int) -> list[str]:
         # every worker runs its own memtable + WAL (serve-w<idx>.*.wal):
         # the flag must reach them all
         out.append("--upserts")
+    if args.follow:
+        # every follower worker tails the leader; only worker 0 persists
+        # the mirror (the others apply shipped bytes in memory)
+        out += ["--follow", args.follow]
     for flag, val in (
         ("--maxBatch", args.maxBatch),
         ("--batchWaitMs", args.batchWaitMs),
@@ -212,6 +225,43 @@ def main(argv=None):
             print(f"serve: {', '.join(dead)} only apply to the aio front "
                   "end and are ignored with --frontend threaded",
                   file=sys.stderr)
+    if args.follow:
+        if _upserts_enabled(args):
+            # a follower is read-only BY ROLE: its overlay exists to
+            # apply the leader's stream, and a second writer would fork
+            # the replica — the write path belongs to the leader
+            print("serve: --follow and --upserts are mutually exclusive "
+                  "(a follower forwards writes to its leader; promote it "
+                  "with 'doctor promote' to accept writes)",
+                  file=sys.stderr)
+            return 2
+        if _maintain_enabled(args):
+            # compaction rewrites segments the ship stream mirrors —
+            # the leader compacts, the follower re-syncs the cut
+            print("serve: --follow and --maintain are mutually exclusive "
+                  "(the leader owns compaction; the follower mirrors its "
+                  "commits)", file=sys.stderr)
+            return 2
+        if args._workerIndex is None and not os.path.exists(
+            os.path.join(args.storeDir, "manifest.json")
+        ):
+            # first start against an empty directory: bootstrap the
+            # snapshot cut BEFORE any worker loads the store (fleet
+            # workers need a loadable manifest mirror on their first
+            # SnapshotManager load)
+            from annotatedvdb_tpu.store.replication import (
+                ReplError,
+                ReplicaTailer,
+            )
+
+            try:
+                ReplicaTailer(
+                    args.storeDir, args.follow, log=log, persist=True
+                ).bootstrap()
+            except (ReplError, OSError, ValueError) as err:
+                print(f"serve: cannot bootstrap from {args.follow}: {err}",
+                      file=sys.stderr)
+                return 1
     maintain = args._workerIndex is None and _maintain_enabled(args)
     if args._workerIndex is None and (workers > 1 or maintain):
         if args.frontend == "threaded":
@@ -345,6 +395,20 @@ def _run_single(args, log) -> int:
         from annotatedvdb_tpu.store.wal import WriteAheadLog
 
         worker = args._workerIndex or 0
+        # replication fencing: remember the manifest epoch this writer
+        # opened under — if a follower is promoted while this leader is
+        # alive (or wakes a deposed one), the on-disk epoch moves past
+        # this value and every flush commit aborts instead of clobbering
+        # the promoted lineage (store/replication.py)
+        fence = 0
+        try:
+            import json as json_mod
+
+            with open(os.path.join(args.storeDir, "manifest.json")) as f:
+                fence = int((json_mod.load(f) or {}).get(
+                    "repl_epoch", 0) or 0)
+        except (OSError, ValueError):
+            pass
         try:
             wal = WriteAheadLog(
                 args.storeDir, name=f"serve-w{worker}", log=log
@@ -352,7 +416,7 @@ def _run_single(args, log) -> int:
             memtable = Memtable(
                 width=manager.current().store.width,
                 store_dir=args.storeDir, wal=wal,
-                registry=registry, log=log,
+                registry=registry, log=log, fence_epoch=fence,
             )
             # recovery: acknowledged-but-unflushed upserts from a previous
             # incarnation (crash, SIGKILL, wedge kill) come back before
@@ -369,6 +433,69 @@ def _run_single(args, log) -> int:
         # are visible immediately, first-wins against the base store
         manager = MemtableSnapshots(manager, memtable)
 
+    tailer = None
+    if args.follow:
+        from annotatedvdb_tpu.serve.snapshot import MemtableSnapshots
+        from annotatedvdb_tpu.store.memtable import Memtable
+        from annotatedvdb_tpu.store.replication import ReplicaTailer
+
+        follow_url = args.follow.rstrip("/")
+        base_manager = manager
+        worker = args._workerIndex or 0
+
+        def _overlay_mem():
+            # memory-only overlay: the mirrored WAL files on disk are
+            # the durability (worker 0 fsyncs them before records count
+            # as applied); flush triggers are disabled — a follower
+            # never writes segments, it mirrors the leader's
+            return Memtable(
+                width=base_manager.current().store.width, store_dir=None,
+                wal=None, flush_bytes=0, flush_age_s=0.0, log=log,
+            )
+
+        mem_ref = {"mem": _overlay_mem()}
+        manager = MemtableSnapshots(base_manager, mem_ref["mem"])
+
+        def _apply_rows(rows):
+            mem_ref["mem"].upsert(
+                base_manager.current().store, rows, durable=False
+            )
+
+        def _on_resync():
+            # a leader commit landed: pick up the new base cut, then
+            # swap in a fresh overlay (rows now covered by the cut
+            # leave memory; first-wins keeps the overlap byte-stable)
+            try:
+                base_manager.refresh()
+            except Exception as err:
+                log(f"repl: base refresh after re-sync failed ({err})")
+            fresh = _overlay_mem()
+            mem_ref["mem"] = fresh
+            manager.reset_memtable(fresh)
+
+        try:
+            # only worker 0 mirrors bytes into the shared store dir;
+            # sibling workers tail the leader applying shipped frames
+            # straight from memory
+            tailer = ReplicaTailer(
+                args.storeDir, follow_url, log=log, registry=registry,
+                apply_rows=_apply_rows, on_resync=_on_resync,
+                persist=(worker == 0),
+            )
+            recovered = tailer.resume()
+        except (OSError, ValueError) as err:
+            print(f"serve: cannot start follower: {err}", file=sys.stderr)
+            return 1
+        if recovered:
+            # restart recovery: records already durable in the local
+            # mirror re-enter the overlay before the first request
+            for record in tailer.local_records():
+                rows = record.get("rows")
+                if isinstance(rows, list):
+                    _apply_rows(rows)
+            log(f"repl: re-applied {recovered} mirrored WAL record(s) "
+                "into the overlay")
+
     max_wait_s = (
         args.batchWaitMs / 1000.0 if args.batchWaitMs is not None else None
     )
@@ -383,7 +510,7 @@ def _run_single(args, log) -> int:
     if args.frontend == "threaded":
         return _run_threaded(args, manager, registry, residency, tracer,
                              max_wait_s, log, memtable=memtable,
-                             flight=flight, health=health)
+                             flight=flight, health=health, tailer=tailer)
 
     from annotatedvdb_tpu.serve.aio import build_aio_server
 
@@ -408,6 +535,13 @@ def _run_single(args, log) -> int:
         print(f"serve: cannot start: {err}", file=sys.stderr)
         return 1
     ctx = server.ctx
+    if tailer is not None:
+        # the staleness contract flows through the context: lag gates
+        # /readyz, writes 403 toward the leader; the tail thread starts
+        # only once the context that consumes its gauge exists
+        ctx.repl = tailer
+        ctx.follow_url = tailer.leader_url
+        tailer.start()
     snap = manager.current()
 
     # GC hygiene for a latency-sensitive process: the loaded store is
@@ -472,6 +606,8 @@ def _run_single(args, log) -> int:
     except KeyboardInterrupt:
         log("shutting down")
     finally:
+        if tailer is not None:
+            tailer.stop()
         server.shutdown()
         ctx.batcher.close()
         if memtable is not None and memtable.wal is not None:
@@ -509,7 +645,7 @@ def _worker_socket(args):
 
 def _run_threaded(args, manager, registry, residency, tracer,
                   max_wait_s, log, memtable=None, flight=None,
-                  health=None) -> int:
+                  health=None, tailer=None) -> int:
     """The PR-5 thread-per-connection server (byte-parity reference)."""
     from annotatedvdb_tpu.serve.http import build_server
 
@@ -528,6 +664,10 @@ def _run_threaded(args, manager, registry, residency, tracer,
         print(f"serve: cannot start: {err}", file=sys.stderr)
         return 1
     ctx = httpd.ctx
+    if tailer is not None:
+        ctx.repl = tailer
+        ctx.follow_url = tailer.leader_url
+        tailer.start()
     snap = ctx.manager.current()
     host, port = httpd.server_address[:2]
     print(f"serving {args.storeDir} (generation {snap.generation}, "
@@ -537,6 +677,8 @@ def _run_threaded(args, manager, registry, residency, tracer,
     except KeyboardInterrupt:
         log("shutting down")
     finally:
+        if tailer is not None:
+            tailer.stop()
         httpd.server_close()
         ctx.batcher.close()
         if memtable is not None and memtable.wal is not None:

@@ -195,6 +195,19 @@ ENV_VARS: dict = {
                           "/variants/upsert with a per-worker WAL "
                           "(replayed on worker start) and memtable "
                           "flushes to store segments",
+    # replication (store/replication.py; serve --follow / doctor promote)
+    "AVDB_REPL_MAX_LAG_S": "declared follower staleness bound in seconds: "
+                           "past it /readyz answers 503 and the "
+                           "replication_lag SLO burns (default 5; 0 "
+                           "disables both planes together)",
+    "AVDB_REPL_POLL_S": "follower tail poll interval in seconds "
+                        "(default 0.5; clamped to >= 0.02)",
+    "AVDB_REPL_CHUNK_BYTES": "snapshot/WAL ship transfer chunk size "
+                             "(default 4m; 512k / 8m suffixes; clamped "
+                             "to >= 4k)",
+    "AVDB_REPL_TIMEOUT_S": "per-request HTTP timeout for ship fetches "
+                           "from the leader (default 10; clamped to "
+                           ">= 0.1)",
     "AVDB_LOCK_TRACE": "1 arms the lock-order/deadlock detector: serve-"
                        "stack locks record per-thread acquisition order "
                        "(analysis/lockorder), cycles are potential "

@@ -4,8 +4,11 @@ An SLO here is a *judgment* the database makes about itself from the
 time-series ring (``obs/timeseries.py``): availability (non-error answer
 fraction), point-read p99 and upsert durable-ack p99 against the
 brownout target (``AVDB_SERVE_BROWNOUT_P99_MS`` — the ONE latency
-contract the serving stack already enforces), and a load variants/sec
-floor (``AVDB_SLO_LOAD_FLOOR``; 0 keeps it declared but dormant).
+contract the serving stack already enforces), a load variants/sec
+floor (``AVDB_SLO_LOAD_FLOOR``; 0 keeps it declared but dormant), and
+follower replication lag vs the declared staleness bound
+(``AVDB_REPL_MAX_LAG_S`` — the same bound ``/readyz`` enforces, so the
+alert plane and the readiness plane never disagree about "stale").
 
 **Burn rate** is budget spend speed: 1.0 means the error budget drains
 exactly at the rate the objective allows, N means N times faster.  For
@@ -13,7 +16,9 @@ availability the budget is ``1 - target`` of requests erroring; for a
 latency SLO it is ``1 - objective`` of requests allowed over the target
 (the window fraction above target comes from the histogram-bucket delta,
 interpolated — no raw latencies are ever kept); for a rate floor it is
-the floor/measured ratio.  An alert needs BOTH windows of a fast+slow
+the floor/measured ratio; for a gauge ceiling it is the fraction of the
+window's sampled points past the ceiling over the allowed fraction
+(``1 - objective``).  An alert needs BOTH windows of a fast+slow
 pair (``AVDB_SLO_FAST_S`` / ``AVDB_SLO_SLOW_S``) burning past
 ``AVDB_SLO_BURN``: the fast window proves the problem is happening NOW,
 the slow window proves it is sustained — a single hot sample moves
@@ -43,8 +48,10 @@ from annotatedvdb_tpu.obs.timeseries import (
     TimeSeriesRing,
     counter_delta,
     counter_rate,
+    gauge_value,
     histogram_window,
     history_path,
+    trailing_samples,
     window_samples,
 )
 
@@ -159,11 +166,18 @@ class SloSpec:
     - ``latency``: ``objective`` fraction of ``metric`` observations
       (optionally label-pinned) must finish under ``target_s`` seconds;
     - ``rate_floor``: the windowed rate of ``metric`` must hold
-      ``floor`` per second (0 = dormant; absent metric = no judgment).
+      ``floor`` per second (0 = dormant; absent metric = no judgment);
+    - ``gauge_ceiling``: at most ``1 - objective`` of the window's
+      sampled ``metric`` gauge points may sit above ``ceiling`` (0 =
+      dormant; absent metric — e.g. the replication-lag gauge on a
+      process that is not a follower — = no judgment).  A gauge carries
+      no delta, so the burn is the breached-sample fraction over the
+      window's POINTS, not over a bracketing pair.
     """
 
     def __init__(self, name: str, kind: str, description: str, **params):
-        if kind not in ("availability", "latency", "rate_floor"):
+        if kind not in ("availability", "latency", "rate_floor",
+                        "gauge_ceiling"):
             raise ValueError(f"slo {name}: unknown kind {kind!r}")
         self.name = name
         self.kind = kind
@@ -180,16 +194,38 @@ class SloSpec:
             return {"target_ms": round(
                 float(p.get("target_s", 0.0)) * 1000, 3
             ), "objective": p.get("objective")}
+        if self.kind == "gauge_ceiling":
+            return {"ceiling": p.get("ceiling"),
+                    "objective": p.get("objective")}
         return {"floor_per_s": p.get("floor")}
 
-    def burn(self, pair) -> float | None:
+    def burn(self, pair, window: list | None = None) -> float | None:
         """Burn rate over one ``(first, last)`` sample pair, or None
         when the window carries no judgment (no traffic, metric absent,
-        dormant floor)."""
+        dormant floor/ceiling).  ``window`` is the full sample sublist
+        the pair brackets — only the gauge kind reads it (point
+        fractions need points); pair-only callers get the honest
+        two-point fallback."""
         if pair is None:
             return None
         first, last = pair
         p = self.params
+        if self.kind == "gauge_ceiling":
+            ceiling = float(p.get("ceiling") or 0.0)
+            if ceiling <= 0:
+                return None
+            points = window if window is not None else [first, last]
+            vals = [
+                gauge_value(s.get("metrics") or {}, p["metric"],
+                            p.get("labels"))
+                for s in points
+            ]
+            vals = [v for v in vals if v is not None]
+            if not vals:
+                return None
+            frac = sum(1 for v in vals if v > ceiling) / len(vals)
+            budget = 1.0 - float(p.get("objective", 0.9))
+            return min(frac / budget, BURN_CAP)
         if self.kind == "availability":
             errors = counter_delta(
                 first, last, "avdb_query_errors_total"
@@ -231,8 +267,14 @@ def default_slos() -> list:
     """The declared SLO set every serving worker evaluates.  The p99
     targets resolve from the same ``AVDB_SERVE_BROWNOUT_P99_MS`` knob
     the brownout governor enforces — the alert plane and the shedding
-    plane must never disagree about what "too slow" means."""
+    plane must never disagree about what "too slow" means.  The
+    replication-lag ceiling resolves from ``AVDB_REPL_MAX_LAG_S`` for
+    the same reason: the bound past which ``/readyz`` declares a
+    follower stale IS the bound the alert plane burns against (0
+    disables both planes together; on a non-follower the gauge never
+    exists, so the objective stays declared-but-silent)."""
     from annotatedvdb_tpu.serve.resilience import brownout_p99_target_s
+    from annotatedvdb_tpu.store.replication import repl_max_lag_from_env
 
     p99_t = brownout_p99_target_s()
     return [
@@ -257,6 +299,13 @@ def default_slos() -> list:
             "load_rate", "rate_floor",
             "load-pipeline variants/sec vs the declared floor",
             metric="avdb_rows_total", floor=slo_load_floor_from_env(),
+        ),
+        SloSpec(
+            "replication_lag", "gauge_ceiling",
+            "follower staleness vs the declared AVDB_REPL_MAX_LAG_S "
+            "bound",
+            metric="avdb_replication_lag_seconds",
+            ceiling=repl_max_lag_from_env(), objective=0.9,
         ),
     ]
 
@@ -314,11 +363,13 @@ class SloRegistry:
         now = self.clock() if now is None else now
         pair_fast = window_samples(samples, self.fast_s, now=now)
         pair_slow = window_samples(samples, self.slow_s, now=now)
+        win_fast = trailing_samples(samples, self.fast_s, now=now)
+        win_slow = trailing_samples(samples, self.slow_s, now=now)
         firing = 0
         for spec in self.specs:
             st = self._state[spec.name]
-            bf = spec.burn(pair_fast)
-            bs = spec.burn(pair_slow)
+            bf = spec.burn(pair_fast, window=win_fast)
+            bs = spec.burn(pair_slow, window=win_slow)
             st["burn_fast"], st["burn_slow"] = bf, bs
             self._g_burn[spec.name].set(bf or 0.0)
             breach = (

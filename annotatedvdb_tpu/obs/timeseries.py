@@ -216,6 +216,25 @@ def window_samples(samples: list, window_s: float,
     return first, last
 
 
+def trailing_samples(samples: list, window_s: float,
+                     now: float | None = None):
+    """Every sample inside the trailing ``window_s`` seconds of a sample
+    list (oldest first), or None when fewer than two samples exist.
+    Falls back to the newest two samples when the window catches fewer —
+    the same young-ring honesty as :func:`window_samples`.  Gauge-kind
+    SLOs feed on this: a gauge carries no delta, so its window judgment
+    is the FRACTION of sampled points past the bound, which needs the
+    points themselves rather than a bracketing pair."""
+    if len(samples) < 2:
+        return None
+    cutoff = (float(samples[-1]["t"]) if now is None else now) \
+        - float(window_s)
+    win = [s for s in samples if float(s["t"]) >= cutoff]
+    if len(win) < 2:
+        win = samples[-2:]
+    return win
+
+
 def derive_series(samples: list) -> list:
     """The ``/metrics/history`` derivation: every metric in the ring as
     a point list — counters as per-interval rates, gauges as sampled

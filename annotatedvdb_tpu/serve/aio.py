@@ -86,6 +86,9 @@ from annotatedvdb_tpu.serve.http import (
     MSG_DEADLINE_ADMISSION,
     MSG_DEADLINE_EXECUTE,
     REGIONS_BODY_ERROR,
+    REPL_MANIFEST_ROUTE,
+    REPL_SEGMENT_ROUTE,
+    REPL_WAL_ROUTE,
     STATS_BODY_ERROR,
     STATS_ROUTE,
     TRACE_HEADER,
@@ -103,6 +106,8 @@ from annotatedvdb_tpu.serve.http import (
     parse_stats_body,
     parse_upsert_body,
     readyz_payload,
+    repl_file_response,
+    repl_manifest_payload,
     resolve_trace_id,
     stats_payload,
 )
@@ -140,6 +145,7 @@ _STATUS = {
 
 _CT_JSON = b"Content-Type: application/json\r\nContent-Length: "
 _CT_TEXT = b"Content-Type: text/plain; version=0.0.4\r\nContent-Length: "
+_CT_BIN = b"Content-Type: application/octet-stream\r\nContent-Length: "
 
 #: rows rendered between flow-control drains while streaming a region
 _STREAM_ROWS_PER_CHUNK = 256
@@ -1301,6 +1307,21 @@ class AioServer:
                 # chaos-gated like /_chaos: a production server 404s this
                 # byte-identically to any unknown route
                 return _resp(200, debug_trace_payload(ctx)), keep, tid
+            if path == REPL_MANIFEST_ROUTE:
+                # the ship document stats the manifest and scans WAL
+                # stable prefixes — file I/O, executor work (AVDB701)
+                fut = self._loop.run_in_executor(
+                    self._pool,
+                    lambda: _resp(*repl_manifest_payload(ctx)),
+                )
+                return ("exec", fut, "repl", time.perf_counter(),
+                        tid, None), keep, tid
+            if path in (REPL_SEGMENT_ROUTE, REPL_WAL_ROUTE):
+                fut = self._loop.run_in_executor(
+                    self._pool, self._repl_file_work, url.query
+                )
+                return ("exec", fut, "repl", time.perf_counter(),
+                        tid, None), keep, tid
             return _error(404, f"no such route: {path}"), keep, tid
         if method == "POST":
             try:
@@ -1501,6 +1522,16 @@ class AioServer:
         return _resp(200, json.dumps(
             {"armed": spec or None, "pid": os.getpid()}
         ))
+
+    def _repl_file_work(self, query: str) -> bytes:
+        """Executor half of ``GET /repl/{segment,wal}``: raw range bytes
+        (the shared builder clamps WAL/ledger reads to their stable
+        prefixes, so a torn frame can never leave this worker)."""
+        status, body = repl_file_response(self.ctx, query)
+        if isinstance(body, bytes):
+            head = _STATUS[status] + _CT_BIN + str(len(body)).encode()
+            return head + b"\r\n\r\n" + body
+        return _resp(status, body)
 
     def _bulk_item(self, body: bytes, client: str | None = None,
                    max_ids: int | None = None,

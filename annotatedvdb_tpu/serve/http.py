@@ -575,6 +575,83 @@ def parse_regions_body(body: bytes):
     )
 
 
+#: the replication ship route spellings — single-sourced for both front
+#: ends (the UPSERT_ROUTE convention); the follower's tailer
+#: (``store/replication.py``) fetches exactly these paths
+REPL_MANIFEST_ROUTE = "/repl/manifest"
+REPL_SEGMENT_ROUTE = "/repl/segment"
+REPL_WAL_ROUTE = "/repl/wal"
+
+#: server-side ceiling on one ship range read (the follower chunks at
+#: AVDB_REPL_CHUNK_BYTES; this bounds a misconfigured client's single-
+#: request memory on the leader)
+REPL_MAX_RANGE_BYTES = 64 << 20
+
+#: the 404 body when the ship surface has no on-disk store to serve from
+#: (in-memory test/bench stores) — shared by both front ends (AVDB801)
+MSG_REPL_UNAVAILABLE = (
+    "replication ship surface unavailable: this server has no on-disk "
+    "store directory"
+)
+
+
+def follower_upsert_payload(ctx) -> str:
+    """The 403 body an upsert gets on a replication follower — carries
+    the leader's location so a well-behaved client redirects its writes
+    (ONE builder for both front ends, the AVDB801 contract)."""
+    return json.dumps({
+        "error": "this server is a replication follower (read-only); "
+                 "send writes to the leader",
+        "leader": ctx.follow_url,
+    })
+
+
+def repl_manifest_payload(ctx) -> tuple[int, str]:
+    """(status, body) for ``GET /repl/manifest`` — the leader's ship
+    document (the consistent snapshot cut plus the WAL/ledger stable-
+    prefix listing), built by
+    :func:`annotatedvdb_tpu.store.replication.ship_manifest`.  ONE
+    builder for both front ends; the aio front end runs it on the
+    executor pool (it stats and reads files — AVDB701)."""
+    if ctx.repl_store_dir is None:
+        return 404, json.dumps({"error": MSG_REPL_UNAVAILABLE})
+    from annotatedvdb_tpu.store.replication import ReplError, ship_manifest
+
+    try:
+        return 200, json.dumps(ship_manifest(ctx.repl_store_dir))
+    except ReplError as err:
+        return 503, json.dumps({"error": str(err)})
+
+
+def repl_file_response(ctx, query: str) -> tuple[int, "bytes | str"]:
+    """(status, body) for ``GET /repl/{segment,wal}?name=&offset=&limit=``
+    — raw bytes (200) of one shippable file range, clamped to the file's
+    stable prefix for WAL/ledger streams; a JSON error string otherwise.
+    Both ship routes share this builder: the NAME (validated against the
+    ship namespace by ``ship_file_range``) decides the clamping, never
+    the route spelling — so a torn frame can never ship regardless of
+    which route a client picked."""
+    if ctx.repl_store_dir is None:
+        return 404, json.dumps({"error": MSG_REPL_UNAVAILABLE})
+    params = parse_qs(query or "")
+    name = (params.get("name") or [""])[0]
+    try:
+        offset = int((params.get("offset") or ["0"])[0])
+        limit = int((params.get("limit") or [str(REPL_MAX_RANGE_BYTES)])[0])
+    except ValueError:
+        return 400, json.dumps(
+            {"error": "repl range: offset/limit must be integers"}
+        )
+    from annotatedvdb_tpu.store.replication import ship_file_range
+
+    blob = ship_file_range(
+        ctx.repl_store_dir, name, offset, min(limit, REPL_MAX_RANGE_BYTES)
+    )
+    if blob is None:
+        return 404, json.dumps({"error": f"not a shippable file: {name!r}"})
+    return 200, blob
+
+
 class ServeContext:
     """Everything a handler thread needs, shared across requests."""
 
@@ -622,6 +699,17 @@ class ServeContext:
         #: historical read-only server — the upsert route answers
         #: MSG_UPSERTS_DISABLED when unset
         self.memtable = memtable
+        #: replication plane.  The ship surface (``GET /repl/*``) serves
+        #: from the snapshot manager's on-disk store directory (None for
+        #: in-memory stores: the routes 404).  A follower's serve path
+        #: sets ``repl`` to its ReplicaTailer (lag gates /readyz) and
+        #: ``follow_url`` to the leader base URL (upserts answer 403
+        #: pointing there).
+        self.repl_store_dir = getattr(
+            getattr(manager, "base", manager), "store_dir", None
+        )
+        self.repl = None
+        self.follow_url = None
         self.max_inflight = (
             max_inflight if max_inflight is not None else batcher.max_queue
         )
@@ -917,6 +1005,12 @@ class ServeContext:
         WAL frame is fsync'd (``Memtable.upsert`` orders WAL-then-
         visibility), so an acknowledged upsert survives SIGKILL at any
         instant."""
+        if self.follow_url is not None:
+            # a follower is read-only BY ROLE, not by configuration: its
+            # overlay memtable exists purely to apply the leader's shipped
+            # stream, so a client write is refused with the leader's
+            # location rather than silently forking the replica
+            return 403, follower_upsert_payload(self), 0
         memtable = self.memtable
         if memtable is None:
             return 403, json.dumps({"error": MSG_UPSERTS_DISABLED}), 0
@@ -1062,6 +1156,15 @@ class ServeContext:
             self.health.tick()
         if getattr(self.manager, "swapping", False):
             return False, "snapshot swap in progress"
+        if self.repl is not None and self.repl.lag_exceeded():
+            # the bounded-staleness contract: a follower past its
+            # declared lag bound (AVDB_REPL_MAX_LAG_S) drains out of the
+            # router rotation rather than serving reads staler than it
+            # promised; it re-enters the instant a tail cycle catches up
+            return False, (
+                f"replication lag {self.repl.lag_s():.1f}s exceeds the "
+                f"declared staleness bound ({self.repl.max_lag_s:g}s)"
+            )
         if self.governor.shed_bulk():
             return False, f"brownout level {self.governor.level} " \
                           f"({self.governor.level_name})"
@@ -1114,9 +1217,9 @@ class ServeHandler(BaseHTTPRequestHandler):
     def log_message(self, format, *args):  # stdlib signature
         self.server.ctx.log(f"{self.address_string()} {format % args}")
 
-    def _reply(self, status: int, body: str,
+    def _reply(self, status: int, body,
                content_type: str = "application/json") -> None:
-        payload = body.encode()
+        payload = body.encode() if isinstance(body, str) else body
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
@@ -1168,6 +1271,16 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         if path == HISTORY_ROUTE:
             self._reply(200, metrics_history_payload(ctx, url.query))
+            return
+        if path == REPL_MANIFEST_ROUTE:
+            status, body = repl_manifest_payload(ctx)
+            self._reply(status, body)
+            return
+        if path in (REPL_SEGMENT_ROUTE, REPL_WAL_ROUTE):
+            status, body = repl_file_response(ctx, url.query)
+            self._reply(status, body,
+                        content_type="application/octet-stream"
+                        if isinstance(body, bytes) else "application/json")
             return
         if path == "/debug/trace" and ctx.debug_trace_enabled:
             # chaos-gated like /_chaos: on a production server this path

@@ -204,6 +204,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
     # ---- directory scan: orphans, stale tmp, foreign files -----------------
     from annotatedvdb_tpu.store.compact import is_compact_tmp
     from annotatedvdb_tpu.store.memtable import is_flush_tmp
+    from annotatedvdb_tpu.store.replication import is_repl_cursor, is_repl_tmp
     from annotatedvdb_tpu.store.wal import is_wal_file, is_wal_tmp
 
     for fname in sorted(os.listdir(store_dir)):
@@ -216,6 +217,32 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
             if repair:
                 os.remove(fp)
                 did(f"removed {fp}")
+            continue
+        if is_repl_tmp(fname):
+            # a replication bootstrap killed mid-chunk-stream: the rename
+            # (and CRC verify) never happened, so nothing references it —
+            # the non-destructive recovery is re-running bootstrap
+            # (serve --follow refetches anything unverified)
+            note("warn", "repl-tmp",
+                 f"{fp}: in-flight replication bootstrap chunk temp from "
+                 "a killed ship transfer; re-run bootstrap (serve "
+                 "--follow) to refetch it")
+            if repair:
+                os.remove(fp)
+                did(f"removed {fp} (bootstrap refetches it)")
+            continue
+        if is_repl_cursor(fname):
+            # a follower's tail cursor left behind (the store is being
+            # inspected outside its follower, or a promote was killed
+            # before the cursor drop): pruning loses only resume hints —
+            # re-running bootstrap rebuilds it from the local mirrors
+            note("warn", "repl-cursor",
+                 f"{fp}: dangling replication bootstrap cursor — this "
+                 "store was (or is) a follower; re-run bootstrap (serve "
+                 "--follow) to resume, or promote to seal it as a leader")
+            if repair:
+                os.remove(fp)
+                did(f"removed {fp} (re-run bootstrap to rebuild it)")
             continue
         if is_wal_tmp(fname):
             # a killed WAL rotation (memtable flush start): the rename

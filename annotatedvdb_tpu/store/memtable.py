@@ -188,10 +188,17 @@ class Memtable:
                  wal: WriteAheadLog | None = None,
                  flush_bytes: int | None = None,
                  flush_age_s: float | None = None,
-                 registry=None, log=None):
+                 registry=None, log=None,
+                 fence_epoch: int | None = None):
         self.width = int(width)
         self.store_dir = store_dir
         self.wal = wal
+        #: replication fencing: the manifest ``repl_epoch`` this writer
+        #: opened under (None = unfenced legacy writer).  A flush commit
+        #: observing a HIGHER on-disk epoch aborts — the store was
+        #: promoted out from under a deposed leader, which must never
+        #: commit over the new lineage (store/replication.py).
+        self.fence_epoch = fence_epoch
         self.log = log if log is not None else (lambda msg: None)
         self.flush_bytes = (
             flush_bytes_from_env() if flush_bytes is None
@@ -493,7 +500,8 @@ class Memtable:
                     for code, segs in plan.items()
                 }
                 result = flush_segments(
-                    self.store_dir, merged, self.width, log=self.log
+                    self.store_dir, merged, self.width, log=self.log,
+                    fence_epoch=self.fence_epoch,
                 )
             if result["status"] != "flushed":
                 self.log(f"memtable flush aborted: {result.get('reason')}; "
@@ -592,7 +600,8 @@ class Memtable:
 
 
 def flush_segments(store_dir: str, merged: dict[int, Segment],
-                   width: int, log=None) -> dict:
+                   width: int, log=None,
+                   fence_epoch: int | None = None) -> dict:
     """Commit one merged segment per chromosome group into the store.
 
     The write half of :meth:`Memtable.flush` — segment container bytes go
@@ -626,6 +635,20 @@ def flush_segments(store_dir: str, merged: dict[int, Segment],
             f"{mpath}: store width {manifest.get('width')} != memtable "
             f"width {width}"
         )
+    if fence_epoch is not None \
+            and int(manifest.get("repl_epoch", 0) or 0) > int(fence_epoch):
+        # replication fencing: the store was promoted past this writer's
+        # lineage (repl_epoch moved while it slept) — a deposed leader
+        # must never commit over the promoted store.  Abort like any
+        # preemption: nothing written, rows stay in the memtable + WAL.
+        reason = (
+            f"fenced: store repl_epoch "
+            f"{int(manifest.get('repl_epoch', 0) or 0)} > this writer's "
+            f"epoch {int(fence_epoch)} (store was promoted; this leader "
+            "is deposed)"
+        )
+        log(f"memtable flush preempted: {reason}")
+        return {"status": "aborted", "reason": reason}
     fingerprint = (st.st_mtime_ns, st.st_size, st.st_ino)
     # crash point #1: the plan is captured, nothing written — a death here
     # must leave the store byte-untouched (rows stay in memtable + WAL)

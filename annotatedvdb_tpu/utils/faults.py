@@ -159,6 +159,26 @@ arming any other name is a ``ValueError`` at parse time):
                             everywhere (logged once, next tick runs):
                             the maintenance chains hosting the tick and
                             the respawn loop never die of their observer
+``repl.ship``               in ``store.replication``: on the LEADER once
+                            per ship-document build, and on the FOLLOWER
+                            before a fetched chunk lands on local disk —
+                            ``torn_write`` tears the mirrored WAL/segment
+                            tail, which the resume-time stable-prefix
+                            scan (or the bootstrap CRC verify) must
+                            catch; a death leaves a resumable cursor
+``repl.apply``              in the follower tail: after shipped bytes
+                            are durable locally, before the overlay
+                            applies them (and once per bootstrap before
+                            the manifest mirror installs) — a death at
+                            either site must land the follower on a
+                            consistent applied-LSN prefix, never a
+                            hybrid (restart replays the mirrored files)
+``repl.promote``            twice in ``replication.promote``: before
+                            anything mutates (a kill leaves an intact
+                            follower that promotes again), and mid-
+                            epoch-commit (``torn_write`` tears the
+                            manifest tmp; the atomic replace never
+                            happens, the store stays a follower)
 ======================== ====================================================
 
 **Process-death actions are subprocess-only.**  ``kill``/``torn_write``
@@ -218,6 +238,9 @@ POINTS = frozenset({
     "mesh.dispatch",
     "obs.flight",
     "obs.tick",
+    "repl.ship",
+    "repl.apply",
+    "repl.promote",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

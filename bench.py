@@ -1216,6 +1216,38 @@ def bench_chaos() -> dict:
     return record
 
 
+def bench_replication() -> dict:
+    """The replica-fleet leg (``tools/chaos_soak.py --repl``): a leader
+    takes WAL-durable upserts while a follower tails its ship stream,
+    then the leader is SIGKILLed mid-ship and the follower is promoted —
+    the record lands as the ``serving.replication`` block (schema-checked
+    with ``acked_missing`` REQUIRED 0, the mixed-workload precedent
+    extended across a failover).  Runs as a subprocess (it builds its own
+    fleets and stores); a failed run records the violations instead of
+    aborting the bench."""
+    import subprocess
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "chaos_soak.py")
+    try:
+        p = subprocess.run(
+            [sys.executable, tool, "--repl", "--json", "-"],
+            capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "replication leg timed out"}
+    try:
+        record = json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"replication leg rc={p.returncode}, no JSON "
+                         f"({p.stderr[-300:]!r})"}
+    rp = dict(record.get("repl") or {})
+    rp["acked"] = (record.get("upserts") or {}).get("acked", 0)
+    rp["wrong_bytes"] = record.get("wrong_bytes", 0)
+    rp["violations"] = record.get("violations", [])
+    return rp
+
+
 def _build_fragmented_store(work: str, n_rows: int, batch: int = 4096):
     """(store_dir, ids): a synth store committed checkpoint-by-checkpoint
     (persist per batch), so the directory holds one segment file pair per
@@ -2383,6 +2415,8 @@ def serve_only():
         shutil.rmtree(work, ignore_errors=True)
     settle()
     serving["chaos"] = bench_chaos()
+    settle()
+    serving["replication"] = bench_replication()
     settle()
     try:
         compaction = bench_compaction()

@@ -1758,3 +1758,163 @@ def test_obs_tick_harvest_failure_absorbed_by_supervisor(tmp_path):
         shutil.rmtree(fleet._telemetry_dir, ignore_errors=True)
         fleet._hb_mm.close()
         os.unlink(fleet._hb_path)
+
+
+# -- replication kill points (store/replication.py) ---------------------------
+
+
+def _repl_leader(tmp_path, rows):
+    """One in-process leader (store + memtable + WAL + threaded front
+    end) with ``rows`` upserted — the replication matrix's write source."""
+    import threading
+
+    from annotatedvdb_tpu.obs.metrics import MetricsRegistry
+    from annotatedvdb_tpu.serve.http import build_server
+    from annotatedvdb_tpu.serve.snapshot import (
+        MemtableSnapshots,
+        SnapshotManager,
+    )
+    from annotatedvdb_tpu.store.memtable import Memtable
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    store_dir = str(tmp_path / "repl-leader")
+    _tiny_store().save(store_dir)
+    mem = Memtable(
+        width=8, store_dir=store_dir,
+        wal=WriteAheadLog(store_dir, "serve-w0", log=lambda m: None),
+        log=lambda m: None,
+    )
+    store = VariantStore.load(store_dir, readonly=True)
+    for row in rows:
+        mem.upsert(store, [row], durable=True)
+    httpd = build_server(
+        manager=MemtableSnapshots(
+            SnapshotManager(store_dir, log=lambda m: None), mem
+        ),
+        port=0, memtable=mem,
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    return store_dir, url, httpd
+
+
+_REPL_ROWS = [
+    {"code": 3, "pos": 15, "ref": "A", "alt": "G"},
+    {"code": 3, "pos": 25, "ref": "AT", "alt": "A"},
+]
+
+
+@pytest.mark.parametrize("fault", ["repl.ship:1:raise", "repl.ship:1:eio"])
+def test_repl_ship_fault_cycle_retries_to_identical_state(tmp_path, fault):
+    """repl.ship fires on the leader's ship surface: the poisoned cycle
+    fails whole (ReplError — nothing half-applied), and the NEXT cycle
+    lands the follower on the leader's exact applied-LSN state."""
+    from annotatedvdb_tpu.store import replication as repl
+
+    store_dir, url, httpd = _repl_leader(tmp_path, _REPL_ROWS)
+    fdir = str(tmp_path / "repl-follower")
+    applied: list = []
+    tailer = repl.ReplicaTailer(fdir, url, log=lambda m: None,
+                                apply_rows=applied.extend)
+    try:
+        faults.reset(fault)
+        with pytest.raises(repl.ReplError):
+            tailer.sync_once()
+        assert applied == []  # the failed cycle applied NOTHING
+        faults.reset("")
+        tailer.sync_once()
+        assert [r["pos"] for r in applied] == [15, 25]
+        # the mirror is byte-identical to the leader's stable stream
+        for fname in repl.wal_files(store_dir):
+            with open(os.path.join(store_dir, fname), "rb") as f:
+                leader_bytes = f.read()
+            with open(os.path.join(fdir, fname), "rb") as f:
+                assert f.read() == leader_bytes
+    finally:
+        faults.reset("")
+        httpd.shutdown()
+        httpd.ctx.batcher.close()
+
+
+def test_repl_apply_fault_restart_lands_on_applied_lsn_prefix(tmp_path):
+    """repl.apply dies AFTER the shipped bytes are durable on the
+    follower but BEFORE the overlay applied them: a restarted tailer
+    recovers the records from its own mirror and the live stream applies
+    each acked row exactly once — a consistent applied-LSN prefix, never
+    a hybrid."""
+    from annotatedvdb_tpu.store import replication as repl
+
+    store_dir, url, httpd = _repl_leader(tmp_path, _REPL_ROWS)
+    fdir = str(tmp_path / "repl-follower")
+    try:
+        t1 = repl.ReplicaTailer(fdir, url, log=lambda m: None)
+        t1.bootstrap()  # cut installed; WAL tail not mirrored yet
+        applied: list = []
+        t1.apply_rows = applied.extend
+        faults.reset("repl.apply:1:raise")
+        with pytest.raises(faults.InjectedFault):
+            t1.sync_once()
+        faults.reset("")
+        assert applied == []  # durable locally, applied nowhere
+
+        # restart: a fresh incarnation resumes from the mirror alone
+        t2 = repl.ReplicaTailer(fdir, url, log=lambda m: None)
+        recovered = t2.resume()
+        replayed = [r["pos"] for rec in t2.local_records()
+                    for r in rec["rows"]]
+        live: list = []
+        t2.apply_rows = live.extend
+        t2.sync_once()
+        total = replayed + [r["pos"] for r in live]
+        # every acked row exactly once, in WAL order — no loss, no dupes
+        assert sorted(total) == [15, 25]
+        assert recovered + len(live) >= 1
+    finally:
+        faults.reset("")
+        httpd.shutdown()
+        httpd.ctx.batcher.close()
+
+
+def test_repl_promote_fault_leaves_promotable_follower(tmp_path):
+    """repl.promote (raise, hit #1 — before any mutation): the follower
+    is byte-untouched and promotes cleanly on re-run; the deposed
+    leader's flush is fenced afterwards."""
+    from annotatedvdb_tpu.store import replication as repl
+    from annotatedvdb_tpu.store.memtable import Memtable
+
+    store_dir, url, httpd = _repl_leader(tmp_path, _REPL_ROWS)
+    fdir = str(tmp_path / "repl-follower")
+    try:
+        tailer = repl.ReplicaTailer(fdir, url, log=lambda m: None)
+        tailer.bootstrap()
+        tailer.sync_once()
+        before = sorted(os.listdir(fdir))
+
+        faults.reset("repl.promote:1:raise")
+        with pytest.raises(faults.InjectedFault):
+            repl.promote(fdir, log=lambda m: None)
+        faults.reset("")
+        assert sorted(os.listdir(fdir)) == before  # byte-untouched
+        with open(os.path.join(fdir, "manifest.json")) as f:
+            assert json.load(f).get("repl_epoch", 0) == 0
+
+        out = repl.promote(fdir, log=lambda m: None)
+        assert out["status"] == "promoted" and out["epoch"] == 1
+        promoted = VariantStore.load(fdir, readonly=True)
+        assert promoted.n == 5  # 3 seed + 2 tailed rows sealed
+
+        # deposed-leader write fenced: a writer that opened the store
+        # under the old epoch cannot commit a flush over the new lineage
+        deposed = Memtable(width=8, store_dir=fdir, wal=None,
+                           log=lambda m: None, fence_epoch=0)
+        deposed.upsert(
+            promoted, [{"code": 3, "pos": 99, "ref": "A", "alt": "G"}],
+            durable=False,
+        )
+        result = deposed.flush()
+        assert result["status"] == "aborted"
+        assert "fenced" in result["reason"]
+    finally:
+        faults.reset("")
+        httpd.shutdown()
+        httpd.ctx.batcher.close()

@@ -21,6 +21,7 @@ from annotatedvdb_tpu.obs.slo import (
     HealthPlane,
     SloRegistry,
     SloSpec,
+    default_slos,
     fraction_above,
     replay_history,
     worst_of,
@@ -35,6 +36,7 @@ from annotatedvdb_tpu.obs.timeseries import (
     history_path,
     list_history,
     load_history,
+    trailing_samples,
     window_samples,
 )
 
@@ -362,6 +364,97 @@ def test_worst_of_ranking():
     assert worst_of(["ok", "resolved"]) == "resolved"
     assert worst_of(["resolved", "pending", "ok"]) == "pending"
     assert worst_of(["pending", "firing"]) == "firing"
+
+
+# ---------------------------------------------------------------------------
+# the gauge-ceiling kind (PR 18: follower replication lag)
+
+
+def _lag_sample(t: float, lag: float | None) -> dict:
+    metrics = {} if lag is None else {
+        "avdb_replication_lag_seconds": [
+            {"kind": "gauge", "labels": {}, "value": lag},
+        ],
+    }
+    return {"t": t, "metrics": metrics}
+
+
+LAG_SPEC = dict(metric="avdb_replication_lag_seconds", ceiling=5.0,
+                objective=0.9)
+
+
+def test_gauge_ceiling_burn_is_the_breached_point_fraction():
+    spec = SloSpec("replication_lag", "gauge_ceiling", "t", **LAG_SPEC)
+    # 10 points, 3 past the ceiling: frac 0.3 against a 0.1 budget = 3.0
+    win = [_lag_sample(float(t), 8.0 if t < 3 else 0.1)
+           for t in range(10)]
+    assert spec.burn((win[0], win[-1]), window=win) == pytest.approx(3.0)
+    # every point clean -> burn 0; every point hot -> 1/0.1 = 10
+    clean = [_lag_sample(float(t), 0.2) for t in range(4)]
+    assert spec.burn((clean[0], clean[-1]), window=clean) == 0.0
+    hot = [_lag_sample(float(t), 9.0) for t in range(4)]
+    assert spec.burn((hot[0], hot[-1]), window=hot) \
+        == pytest.approx(10.0)
+    # metric absent (not a follower) = no judgment, never a clean 0
+    bare = [_lag_sample(float(t), None) for t in range(4)]
+    assert spec.burn((bare[0], bare[-1]), window=bare) is None
+    # ceiling 0 = dormant (the AVDB_REPL_MAX_LAG_S=0 story), even hot
+    dormant = SloSpec("replication_lag", "gauge_ceiling", "t",
+                      metric="avdb_replication_lag_seconds", ceiling=0.0)
+    assert dormant.burn((hot[0], hot[-1]), window=hot) is None
+    # pair-only callers (no window kwarg) get the two-point fallback
+    assert spec.burn((hot[0], hot[-1])) == pytest.approx(10.0)
+    note = spec.target_note()
+    assert note == {"ceiling": 5.0, "objective": 0.9}
+
+
+def test_trailing_samples_bracketing():
+    samples = [_lag_sample(float(t), 0.0) for t in range(10)]
+    win = trailing_samples(samples, 3.0, now=9.0)
+    assert [s["t"] for s in win] == [6.0, 7.0, 8.0, 9.0]
+    # a window thinner than two samples falls back to the newest two
+    assert [s["t"] for s in trailing_samples(samples, 0.0, now=20.0)] \
+        == [8.0, 9.0]
+    assert trailing_samples(samples[:1], 3.0) is None
+
+
+def test_replication_lag_slo_fires_on_sustained_breach_then_resolves():
+    """The lag-gauge walk mirrors the availability one: a follower stuck
+    past the bound for both windows pages; catching back up resolves."""
+    slos = SloRegistry(
+        MetricsRegistry(),
+        specs=[SloSpec("replication_lag", "gauge_ceiling", "test",
+                       **LAG_SPEC)],
+        fast_s=1.0, slow_s=2.0, burn_threshold=2.0,
+    )
+    # lag healthy (ticks 0-2), stuck at 30s (ticks 3-5), recovered
+    lag = {0: 0.1, 1: 0.1, 2: 0.1, 3: 30.0, 4: 30.0, 5: 30.0,
+           6: 0.1, 7: 0.1, 8: 0.1, 9: 0.1}
+    samples, states = [], []
+    for t in range(10):
+        samples.append(_lag_sample(float(t), lag[t]))
+        [row] = slos.evaluate(list(samples), now=float(t))
+        states.append(row["state"])
+    assert states[:3] == ["ok", "ok", "ok"]
+    assert "firing" in states
+    assert states[-1] == "resolved"
+    [final] = slos.alerts()
+    assert final["fired_total"] == 1
+    assert final["kind"] == "gauge_ceiling"
+    assert final["ceiling"] == 5.0
+
+
+def test_default_slos_declare_replication_lag(monkeypatch):
+    [spec] = [s for s in default_slos() if s.name == "replication_lag"]
+    assert spec.kind == "gauge_ceiling"
+    assert spec.params["metric"] == "avdb_replication_lag_seconds"
+    assert spec.params["ceiling"] == 5.0  # the AVDB_REPL_MAX_LAG_S default
+    # the readiness knob IS the alerting knob: 0 disables both planes
+    monkeypatch.setenv("AVDB_REPL_MAX_LAG_S", "0")
+    [spec] = [s for s in default_slos() if s.name == "replication_lag"]
+    assert spec.params["ceiling"] == 0.0
+    hot = [_lag_sample(float(t), 99.0) for t in range(4)]
+    assert spec.burn((hot[0], hot[-1]), window=hot) is None
 
 
 def test_health_plane_tick_persists_alert_extras(tmp_path):

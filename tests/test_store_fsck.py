@@ -156,6 +156,7 @@ def test_corrupt_fixture_repairs_end_to_end(tmp_path):
     assert report["exit_code"] == 2
     assert {"segment-torn", "segment-orphan", "stale-tmp", "compact-tmp",
             "wal-pending", "wal-tmp", "flush-tmp",
+            "repl-tmp", "repl-cursor",
             "ledger-torn", "undo-intent-dangling"} <= _codes(report)
     # the abandoned compaction/flush temps and the WAL are attributed,
     # never "foreign"
@@ -275,3 +276,39 @@ def test_wal_survives_loader_save_cleanup(tmp_path):
     store.shard(1).set_col("ref_snp", [0], [77])  # dirty a segment
     store.save(d)  # save() prunes orphans; the WAL must survive
     assert any(f.endswith(".wal") for f in os.listdir(d))
+
+
+# ---------------------------------------------------------------------------
+# replication debris: bootstrap chunk temps, dangling tail cursors
+
+
+def test_repl_debris_attributed_and_pruned(tmp_path):
+    """``*.repl.tmp`` (a bootstrap chunk stream killed mid-transfer) and
+    ``repl.cursor.json`` (a follower's tail cursor) get dedicated finding
+    codes — never ``foreign-file`` — and ``--repair`` prunes both while
+    naming the non-destructive recovery: re-running bootstrap
+    (``serve --follow``) refetches/rebuilds everything pruned here."""
+    d = str(tmp_path / "vdb")
+    _mkstore(d)
+    tmp = os.path.join(d, "chr1.000001.npz.repl.tmp")
+    open(tmp, "wb").write(b"half a shipped segment")
+    cursor = os.path.join(d, "repl.cursor.json")
+    with open(cursor, "w") as f:
+        json.dump({"repl_cursor": 1, "leader": "http://127.0.0.1:1",
+                   "fingerprint": [1, 2, 3], "epoch": 0, "offsets": {}}, f)
+    report = fsck(d, log=lambda m: None)
+    codes = _codes(report)
+    assert {"repl-tmp", "repl-cursor"} <= codes
+    assert "foreign-file" not in codes
+    assert report["exit_code"] == 1  # warnings, not fatal damage
+    # both findings prescribe the bootstrap re-run, and detection alone
+    # never deletes
+    for code in ("repl-tmp", "repl-cursor"):
+        f = [x for x in report["findings"] if x["code"] == code][0]
+        assert "bootstrap" in f["message"]
+    assert os.path.exists(tmp) and os.path.exists(cursor)
+    report = fsck(d, repair=True, log=lambda m: None)
+    assert any("bootstrap" in r or "refetches" in r
+               for r in report["repairs"])
+    assert not os.path.exists(tmp) and not os.path.exists(cursor)
+    assert fsck(d, log=lambda m: None)["status"] == "clean"

@@ -40,6 +40,13 @@ Modes:
   the whole schedule — injected latency, device EIO, snapshot-swap
   failure against a real commit, a worker SIGKILL, and a wedged loop the
   watchdog must catch.
+- ``--repl``   (~40 s): the REPLICA-FLEET certification — a leader
+  takes WAL-durable upserts while a follower bootstraps + tails the
+  ship stream (flaky by injection for a window); the harness proves
+  bounded staleness, SIGKILLs the leader mid-ship, watches the follower
+  declare itself stale (``/readyz`` 503), runs ``doctor promote``, and
+  asserts zero acked-upsert loss + byte-exact reads + restored write
+  availability on the promoted leader (see ``run_repl``).
 - ``--soak``   (>= 2 min): the LONG-AUTONOMY certification — the fleet
   runs with the maintenance daemon armed (``AVDB_MAINTAIN``), upserts
   sustain for most of the run so memtable flushes keep fragmenting the
@@ -1119,6 +1126,372 @@ def run(args) -> tuple[dict, list[str]]:
         shutil.rmtree(work, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# the replication leg (--repl): kill-the-leader failover certification
+
+
+def _spawn_serve(store_dir: str, extra: list, env: dict):
+    """(proc, host, port, stderr_lines): one serve CLI subprocess on an
+    ephemeral port, its stderr drained on a daemon thread (a full pipe
+    would wedge the server mid-run)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "annotatedvdb_tpu", "serve",
+         "--storeDir", store_dir, "--port", "0", "--workers", "1",
+         *extra],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    stderr_lines: list[str] = []
+    threading.Thread(
+        target=lambda: stderr_lines.extend(proc.stderr),
+        name="repl-serve-stderr", daemon=True,
+    ).start()
+    line = proc.stdout.readline()
+    m = re.search(r"http://([\d.]+):(\d+)", line)
+    if not m:
+        proc.kill()
+        raise RuntimeError(
+            f"no serve address line: {line!r} "
+            f"(stderr: {''.join(stderr_lines)[-400:]!r})"
+        )
+    return proc, m.group(1), int(m.group(2)), stderr_lines
+
+
+def _gauge(host: str, port: int, name: str) -> float | None:
+    """One metric value scraped from GET /metrics, or None."""
+    try:
+        status, body = get(host, port, "/metrics", timeout=3.0)
+    except OSError:
+        return None
+    if status != 200:
+        return None
+    m = re.search(rf"^{re.escape(name)}(?:{{[^}}]*}})? ([0-9.eE+-]+)",
+                  body, re.M)
+    return float(m.group(1)) if m else None
+
+
+def _pctl(samples: list, q: float) -> float:
+    vals = sorted(s for s in samples if s is not None)
+    if not vals:
+        return 0.0
+    return round(vals[min(int(q * (len(vals) - 1)), len(vals) - 1)], 3)
+
+
+def run_repl(args) -> tuple[dict, list[str]]:
+    """The replica-fleet certification: a leader takes WAL-durable
+    upserts while a follower bootstraps its snapshot cut and tails the
+    ship stream (flaky by injection for a window); the harness proves
+    bounded staleness end to end, then SIGKILLs the leader mid-ship,
+    watches the follower declare itself stale (``/readyz`` 503), runs
+    the ``doctor promote`` runbook, and holds the promoted store to the
+    same contract the WAL ack made: every acknowledged upsert readable,
+    every pre-chaos sample byte-identical, writes accepted again.
+
+    What it asserts:
+
+    1. **zero wrong bytes** on the follower during AND after the tail
+       (same Checker as the base schedule, pointed at the replica);
+    2. **lag bounded**: the follower catches up (lag sinks under 1 s)
+       after the write stream ends, with the whole lag timeline sampled
+       for the record's p50/p99;
+    3. **staleness declared**: after the leader dies the follower's
+       ``/readyz`` flips 503 within the configured bound + margin —
+       a stale replica that keeps advertising ready is a violation;
+    4. **zero acked-upsert loss across failover**: after promote, every
+       row the dead leader ACKNOWLEDGED answers from the new leader
+       (the follower had caught up before the kill, so the ack set is
+       exactly the recoverable set);
+    5. **failover bounded**: stop-follower -> promote -> serving
+       writable inside the recovery window.
+    """
+    work = tempfile.mkdtemp(prefix="avdb_repl_")
+    leader_dir = os.path.join(work, "leader")
+    follower_dir = os.path.join(work, "follower")
+    duration_s = args.duration or 10.0
+    max_lag_s = 3.0
+    recovery_window_s = 30.0
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AVDB_JAX_PLATFORM="cpu",
+        AVDB_SERVE_CHAOS="1",
+        # one leader flush mid-window: the fingerprint moves under the
+        # tailer and the re-sync cut must keep every acked row visible
+        AVDB_MEMTABLE_FLUSH_S="6",
+    )
+    env.pop("AVDB_FAULT", None)
+    fenv = dict(env, AVDB_REPL_MAX_LAG_S=str(max_lag_s),
+                AVDB_REPL_POLL_S="0.15")
+    log("repl: building leader store")
+    ids, _region = build_store(leader_dir, n=1500)
+    leader = follower = new_leader = None
+    violations: list[str] = []
+    try:
+        leader, lhost, lport, _lerr = _spawn_serve(
+            leader_dir, ["--upserts"], env)
+        wait_healthy(lhost, lport)
+        leader_url = f"http://{lhost}:{lport}"
+        log(f"repl: leader pid {leader.pid} on {leader_url}")
+        follower, fhost, fport, ferr = _spawn_serve(
+            follower_dir, ["--follow", leader_url], fenv)
+        wait_healthy(fhost, fport)
+        log(f"repl: follower pid {follower.pid} on "
+            f"http://{fhost}:{fport}")
+
+        # reference bytes from the LEADER; the follower must reproduce
+        # them now (bootstrap cut) and at every 200 after (the Checker)
+        reference: dict[str, str] = {}
+        for vid in ids[:: max(len(ids) // 12, 1)][:12]:
+            status, body = get(lhost, lport, f"/variant/{vid}")
+            if status != 200:
+                raise RuntimeError(f"leader reference GET -> {status}")
+            reference[vid] = body
+        for vid, want in reference.items():
+            status, body = get(fhost, fport, f"/variant/{vid}")
+            if status != 200 or body != want:
+                violations.append(
+                    f"bootstrap cut diverges on {vid}: {status}"
+                )
+                break
+        checker = Checker(fhost, fport, reference)
+        t_start = time.monotonic()
+        upserts = UpsertDriver(lhost, lport, t_start,
+                               start_rel=0.5, stop_rel=duration_s,
+                               rate=40.0)
+        checker.start()
+        upserts.start()
+
+        # mid-ship chaos: the tailer's ship path goes flaky for a
+        # window — cycles fail whole and retry, lag stays bounded
+        faults_armed = ["repl.ship:prob:0.25:raise (flaky ship window "
+                        "on the follower)",
+                        "SIGKILL leader mid-ship",
+                        "doctor promote (failover runbook)"]
+        lag_samples: list = []
+        armed = False
+        while time.monotonic() < t_start + duration_s:
+            if not armed and time.monotonic() >= t_start + 2.0:
+                try:
+                    arm(fhost, fport, "repl.ship:prob:0.25:raise",
+                        ttl_s=3.0)
+                except OSError as err:
+                    log(f"repl: arm refused ({err}); continuing unarmed")
+                armed = True
+            lag_samples.append(
+                _gauge(fhost, fport, "avdb_replication_lag_seconds"))
+            time.sleep(0.25)
+        upserts.join(timeout=30)
+        if not upserts.acked:
+            violations.append("upsert leg acknowledged nothing (the "
+                              "write stream never engaged)")
+
+        # catch-up: the staleness bound at work — lag sinks and the
+        # LAST acked row answers from the replica (single WAL stream,
+        # order preserved: last-applied implies every earlier ack)
+        caught_up = False
+        last = upserts.acked[-1] if upserts.acked else None
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            lag = _gauge(fhost, fport, "avdb_replication_lag_seconds")
+            lag_samples.append(lag)
+            if lag is not None and lag < 1.0:
+                if last is None:
+                    caught_up = True
+                    break
+                try:
+                    status, _b = get(fhost, fport, f"/variant/{last}")
+                except OSError:
+                    status = 0
+                if status == 200:
+                    caught_up = True
+                    break
+            time.sleep(0.25)
+        if not caught_up:
+            violations.append(
+                "follower never caught up after the write stream ended "
+                "(lag unbounded or acked tail unreadable)"
+            )
+        ship_bytes = _gauge(fhost, fport,
+                            "avdb_repl_ship_bytes_total") or 0.0
+        applied = _gauge(fhost, fport,
+                         "avdb_repl_records_applied_total") or 0.0
+        resyncs = _gauge(fhost, fport, "avdb_repl_resyncs_total") or 0.0
+        tail_s = round(time.monotonic() - t_start, 2)
+        log(f"repl: caught up — {int(applied)} record(s) applied, "
+            f"{int(ship_bytes)} ship bytes, {int(resyncs)} resync(s)")
+
+        # -- kill the leader mid-ship ---------------------------------
+        leader.send_signal(signal.SIGKILL)
+        leader.wait(timeout=10)
+        t_kill = time.monotonic()
+        log(f"repl: SIGKILLed leader pid {leader.pid}")
+        lag_503_s = None
+        deadline = t_kill + max_lag_s + 7.0
+        while time.monotonic() < deadline:
+            try:
+                status, body = get(fhost, fport, "/readyz", timeout=3.0)
+            except OSError:
+                status, body = 0, ""
+            if status == 503 and "replication" in body:
+                lag_503_s = round(time.monotonic() - t_kill, 2)
+                break
+            time.sleep(0.2)
+        if lag_503_s is None:
+            violations.append(
+                f"follower /readyz never flipped 503 within "
+                f"{max_lag_s}s bound + margin after the leader died — "
+                "a stale replica kept advertising ready"
+            )
+        else:
+            log(f"repl: follower declared stale {lag_503_s}s after "
+                "the kill")
+        # stale reads still answer, still byte-exact (the checker keeps
+        # scoring 200s through the whole window)
+        checker.stop.set()
+        checker.join(timeout=5)
+
+        # -- failover: the promote runbook ----------------------------
+        t_fail = time.monotonic()
+        follower.send_signal(signal.SIGTERM)
+        follower.wait(timeout=30)
+        p = subprocess.run(
+            [sys.executable, "-m", "annotatedvdb_tpu", "doctor",
+             "promote", "--storeDir", follower_dir, "--json"],
+            env=env, capture_output=True, text=True, timeout=120,
+            cwd=ROOT,
+        )
+        promote_report: dict = {}
+        if p.returncode != 0:
+            violations.append(
+                f"doctor promote rc={p.returncode}: {p.stderr[-300:]}"
+            )
+        else:
+            try:
+                promote_report = json.loads(p.stdout)
+            except ValueError:
+                violations.append(
+                    f"doctor promote: unparseable: {p.stdout[:200]}"
+                )
+        new_leader, nhost, nport, _nerr = _spawn_serve(
+            follower_dir, ["--upserts"], env)
+        wait_healthy(nhost, nport)
+        failover_s = round(time.monotonic() - t_fail, 2)
+        log(f"repl: promoted and serving writable in {failover_s}s "
+            f"(epoch {promote_report.get('epoch')}, "
+            f"{promote_report.get('rows')} tailed row(s) sealed)")
+
+        # -- the ack contract across the failover ---------------------
+        missing, verify_s = verify_acked_upserts(
+            nhost, nport, upserts.acked)
+        if missing:
+            violations.append(
+                f"{missing} of {len(upserts.acked)} ACKNOWLEDGED "
+                "upserts unreadable from the promoted leader — "
+                "acked-upsert loss across failover"
+            )
+        wrong_after = 0
+        for vid, want in reference.items():
+            status, body = get(nhost, nport, f"/variant/{vid}")
+            if status != 200 or body != want:
+                wrong_after += 1
+        if wrong_after:
+            violations.append(
+                f"{wrong_after} reference row(s) wrong/missing on the "
+                "promoted leader"
+            )
+        try:
+            status, _b = post(nhost, nport, "/variants/upsert", {
+                "variants": [{"id": "8:9500001:A:G",
+                              "annotations": {"other_annotation":
+                                              {"post_promote": 1}}}],
+            })
+        except OSError:
+            status = 0
+        write_ok = status == 200
+        if not write_ok:
+            violations.append(
+                f"promoted leader refused a write ({status}) — "
+                "failover never restored write availability"
+            )
+        if checker.wrong_bytes:
+            violations.append(
+                f"{checker.wrong_bytes} WRONG-BYTE follower responses: "
+                f"{checker.mismatches}"
+            )
+        recovered = (not missing and write_ok and not wrong_after
+                     and failover_s <= recovery_window_s)
+        if failover_s > recovery_window_s:
+            violations.append(
+                f"failover took {failover_s}s, over the "
+                f"{recovery_window_s}s window"
+            )
+
+        status_counts = dict(checker.status_counts)
+        requests = sum(status_counts.values()) + checker.transport_errors
+        hard = sum(v for k, v in status_counts.items()
+                   if k.startswith("5") and k not in SHED_STATUSES)
+        error_budget = 0.02
+        hard_rate = hard / max(requests, 1)
+        if hard_rate > error_budget:
+            violations.append(
+                f"follower hard error rate {hard_rate:.4f} over budget "
+                f"{error_budget} (statuses {status_counts})"
+            )
+        record = {
+            "mode": "repl",
+            "workers": 2,  # one leader + one follower process
+            "duration_s": round(duration_s, 1),
+            "requests": int(requests),
+            "ok": int(status_counts.get("200", 0)),
+            "hard_errors": int(hard),
+            "transport_errors": int(checker.transport_errors),
+            "status_counts": status_counts,
+            "wrong_bytes": int(checker.wrong_bytes),
+            "error_rate": round(hard_rate, 5),
+            "error_budget": error_budget,
+            "faults": faults_armed,
+            "recovered": bool(recovered),
+            "recovered_s": failover_s,
+            "recovery_window_s": recovery_window_s,
+            "violations": violations,
+            "upserts": {
+                "acked": len(upserts.acked),
+                "errors": int(upserts.errors),
+                "missing": int(missing),
+                "verify_s": verify_s,
+            },
+            "repl": {
+                "max_lag_s": max_lag_s,
+                "lag_p50_s": _pctl(lag_samples, 0.50),
+                "lag_p99_s": _pctl(lag_samples, 0.99),
+                "ship_bytes": int(ship_bytes),
+                "ship_mb_per_s": round(
+                    ship_bytes / (1024 * 1024) / max(tail_s, 0.001), 3),
+                "records_applied": int(applied),
+                "resyncs": int(resyncs),
+                "stale_503_s": lag_503_s,
+                "failover_s": failover_s,
+                "promote_epoch": promote_report.get("epoch"),
+                "promote_rows": promote_report.get("rows"),
+                "acked_missing": int(missing),
+                "post_promote_write_ok": bool(write_ok),
+            },
+        }
+        return record, violations
+    finally:
+        for proc in (leader, follower, new_leader):
+            if proc is None or proc.poll() is not None:
+                continue
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="chaos/soak certification for the serve stack"
@@ -1131,17 +1504,22 @@ def main(argv=None) -> int:
                              "daemon armed, sustained upserts, "
                              "daemon-driven compaction + the full chaos "
                              "schedule concurrently")
+    parser.add_argument("--repl", action="store_true",
+                        help="~40s replication leg: leader + follower "
+                             "fleets, flaky ship window, SIGKILL the "
+                             "leader mid-ship, doctor promote, zero "
+                             "acked-upsert loss across the failover")
     parser.add_argument("--duration", type=float, default=None,
                         help="load duration in seconds (default: 8 smoke, "
-                             "40 full, 130 soak)")
+                             "40 full, 130 soak, 10 repl)")
     parser.add_argument("--json", default=None, metavar="PATH",
                         help="write the chaos record as JSON to PATH "
                              "('-' = stdout)")
     args = parser.parse_args(argv)
-    if args.smoke and args.soak:
-        parser.error("--smoke and --soak are mutually exclusive")
+    if sum((args.smoke, args.soak, args.repl)) > 1:
+        parser.error("--smoke, --soak and --repl are mutually exclusive")
     try:
-        record, violations = run(args)
+        record, violations = run_repl(args) if args.repl else run(args)
     except Exception as exc:
         log(f"HARNESS ERROR: {type(exc).__name__}: {exc}")
         return 2
@@ -1155,11 +1533,21 @@ def main(argv=None) -> int:
     for v in violations:
         log(f"VIOLATION: {v}")
     if not violations:
-        log(f"{record['mode']}: contract held — {record['ok']} ok / "
-            f"{record['requests']} requests, {record['shed']} shed, "
-            f"{record['hard_errors']} hard, "
-            f"{record['transport_errors']} transport, p99 "
-            f"{record['p99_ms']}ms, recovered in {record['recovered_s']}s")
+        if record["mode"] == "repl":
+            r = record["repl"]
+            log(f"repl: contract held — {record['upserts']['acked']} "
+                f"acked / 0 lost across failover, lag p99 "
+                f"{r['lag_p99_s']}s (bound {r['max_lag_s']}s), stale "
+                f"declared {r['stale_503_s']}s after the kill, "
+                f"promoted + writable in {r['failover_s']}s, "
+                f"{record['ok']} byte-exact follower reads")
+        else:
+            log(f"{record['mode']}: contract held — {record['ok']} ok / "
+                f"{record['requests']} requests, {record['shed']} shed, "
+                f"{record['hard_errors']} hard, "
+                f"{record['transport_errors']} transport, p99 "
+                f"{record['p99_ms']}ms, recovered in "
+                f"{record['recovered_s']}s")
     return 1 if violations else 0
 
 

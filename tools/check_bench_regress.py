@@ -18,11 +18,15 @@ the recorded history is genuinely noisy across machines):
   ``--qps-drop`` x median.
 
 A series needs the newest point plus at least one prior to judge;
-anything thinner is reported as ``thin`` and skipped (exit 0 — a young
-history is not a regression).  Chained into ``tools/run_checks.sh`` and
+anything thinner is reported as ``thin`` and skipped.  Below that, a
+history of fewer than ``MIN_HISTORY`` (3) parseable records — including
+an empty directory — is "insufficient history": the watchdog says so
+and exits 0, because a young repo (or a fresh checkout someone runs the
+checks in before their first bench run) is not a regression and must
+not fail the check chain.  Chained into ``tools/run_checks.sh`` and
 importable by ``doctor``/tests (:func:`evaluate_history`).
 
-Exit codes: 0 = no regression, 1 = regression, 2 = no usable history.
+Exit codes: 0 = no regression (or insufficient history), 1 = regression.
 """
 
 from __future__ import annotations
@@ -42,6 +46,12 @@ DEFAULT_P99_RISE = 2.0
 
 #: prior points the trailing median draws from
 DEFAULT_WINDOW = 5
+
+#: parseable records below which the watchdog declines to judge at all:
+#: a 1- or 2-run history gives the trailing median nothing statistical
+#: to stand on (the median IS the single prior), and an empty directory
+#: is a fresh checkout — both exit 0 with "insufficient history"
+MIN_HISTORY = 3
 
 
 def load_records(bench_dir: str) -> list:
@@ -150,10 +160,16 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__))
     )
     records = load_records(bench_dir)
-    if not records:
-        print(f"check_bench_regress: {bench_dir}: no parseable "
-              "BENCH_r*.json history", file=sys.stderr)
-        return 2
+    if len(records) < MIN_HISTORY:
+        print(f"check_bench_regress: {bench_dir}: insufficient history "
+              f"({len(records)} parseable BENCH_r*.json record(s), "
+              f"need >= {MIN_HISTORY} to judge) — skipping",
+              file=sys.stderr)
+        if args.json:
+            print(json.dumps({"checks": [], "regressions": 0, "thin": 0,
+                              "insufficient_history": len(records)},
+                             indent=1))
+        return 0
     report = evaluate_history(records, window=args.window,
                               qps_drop=args.qps_drop,
                               p99_rise=args.p99_rise)

@@ -25,10 +25,16 @@ wrappers (``{"n_devices", "rc", "ok", ...}``) stay loadable, and
 ``--multichip`` records carry the strict ``multichip`` scaling block
 (``byte_identical`` REQUIRED true at every device count).
 
+``REPL_r*.json`` files (the committed ``chaos_soak.py --repl`` failover
+certifications) validate as raw chaos records with the strict ``repl``
+block: ``acked_missing`` REQUIRED 0, ``recovered`` REQUIRED true, zero
+violations — the same contract the ``serving.replication`` bench block
+carries.
+
 Usage::
 
     python tools/check_bench_schema.py [FILE ...]   # default:
-                                        # BENCH_*.json + MULTICHIP_*.json
+                          # BENCH_*.json + MULTICHIP_*.json + REPL_*.json
 """
 
 from __future__ import annotations
@@ -223,6 +229,9 @@ def _check_serving(sv, where: str, errors: list) -> None:
     if "chaos" in sv and isinstance(sv["chaos"], dict) \
             and "error" not in sv["chaos"]:
         _check_chaos(sv["chaos"], w, errors)
+    if "replication" in sv and isinstance(sv["replication"], dict) \
+            and "error" not in sv["replication"]:
+        _check_replication(sv["replication"], w, errors)
 
 
 def _check_mixed_workload(mx: dict, where: str, errors: list) -> None:
@@ -486,6 +495,15 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
                     f"{w}.flight.parse_failures: harvested flight "
                     "file(s) failed to parse"
                 )
+    if "repl" in ch:
+        # the replica-fleet leg (--repl): kill-the-leader failover —
+        # acked_missing REQUIRED 0 and write availability REQUIRED
+        # restored (the acked_missing precedent: a record showing
+        # replication losing acknowledged writes is a broken build)
+        if not isinstance(ch["repl"], dict):
+            errors.append(f"{w}.repl: must be an object")
+        else:
+            _check_repl_block(ch["repl"], f"{w}.repl", errors)
     if "maintain" in ch:
         # the long-autonomy soak's daemon observables (--soak only):
         # daemon-driven passes, >= 1 brownout pause, and convergence
@@ -508,6 +526,61 @@ def _check_chaos(ch: dict, where: str, errors: list) -> None:
                     f"{w}.maintain.converged: read-amp never returned "
                     "below the low watermark — autonomy is broken"
                 )
+
+
+def _check_repl_block(rp: dict, w: str, errors: list) -> None:
+    """The shared replication-evidence shape: the ``repl`` sub-block of
+    a ``--repl`` chaos record AND the ``serving.replication`` bench
+    block validate against the same contract — ship throughput, the
+    sampled lag distribution, failover-to-ready seconds, and the two
+    hard verdicts (``acked_missing`` REQUIRED 0,
+    ``post_promote_write_ok`` REQUIRED true when present)."""
+    _check_fields(
+        rp,
+        {
+            "max_lag_s": _is_num, "lag_p50_s": _is_num,
+            "lag_p99_s": _is_num, "ship_bytes": _is_int,
+            "ship_mb_per_s": _is_num, "records_applied": _is_int,
+            "resyncs": _is_int,
+            "stale_503_s": lambda v: v is None or _is_num(v),
+            "failover_s": _is_num, "acked": _is_int,
+            "acked_missing": _is_int,
+            "promote_epoch": lambda v: v is None or _is_int(v),
+            "promote_rows": lambda v: v is None or _is_int(v),
+            "post_promote_write_ok": lambda v: isinstance(v, bool),
+            "wrong_bytes": _is_int,
+            "violations": lambda v: isinstance(v, list),
+        },
+        w, errors,
+        required=("ship_mb_per_s", "lag_p50_s", "lag_p99_s",
+                  "failover_s", "acked_missing"),
+    )
+    if _is_int(rp.get("acked_missing")) and rp["acked_missing"] != 0:
+        errors.append(
+            f"{w}.acked_missing: {rp['acked_missing']} acknowledged "
+            "upsert(s) lost across the failover — the replication ack "
+            "contract is broken"
+        )
+    if rp.get("post_promote_write_ok") is False:
+        errors.append(
+            f"{w}.post_promote_write_ok: the promoted leader never "
+            "restored write availability"
+        )
+    if _is_num(rp.get("lag_p50_s")) and _is_num(rp.get("lag_p99_s")) \
+            and rp["lag_p99_s"] < rp["lag_p50_s"]:
+        errors.append(f"{w}: lag_p99_s below lag_p50_s")
+    if _is_int(rp.get("wrong_bytes")) and rp["wrong_bytes"]:
+        errors.append(
+            f"{w}.wrong_bytes: follower reads diverged from the "
+            "leader's bytes"
+        )
+
+
+def _check_replication(rp: dict, where: str, errors: list) -> None:
+    """The ``serving.replication`` bench block: the ``--repl`` chaos
+    leg's evidence reshaped for the bench record (``bench.py --serve``),
+    same contract as the committed ``REPL_r*.json`` records."""
+    _check_repl_block(rp, f"{where}.replication", errors)
 
 
 def _check_compaction(cp: dict, where: str, errors: list) -> None:
@@ -925,6 +998,18 @@ def validate_file(path: str) -> list[str]:
     if "n_devices" in obj and "parsed" not in obj:
         # historic MULTICHIP_r01–r05 dryrun wrappers
         return _check_multichip_dryrun(obj, name)
+    if obj.get("mode") == "repl" and "repl" in obj:
+        # committed REPL_r*.json: the raw --repl chaos record from
+        # tools/chaos_soak.py (the kill-the-leader certification)
+        errors: list[str] = []
+        _check_chaos(obj, name, errors)
+        if obj.get("recovered") is not True:
+            errors.append(f"{name}: recovered must be true — the "
+                          "failover never completed")
+        if obj.get("violations"):
+            errors.append(f"{name}: committed repl record carries "
+                          f"violations: {obj['violations']}")
+        return errors
     if "parsed" in obj or "rc" in obj:  # driver wrapper
         errors: list[str] = []
         if obj.get("rc") == 0 and not isinstance(obj.get("parsed"), dict):
@@ -943,6 +1028,7 @@ def main(argv=None) -> int:
     paths = argv or sorted(
         glob.glob(os.path.join(root, "BENCH_*.json"))
         + glob.glob(os.path.join(root, "MULTICHIP_*.json"))
+        + glob.glob(os.path.join(root, "REPL_*.json"))
     )
     if not paths:
         print("no BENCH_*.json files found", file=sys.stderr)

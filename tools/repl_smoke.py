@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Replica-fleet smoke: bootstrap -> tail -> kill the leader -> promote.
+
+A ~20 second cut of the chaos harness's ``--repl`` certification leg
+(:func:`chaos_soak.run_repl` with a short write window), sized for the
+check chain: a leader takes WAL-durable upserts while a ``serve
+--follow`` replica bootstraps its snapshot cut and tails the ship
+stream (flaky by injection for part of the window); the harness
+byte-verifies follower reads against the leader, SIGKILLs the leader
+mid-ship, watches the follower's ``/readyz`` flip 503 past the declared
+staleness bound, runs the ``doctor promote`` runbook, and holds the
+promoted store to the WAL ack's contract — every ACKNOWLEDGED upsert
+readable (``acked_missing`` MUST be 0), every pre-chaos sample
+byte-identical, writes accepted again.
+
+The full leg (longer window, committed ``REPL_r*.json`` record) stays
+in ``tools/chaos_soak.py --repl``; this wrapper exists so every
+``run_checks.sh`` pass exercises the failover path without the soak
+budget.
+
+Part of ``tools/run_checks.sh``.  Exit codes: 0 clean, 1 smoke failure,
+2 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# pin CPU before anything imports jax (same discipline as the other
+# smokes — the harness spawns real `serve` subprocesses)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: write-window seconds handed to the repl leg (the leg adds bootstrap,
+#: catch-up, kill detection, and promote on top — ~20 s wall total)
+DURATION_S = 6.0
+
+
+def main() -> int:
+    import chaos_soak
+
+    try:
+        record, violations = chaos_soak.run_repl(
+            argparse.Namespace(duration=DURATION_S)
+        )
+    except Exception as exc:
+        print(f"repl_smoke: internal error: {exc!r}", file=sys.stderr)
+        return 2
+    rp = record.get("repl") or {}
+    ups = record.get("upserts") or {}
+    if violations or not record.get("recovered"):
+        for v in violations or ["leg did not report recovered"]:
+            print(f"repl_smoke FAIL {v}", file=sys.stderr)
+        print(f"repl_smoke: record {json.dumps(record)[:600]}",
+              file=sys.stderr)
+        return 1
+    print(
+        f"repl_smoke: ok ({ups.get('acked', 0)} acked / "
+        f"{rp.get('acked_missing', 0)} lost across failover, "
+        f"lag p99 {rp.get('lag_p99_s')}s, "
+        f"stale 503 in {rp.get('stale_503_s')}s, "
+        f"promoted + writable in {rp.get('failover_s')}s)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,13 @@
 #                    /_chaos delay lever walks the point-read p99 SLO
 #                    ok -> pending -> firing, the lever disarms, and the
 #                    alert resolves through the clear-tick hysteresis
-#  12. check_bench_regress — the newest committed BENCH record's
+#                    (plus the replication_lag gauge-ceiling walk)
+#  12. repl_smoke   — the replica fleet: a follower bootstraps from the
+#                    leader's snapshot cut, tails the WAL ship stream
+#                    under injected flakiness, the leader is SIGKILLed,
+#                    `doctor promote` fails over, and every acknowledged
+#                    upsert answers byte-identical from the new leader
+#  13. check_bench_regress — the newest committed BENCH record's
 #                    headlines (serving qps/p99, load variants/sec)
 #                    against the trailing median of their own history
 #
@@ -92,6 +98,9 @@ python "$root/tools/chaos_soak.py" --smoke || rc=1
 
 echo "== slo smoke ==" >&2
 python "$root/tools/slo_smoke.py" || rc=1
+
+echo "== repl smoke ==" >&2
+python "$root/tools/repl_smoke.py" || rc=1
 
 echo "== bench regression watchdog ==" >&2
 python "$root/tools/check_bench_regress.py" || rc=1

@@ -12,7 +12,13 @@ walks the alert lifecycle the way an operator would see it:
    ``/alerts``, ``/healthz`` and the ``avdb_slo_burn_rate`` /
    ``avdb_alerts_firing`` Prometheus series);
 3. load removed — the lever disarms, the windows drain, and the alert
-   resolves after the clear-tick hysteresis.
+   resolves after the clear-tick hysteresis;
+4. replication-lag breach — the ``avdb_replication_lag_seconds`` gauge
+   (the signal a ``serve --follow`` tailer exports; driven directly
+   here, the tailer itself is certified by ``tools/repl_smoke.py`` and
+   the chaos ``--repl`` leg) jumps past the smoke's 1 s ceiling, the
+   ``replication_lag`` gauge-ceiling SLO fires, and catching back up
+   resolves it.
 
 The latency SLO target is pinned via an explicit spec (50 ms) instead of
 ``AVDB_SERVE_BROWNOUT_P99_MS`` so the smoke never races the brownout
@@ -63,6 +69,10 @@ SLOW_S = 2.0
 
 FIRE_DEADLINE_S = 10.0
 RESOLVE_DEADLINE_S = 14.0
+
+#: replication-lag ceiling the smoke's gauge-ceiling SLO judges against
+#: (seconds) — tiny so the induced 30 s lag is unambiguously a breach
+LAG_CEILING_S = 1.0
 
 
 def _get(port: int, path: str):
@@ -147,6 +157,12 @@ def main() -> int:
                 metric="avdb_query_seconds", labels={"kind": "point"},
                 target_s=TARGET_S, objective=0.99,
             ),
+            SloSpec(
+                "replication_lag", "gauge_ceiling",
+                "follower staleness vs the smoke's pinned 1 s ceiling",
+                metric="avdb_replication_lag_seconds",
+                ceiling=LAG_CEILING_S, objective=0.9,
+            ),
         ]
         health = HealthPlane(
             registry, store_dir=store_dir, worker=0, specs=specs,
@@ -217,6 +233,31 @@ def main() -> int:
         check("fired_total recorded", row.get("fired_total", 0) >= 1,
               json.dumps(row))
 
+        # -- phase 4: replication-lag breach -> fire -> catch up --------
+        # declared but silent until the gauge exists (no follower here)
+        row = _alert(port, "replication_lag")
+        check("lag slo declared dormant", row.get("state") == "ok"
+              and row.get("burn_fast") is None, json.dumps(row))
+        lag_gauge = registry.gauge(
+            "avdb_replication_lag_seconds",
+            "seconds since this follower last held the leader's "
+            "full stable WAL/ledger stream",
+        )
+        lag_gauge.set(30.0)  # follower stuck far past the 1 s ceiling
+        row = _await_state(
+            port, "replication_lag", ("firing",), FIRE_DEADLINE_S
+        )
+        check("lag alert fired", row.get("state") == "firing",
+              json.dumps(row))
+        check("lag ceiling carried", row.get("ceiling") == LAG_CEILING_S,
+              json.dumps(row))
+        lag_gauge.set(0.05)  # caught back up: the windows drain
+        row = _await_state(
+            port, "replication_lag", ("resolved",), RESOLVE_DEADLINE_S
+        )
+        check("lag alert resolved", row.get("state") == "resolved",
+              json.dumps(row))
+
         # the history ring recorded the whole episode
         status, body = _get(port, "/metrics/history")
         rec = json.loads(body) if status == 200 else {}
@@ -245,7 +286,8 @@ def main() -> int:
             print(f"slo_smoke FAIL {f}", file=sys.stderr)
         return 1
     print("slo_smoke: ok (point_read_p99 walked ok -> firing -> resolved "
-          "under the /_chaos delay lever)", file=sys.stderr)
+          "under the /_chaos delay lever; replication_lag fired on the "
+          "induced lag breach and resolved on catch-up)", file=sys.stderr)
     return 0
 
 
