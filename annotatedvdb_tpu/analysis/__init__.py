@@ -17,7 +17,7 @@ AVDB4xx     env-var drift: ``AVDB_*`` reads vs ``config.ENV_VARS`` vs
 AVDB5xx     CLI-contract: the six loader CLIs' shared flag set
             (``rules_cli``)
 AVDB6xx     hygiene: bare except, silent Exception-pass, mutable default
-            args (``rules_hygiene``)
+            args, stale noqa suppressions (``rules_hygiene``)
 AVDB7xx     async-safety: blocking calls on the event loop, await under a
             sync lock (``rules_async``)
 AVDB8xx     cross-front-end parity: duplicated response literals /
@@ -25,6 +25,10 @@ AVDB8xx     cross-front-end parity: duplicated response literals /
             ``serve/http.py`` and ``serve/aio.py`` (``rules_parity``)
 AVDB9xx     device/host twin contract: jitted ``ops/`` kernels vs the
             ``ops.TWINS`` registry and its parity tests (``rules_twins``)
+AVDB10xx    durability protocol: fsync-before-rename, tmp-family
+            attribution vs ``store/fsck.py`` and the corrupt_store
+            fixtures, manifest-commit crash points, WAL/HTTP ack
+            ordering (``rules_durability``)
 ==========  ============================================================
 
 Entry point: ``python tools/avdb_check.py [--json] [--diff REV]
@@ -35,7 +39,11 @@ with ``# avdb: noqa[CODE] -- reason``.
 The package also carries the DYNAMIC half of the suite:
 ``analysis/lockorder`` — the lock-order/deadlock detector behind
 ``AVDB_LOCK_TRACE=1`` (see ``utils.locks.make_lock``): per-thread
-acquisition-order graph, cycle detection, held-duration histograms.
+acquisition-order graph, cycle detection, held-duration histograms —
+and ``analysis/iotrace`` — the crash-consistency sanitizer behind
+``AVDB_IO_TRACE=1`` (see ``utils.io``): a happens-before recorder over
+the store's durable I/O flagging rename-before-fsync, unlinks of
+manifest-referenced files, and missing directory fsyncs.
 """
 
 from annotatedvdb_tpu.analysis.core import (  # noqa: F401 (public API)

@@ -31,8 +31,10 @@ repo's tests run.
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
 
 #: directories never analyzed, by bare name (__pycache__/.git are noise)
@@ -78,27 +80,44 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
-        #: {line_number: set of suppressed codes} — empty set = all codes
+        #: {line_number: set of suppressed codes} — None = all codes.
+        #: Collected from COMMENT tokens only (not raw line scans): a noqa
+        #: spelled inside a docstring or string literal — this module's own
+        #: docstring, the analyzer's fixture strings — is prose, not a
+        #: suppression, and must neither suppress nor trip the AVDB604
+        #: stale-suppression audit.
         self.noqa: dict[int, set[str] | None] = {}
-        for i, line in enumerate(self.lines, start=1):
-            if "avdb" not in line or "noqa" not in line:
-                continue
-            m = _NOQA_RE.search(line)
-            if not m:
-                continue
-            codes = m.group("codes")
-            if codes:
-                self.noqa[i] = {
-                    c.strip().upper() for c in codes.split(",") if c.strip()
-                }
-            else:
-                self.noqa[i] = None  # blanket: every code
+        if "noqa" in source:
+            try:
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline
+                ):
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _NOQA_RE.search(tok.string)
+                    if not m:
+                        continue
+                    codes = m.group("codes")
+                    if codes:
+                        self.noqa[tok.start[0]] = {
+                            c.strip().upper()
+                            for c in codes.split(",") if c.strip()
+                        }
+                    else:
+                        self.noqa[tok.start[0]] = None  # blanket: every code
+            except (tokenize.TokenError, IndentationError):
+                pass  # unparseable tail: ast.parse above already raised
 
     def suppressed(self, line: int, code: str) -> bool:
         if line not in self.noqa:
             return False
         codes = self.noqa[line]
-        return codes is None or code in codes
+        if codes is None:
+            # A blanket noqa covers every code EXCEPT the stale-suppression
+            # audit: a suppression must not self-certify.  Silencing a
+            # deliberate AVDB604 fixture takes an explicit [AVDB604].
+            return code != "AVDB604"
+        return code in codes
 
 
 @dataclass
@@ -139,6 +158,18 @@ class ProjectFacts:
     twins_scan: bool = False
     #: the scanned ops/__init__.py path (registry findings anchor there)
     twins_registry_path: str = ""
+    #: True when store/fsck.py was scanned: only then are the tmp-family
+    #: cross-reference codes (AVDB1002/1003) decidable — a --diff subset
+    #: must not judge the attribution table it did not scan
+    fsck_scan: bool = False
+    #: fsck finding-code literals collected from store/fsck.py's note()
+    #: calls ("flush-tmp", "compact-tmp", ...)
+    fsck_codes: set = field(default_factory=set)
+    #: the scanned store/fsck.py path (cross-reference findings anchor)
+    fsck_path: str = ""
+    #: [(path, line, family)] — writer tmp-suffix families discovered in
+    #: store/ string literals (".flush.tmp" -> "flush")
+    tmp_suffixes: list = field(default_factory=list)
 
 
 @dataclass
@@ -306,6 +337,7 @@ def run_paths(paths, root: str | None = None,
     from annotatedvdb_tpu.analysis import (
         rules_async,
         rules_cli,
+        rules_durability,
         rules_env,
         rules_hygiene,
         rules_locks,
@@ -334,6 +366,7 @@ def run_paths(paths, root: str | None = None,
         rules_locks.check,
         rules_hygiene.check,
         rules_async.check,
+        rules_durability.check,
     )
     collectors = (
         rules_registry.collect,
@@ -341,6 +374,7 @@ def run_paths(paths, root: str | None = None,
         rules_cli.collect,
         rules_parity.collect,
         rules_twins.collect,
+        rules_durability.collect,
     )
     finalizers = (
         rules_registry.finalize,
@@ -348,8 +382,10 @@ def run_paths(paths, root: str | None = None,
         rules_cli.finalize,
         rules_parity.finalize,
         rules_twins.finalize,
+        rules_durability.finalize,
     )
 
+    scanned: list[tuple[str, FileContext]] = []
     for path in files:
         source = _read(path)
         try:
@@ -361,14 +397,26 @@ def run_paths(paths, root: str | None = None,
                 "fix the syntax error (nothing else was checked here)",
             ))
             continue
+        scanned.append((path, ctx))
         for rule in per_file:
             findings.extend(rule(ctx))
         for coll in collectors:
             coll(ctx, facts, project)
     if not audit:
-        facts.twins_scan = False  # collectors set it; --diff disables
+        facts.twins_scan = False  # collectors set them; --diff disables
+        facts.fsck_scan = False
     for fin in finalizers:
         findings.extend(fin(facts, project))
+
+    # AVDB604 — stale-suppression audit: runs against the findings that
+    # WOULD fire (pre-suppression), so it sees exactly what each noqa
+    # comment is suppressing.  Tree-gated like the other whole-project
+    # audits: on a --diff subset, a noqa for a cross-file code is not
+    # decidable (its code may fire only on a full scan).
+    if facts.tree_scan:
+        findings.extend(
+            rules_hygiene.audit_noqa(scanned, findings, root)
+        )
 
     # apply per-line suppressions.  Project-level findings carry
     # repo-RELATIVE paths (e.g. "annotatedvdb_tpu/config.py") while the
@@ -381,11 +429,14 @@ def run_paths(paths, root: str | None = None,
     }
     kept: list[Finding] = []
     for f in findings:
-        abs_path = (
-            f.path if os.path.isabs(f.path)
-            else os.path.join(root, f.path)
-        )
-        abs_path = os.path.abspath(abs_path)
+        # per-file findings carry the SCAN path verbatim (a facts.contexts
+        # key, possibly cwd-relative); project-level findings carry
+        # root-RELATIVE paths.  Try the scan path first, then anchor on
+        # root — `avdb_check fixture_tree --root fixture_tree` from the
+        # repo root must resolve both kinds.
+        abs_path = os.path.abspath(f.path)
+        if abs_path not in ctx_by_abs and not os.path.isabs(f.path):
+            abs_path = os.path.abspath(os.path.join(root, f.path))
         if abs_path not in ctx_by_abs:
             try:
                 ctx_by_abs[abs_path] = (

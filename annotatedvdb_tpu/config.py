@@ -213,6 +213,13 @@ ENV_VARS: dict = {
                        "(analysis/lockorder), cycles are potential "
                        "deadlocks, held time exports as "
                        "avdb_lock_held_seconds",
+    "AVDB_IO_TRACE": "1 arms the crash-consistency sanitizer: store-path "
+                     "open/write/fsync/rename/unlink route through "
+                     "recording wrappers (utils/io) feeding a happens-"
+                     "before recorder (analysis/iotrace) that flags "
+                     "rename-before-fsync, unlink of a manifest-"
+                     "referenced file, and missing directory fsync "
+                     "after a manifest replace under AVDB_FSYNC=1",
     "AVDB_TRACE_SAMPLE": "fraction of requests recording per-stage span "
                          "breakdowns into the span ring + "
                          "avdb_stage_seconds (default 1.0; 0 disarms "

@@ -83,6 +83,7 @@ from annotatedvdb_tpu.store.variant_store import (
     sidecar_line,
 )
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
 from annotatedvdb_tpu.utils.pipeline import BoundedStage
 
 #: compaction temp suffixes — a distinct namespace from save()'s dot-prefixed
@@ -509,7 +510,7 @@ def _merge_label_to_temp(store_dir: str, label: str, glist: list,
     fsync_data = _fsync_wanted()
     stage = BoundedStage(payload(), depth=4, name=f"compact-{label}")
     try:
-        with open(tmp_npz, "wb", buffering=1 << 20) as raw_f:
+        with tio.open(tmp_npz, "wb", buffering=1 << 20) as raw_f:
             f = _CrcWriter(raw_f)
             first = True
             for blob in stage:
@@ -522,14 +523,14 @@ def _merge_label_to_temp(store_dir: str, label: str, glist: list,
                     first = False
             if fsync_data:
                 f.flush()
-                os.fsync(f.fileno())
+                tio.fsync(raw_f)
             npz_rec = {"bytes": f.nbytes, "crc32": f.crc}
     finally:
         stage.close()
 
     present = [c for c in OBJECT_COLUMNS
                if any(p.obj[c] is not None for p in parts)]
-    with open(tmp_jsonl, "wb") as raw_f:
+    with tio.open(tmp_jsonl, "wb") as raw_f:
         f = _CrcWriter(raw_f)
         if present and n_out:
             # zlib-compressed JSONB sidecar: the reader sniffs the leading
@@ -555,7 +556,7 @@ def _merge_label_to_temp(store_dir: str, label: str, glist: list,
             f.write(comp.flush())
         if fsync_data:
             f.flush()
-            os.fsync(f.fileno())
+            tio.fsync(raw_f)
         jsonl_rec = {"bytes": f.nbytes, "crc32": f.crc}
     return {
         "npz": npz_rec, "jsonl": jsonl_rec,
@@ -651,7 +652,7 @@ def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
                     "run `doctor --repair` to audit the store")
                 continue
             try:
-                os.remove(fp)
+                tio.unlink(fp)
             except OSError:
                 pass  # fsck prunes leftovers (compact-tmp / orphan findings)
 
@@ -714,7 +715,7 @@ def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
                     raise _Preempted(
                         "a loader committed a new generation mid-pass"
                     )
-                os.replace(src, dst)
+                tio.replace(src, dst)
                 created.remove(src)
                 created.append(dst)
                 finals.append(dst)
@@ -761,21 +762,10 @@ def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
             stats["segments"][label] = 1
         new_manifest["stats"] = stats
 
-        mtmp = os.path.join(store_dir, f".manifest.tmp{os.getpid()}")
-        with open(mtmp, "w") as f:
-            json.dump(new_manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(mtmp, mpath)
-        if _fsync_wanted():
-            # power-loss opt-in (save() parity): commit the rename
-            # METADATA — the new segments' renames and the manifest swap
-            # all live in this one directory
-            dfd = os.open(store_dir, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+        # tmp -> fsync -> atomic replace; under AVDB_FSYNC (save() parity)
+        # also commits the rename METADATA — the new segments' renames and
+        # the manifest swap all live in replace_manifest's one directory
+        tio.replace_manifest(mpath, new_manifest)
         committed = True
         for fp in finals:
             created.remove(fp)
@@ -794,7 +784,7 @@ def compact_store(store_dir: str, *, groups=None, max_bytes: int | None = None,
                         fp = os.path.join(store_dir, stem + ext)
                         try:
                             size = os.path.getsize(fp)
-                            os.remove(fp)
+                            tio.unlink(fp)
                             bytes_reclaimed += size
                         except FileNotFoundError:
                             pass

@@ -29,6 +29,9 @@ import json
 import os
 import re
 
+from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
+
 SEGMENT_RE = re.compile(
     r"^chr(?P<label>[0-9A-Za-z_]+)\.(?P<sid>\d{6})\.(npz|ann\.jsonl)$"
 )
@@ -215,7 +218,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
             note("warn", "stale-tmp",
                  f"{fp}: leftover tmp file from a crashed save")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp}")
             continue
         if is_repl_tmp(fname):
@@ -228,7 +231,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                  "a killed ship transfer; re-run bootstrap (serve "
                  "--follow) to refetch it")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp} (bootstrap refetches it)")
             continue
         if is_repl_cursor(fname):
@@ -241,7 +244,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                  "store was (or is) a follower; re-run bootstrap (serve "
                  "--follow) to resume, or promote to seal it as a leader")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp} (re-run bootstrap to rebuild it)")
             continue
         if is_wal_tmp(fname):
@@ -251,7 +254,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                  f"{fp}: abandoned write-ahead-log rotation temp from a "
                  "killed memtable flush (nothing in it was acknowledged)")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp}")
             continue
         if is_wal_file(fname):
@@ -267,7 +270,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                  "--repair prunes it (unflushed acknowledged upserts in "
                  "it are LOST)")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp} (unreplayed upserts dropped)")
             continue
         if is_flush_tmp(fname):
@@ -278,7 +281,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                  f"{fp}: abandoned memtable-flush temp from a killed "
                  "flush pass (the WAL still covers its rows)")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp}")
             continue
         if is_compact_tmp(fname):
@@ -289,7 +292,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                  f"{fp}: abandoned compaction temp from a killed "
                  "`doctor compact` pass")
             if repair:
-                os.remove(fp)
+                tio.unlink(fp)
                 did(f"removed {fp}")
             continue
         m = SEGMENT_RE.match(fname)
@@ -302,7 +305,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                      "(a checkpoint that never committed, or another "
                      "store's leavings)")
                 if repair:
-                    os.remove(fp)
+                    tio.unlink(fp)
                     did(f"removed {fp}")
             continue
         if fname.endswith(".npz") or fname.endswith(".ann.jsonl"):
@@ -329,12 +332,15 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
             else:
                 del manifest["shards"][label]
         manifest["format"] = 3  # every surviving shard was normalized above
-        tmp = os.path.join(store_dir, f".manifest.tmp{os.getpid()}")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, mpath)
+        # crash point: the rolled-back manifest is staged, not committed —
+        # a death here leaves the damaged-but-diagnosed store for the next
+        # fsck run to repair again (repair is idempotent).  replace_manifest
+        # also fsyncs the directory under AVDB_FSYNC: a repair that doesn't
+        # survive power loss would resurrect the damage it just rolled back.
+        tio.replace_manifest(
+            mpath, manifest,
+            pre_sync=lambda f: faults.fire("fsck.repair", f),
+        )
         did(f"dropped damaged backing group(s): {', '.join(dropped)} "
             "(shard rolled back to its last consistent rows)")
         # canonicalize: a load+save round trip revalidates backing-group

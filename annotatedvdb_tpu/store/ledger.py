@@ -20,6 +20,7 @@ import threading
 import time
 
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
 
 
 class AlgorithmLedger:
@@ -81,15 +82,15 @@ class AlgorithmLedger:
                 faults.fire("ledger.append")
                 d, base = os.path.split(self.path)
                 tmp = os.path.join(d, f".{base}.tmp{os.getpid()}")
-                with open(tmp, "w") as out:
+                with tio.open(tmp, "w") as out:
                     for e in self._entries:
                         out.write(json.dumps(e) + "\n")
                     out.flush()
-                    os.fsync(out.fileno())
-                os.replace(tmp, self.path)
+                    tio.fsync(out)
+                tio.replace(tmp, self.path)
                 self._heal_before_append = False
                 return
-            with open(self.path, "a") as f:
+            with tio.open(self.path, "a") as f:
                 line = json.dumps(entry) + "\n"
                 # crash point, BEFORE the write: raise/kill model a death in
                 # which this record never landed; torn_write writes half the
@@ -97,15 +98,13 @@ class AlgorithmLedger:
                 # tolerant open-scan above recovers from)
                 faults.fire("ledger.append", f, payload=line)
                 f.write(line)
-                from annotatedvdb_tpu.store.variant_store import _fsync_wanted
-
-                if _fsync_wanted():
+                if tio.fsync_wanted():
                     # power-loss opt-in: make the cursor promptly durable.
                     # (Safety never depends on this — the store's fsync'd
                     # renames complete BEFORE this append is written, so the
                     # cursor can lag the store but never lead it.)
                     f.flush()
-                    os.fsync(f.fileno())
+                    tio.fsync(f)
 
     def begin(self, script: str, params: dict, commit: bool) -> int:
         """Register a load; returns the new algorithm-invocation id (serial)."""

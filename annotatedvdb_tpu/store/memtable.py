@@ -44,11 +44,11 @@ from annotatedvdb_tpu.store.variant_store import (
     ChromosomeShard,
     Segment,
     VariantStore,
-    _fsync_wanted,
 )
 from annotatedvdb_tpu.store.wal import WriteAheadLog
 from annotatedvdb_tpu.types import chromosome_label
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
 from annotatedvdb_tpu.utils.locks import make_lock
 
 #: flush temp suffix — final segment files land as
@@ -684,7 +684,7 @@ def flush_segments(store_dir: str, merged: dict[int, Segment],
                     "place — run `doctor --repair` to audit the store")
                 continue
             try:
-                os.remove(fp)
+                tio.unlink(fp)
             except OSError:
                 pass  # fsck prunes leftovers (flush-tmp / orphan findings)
 
@@ -718,7 +718,7 @@ def flush_segments(store_dir: str, merged: dict[int, Segment],
                         "another writer committed a new generation mid-flush"
                     )
                 try:
-                    os.replace(src, dst)
+                    tio.replace(src, dst)
                 except FileNotFoundError:
                     # a racing loader's save() cleanup pruned our temp as
                     # an orphan — its commit owns the manifest now
@@ -759,26 +759,18 @@ def flush_segments(store_dir: str, merged: dict[int, Segment],
             stats["rows"][label] = int(stats["rows"].get(label, 0)) + n
         new_manifest["stats"] = stats
 
-        mtmp = os.path.join(store_dir, f".manifest.tmp{os.getpid()}")
-        with open(mtmp, "w") as f:
-            json.dump(new_manifest, f)
-            f.flush()
-            # crash point #2: the new manifest tmp is written, the atomic
-            # replace has not happened — a death here leaves the OLD
-            # manifest serving (final-named segments are prunable orphans,
-            # the WAL still covers every row); torn_write tears the tmp
-            faults.fire("memtable.flush", f)
-            os.fsync(f.fileno())
-        os.replace(mtmp, mpath)
-        if _fsync_wanted():
-            # power-loss opt-in (save()/compact parity): commit the rename
-            # metadata — segment renames and the manifest swap share this
-            # one directory
-            dfd = os.open(store_dir, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+        # crash point #2 fires via pre_sync: the new manifest tmp is
+        # written, the atomic replace has not happened — a death here
+        # leaves the OLD manifest serving (final-named segments are
+        # prunable orphans, the WAL still covers every row); torn_write
+        # tears the tmp.  replace_manifest then fsyncs, atomically
+        # replaces, and (AVDB_FSYNC opt-in, save()/compact parity)
+        # commits the rename metadata — segment renames and the manifest
+        # swap share its one directory fsync.
+        tio.replace_manifest(
+            mpath, new_manifest,
+            pre_sync=lambda f: faults.fire("memtable.flush", f),
+        )
         committed = True
         nbytes = sum(
             os.path.getsize(os.path.join(

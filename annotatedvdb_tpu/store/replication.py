@@ -75,6 +75,7 @@ from annotatedvdb_tpu.store.wal import (
     is_wal_file,
 )
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
 
 #: in-flight bootstrap chunk temp suffix — a distinct namespace (like
 #: ``*.flush.tmp*``) so fsck attributes a killed bootstrap's debris
@@ -374,11 +375,11 @@ def _atomic_write(path: str, blob: bytes) -> None:
         os.path.dirname(path),
         f".{os.path.basename(path)}.tmp{os.getpid()}",
     )
-    with open(tmp, "wb") as f:
+    with tio.open(tmp, "wb") as f:
         f.write(blob)
         f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+        tio.fsync(f)
+    tio.replace(tmp, path)
 
 
 class ReplicaTailer:
@@ -497,7 +498,7 @@ class ReplicaTailer:
         (and CRC when given) at the end — a mismatch means the leader
         committed mid-sync; the cycle retries with a fresh cut."""
         got = 0
-        with open(dest_tmp, "wb") as f:
+        with tio.open(dest_tmp, "wb") as f:
             while got < total:
                 blob = self._fetch_range(
                     route, name, got, min(self.chunk_bytes, total - got)
@@ -512,7 +513,7 @@ class ReplicaTailer:
                 f.write(blob)
                 got += len(blob)
             f.flush()
-            os.fsync(f.fileno())
+            tio.fsync(f)
         if got != total:
             raise ReplError(
                 f"{name}: short ship ({got} of {total} bytes); "
@@ -584,7 +585,7 @@ class ReplicaTailer:
                 tmp = path + REPL_TMP_SUFFIX
                 self._fetch_file("/repl/segment", name, rec["bytes"],
                                  rec["crc32"], tmp)
-                os.replace(tmp, path)
+                tio.replace(tmp, path)
                 fetched += 1
         # crash point: every segment landed, the manifest mirror has not
         # — a kill here resumes cleanly (segments verify, manifest
@@ -596,7 +597,11 @@ class ReplicaTailer:
         self._sync_ledger(doc)
         blob = json.dumps(manifest, separators=(",", ":")).encode()
         if self.persist:
-            _atomic_write(
+            # replace_manifest rather than the plain cursor writer: the
+            # manifest mirror is a real commit point, so under AVDB_FSYNC
+            # its rename metadata must be made durable too (the segment
+            # renames above share the one directory fsync)
+            tio.replace_manifest(
                 os.path.join(self.store_dir, "manifest.json"), blob
             )
             self._write_cursor()
@@ -622,12 +627,12 @@ class ReplicaTailer:
                                  total - have)
         if not blob:
             return
-        with open(path, "r+b" if os.path.exists(path) else "wb") as f:
+        with tio.open(path, "r+b" if os.path.exists(path) else "wb") as f:
             f.seek(have)
             f.truncate()
             f.write(blob)
             f.flush()
-            os.fsync(f.fileno())
+            tio.fsync(f)
 
     # -- tail -----------------------------------------------------------------
 
@@ -706,7 +711,7 @@ class ReplicaTailer:
                 for fname in list(wal_files(self.store_dir)):
                     if fname not in live:
                         try:
-                            os.remove(
+                            tio.unlink(
                                 os.path.join(self.store_dir, fname)
                             )
                         except OSError:
@@ -731,7 +736,7 @@ class ReplicaTailer:
                 continue
             path = os.path.join(self.store_dir, fname)
             if self.persist:
-                with open(path, "ab") as f:
+                with tio.open(path, "ab") as f:
                     if f.tell() != have:
                         # mirror drifted (manual edit, lost truncate):
                         # rebuild this stream from scratch next cycle
@@ -744,7 +749,7 @@ class ReplicaTailer:
                                 tear_base=have)
                     f.write(blob)
                     f.flush()
-                    os.fsync(f.fileno())
+                    tio.fsync(f)
                 records = read_wal_records(path, have, have + len(blob))
             else:
                 records = _parse_frames(blob, skip_header=(have == 0))
@@ -896,7 +901,7 @@ def promote(store_dir: str, log=None) -> dict:
         # (a fresh leader starts a fresh WAL interval)
         for fname in wal_files(store_dir):
             try:
-                os.remove(os.path.join(store_dir, fname))
+                tio.unlink(os.path.join(store_dir, fname))
             except OSError:
                 pass
     # fencing epoch commit: one atomic manifest replace.  Any writer that
@@ -908,25 +913,24 @@ def promote(store_dir: str, log=None) -> dict:
     except (OSError, ValueError) as err:
         raise ReplError(f"{mpath}: unreadable manifest ({err})") from err
     manifest["repl_epoch"] = new_epoch
-    tmp = os.path.join(store_dir, f".manifest.tmp{os.getpid()}")
-    with open(tmp, "w") as f:
-        json.dump(manifest, f)
-        f.flush()
-        # crash point #2: the epoch bump is staged, not committed —
-        # torn_write tears the tmp (the atomic replace never happens, the
-        # store stays a promotable follower)
-        faults.fire("repl.promote", f)
-        os.fsync(f.fileno())
-    os.replace(tmp, mpath)
+    # crash point #2 fires via pre_sync: the epoch bump is staged, not
+    # committed — torn_write tears the tmp (the atomic replace never
+    # happens, the store stays a promotable follower).  replace_manifest
+    # also commits the rename metadata under AVDB_FSYNC: the epoch fence
+    # must survive power loss, or a deposed leader could wake up unfenced.
+    tio.replace_manifest(
+        mpath, manifest,
+        pre_sync=lambda f: faults.fire("repl.promote", f),
+    )
     for fname in (CURSOR_FILE,):
         try:
-            os.remove(os.path.join(store_dir, fname))
+            tio.unlink(os.path.join(store_dir, fname))
         except OSError:
             pass
     for fname in sorted(os.listdir(store_dir)):
         if is_repl_tmp(fname):
             try:
-                os.remove(os.path.join(store_dir, fname))
+                tio.unlink(os.path.join(store_dir, fname))
             except OSError:
                 pass
     log(f"repl: promoted to leader (fencing epoch {new_epoch}, "

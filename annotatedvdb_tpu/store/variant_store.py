@@ -45,6 +45,7 @@ import numpy as np
 
 from annotatedvdb_tpu.types import chromosome_label, decode_allele
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
 from annotatedvdb_tpu.utils.strings import deep_update
 
 
@@ -143,8 +144,10 @@ _DEVICE_LOOKUP_MODE: str | None = None
 
 def _fsync_wanted() -> bool:
     """AVDB_FSYNC opt-in: full power-loss durability for segment data and
-    rename metadata (see ``VariantStore.save``).  '0'/'false' disable."""
-    return os.environ.get("AVDB_FSYNC", "").lower() not in ("", "0", "false")
+    rename metadata (see ``VariantStore.save``).  '0'/'false' disable.
+    Canonical definition lives in ``utils.io`` (the traced-I/O layer needs
+    it without importing the store)."""
+    return tio.fsync_wanted()
 
 
 def _verify_mode() -> str:
@@ -1505,32 +1508,20 @@ class VariantStore:
         # survivable default matches the reference's own bulk loads
         # (UNLOGGED tables are truncated by Postgres crash recovery,
         # createVariant.sql:4).
-        fsync_data = _fsync_wanted()
         # crash point: every segment of this checkpoint is on disk, the
         # commit (manifest swap) has not happened — a death here must leave
         # the PREVIOUS manifest fully consistent (new files are orphans)
         faults.fire("store.save.pre_manifest")
-        mtmp = os.path.join(path, f".manifest.tmp{os.getpid()}")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(mtmp, os.path.join(path, "manifest.json"))
-        if fsync_data:
-            # commit the rename METADATA too (every segment rename above
-            # shares this directory, so one directory fsync after the
-            # manifest swap covers them all)
-            dfd = os.open(path, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+        # tmp -> flush -> fsync -> atomic replace -> dir fsync under
+        # AVDB_FSYNC (one directory fsync after the manifest swap covers
+        # every segment rename above — they share the directory)
+        tio.replace_manifest(os.path.join(path, "manifest.json"), manifest)
         for fname in os.listdir(path):
             if fname not in live_files and (
                     fname.endswith(".npz") or fname.endswith(".ann.jsonl")
                     # orphaned tmp files from crashed saves (any pid)
                     or (fname.startswith(".") and ".tmp" in fname)):
-                os.remove(os.path.join(path, fname))
+                tio.unlink(os.path.join(path, fname))
         # drop integrity records for files the cleanup just removed
         self._integrity = {
             stem: rec for stem, rec in self._integrity.items()
@@ -1576,7 +1567,7 @@ class VariantStore:
             "ref": ref, "alt": alt,
             **{name: seg.cols[name] for name, _ in _NUMERIC_COLUMNS},
         }
-        with open(tmp, "wb", buffering=1 << 20) as raw_f:
+        with tio.open(tmp, "wb", buffering=1 << 20) as raw_f:
             # integrity record accumulates on the bytes in hand (see
             # _CrcWriter) — no post-hoc re-read pass
             f = _CrcWriter(raw_f)
@@ -1594,11 +1585,11 @@ class VariantStore:
                     first = False
             if fsync_data:
                 f.flush()
-                os.fsync(f.fileno())
+                tio.fsync(raw_f)
         npz_rec = {"bytes": f.nbytes, "crc32": f.crc}
-        os.replace(tmp, os.path.join(path, stem + ".npz"))
+        tio.replace(tmp, os.path.join(path, stem + ".npz"))
         atmp = os.path.join(path, f".{stem}.tmp{os.getpid()}.ann.jsonl")
-        with open(atmp, "wb") as raw_f:
+        with tio.open(atmp, "wb") as raw_f:
             f = _CrcWriter(raw_f)
             present = [(c, seg.obj[c]) for c in OBJECT_COLUMNS
                        if seg.obj[c] is not None]
@@ -1610,8 +1601,8 @@ class VariantStore:
                     f.write(line.encode())
             if fsync_data:
                 f.flush()
-                os.fsync(f.fileno())
-        os.replace(atmp, os.path.join(path, stem + ".ann.jsonl"))
+                tio.fsync(raw_f)
+        tio.replace(atmp, os.path.join(path, stem + ".ann.jsonl"))
         return {"npz": npz_rec, "jsonl": {"bytes": f.nbytes, "crc32": f.crc}}
 
     @classmethod

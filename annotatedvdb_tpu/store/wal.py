@@ -45,6 +45,7 @@ import zlib
 
 from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
 from annotatedvdb_tpu.utils.locks import make_lock
 
 _FRAME = struct.Struct("<II")  # payload byte length, crc32(payload)
@@ -149,13 +150,13 @@ class WriteAheadLog:
         a ``*.wal.tmp`` (attributed by fsck), never a half-headed WAL."""
         path = self._path(seq)
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
+        with tio.open(tmp, "wb") as f:
             f.write((json.dumps(
                 {"wal": 1, "name": self.name, "seq": seq}
             ) + "\n").encode())
             f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+            tio.fsync(f)
+        tio.replace(tmp, path)
 
     def append(self, payload: dict) -> int:
         """Write one CRC-framed record and fsync; returns frame bytes.
@@ -178,7 +179,7 @@ class WriteAheadLog:
                 path = self._path(self._seq)
                 if not os.path.exists(path):
                     self._create(self._seq)
-                self._f = open(path, "ab")
+                self._f = tio.open(path, "ab")
             f = self._f
             pre = f.tell()
             # crash point BEFORE the write: raise/eio/kill model a death in
@@ -193,7 +194,7 @@ class WriteAheadLog:
             # applies it in full or not at all, never a hybrid
             faults.fire("wal.fsync", f, tear_base=pre)
             t_fsync = time.perf_counter()
-            os.fsync(f.fileno())
+            tio.fsync(f)
             # the ack barrier's cost, attributed to the acknowledging
             # request's trace (single writer per worker: the caller reads
             # it back under the memtable lock it already holds)
@@ -211,7 +212,7 @@ class WriteAheadLog:
         with self._lock:
             if self._f is not None:
                 self._f.flush()
-                os.fsync(self._f.fileno())
+                tio.fsync(self._f)
                 self._f.close()
                 self._f = None
             sealed = self._seq
@@ -238,7 +239,7 @@ class WriteAheadLog:
             if seq >= active:
                 continue
             try:
-                os.remove(path)
+                tio.unlink(path)
                 removed += 1
             except OSError as err:
                 self.log(f"wal: could not remove sealed {path} ({err}); "
@@ -264,7 +265,7 @@ class WriteAheadLog:
                         f.readline()  # header
                         empty = not f.read(1)
                     if empty:
-                        os.remove(path)
+                        tio.unlink(path)
                 except OSError:
                     continue
 

@@ -241,6 +241,7 @@ POINTS = frozenset({
     "repl.ship",
     "repl.apply",
     "repl.promote",
+    "fsck.repair",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

@@ -1,0 +1,14 @@
+"""Front-end ack-order fixture: a 200 built before the durable upsert.
+
+Only this one front end exists in the tree, so the AVDB8xx parity
+finalizer stays silent and the findings here are AVDB1005's alone.
+"""
+
+
+def handle_upsert(ctx, body):
+    if body is None:
+        return (400, {"error": "empty body"})
+    if ctx.queue_full:
+        return (200, {"status": "queued"})  # EXPECT: AVDB1005
+    accepted = ctx.memtable.upsert(ctx.store, body["rows"])
+    return (200, {"accepted": accepted})

@@ -1,0 +1,53 @@
+"""Durability-protocol fixture: each AVDB10xx shape violated exactly once.
+
+A miniature store writer that gets every step of the tmp -> fsync ->
+rename -> manifest-commit protocol wrong in a different function, plus
+one correct function per rule so the checker's negative space is pinned
+too.  Scanned as a tree (``run_paths([tree], root=tree)``) together with
+the sibling ``store/fsck.py`` so the AVDB1002/1003 cross-reference arms.
+"""
+
+import json
+import os
+
+
+def unsynced_rename(path):
+    tmp = path + ".flush.tmp"
+    with open(tmp, "w") as f:
+        f.write("payload")
+    os.replace(tmp, path)  # EXPECT: AVDB1001
+
+
+def synced_rename(path):
+    tmp = path + ".flush.tmp"
+    with open(tmp, "w") as f:
+        f.write("payload")
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def uninjectable_manifest_commit(store_dir, manifest):
+    mpath = os.path.join(store_dir, "manifest.json")
+    tmp = mpath + ".t"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        os.fsync(f.fileno())
+    os.replace(tmp, mpath)  # EXPECT: AVDB1004
+
+
+COMPACT_TMP = ".compact.tmp"  # EXPECT: AVDB1002, AVDB1003
+
+
+class UnsyncedWriteAheadLog:
+    def append(self, frame):  # EXPECT: AVDB1005
+        self._f.write(frame)
+        return len(frame)
+
+
+class EagerAckWriteAheadLog:
+    def append(self, frame):
+        if not frame:
+            return 0  # EXPECT: AVDB1005
+        self._f.write(frame)
+        os.fsync(self._f.fileno())
+        return len(frame)

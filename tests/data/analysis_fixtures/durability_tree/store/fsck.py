@@ -1,0 +1,19 @@
+"""Miniature fsck: attributes only the ``flush`` tmp family.
+
+Scanning this file arms the AVDB1002/1003 cross-reference (fsck_scan):
+the ``flush-tmp`` code below attributes ``.flush.tmp`` debris, while the
+``.compact.tmp`` literal in ``bad_writer.py`` stays unattributed and
+must be flagged.
+"""
+
+
+def note(level, code, path):
+    return {"level": level, "code": code, "path": path}
+
+
+def scan_store(names):
+    findings = []
+    for name in names:
+        if name.endswith(".flush.tmp"):
+            findings.append(note("warn", "flush-tmp", name))
+    return findings

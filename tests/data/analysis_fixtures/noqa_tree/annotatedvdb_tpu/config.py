@@ -1,0 +1,6 @@
+"""Miniature registry: scanning this path arms ``full_registry_scan``
+(and, with the sibling ``tests/`` module, ``tree_scan``) so the AVDB604
+stale-suppression audit runs over this fixture tree.  Empty registries —
+the audits have nothing to cross-reference and stay silent."""
+
+ENV_VARS = {}

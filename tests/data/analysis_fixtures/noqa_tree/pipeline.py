@@ -1,0 +1,22 @@
+"""Stale-suppression fixture: one live noqa, one stale, one empty blanket.
+
+The live one (``swallow``) suppresses an AVDB602 that genuinely fires —
+AVDB604 must leave it alone.  The stale one names a code that fires
+nowhere near it; the blanket one suppresses nothing at all (and must not
+be able to self-suppress the audit that flags it).
+"""
+
+
+def swallow(probe):
+    try:
+        probe()
+    except Exception:  # avdb: noqa[AVDB602] -- fixture: deliberately silent
+        pass
+
+
+def stale(probe):
+    result = probe()  # avdb: noqa[AVDB602] -- nothing swallowed here  # EXPECT: AVDB604
+    return result
+
+
+TUNING = 7  # avdb: noqa  # EXPECT: AVDB604

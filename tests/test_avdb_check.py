@@ -106,12 +106,15 @@ def test_every_rule_family_covered_by_fixtures():
                      "__init__.py"),
         os.path.join("twins_tree", "annotatedvdb_tpu", "ops",
                      "kernels.py"),
+        os.path.join("durability_tree", "store", "bad_writer.py"),
+        os.path.join("durability_tree", "serve", "http.py"),
+        os.path.join("noqa_tree", "pipeline.py"),
     ]
     for name in FIXTURE_FILES + ["cli_viol.py"] + tree_fixtures:
         for _line, code in expected_pairs(os.path.join(FIXTURES, name)):
-            families.add(code[:5])  # AVDB1..AVDB9
+            families.add(code[:-2])  # AVDB101 -> AVDB1, AVDB1001 -> AVDB10
     assert families == {"AVDB1", "AVDB2", "AVDB3", "AVDB4", "AVDB5",
-                        "AVDB6", "AVDB7", "AVDB8", "AVDB9"}
+                        "AVDB6", "AVDB7", "AVDB8", "AVDB9", "AVDB10"}
 
 
 # ---------------------------------------------------------------------------
@@ -178,6 +181,86 @@ def test_twins_silent_without_registry_scan():
         root=tree,
     )
     assert [f for f in findings if f.code.startswith("AVDB9")] == []
+
+
+# ---------------------------------------------------------------------------
+# durability tree (AVDB10xx) and the stale-noqa tree (AVDB604)
+
+
+def test_durability_tree_fixture():
+    tree = os.path.join(FIXTURES, "durability_tree")
+    findings, n = run_paths([tree], root=tree)
+    assert n == 3
+    got = {}
+    for f in findings:
+        rel = f.path.replace("\\", "/").split("durability_tree/")[-1]
+        got.setdefault(rel, set()).add((f.line, f.code))
+    want = _tree_pairs(tree, [
+        os.path.join("store", "bad_writer.py"),
+        os.path.join("serve", "http.py"),
+    ])
+    assert got == want, (got, want)
+
+
+def test_durability_fsck_xref_silent_without_fsck_scan():
+    """AVDB1002/1003 cross-reference fsck's attribution codes; a scan
+    that does not include store/fsck.py cannot decide them."""
+    tree = os.path.join(FIXTURES, "durability_tree")
+    findings, _n = run_paths(
+        [os.path.join(tree, "store", "bad_writer.py")], root=tree
+    )
+    codes = {f.code for f in findings}
+    assert "AVDB1002" not in codes and "AVDB1003" not in codes
+    # the per-function durability codes stay live on the partial scan
+    assert {"AVDB1001", "AVDB1004", "AVDB1005"} <= codes
+
+
+def test_durability_fsck_xref_silent_in_diff_mode():
+    """audit=False (--diff) force-disables the fsck cross-reference even
+    when store/fsck.py happens to be in the scan set."""
+    tree = os.path.join(FIXTURES, "durability_tree")
+    findings, _n = run_paths([tree], root=tree, audit=False)
+    codes = {f.code for f in findings}
+    assert "AVDB1002" not in codes and "AVDB1003" not in codes
+    assert "AVDB1001" in codes
+
+
+def test_noqa_tree_fixture():
+    """The stale and blanket suppressions are flagged AVDB604; the live
+    AVDB602 suppression is honored (no AVDB602 in the output)."""
+    tree = os.path.join(FIXTURES, "noqa_tree")
+    findings, n = run_paths([tree], root=tree)
+    assert n == 3
+    got = {}
+    for f in findings:
+        rel = f.path.replace("\\", "/").split("noqa_tree/")[-1]
+        got.setdefault(rel, set()).add((f.line, f.code))
+    want = _tree_pairs(tree, ["pipeline.py"])
+    assert got == want, (got, want)
+
+
+def test_noqa_audit_gated_to_tree_scans():
+    """A partial scan (no config.py / no tests/) must not judge
+    staleness — the suppressed code might fire only on a full scan."""
+    tree = os.path.join(FIXTURES, "noqa_tree")
+    findings, _n = run_paths(
+        [os.path.join(tree, "pipeline.py")], root=tree
+    )
+    assert [f for f in findings if f.code == "AVDB604"] == []
+
+
+def test_blanket_noqa_cannot_self_suppress_avdb604(tmp_path):
+    """A blanket noqa covers every code EXCEPT AVDB604 — a suppression
+    must not certify itself; silencing the audit takes an explicit
+    [AVDB604] list."""
+    ctx = FileContext(
+        str(tmp_path / "f.py"),
+        "x = 1  # avdb: noqa\n"
+        "y = 2  # avdb: noqa[AVDB604] -- deliberate fixture\n",
+    )
+    assert not ctx.suppressed(1, "AVDB604")
+    assert ctx.suppressed(1, "AVDB999")
+    assert ctx.suppressed(2, "AVDB604")
 
 
 # ---------------------------------------------------------------------------
@@ -400,7 +483,7 @@ def test_json_output_schema():
     assert isinstance(report["findings"], list) and report["findings"]
     for f in report["findings"]:
         assert set(f) == {"code", "path", "line", "message", "hint"}
-        assert re.fullmatch(r"AVDB\d{3}", f["code"])
+        assert re.fullmatch(r"AVDB\d{3,4}", f["code"])
         assert isinstance(f["line"], int) and f["line"] >= 1
         assert f["message"] and f["hint"]
 

@@ -1918,3 +1918,50 @@ def test_repl_promote_fault_leaves_promotable_follower(tmp_path):
         faults.reset("")
         httpd.shutdown()
         httpd.ctx.batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# fsck.repair — the repair pass's own manifest commit is a crash point too
+
+
+@pytest.mark.parametrize("fault", [
+    "fsck.repair:1:raise",
+    "fsck.repair:1:eio",
+])
+def test_fsck_repair_commit_fault_leaves_diagnosable_store(tmp_path, fault):
+    """``fsck.repair`` fires while the rolled-back manifest is staged (tmp
+    written, atomic replace not yet done): a death there must leave the
+    damaged-but-diagnosed store byte-identical — the OLD manifest still
+    serving — so the next repair run diagnoses the same damage and
+    converges.  Repair is idempotent; its commit is one atomic replace."""
+    vcf = str(tmp_path / "d.vcf")
+    _write_vcf(vcf, n=300)
+    store_dir = str(tmp_path / "store")
+    counters, exc = _run_load(store_dir, vcf)
+    assert exc is None, exc
+    # tear one referenced segment: size mismatch vs its integrity record
+    seg = next(f for f in sorted(os.listdir(store_dir))
+               if f.endswith(".npz"))
+    with open(os.path.join(store_dir, seg), "r+b") as f:
+        f.truncate(16)
+    mpath = os.path.join(store_dir, "manifest.json")
+    with open(mpath, "rb") as f:
+        manifest_before = f.read()
+
+    faults.reset(fault)
+    try:
+        with pytest.raises((faults.InjectedFault, OSError)):
+            fsck(store_dir, repair=True, log=lambda m: None)
+    finally:
+        faults.reset("")
+    # the commit never happened: the old manifest is byte-identical and
+    # the damage is still on disk for the next run to diagnose
+    with open(mpath, "rb") as f:
+        assert f.read() == manifest_before
+
+    # unarmed re-run converges: the damaged group rolls back, debris is
+    # pruned, and the store then deep-fscks clean
+    report = fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["exit_code"] in (0, 1), report
+    assert fsck(store_dir, deep=True,
+                log=lambda m: None)["exit_code"] == 0

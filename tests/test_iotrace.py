@@ -1,0 +1,264 @@
+"""Crash-consistency sanitizer (``AVDB_IO_TRACE=1``): the ``utils/io``
+traced wrappers, the ``analysis/iotrace`` happens-before recorder, and
+the real store writers driven end-to-end with tracing armed.
+
+The armed legs are the regression net for the three ordering holes this
+sanitizer caught when first pointed at the tree: the replication
+bootstrap's manifest install and promote's epoch commit had no directory
+fsync under ``AVDB_FSYNC=1``, and fsck's repair manifest rewrite had
+neither a crash point nor a directory fsync — all three now route
+through ``utils.io.replace_manifest``.
+"""
+
+import json
+import os
+
+import pytest
+
+import test_serve as ts
+import test_replication as tr
+from annotatedvdb_tpu.analysis.iotrace import (
+    RECORDER,
+    IoTraceRecorder,
+    _durable_class,
+    _manifest_refs,
+)
+from annotatedvdb_tpu.store import compact_store
+from annotatedvdb_tpu.store import replication as repl
+from annotatedvdb_tpu.store.fsck import fsck
+from annotatedvdb_tpu.utils import io as tio
+
+
+@pytest.fixture()
+def traced(monkeypatch):
+    """Arm tracing around one test, with a clean recorder both sides."""
+    monkeypatch.setenv("AVDB_IO_TRACE", "1")
+    RECORDER.reset()
+    yield RECORDER
+    RECORDER.reset()
+
+
+def _kinds(recorder) -> set:
+    return {v["kind"] for v in recorder.violations()}
+
+
+# -- wrapper semantics -------------------------------------------------------
+
+
+def test_unarmed_wrappers_are_passthrough(tmp_path, monkeypatch):
+    """Unarmed, tio.open returns the raw file (no proxy) and no wrapper
+    call touches the recorder."""
+    monkeypatch.delenv("AVDB_IO_TRACE", raising=False)
+    RECORDER.reset()
+    p = str(tmp_path / "a.txt")
+    f = tio.open(p, "w")
+    assert type(f).__name__ != "TracedFile"
+    f.write("x")
+    tio.fsync(f)
+    f.close()
+    tio.replace(p, str(tmp_path / "b.txt"))
+    tio.unlink(str(tmp_path / "b.txt"))
+    tio.fsync_dir(str(tmp_path))
+    assert RECORDER.report()["events"] == 0
+    assert RECORDER.violations() == []
+
+
+def test_traced_file_api_parity(tmp_path, traced):
+    """The proxy answers every file-API surface the writers use."""
+    p = str(tmp_path / "a.txt")
+    with tio.open(p, "w") as f:
+        assert type(f).__name__ == "TracedFile"
+        f.write("line1\n")
+        f.flush()
+        assert isinstance(f.fileno(), int)
+        assert f.tell() > 0
+        assert f.name == p
+        assert not f.closed
+    assert f.closed
+    # read opens stay raw even when armed (only writes are judged)
+    with tio.open(p) as rf:
+        assert type(rf).__name__ != "TracedFile"
+        assert list(rf) == ["line1\n"]
+    assert traced.report()["events"] >= 2  # the open + at least one write
+
+
+def test_manifest_refs_both_formats(tmp_path):
+    m1 = tmp_path / "m1.json"
+    m1.write_text(json.dumps({"shards": {"8": [0, 1]}}))
+    assert _manifest_refs(str(m1)) == {
+        "chr8.000000.npz", "chr8.000000.ann.jsonl",
+        "chr8.000001.npz", "chr8.000001.ann.jsonl",
+    }
+    m2 = tmp_path / "m2.json"
+    m2.write_text(json.dumps({"format": 2, "shards": {"X": [3]}}))
+    assert _manifest_refs(str(m2)) == {
+        "chrX.000003.npz", "chrX.000003.ann.jsonl",
+    }
+    assert _manifest_refs(str(tmp_path / "absent.json")) == set()
+
+
+def test_durable_class_taxonomy():
+    assert _durable_class("manifest.json") == "manifest"
+    assert _durable_class("serve-w0.wal") == "wal"
+    assert _durable_class("chr8.000000.npz") == "data"
+    assert _durable_class(".manifest.json.tmp123") is None
+    assert _durable_class("chr8.000000.flush.tmp.npz") is None
+
+
+# -- recorder judgments ------------------------------------------------------
+
+
+def test_clean_commit_protocol_records_no_violation(tmp_path, traced):
+    mpath = str(tmp_path / "manifest.json")
+    tmp = mpath + ".t"
+    with tio.open(tmp, "w") as f:
+        f.write(json.dumps({"shards": {}}))
+        f.flush()
+        tio.fsync(f)
+    tio.replace(tmp, mpath)
+    assert traced.violations() == []
+
+
+def test_misordered_writer_detected(tmp_path, traced, monkeypatch):
+    """A writer that renames before fsync and never dir-fsyncs trips
+    both judgments — the shape AVDB1001 proves statically, seen live."""
+    monkeypatch.setenv("AVDB_FSYNC", "1")
+    mpath = str(tmp_path / "manifest.json")
+    tmp = mpath + ".t"
+    with tio.open(tmp, "w") as f:
+        f.write(json.dumps({"shards": {}}))
+    tio.replace(tmp, mpath)  # dirty source: no fsync ever happened
+    assert _kinds(traced) == {
+        "rename-before-fsync", "manifest-replace-without-dir-fsync",
+    }
+
+
+def test_data_class_judged_only_under_avdb_fsync(tmp_path, traced,
+                                                 monkeypatch):
+    """Segment-data durability is the AVDB_FSYNC opt-in; the recorder
+    mirrors it instead of inventing a stricter contract."""
+    seg = str(tmp_path / "chr8.000000.npz")
+    monkeypatch.delenv("AVDB_FSYNC", raising=False)
+    with tio.open(seg + ".t", "wb") as f:
+        f.write(b"x")
+    tio.replace(seg + ".t", seg)
+    assert traced.violations() == []  # unarmed: page-cache durability ok
+    monkeypatch.setenv("AVDB_FSYNC", "1")
+    with tio.open(seg + ".t", "wb") as f:
+        f.write(b"x")
+    tio.replace(seg + ".t", seg)
+    assert _kinds(traced) == {"rename-before-fsync"}
+
+
+def test_unlink_of_manifest_referenced_file_detected(tmp_path, traced):
+    store = tmp_path / "store"
+    store.mkdir()
+    live = store / "chr8.000000.npz"
+    live.write_bytes(b"seg")
+    stale = store / ".manifest.json.tmp999"
+    stale.write_bytes(b"junk")
+    tio.replace_manifest(str(store / "manifest.json"),
+                         {"shards": {"8": [0]}})
+    tio.unlink(str(stale))  # debris: not referenced, no violation
+    assert traced.violations() == []
+    tio.unlink(str(live))
+    assert _kinds(traced) == {"unlink-live-file"}
+
+
+def test_dir_fsync_discharges_manifest_obligation(tmp_path, traced,
+                                                  monkeypatch):
+    monkeypatch.setenv("AVDB_FSYNC", "1")
+    mpath = str(tmp_path / "manifest.json")
+    tmp = mpath + ".t"
+    with tio.open(tmp, "w") as f:
+        f.write(json.dumps({"shards": {}}))
+        tio.fsync(f)
+    tio.replace(tmp, mpath)
+    assert _kinds(traced) == {"manifest-replace-without-dir-fsync"}
+    tio.fsync_dir(str(tmp_path))
+    assert traced.violations() == []
+
+
+def test_replace_manifest_helper_is_clean_under_full_durability(
+        tmp_path, traced, monkeypatch):
+    """The blessed helper discharges every obligation it creates —
+    including the directory fsync the fixed writers used to miss."""
+    monkeypatch.setenv("AVDB_FSYNC", "1")
+    tio.replace_manifest(str(tmp_path / "manifest.json"), {"shards": {}})
+    assert traced.violations() == []
+    # pre-serialized bytes land byte-identical (the repl mirror's format)
+    blob = b'{"shards": {}}\n'
+    tio.replace_manifest(str(tmp_path / "manifest.json"), blob)
+    assert traced.violations() == []
+    assert open(str(tmp_path / "manifest.json"), "rb").read() == blob
+
+
+def test_recorder_reset_and_report_shape(traced):
+    rec = IoTraceRecorder()
+    rec.note_write("/x/a")
+    rec.note_rename("/x/a", "/x/serve-w0.wal")
+    assert len(rec.violations()) == 1
+    report = rec.report()
+    assert set(report) == {"events", "violations", "dirty",
+                          "pending_dir_fsync"}
+    rec.reset()
+    assert rec.report() == {"events": 0, "violations": [], "dirty": [],
+                            "pending_dir_fsync": []}
+
+
+# -- the real writers, traced (slowish: full store builds) -------------------
+
+
+def test_store_build_flush_compact_fsck_traced_clean(tmp_path, traced,
+                                                     monkeypatch):
+    """save() + memtable flush + WAL + compaction + fsck repair under
+    AVDB_IO_TRACE=1 AVDB_FSYNC=1: zero ordering violations."""
+    monkeypatch.setenv("AVDB_FSYNC", "1")
+    store_dir = str(tmp_path / "vdb")
+    ts._build_store(store_dir)  # fragmented multi-segment save()s
+
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.store.memtable import Memtable
+    from annotatedvdb_tpu.store.wal import WriteAheadLog
+
+    store = VariantStore.load(store_dir)
+    mem = Memtable(
+        width=8, store_dir=store_dir,
+        wal=WriteAheadLog(store_dir, "trace-w0", log=lambda m: None),
+        log=lambda m: None,
+    )
+    mem.upsert(store, [{"code": 3, "pos": 77, "ref": "A", "alt": "G"}],
+               durable=True)
+    assert mem.flush()["status"] == "flushed"
+    mem.wal.close(remove_if_empty=True)
+
+    assert compact_store(store_dir)["status"] == "compacted"
+
+    # plant crash debris; repair unlinks it and rewrites the manifest
+    with open(os.path.join(store_dir, ".manifest.json.tmp42"), "w") as f:
+        f.write("junk")
+    report = fsck(store_dir, repair=True, log=lambda m: None)
+    assert report["status"] == "repaired" and report["repairs"]
+
+    assert traced.violations() == [], traced.report()
+
+
+def test_replication_ship_bootstrap_promote_traced_clean(tmp_path, traced,
+                                                         monkeypatch):
+    """The full replica lifecycle traced: leader upserts, snapshot-cut
+    bootstrap, WAL tail, promote (epoch commit).  Regression for the
+    bootstrap-install and promote dir-fsync holes."""
+    monkeypatch.setenv("AVDB_FSYNC", "1")
+    leader = tr._Leader(str(tmp_path / "leader"))
+    try:
+        leader.upsert([{"id": "3:15:A:G"},
+                       {"id": "3:25:AT:A", "ref_snp": 9}])
+        fdir = str(tmp_path / "follower")
+        tailer = repl.ReplicaTailer(fdir, leader.url, log=lambda m: None)
+        tailer.bootstrap()
+        assert tailer.sync_once()["applied"] == 1
+        out = repl.promote(fdir, log=lambda m: None)
+        assert out["status"] == "promoted" and out["rows"] == 2
+    finally:
+        leader.close()
+    assert traced.violations() == [], traced.report()

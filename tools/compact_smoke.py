@@ -158,6 +158,19 @@ def main() -> int:
         if p.returncode != 0 or plan["eligible"]:
             log(f"FAIL: dry-run still sees work: {plan}")
             return 1
+        if os.environ.get("AVDB_IO_TRACE", "") == "1":
+            # crash-consistency smoke: the compaction + kill/repair legs
+            # ran with durable I/O traced — zero ordering violations or
+            # the smoke fails (tools/run_checks.sh arms this)
+            from annotatedvdb_tpu.analysis.iotrace import RECORDER
+
+            io_rep = RECORDER.report()
+            if io_rep["violations"]:
+                for v in io_rep["violations"]:
+                    log(f"FAIL: io-order violation: {v['kind']} "
+                        f"{v['path']} ({v['detail']})")
+                return 1
+            log(f"io order clean ({io_rep['events']} traced I/O events)")
         log(f"contract held: {files_before} -> 1 segment file(s), "
             f"{rep['bytes_before']} -> {rep['bytes_after']} bytes, "
             "kill/repair/byte-verify clean")

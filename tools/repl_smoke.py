@@ -62,6 +62,20 @@ def main() -> int:
         print(f"repl_smoke: record {json.dumps(record)[:600]}",
               file=sys.stderr)
         return 1
+    if os.environ.get("AVDB_IO_TRACE", "") == "1":
+        # crash-consistency smoke: the in-process tailer legs (bootstrap,
+        # WAL tail, promote epoch commit) ran traced — any happens-before
+        # violation fails the smoke (tools/run_checks.sh arms this)
+        from annotatedvdb_tpu.analysis.iotrace import RECORDER
+
+        rep = RECORDER.report()
+        if rep["violations"]:
+            for v in rep["violations"]:
+                print(f"repl_smoke FAIL io-order: {v['kind']} "
+                      f"{v['path']} ({v['detail']})", file=sys.stderr)
+            return 1
+        print(f"repl_smoke: io order clean ({rep['events']} traced "
+              f"I/O events)", file=sys.stderr)
     print(
         f"repl_smoke: ok ({ups.get('acked', 0)} acked / "
         f"{rp.get('acked_missing', 0)} lost across failover, "

@@ -5,7 +5,8 @@
 #
 #   1. avdb_check  — project-native rules (trace-safety, lock-discipline,
 #                    registry-drift, env-drift, CLI-contract, hygiene,
-#                    async-safety, cross-front-end parity, twin contract)
+#                    async-safety, cross-front-end parity, twin contract,
+#                    durability protocol)
 #   2. ruff        — generic pyflakes-class lint (pyproject.toml subset);
 #                    SKIPPED with a notice when ruff is not installed
 #                    (the container image does not ship it)
@@ -18,10 +19,13 @@
 #   5. compact_smoke — crash-safe `doctor compact`: kill a pass mid-merge,
 #                    doctor --repair the debris, complete the pass, and
 #                    byte-verify the store against the pre-compaction
-#                    reference
+#                    reference; runs under AVDB_IO_TRACE=1 (the crash-
+#                    consistency sanitizer: any rename-before-fsync /
+#                    live-file unlink / missing dir fsync fails it)
 #   6. upsert_smoke — the WAL-durable live write path: upsert -> SIGKILL
 #                    the worker -> respawn replays the WAL -> byte-verify
-#                    -> memtable flush -> deep fsck clean
+#                    -> memtable flush -> deep fsck clean; io-order
+#                    traced under AVDB_IO_TRACE=1 like compact_smoke
 #   7. maintain_smoke — autonomous storage management: a fleet with the
 #                    maintenance daemon armed sustains upserts until the
 #                    segment watermark trips, and daemon-driven
@@ -47,7 +51,8 @@
 #                    leader's snapshot cut, tails the WAL ship stream
 #                    under injected flakiness, the leader is SIGKILLed,
 #                    `doctor promote` fails over, and every acknowledged
-#                    upsert answers byte-identical from the new leader
+#                    upsert answers byte-identical from the new leader;
+#                    io-order traced under AVDB_IO_TRACE=1
 #  13. check_bench_regress — the newest committed BENCH record's
 #                    headlines (serving qps/p99, load variants/sec)
 #                    against the trailing median of their own history
@@ -78,11 +83,11 @@ python "$root/tools/check_bench_schema.py" || rc=1
 echo "== serve smoke (lock-order traced) ==" >&2
 AVDB_LOCK_TRACE=1 python "$root/tools/serve_smoke.py" || rc=1
 
-echo "== compact smoke ==" >&2
-python "$root/tools/compact_smoke.py" || rc=1
+echo "== compact smoke (io-order traced) ==" >&2
+AVDB_IO_TRACE=1 python "$root/tools/compact_smoke.py" || rc=1
 
-echo "== upsert smoke ==" >&2
-python "$root/tools/upsert_smoke.py" || rc=1
+echo "== upsert smoke (io-order traced) ==" >&2
+AVDB_IO_TRACE=1 python "$root/tools/upsert_smoke.py" || rc=1
 
 echo "== maintain smoke ==" >&2
 python "$root/tools/maintain_smoke.py" || rc=1
@@ -99,8 +104,8 @@ python "$root/tools/chaos_soak.py" --smoke || rc=1
 echo "== slo smoke ==" >&2
 python "$root/tools/slo_smoke.py" || rc=1
 
-echo "== repl smoke ==" >&2
-python "$root/tools/repl_smoke.py" || rc=1
+echo "== repl smoke (io-order traced) ==" >&2
+AVDB_IO_TRACE=1 python "$root/tools/repl_smoke.py" || rc=1
 
 echo "== bench regression watchdog ==" >&2
 python "$root/tools/check_bench_regress.py" || rc=1

@@ -187,6 +187,21 @@ def main() -> int:
         if report["exit_code"] != 0:
             log(f"FAIL: final fsck not clean: {report}")
             return 1
+        if os.environ.get("AVDB_IO_TRACE", "") == "1":
+            # crash-consistency smoke: the replay/flush/fsck legs above
+            # ran with every durable I/O call traced (tools/run_checks.sh
+            # arms this; see analysis/iotrace).  Any happens-before
+            # violation — rename before fsync, unlink of a live file,
+            # manifest replace without its dir fsync — fails the smoke.
+            from annotatedvdb_tpu.analysis.iotrace import RECORDER
+
+            rep = RECORDER.report()
+            if rep["violations"]:
+                for v in rep["violations"]:
+                    log(f"FAIL: io-order violation: {v['kind']} "
+                        f"{v['path']} ({v['detail']})")
+                return 1
+            log(f"io order clean ({rep['events']} traced I/O events)")
         log("contract held: ack -> SIGKILL -> replay -> flush -> "
             "byte-verify -> deep fsck clean")
         return 0
