@@ -26,6 +26,8 @@ COMMANDS = {
                "store fsck/repair + quarantine replay"),
     "export-vcf": ("annotatedvdb_tpu.cli.export_variant2vcf",
                    "dump the store back to VCF"),
+    "export": ("annotatedvdb_tpu.cli.export_corpus",
+               "stream the store as a tokenized ML training corpus"),
     "split-vcf": ("annotatedvdb_tpu.cli.split_vcf_by_chr",
                   "demux a VCF per chromosome"),
     "bin-references": ("annotatedvdb_tpu.cli.generate_bin_index_references",

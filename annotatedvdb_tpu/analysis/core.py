@@ -236,7 +236,7 @@ def _literal_assignment(tree: ast.AST, name: str):
     return None
 
 
-#: the six loader CLIs bound by the shared flag contract (repo-relative)
+#: the loader/export CLIs bound by the shared flag contract (repo-relative)
 LOADER_CLIS = (
     "annotatedvdb_tpu/cli/load_vcf.py",
     "annotatedvdb_tpu/cli/load_vep.py",
@@ -244,6 +244,7 @@ LOADER_CLIS = (
     "annotatedvdb_tpu/cli/load_snpeff_lof.py",
     "annotatedvdb_tpu/cli/update_qc.py",
     "annotatedvdb_tpu/cli/update_variant_annotation.py",
+    "annotatedvdb_tpu/cli/export_corpus.py",
 )
 
 

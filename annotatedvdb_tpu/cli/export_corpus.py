@@ -1,0 +1,129 @@
+"""CLI: stream the store out as a tokenized training corpus.
+
+``avdb export`` — the ML-export driver: a chromosome (``--chromosome``),
+a ``--region`` slice, or the whole store leaves as fixed-shape token/
+feature batches (``export/core.py``), shuffled by ``--seed`` (same seed
+⇒ byte-identical corpus), committed as ``part-<n>.npz`` + a manifest
+under the AVDB10xx tmp→fsync→rename discipline.
+
+Lifecycle mirrors the loaders: default is a **dry run** (plan + summary,
+nothing written) unless ``--commit`` is passed; ``--test`` stops after
+one part (the manifest records ``complete: false``); ``--resume``
+continues a killed export after its last ledger-committed part.  Shared
+flags come from the typed config registry (``config.add_lifecycle_args``
++ ``obs.add_obs_args`` — the loader-CLI contract).
+
+Usage:  python -m annotatedvdb_tpu export --storeDir ./vdb --out ./corpus \
+            [--chromosome 19 | --region chr19:1000-50000] [--commit] \
+            [--seed 7] [--ordered] [--resume] [--hostOnly] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from annotatedvdb_tpu.config import (
+    StoreConfig,
+    add_lifecycle_args,
+    add_runtime_args,
+    runtime_from_args,
+)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="export the store as a tokenized training corpus"
+    )
+    parser.add_argument("--storeDir", required=True,
+                        help="variant store directory")
+    parser.add_argument("--out", required=True,
+                        help="corpus output directory (created if missing)")
+    parser.add_argument("--chromosome", default=None,
+                        help="export one chromosome (default: whole store)")
+    parser.add_argument("--region", default=None, metavar="CHR:START-END",
+                        help="export one region slice ([chr]N:start-end)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="corpus shuffle seed (default "
+                             "AVDB_EXPORT_SHUFFLE_SEED; same seed => "
+                             "byte-identical corpus)")
+    parser.add_argument("--ordered", action="store_true",
+                        help="emit batches in plan order (no shuffle)")
+    parser.add_argument("--resume", action="store_true",
+                        help="continue after the last ledger-committed part")
+    parser.add_argument("--hostOnly", action="store_true",
+                        help="pack on the byte-identical numpy twin "
+                             "(no device)")
+    parser.add_argument("--batchRows", type=int, default=None,
+                        help="rows per fixed-shape batch (default "
+                             "AVDB_EXPORT_BATCH_ROWS)")
+    parser.add_argument("--partBytes", default=None, metavar="BYTES",
+                        help="target part size, e.g. 8m (default "
+                             "AVDB_EXPORT_PART_BYTES)")
+    add_lifecycle_args(parser)
+    add_runtime_args(parser)
+    from annotatedvdb_tpu.obs import add_obs_args
+
+    add_obs_args(parser)
+    args = parser.parse_args(argv)
+    if args.chromosome and args.region:
+        parser.error("--chromosome and --region are mutually exclusive")
+
+    runtime = runtime_from_args(args)
+    try:
+        runtime.validate()  # flag VALUES only; env/runtime errors propagate
+    except ValueError as err:
+        parser.error(str(err))
+    runtime.apply()  # platform pin (the export kernel compiles once)
+
+    store, ledger = StoreConfig(args.storeDir).open(create=False,
+                                                    readonly=True)
+
+    from annotatedvdb_tpu.utils.logging import load_logger
+
+    log, _logger, log_path = load_logger(args.out, "export",
+                                         args.logFilePath)
+    log(f"export {args.storeDir} -> {args.out} "
+        f"(commit={args.commit}, log={log_path})")
+
+    from annotatedvdb_tpu.export.core import run_export
+    from annotatedvdb_tpu.obs import ObsSession
+
+    obs = ObsSession.from_args("export", args, {
+        "store": args.storeDir, "out": args.out,
+        "commit": args.commit, "test": args.test, "resume": args.resume,
+        "chromosome": args.chromosome, "region": args.region,
+        "seed": args.seed, "ordered": args.ordered,
+        "host_only": args.hostOnly,
+    })
+    # the run ledger must witness every abort, not just clean exits —
+    # the load_vcf lifecycle discipline
+    try:
+        summary = run_export(
+            store, ledger, args.storeDir, args.out,
+            chromosome=args.chromosome, region=args.region,
+            batch_rows=args.batchRows, part_bytes=args.partBytes,
+            seed=args.seed, ordered=args.ordered,
+            resume=args.resume, commit=args.commit,
+            host_only=args.hostOnly,
+            max_parts=1 if args.test else None,
+            log=log,
+        )
+    except BaseException as exc:
+        obs.abort(ledger, exc, store=store)
+        raise
+    if args.commit:
+        log(f"COMMITTED {summary['parts_written']} part(s), "
+            f"{summary['rows']} rows, {summary['tokens']} tokens")
+    else:
+        log("DRY RUN (pass --commit to write): "
+            f"{summary['n_parts']} part(s), {summary['total_rows']} rows "
+            "planned")
+    obs.finish(ledger, summary, store=store)
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -256,8 +256,22 @@ ENV_VARS: dict = {
                            "(default 0 = declared but dormant; alerts "
                            "when the windowed avdb_rows_total rate "
                            "drops below it)",
+    # ML corpus export (annotatedvdb_tpu/export)
+    "AVDB_EXPORT_BATCH_ROWS": "rows per fixed-shape export batch (default "
+                              "4096): every batch of a corpus shares this "
+                              "one shape — one traced pack kernel, "
+                              "explicit validity mask at the ragged tail",
+    "AVDB_EXPORT_SHUFFLE_SEED": "corpus shuffle seed (default 0): same "
+                                "seed => byte-identical corpus; the "
+                                "export CLI's --seed overrides, --ordered "
+                                "disables the shuffle",
+    "AVDB_EXPORT_PART_BYTES": "target committed corpus-part size (default "
+                              "8m; k/m/g suffixes): parts hold a "
+                              "deterministic whole number of batches",
     # bench / test gates
     "AVDB_BENCH_ROWS": "synthetic row count for bench.py runs",
+    "AVDB_BENCH_EXPORT_ROWS": "synthetic row count for the bench.py "
+                              "--export corpus leg (default 120000)",
     "AVDB_BENCH_E2E_RUNS": "median-of-N run count for the end-to-end load "
                            "bench leg (default 5)",
     "AVDB_BENCH_VEP_RUNS": "median-of-N run count for the VEP bench leg "

@@ -1,0 +1,71 @@
+"""Single-source region token layout: serving ``tokenize=True`` + export.
+
+PR-8 defined the interval-tokenization envelope (per query interval: bin
+token + post-dedup row span) inline in ``serve/engine.py``; until this
+module the layout was pinned only by tests, so a second consumer — the
+corpus export packer — would have silently forked it.  Both consumers now
+share ONE field list (:data:`TOKEN_FIELDS`), one memoized ltree-path
+renderer (:func:`bin_path`), and one envelope builder
+(:func:`build_region_tokens`).
+
+Import-light on purpose (no jax, no store): the serve engine imports this
+at module top on the request path, and ``export/writer.py``-level tooling
+(fsck, smoke scripts) must be able to reach the layout without paying for
+an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from annotatedvdb_tpu.oracle.binindex import closed_form_path
+from annotatedvdb_tpu.types import chromosome_label
+
+#: the region token envelope, in wire order — the PR-8 layout.  Every
+#: consumer (serve ``tokenize=True``, the corpus manifest, the export
+#: stream) carries exactly these fields; tests pin the list itself.
+TOKEN_FIELDS = (
+    "generation",   # store generation the spans were computed against
+    "bin_level",    # deepest enclosing bin level per interval (int8 list)
+    "leaf_bin",     # leaf-bin ordinal per interval (int32 list)
+    "bin_index",    # ltree path string per interval (closed-form)
+    "row_lo",       # post-dedup row span start, -1 when no index group
+    "row_hi",       # post-dedup row span end (exclusive), -1 when absent
+    "count",        # span width == post-dedup intersection count
+)
+
+
+@functools.lru_cache(maxsize=8192)
+def bin_path(label: str, level: int, leaf: int) -> str:
+    """Memoized ltree path: rows cluster into few (level, leaf) pairs —
+    a 20kb region spans ~2 leaves — so path assembly amortizes away."""
+    return closed_form_path(label, level, leaf)
+
+
+def build_region_tokens(generation, codes, level, leaf, lo, hi, has_index):
+    """The tokenize envelope for one batch of query intervals.
+
+    ``codes`` — chromosome code per interval; ``level``/``leaf``/``lo``/
+    ``hi`` — the BITS kernel outputs (numpy, one row per interval);
+    ``has_index`` — whether the interval's chromosome group has any rows
+    (spans against an absent group report ``-1`` bounds, count 0).  Field
+    set and value encoding are the serving contract: keep byte-identical
+    to what ``QueryEngine.regions_serve`` always returned.
+    """
+    n = len(codes)
+    return {
+        "generation": generation,
+        "bin_level": level.tolist(),
+        "leaf_bin": leaf.tolist(),
+        "bin_index": [
+            bin_path(chromosome_label(codes[i]), int(level[i]), int(leaf[i]))
+            for i in range(n)
+        ],
+        "row_lo": [
+            int(lo[i]) if has_index[i] else -1 for i in range(n)
+        ],
+        "row_hi": [
+            int(hi[i]) if has_index[i] else -1 for i in range(n)
+        ],
+        "count": (hi - lo).tolist(),
+    }

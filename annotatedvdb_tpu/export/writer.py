@@ -1,0 +1,163 @@
+"""Corpus part/manifest writers: byte-deterministic, crash-safe shards.
+
+A corpus directory is ``part-<n>.npz`` files plus one
+``corpus.manifest.json`` describing them — self-describing: the manifest
+carries the token layout, the per-chromosome allele dictionaries, the
+shuffle seed, and a sha256 per part, so a trainer needs nothing but the
+directory.
+
+**Byte determinism.**  ``np.savez`` embeds zip member timestamps, so two
+runs of the same plan would produce different part bytes and the
+replay-exactness contract (same seed ⇒ byte-identical corpus) could never
+be byte-verified.  Parts therefore use the store's own flat sequential
+container (``variant_store._write_segment`` precedent): one JSON header
+line naming the arrays, then each array as a raw ``.npy`` stream
+(``np.lib.format.write_array``).  The ``.npz`` extension is kept for
+tooling familiarity; :func:`read_part` sniffs the leading byte (``{`` vs
+zip's ``P``) exactly like the segment reader.
+
+**Durability.**  Every part lands tmp → fsync → atomic rename (the
+AVDB10xx protocol; ``AVDB_IO_TRACE=1`` sanitizes the ordering in
+``tools/export_smoke.py``), and the manifest commits LAST through the
+blessed ``tio.replace_manifest`` — so a SIGKILL at any instant leaves
+either a committed prefix of the corpus or prunable ``*.export.tmp*``
+debris, never a torn part.  fsck attributes that debris with the
+dedicated ``export-tmp`` finding via :func:`is_export_tmp` (this module
+stays import-light so fsck can reach the predicate without jax).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from annotatedvdb_tpu.utils import faults
+from annotatedvdb_tpu.utils import io as tio
+
+#: the corpus directory's self-description (committed last, atomically)
+MANIFEST_NAME = "corpus.manifest.json"
+
+#: marker every part-staging temp carries (``part-<n>.npz.export.tmp<pid>``)
+EXPORT_TMP_MARKER = ".export.tmp"
+
+
+def part_name(n: int) -> str:
+    """Committed shard file name for part ordinal ``n`` (zero-based)."""
+    return f"part-{n:06d}.npz"
+
+
+def is_export_tmp(fname: str) -> bool:
+    """True for export-subsystem scratch debris: a part-staging temp
+    (``part-*.npz.export.tmp<pid>``) or an abandoned manifest temp
+    (``.corpus.manifest.json.tmp<pid>``).  fsck checks this FIRST in its
+    directory scan so export debris is never attributed ``stale-tmp`` or
+    ``foreign-file`` — the finding names the subsystem that made it."""
+    return EXPORT_TMP_MARKER in fname or (MANIFEST_NAME + ".tmp") in fname
+
+
+def prune_debris(out_dir: str) -> list[str]:
+    """Unlink abandoned export temps in ``out_dir`` (resume's first act:
+    a SIGKILL mid-part strands exactly one).  Returns pruned names."""
+    pruned = []
+    for fname in sorted(os.listdir(out_dir)):
+        fp = os.path.join(out_dir, fname)
+        if os.path.isfile(fp) and is_export_tmp(fname):
+            tio.unlink(fp)
+            pruned.append(fname)
+    return pruned
+
+
+def write_part(out_dir: str, n: int, arrays: dict) -> dict:
+    """Commit one corpus part atomically; returns its ledger record body
+    (``{"part": n, "file": ..., "sha256": ..., "bytes": ...}``).
+
+    ``arrays`` maps name -> ndarray, written in the given (deterministic)
+    order.  The ``export.commit`` crash point fires on the staged temp
+    after the body is written and before the fsync/rename — a torn-write
+    or SIGKILL there must strand only ``*.export.tmp*`` debris.
+    """
+    final = os.path.join(out_dir, part_name(n))
+    tmp = final + EXPORT_TMP_MARKER + str(os.getpid())
+    digest = hashlib.sha256()
+    header = (
+        json.dumps({"corpus": 1, "names": list(arrays)}) + "\n"
+    ).encode()
+    total = len(header)
+    with tio.open(tmp, "wb", buffering=1 << 20) as f:
+        f.write(header)
+        digest.update(header)
+        for name in arrays:
+            buf = _npy_bytes(np.ascontiguousarray(arrays[name]))
+            f.write(buf)
+            digest.update(buf)
+            total += len(buf)
+        # crash point: a death here leaves a staged temp, never a part
+        faults.fire("export.commit", f)
+        f.flush()
+        # unconditional: the rename below lands a durable name, and the
+        # AVDB_IO_TRACE sanitizer (export_smoke) flags never-fsynced bytes
+        # renamed onto one
+        tio.fsync(f)
+    tio.replace(tmp, final)
+    if tio.fsync_wanted():
+        tio.fsync_dir(out_dir)
+    return {
+        "part": n,
+        "file": part_name(n),
+        "sha256": digest.hexdigest(),
+        "bytes": total,
+    }
+
+
+def _npy_bytes(arr) -> bytes:
+    """The exact ``.npy`` stream ``write_array`` produces for ``arr`` —
+    built once and both written and hashed, so the manifest digest is the
+    committed file's bytes by construction."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.lib.format.write_array(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def read_part(path: str) -> dict:
+    """Load one committed part back into ``{name: ndarray}``.  Sniffs the
+    container byte like the segment reader: ``{`` is the flat container
+    (the only format this writer emits); anything else is corrupt."""
+    with open(path, "rb") as f:
+        lead = f.read(1)
+        if lead != b"{":
+            raise ValueError(
+                f"{path}: not a flat-container corpus part "
+                f"(leading byte {lead!r})"
+            )
+        header = json.loads(lead + f.readline())
+        if header.get("corpus") != 1:
+            raise ValueError(f"{path}: unknown corpus container version")
+        return {
+            name: np.lib.format.read_array(f, allow_pickle=False)
+            for name in header["names"]
+        }
+
+
+def write_manifest(out_dir: str, doc: dict) -> None:
+    """Atomic manifest commit (the blessed helper; fsck/save attribute its
+    dot-prefixed temp).  The ``export.commit`` point fires on the staged
+    temp too: the matrix proves a death between the last part and the
+    manifest still resumes to the reference corpus."""
+    tio.replace_manifest(
+        os.path.join(out_dir, MANIFEST_NAME), doc,
+        pre_sync=lambda f: faults.fire("export.commit", f),
+    )
+
+
+def read_manifest(out_dir: str) -> dict | None:
+    """The committed manifest, or None when the directory has none yet."""
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

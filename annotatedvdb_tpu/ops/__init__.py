@@ -53,6 +53,10 @@ TWINS: dict = {
     # reductions, so the twins are byte-exact by construction
     "ops.stats.stats_panel_kernel_jit": "ops.stats.stats_panel_host",
     "ops.stats.windowed_stats_kernel_jit": "ops.stats.windowed_stats_host",
+    # corpus export packing (ops/export_pack.py): elementwise int32/int8
+    # tokenize+mask, so the twin is byte-exact by construction
+    "ops.export_pack.export_pack_kernel_jit":
+        "ops.export_pack.export_pack_host",
 }
 
 __all__ = ["annotate_kernel", "bin_index_kernel", "LEAF_SIZE",

@@ -1,0 +1,104 @@
+"""Fixed-shape corpus batch packing: tokenize + mask on device.
+
+The export subsystem (``annotatedvdb_tpu/export``) streams store rows out
+as training batches.  Each batch arrives host-side as seven int32 columns
+padded to ``AVDB_EXPORT_BATCH_ROWS`` (one traced program per batch shape —
+the bounded-recompile discipline of ``ops/intervals``), and this kernel
+does the device-side work in one call:
+
+- the hierarchical bin token per row — ``(bin_level, leaf_bin)`` of the
+  deepest bin enclosing ``[pos, end]`` where ``end = pos + ref_len - 1``,
+  the SAME closed-form arithmetic as ``ops.binindex``/``ops.intervals``
+  (a variant row's interval token, arXiv 2511.01555);
+- the validity mask (``row < n_valid``) and uniform ``-1`` masking of the
+  padded tail across every output column, so a ragged final chunk is
+  distinguishable from data by construction (``STATS_MISSING`` is also
+  ``-1``: one sentinel for "not a value" everywhere).
+
+Inputs must be pre-clamped/pre-padded by the caller (pad ``pos``/``end``
+with 1, features with ``-1``; clamp ``end`` to ``intervals.MAX_QUERY_POS``)
+— the kernel is pure elementwise/int arithmetic, so the numpy twin
+(:func:`export_pack_host`) is byte-identical by construction and is the
+path the serving breaker or an explicit ``host_only`` always takes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from annotatedvdb_tpu.ops.binindex import LEAF_SIZE, NUM_BIN_LEVELS
+
+
+def export_pack_kernel(pos, end, ref_code, alt_code, af_fp, cadd_fp,
+                       rank_i, n_valid):
+    """Pack one fixed-shape export batch.
+
+    All array inputs int32 ``[B]``; ``n_valid`` int32 scalar (rows beyond
+    it are padding).  Returns ``(mask [B] bool, bin_level [B] int8,
+    leaf_bin [B] int32, pos, ref_code, alt_code, af_fp, cadd_fp, rank_i)``
+    with every padded lane forced to ``-1`` (``mask`` False)."""
+    pos = pos.astype(jnp.int32)
+    end = end.astype(jnp.int32)
+    valid = jnp.arange(pos.shape[0], dtype=jnp.int32) < n_valid
+    a = (pos - 1) // LEAF_SIZE
+    b = (end - 1) // LEAF_SIZE
+    x = a ^ b
+    shifts = jnp.arange(NUM_BIN_LEVELS, dtype=jnp.int32)
+    mism = jnp.sum(
+        (x[:, None] >> shifts[None, :]) != 0, axis=1, dtype=jnp.int32
+    )
+    level = (NUM_BIN_LEVELS - mism).astype(jnp.int8)
+    neg1 = jnp.int32(-1)
+
+    def m(col):
+        return jnp.where(valid, col.astype(jnp.int32), neg1)
+
+    return (
+        valid,
+        jnp.where(valid, level, jnp.int8(-1)),
+        m(a),
+        m(pos),
+        m(ref_code),
+        m(alt_code),
+        m(af_fp),
+        m(cadd_fp),
+        m(rank_i),
+    )
+
+
+export_pack_kernel_jit = jax.jit(export_pack_kernel)
+
+
+def export_pack_host(pos, end, ref_code, alt_code, af_fp, cadd_fp,
+                     rank_i, n_valid):
+    """Numpy twin of :func:`export_pack_kernel` — identical arithmetic on
+    identical int32 values, so outputs are byte-identical (the twin
+    contract ``ops.TWINS`` registers and ``tests/test_export.py`` pins)."""
+    pos = np.asarray(pos, np.int32)
+    end = np.asarray(end, np.int32)
+    valid = np.arange(pos.shape[0], dtype=np.int32) < np.int32(n_valid)
+    a = (pos - 1) // LEAF_SIZE
+    b = (end - 1) // LEAF_SIZE
+    x = a ^ b
+    shifts = np.arange(NUM_BIN_LEVELS, dtype=np.int32)
+    mism = np.sum(
+        (x[:, None] >> shifts[None, :]) != 0, axis=1, dtype=np.int32
+    )
+    level = (NUM_BIN_LEVELS - mism).astype(np.int8)
+
+    def m(col):
+        return np.where(valid, np.asarray(col, np.int32), np.int32(-1))
+
+    return (
+        valid,
+        np.where(valid, level, np.int8(-1)),
+        m(a),
+        m(pos),
+        m(ref_code),
+        m(alt_code),
+        m(af_fp),
+        m(cadd_fp),
+        m(rank_i),
+    )

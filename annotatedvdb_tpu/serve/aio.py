@@ -63,6 +63,11 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import unquote, urlparse
 
+from annotatedvdb_tpu.export.stream import (
+    STREAM_ROUTE as EXPORT_STREAM_ROUTE,
+    parse_stream_query,
+    stream_payload,
+)
 from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
 from annotatedvdb_tpu.serve.batcher import QueueFull
@@ -75,10 +80,12 @@ from annotatedvdb_tpu.serve.http import (
     _RETURNED_RE,
     BULK_BODY_ERROR,
     MSG_BROWNOUT_BULK,
+    MSG_BROWNOUT_EXPORT,
     MSG_BROWNOUT_REGION,
     MSG_BROWNOUT_STATS,
     MSG_BROWNOUT_UPSERT,
     MSG_CAPACITY_BULK,
+    MSG_CAPACITY_EXPORT,
     MSG_CAPACITY_REGION,
     MSG_CAPACITY_STATS,
     HISTORY_ROUTE,
@@ -1322,6 +1329,20 @@ class AioServer:
                 )
                 return ("exec", fut, "repl", time.perf_counter(),
                         tid, None), keep, tid
+            if path == EXPORT_STREAM_ROUTE:
+                if ctx.governor.shed_bulk():
+                    ctx.brownout_shed()
+                    return _error(503, MSG_BROWNOUT_EXPORT), keep, tid
+                retry = self._admit_client(headers, writer)
+                if retry:
+                    ctx.rejected("export")
+                    return _error(
+                        429, "client over rate (export admission)",
+                        retry_after=max(int(retry + 0.999), 1),
+                    ), keep, tid
+                return self._export_item(
+                    url.query, deadline_t, tid
+                ), keep, tid
             return _error(404, f"no such route: {path}"), keep, tid
         if method == "POST":
             try:
@@ -1855,6 +1876,61 @@ class AioServer:
                         rows=result.returned)
             if trace is not None:
                 trace.add("render", time.perf_counter() - t_render)
+            return resp
+        finally:
+            ctx.release()
+
+    def _export_item(self, query: str, deadline_t: float | None = None,
+                     tid: str | None = None):
+        """``GET /export/stream``: the stats admission shape (inflight
+        slot + deadline), execution through the shared payload builder
+        on the executor (kernel pack + allele render are CPU/device
+        work, never event-loop work — AVDB701)."""
+        ctx = self.ctx
+        t0 = time.perf_counter()
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            return _error(504, MSG_DEADLINE_ADMISSION)
+        if not ctx.admit():
+            ctx.rejected("export")
+            return _error(429, MSG_CAPACITY_EXPORT, retry_after=1)
+        trace = ctx.reqtrace.begin(tid, "export") if tid is not None \
+            else None
+        fut = self._loop.run_in_executor(
+            self._pool, self._export_work, query, t0, deadline_t, trace
+        )
+        return ("exec", fut, "export", t0, tid, trace)
+
+    def _export_work(self, query: str, t0: float,
+                     deadline_t: float | None = None, trace=None) -> bytes:
+        """Executor half of an export-stream request (parse, pack,
+        render, account); never raises — errors become response bytes."""
+        ctx = self.ctx
+        try:
+            if deadline_t is not None and time.monotonic() >= deadline_t:
+                ctx.deadline_shed("execute")
+                return _error(504, MSG_DEADLINE_EXECUTE)
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
+            try:
+                params = parse_stream_query(query)
+            except ValueError as err:  # QueryError subclasses ValueError
+                ctx.errored("export")
+                return _error(400, str(err))
+            try:
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    body, n_valid = stream_payload(ctx.engine, params)
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
+            except QueryError as err:
+                ctx.errored("export")
+                return _error(400, str(err))
+            except Exception as err:
+                ctx.errored("export")
+                return _error(500, f"{type(err).__name__}: {err}")
+            resp = _resp(200, body)
+            ctx.observe("export", time.perf_counter() - t0, rows=n_valid)
             return resp
         finally:
             ctx.release()

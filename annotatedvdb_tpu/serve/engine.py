@@ -66,6 +66,8 @@ from annotatedvdb_tpu.obs import reqtrace
 from annotatedvdb_tpu.ops import intervals as interval_ops
 from annotatedvdb_tpu.ops import stats as stats_ops
 from annotatedvdb_tpu.ops.binindex import bin_index_kernel_jit
+from annotatedvdb_tpu.export.tokens import bin_path as _bin_path
+from annotatedvdb_tpu.export.tokens import build_region_tokens
 from annotatedvdb_tpu.oracle.binindex import closed_form_path
 from annotatedvdb_tpu.store.variant_store import (
     _DIGEST_PK,
@@ -220,11 +222,25 @@ def _region_bin(start: int, end: int) -> tuple[int, int]:
     return int(level[0]), int(leaf[0])
 
 
-@functools.lru_cache(maxsize=8192)
-def _bin_path(label: str, level: int, leaf: int) -> str:
-    """Memoized ltree path: rows cluster into few (level, leaf) pairs —
-    a 20kb region spans ~2 leaves — so path assembly amortizes away."""
-    return closed_form_path(label, level, leaf)
+def segment_alleles(seg, j: int, width: int) -> tuple[str, str]:
+    """(ref, alt) strings for one segment row: retained original strings
+    for the over-width tail, decoded device bytes otherwise (the scalar
+    definition ``shard.alleles`` pins).  Single source for every renderer
+    — ``_render_row`` here and the export dictionary coder both call it,
+    so a corpus decode can never diverge from the serving JSON."""
+    la = seg.obj[_LONG_ALLELES]
+    if la is not None and la[j] is not None:
+        ref, alt = la[j]
+        return ref, alt
+    ref_len = int(seg.cols["ref_len"][j])
+    alt_len = int(seg.cols["alt_len"][j])
+    if ref_len > width or alt_len > width:
+        raise ValueError(
+            f"allele length {max(ref_len, alt_len)} exceeds store "
+            f"width {width} with no retained strings (store predates "
+            "long-allele retention; reload from source)"
+        )
+    return decode_allele(seg.ref[j], ref_len), decode_allele(seg.alt[j], alt_len)
 
 
 def render_variant(shard, code: int, gid: int) -> str:
@@ -238,22 +254,7 @@ def _render_row(seg, j: int, label: str, width: int) -> str:
     splice through ``jsonb_dumps`` — raw-text columns copy verbatim).
     Identity strings are assembled without ``json.dumps``: alleles, labels,
     and PKs are [A-Za-z0-9:._-] by construction, nothing to escape."""
-    # alleles: retained original strings for the over-width tail, decoded
-    # device bytes otherwise (the scalar definition shard.alleles pins)
-    la = seg.obj[_LONG_ALLELES]
-    if la is not None and la[j] is not None:
-        ref, alt = la[j]
-    else:
-        ref_len = int(seg.cols["ref_len"][j])
-        alt_len = int(seg.cols["alt_len"][j])
-        if ref_len > width or alt_len > width:
-            raise ValueError(
-                f"allele length {max(ref_len, alt_len)} exceeds store "
-                f"width {width} with no retained strings (store predates "
-                "long-allele retention; reload from source)"
-            )
-        ref = decode_allele(seg.ref[j], ref_len)
-        alt = decode_allele(seg.alt[j], alt_len)
+    ref, alt = segment_alleles(seg, j, width)
     pos = int(seg.cols["pos"][j])
     rs = int(seg.cols["ref_snp"][j])
     adsp = int(seg.cols["is_adsp_variant"][j])
@@ -1137,25 +1138,14 @@ class QueryEngine:
             ))
         tokens = None
         if tokenize:
-            tokens = {
-                "generation": snap.generation,
-                "bin_level": level.tolist(),
-                "leaf_bin": leaf.tolist(),
-                "bin_index": [
-                    _bin_path(chromosome_label(parsed[i][0]),
-                              int(level[i]), int(leaf[i]))
-                    for i in range(n)
-                ],
-                "row_lo": [
-                    int(lo[i]) if indexes[parsed[i][0]] is not None else -1
-                    for i in range(n)
-                ],
-                "row_hi": [
-                    int(hi[i]) if indexes[parsed[i][0]] is not None else -1
-                    for i in range(n)
-                ],
-                "count": (hi - lo).tolist(),
-            }
+            # the PR-8 envelope now lives in export.tokens — the export
+            # packer shares the exact field list and path renderer
+            tokens = build_region_tokens(
+                snap.generation,
+                [parsed[i][0] for i in range(n)],
+                level, leaf, lo, hi,
+                [indexes[parsed[i][0]] is not None for i in range(n)],
+            )
         return RegionsResult(pages, tokens)
 
     # -- analytics (the fused stats panel) -----------------------------------

@@ -50,6 +50,11 @@ from urllib.parse import parse_qs, unquote, urlparse
 #: pulls "returned":N out of the region envelope prefix (fixed field order)
 _RETURNED_RE = re.compile(r'"returned":(\d+)')
 
+from annotatedvdb_tpu.export.stream import (
+    STREAM_ROUTE as EXPORT_STREAM_ROUTE,
+    parse_stream_query,
+    stream_payload,
+)
 from annotatedvdb_tpu.obs import reqtrace as reqtrace_mod
 from annotatedvdb_tpu.obs.metrics import MetricsRegistry
 from annotatedvdb_tpu.obs.reqtrace import TraceRecorder
@@ -492,6 +497,10 @@ MSG_BROWNOUT_STATS = (
 MSG_CAPACITY_BULK = "server at capacity (bulk admission bound)"
 MSG_CAPACITY_REGION = "server at capacity (region admission bound)"
 MSG_CAPACITY_STATS = "server at capacity (stats admission bound)"
+MSG_BROWNOUT_EXPORT = (
+    "brownout: export reads shed (point reads keep serving)"
+)
+MSG_CAPACITY_EXPORT = "server at capacity (export admission bound)"
 
 #: the analytics route path — shared so the two front ends' routing
 #: cannot drift (the UPSERT_ROUTE convention)
@@ -806,7 +815,7 @@ class ServeContext:
         # indexes a dict instead of re-registering per request
         self._kind = {}
         for kind in ("point", "bulk", "region", "regions", "stats",
-                     "upsert"):
+                     "export", "upsert"):
             labels = {"kind": kind}
             self._kind[kind] = (
                 registry.counter(
@@ -1287,6 +1296,9 @@ class ServeHandler(BaseHTTPRequestHandler):
             # 404s byte-identically to any unknown route
             self._reply(200, debug_trace_payload(ctx))
             return
+        if path == EXPORT_STREAM_ROUTE:
+            self._export_stream(ctx, url.query)
+            return
         if path.startswith("/variant/"):
             self._point(ctx, path[len("/variant/"):])
             return
@@ -1622,6 +1634,59 @@ class ServeHandler(BaseHTTPRequestHandler):
                         rows=result.returned)
             if trace is not None:
                 trace.add("render", time.perf_counter() - t_render)
+            ctx.reqtrace.finish(trace, 200)
+            self._reply(200, body)
+        finally:
+            ctx.release()
+
+    def _export_stream(self, ctx: ServeContext, query: str) -> None:
+        """``GET /export/stream``: one packed corpus batch of a region
+        slice — the bulk admission shape of ``_stats`` (brownout shed,
+        deadline at admission, inflight slot, 429), execution through the
+        shared :func:`stream_payload` builder (device kernel behind the
+        breaker, byte-identical host twin when it is open)."""
+        t0 = time.perf_counter()
+        if ctx.governor.shed_bulk():
+            ctx.brownout_shed()
+            self._error(503, MSG_BROWNOUT_EXPORT)
+            return
+        deadline_t = ctx.request_deadline(self.headers.get("X-Deadline-Ms"))
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            ctx.deadline_shed("admission")
+            self._error(504, MSG_DEADLINE_ADMISSION)
+            return
+        if not ctx.admit():
+            ctx.rejected("export")
+            self._error(429, MSG_CAPACITY_EXPORT)
+            return
+        try:
+            ctx.refresh_snapshot()
+            try:
+                params = parse_stream_query(query)
+            except ValueError as err:  # QueryError subclasses ValueError
+                ctx.errored("export")
+                self._error(400, str(err))
+                return
+            trace = ctx.reqtrace.begin(self._trace_id, "export")
+            if trace is not None:
+                trace.add("admission", time.perf_counter() - t0)
+            try:
+                t_dev = time.perf_counter()
+                with reqtrace_mod.activate(trace):
+                    body, n_valid = stream_payload(ctx.engine, params)
+                if trace is not None:
+                    trace.add("device", time.perf_counter() - t_dev)
+            except QueryError as err:
+                ctx.errored("export")
+                ctx.reqtrace.finish(trace, 400)
+                self._error(400, str(err))
+                return
+            except Exception as err:
+                ctx.errored("export")
+                ctx.reqtrace.finish(trace, 500)
+                self._error(500, f"{type(err).__name__}: {err}")
+                return
+            ctx.observe("export", time.perf_counter() - t0, rows=n_valid)
             ctx.reqtrace.finish(trace, 200)
             self._reply(200, body)
         finally:

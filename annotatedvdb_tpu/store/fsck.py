@@ -205,6 +205,7 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
                         damaged.add((label, gi))
 
     # ---- directory scan: orphans, stale tmp, foreign files -----------------
+    from annotatedvdb_tpu.export.writer import is_export_tmp
     from annotatedvdb_tpu.store.compact import is_compact_tmp
     from annotatedvdb_tpu.store.memtable import is_flush_tmp
     from annotatedvdb_tpu.store.replication import is_repl_cursor, is_repl_tmp
@@ -213,6 +214,19 @@ def fsck(store_dir: str, deep: bool = False, repair: bool = False,
     for fname in sorted(os.listdir(store_dir)):
         fp = os.path.join(store_dir, fname)
         if not os.path.isfile(fp):
+            continue
+        if is_export_tmp(fname):
+            # export staging debris (a killed `avdb export` into this
+            # directory): parts commit tmp -> fsync -> rename and the
+            # corpus manifest commits last, so nothing references these —
+            # checked BEFORE the generic dot-prefix branch (the manifest
+            # temp is dot-prefixed) and never attributed foreign-file
+            note("warn", "export-tmp",
+                 f"{fp}: abandoned corpus-export temp from a killed "
+                 "`avdb export` (resume prunes it and re-stages the part)")
+            if repair:
+                tio.unlink(fp)
+                did(f"removed {fp} (export --resume re-stages it)")
             continue
         if fname.startswith(".") and ".tmp" in fname:
             note("warn", "stale-tmp",

@@ -183,6 +183,20 @@ class AlgorithmLedger:
                 e for e in self._entries if e.get("type") == "compact"
             ]
 
+    def export(self, record: dict) -> None:
+        """Append one ``{"type": "export"}`` record — a committed corpus
+        part (``export/core.py``: output dir, plan signature, part ordinal,
+        file, sha256, rows).  ``avdb export --resume`` replans and skips
+        every part recorded here; load resume/undo logic ignores it."""
+        self._append({"type": "export", **record, "ts": time.time()})
+
+    def exports(self) -> list[dict]:
+        """All export records, oldest first (the resume read path)."""
+        with self._lock:
+            return [
+                e for e in self._entries if e.get("type") == "export"
+            ]
+
     def flush(self, record: dict) -> None:
         """Append one ``{"type": "flush"}`` maintenance record — the audit
         trail of a memtable flush (``store/memtable.py``: labels flushed,

@@ -179,6 +179,21 @@ arming any other name is a ``ValueError`` at parse time):
                             epoch-commit (``torn_write`` tears the
                             manifest tmp; the atomic replace never
                             happens, the store stays a follower)
+``export.plan``             in ``export.core.run_export`` after the corpus
+                            plan (and allele dictionaries) are computed,
+                            before anything touches the output directory —
+                            a death here must leave the corpus directory
+                            byte-untouched
+``export.pack``             per packed batch in the export materializer —
+                            the batch is tokenized, nothing staged; a
+                            death must land on a committed-part prefix of
+                            the reference corpus, resumable via the ledger
+``export.commit``           twice per durable export commit: on a part's
+                            staged ``*.export.tmp*`` after the body,
+                            before its fsync/rename (``torn_write`` tears
+                            only the temp), and on the corpus manifest tmp
+                            via the blessed ``replace_manifest`` pre-sync
+                            hook
 ======================== ====================================================
 
 **Process-death actions are subprocess-only.**  ``kill``/``torn_write``
@@ -242,6 +257,9 @@ POINTS = frozenset({
     "repl.apply",
     "repl.promote",
     "fsck.repair",
+    "export.plan",
+    "export.pack",
+    "export.commit",
 })
 
 #: points that fire inside a disposable serve WORKER process: the one

@@ -68,6 +68,7 @@ SERVE_QPS_TARGET = 10_000.0          # closed-loop concurrent point queries/sec
 # from the r05 closed-loop figure above — vs_baseline must divide each
 # metric by ITS OWN target, never mix the two anchors across records.
 SERVE_OPEN_LOOP_QPS_TARGET = 10_000.0  # SLO-gated offered queries/sec
+EXPORT_TOKENS_TARGET = 1_000_000.0   # corpus-export tokens/sec north star
 
 E2E_ROWS = int(os.environ.get("AVDB_BENCH_ROWS", 1 << 21))
 _BASES = "ACGT"
@@ -2453,12 +2454,141 @@ def serve_only():
     }))
 
 
+def _corpus_files_equal(a_dir: str, b_dir: str) -> bool:
+    """Byte-compare two corpus directories (manifest + every part)."""
+    names = sorted(
+        f for f in os.listdir(a_dir)
+        if f.endswith(".npz") or f == "corpus.manifest.json"
+    )
+    if names != sorted(
+        f for f in os.listdir(b_dir)
+        if f.endswith(".npz") or f == "corpus.manifest.json"
+    ):
+        return False
+    for name in names:
+        with open(os.path.join(a_dir, name), "rb") as fa, \
+                open(os.path.join(b_dir, name), "rb") as fb:
+            if fa.read() != fb.read():
+                return False
+    return bool(names)
+
+
+def export_only():
+    """One-command corpus-export bench (``python bench.py --export``):
+    the tokens/sec headline + device-idle occupancy of a one-shot
+    chromosome export, then the determinism battery — same-seed re-run,
+    ``--hostOnly`` twin, and a SIGKILL-mid-part + ``--resume`` run
+    through the real CLI — each byte-compared against the reference
+    corpus.  Pinned to CPU like the serving bench (the pack kernel is
+    shape-stable; relative numbers transfer), printed as one
+    schema-valid JSON line with ``mode: "export"``."""
+    import subprocess
+
+    os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+    from annotatedvdb_tpu.utils import runtime
+
+    platform = runtime.pin_platform("cpu")
+    import jax
+
+    from annotatedvdb_tpu.config import StoreConfig
+    from annotatedvdb_tpu.export.core import run_export
+
+    rows = int(os.environ.get("AVDB_BENCH_EXPORT_ROWS", 120_000))
+    seed, batch_rows, part_bytes = 11, 4096, "2m"
+    work = tempfile.mkdtemp(prefix="avdb_export_")
+    export: dict = {"rows": rows, "seed": seed, "batch_rows": batch_rows}
+    try:
+        store_dir, _ids = _build_serve_store(work, rows)
+        store, ledger = StoreConfig(store_dir).open(create=False,
+                                                    readonly=True)
+        ref = os.path.join(work, "ref")
+        settle()
+        summary = run_export(store, ledger, store_dir, ref,
+                             chromosome="1", seed=seed,
+                             batch_rows=batch_rows, part_bytes=part_bytes)
+        export["one_shot"] = {
+            "tokens_per_sec": summary["tokens_per_sec"],
+            "device_idle_frac": summary["device_idle_frac"],
+            "rows": summary["rows"], "tokens": summary["tokens"],
+            "parts": summary["parts_written"],
+            "seconds": summary["seconds"],
+            "complete": summary["complete"],
+        }
+        settle()
+        try:
+            rerun = os.path.join(work, "rerun")
+            run_export(store, ledger, store_dir, rerun, chromosome="1",
+                       seed=seed, batch_rows=batch_rows,
+                       part_bytes=part_bytes)
+            export["replay_identical"] = _corpus_files_equal(ref, rerun)
+        except Exception as exc:  # the legs after it must still record
+            export["replay_identical"] = False
+            export["replay_error"] = f"{type(exc).__name__}: {exc}"[:300]
+        settle()
+        try:
+            host = os.path.join(work, "host")
+            run_export(store, ledger, store_dir, host, chromosome="1",
+                       seed=seed, batch_rows=batch_rows,
+                       part_bytes=part_bytes, host_only=True)
+            export["host_twin_identical"] = _corpus_files_equal(ref, host)
+        except Exception as exc:
+            export["host_twin_identical"] = False
+            export["host_twin_error"] = f"{type(exc).__name__}: {exc}"[:300]
+        settle()
+        try:
+            # the durability leg rides the REAL CLI: SIGKILL on the 2nd
+            # part commit (env-armed fault), then --resume completes and
+            # the corpus must equal the uninterrupted reference
+            resumed = os.path.join(work, "resumed")
+            argv = [
+                sys.executable, "-m", "annotatedvdb_tpu", "export",
+                "--storeDir", store_dir, "--out", resumed, "--commit",
+                "--chromosome", "1", "--seed", str(seed),
+                "--batchRows", str(batch_rows), "--partBytes", part_bytes,
+            ]
+            env = dict(os.environ, AVDB_FAULT="export.commit:2:kill",
+                       AVDB_JAX_PLATFORM="cpu")
+            kill = subprocess.run(
+                argv, env=env, capture_output=True, timeout=600
+            )
+            env.pop("AVDB_FAULT")
+            resume = subprocess.run(
+                argv + ["--resume"], env=env, capture_output=True,
+                timeout=600,
+            )
+            export["resume"] = {
+                "killed_rc": kill.returncode,
+                "resume_rc": resume.returncode,
+                "identical": _corpus_files_equal(ref, resumed),
+            }
+        except Exception as exc:
+            export["resume"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]
+            }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    headline = export["one_shot"]["tokens_per_sec"]
+    print(json.dumps({
+        "metric": "export_tokens_per_sec",
+        "value": headline,
+        "unit": "tokens/sec",
+        "vs_baseline": round(headline / EXPORT_TOKENS_TARGET, 3),
+        "backend": jax.default_backend(),
+        "platform_pin": platform,
+        "mode": "export",
+        "export": export,
+    }))
+
+
 def main():
     if "--tpu-only" in sys.argv[1:]:
         tpu_only()
         return
     if "--serve" in sys.argv[1:]:
         serve_only()
+        return
+    if "--export" in sys.argv[1:]:
+        export_only()
         return
     if "--multichip" in sys.argv[1:]:
         multichip_only()

@@ -1965,3 +1965,138 @@ def test_fsck_repair_commit_fault_leaves_diagnosable_store(tmp_path, fault):
     assert report["exit_code"] in (0, 1), report
     assert fsck(store_dir, deep=True,
                 log=lambda m: None)["exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# export.plan / export.pack / export.commit — the training-corpus export
+# subsystem's kill points (export/core.py + export/writer.py).  Contract:
+# a death at ANY of them leaves the output directory a committed-part
+# PREFIX of the reference corpus (possibly empty, possibly plus prunable
+# ``*.export.tmp*`` debris — never a torn part), and ``--resume``
+# completes to bytes IDENTICAL to the uninterrupted run.
+
+
+def _corpus_bytes(out_dir):
+    if not os.path.isdir(out_dir):
+        return {}
+    out = {}
+    for fname in sorted(os.listdir(out_dir)):
+        if fname.endswith(".npz") or fname == "corpus.manifest.json":
+            with open(os.path.join(out_dir, fname), "rb") as f:
+                out[fname] = f.read()
+    return out
+
+
+@pytest.fixture()
+def export_refs(tmp_path):
+    """(store, ledger, store_dir, reference corpus bytes): a tiny store
+    whose whole-store export makes 2 one-batch parts — enough that every
+    export kill point has a real committed prefix to land on."""
+    from annotatedvdb_tpu.export.core import run_export
+
+    store_dir = str(tmp_path / "estore")
+    _tiny_store().save(store_dir)
+    store, ledger = StoreConfig(store_dir).open(create=False,
+                                                readonly=True)
+    ref_dir = str(tmp_path / "eref")
+    summary = run_export(store, ledger, store_dir, ref_dir, seed=5,
+                         batch_rows=2, part_bytes=1)
+    assert summary["parts_written"] == 2 and summary["complete"]
+    return store, ledger, store_dir, _corpus_bytes(ref_dir)
+
+
+@pytest.mark.parametrize("fault", [
+    "export.plan:1:raise",
+    "export.plan:1:eio",
+])
+def test_export_plan_fault_leaves_out_dir_untouched(export_refs, tmp_path,
+                                                    fault):
+    """export.plan fires after the plan exists in memory, before anything
+    touches the output directory: a death there must leave NO output
+    directory at all, and an unarmed re-run (no resume needed — nothing
+    was committed) produces the reference corpus."""
+    from annotatedvdb_tpu.export.core import run_export
+
+    store, ledger, store_dir, want = export_refs
+    out_dir = str(tmp_path / "out")
+    faults.reset(fault)
+    try:
+        with pytest.raises((faults.InjectedFault, OSError)):
+            run_export(store, ledger, store_dir, out_dir, seed=5,
+                       batch_rows=2, part_bytes=1)
+    finally:
+        faults.reset("")
+    assert not os.path.exists(out_dir)  # byte-untouched means ABSENT
+    run_export(store, ledger, store_dir, out_dir, seed=5,
+               batch_rows=2, part_bytes=1)
+    assert _corpus_bytes(out_dir) == want
+
+
+@pytest.mark.parametrize("fault", [
+    "export.pack:2:raise",
+    "export.pack:2:eio",
+])
+def test_export_pack_fault_lands_on_prefix_resume_completes(export_refs,
+                                                            tmp_path,
+                                                            fault):
+    """export.pack fires per tokenized batch, before staging: nth=2 dies
+    with part 0 already committed.  The durable state must be exactly the
+    reference's part-0 prefix (no manifest — it commits last), and
+    ``resume=True`` must complete to reference bytes without repacking
+    the committed part."""
+    from annotatedvdb_tpu.export.core import run_export
+
+    store, ledger, store_dir, want = export_refs
+    out_dir = str(tmp_path / "out")
+    faults.reset(fault)
+    try:
+        with pytest.raises((faults.InjectedFault, OSError)):
+            run_export(store, ledger, store_dir, out_dir, seed=5,
+                       batch_rows=2, part_bytes=1)
+    finally:
+        faults.reset("")
+    got = _corpus_bytes(out_dir)
+    assert set(got) == {"part-000000.npz"}  # committed prefix, no manifest
+    assert got["part-000000.npz"] == want["part-000000.npz"]
+    summary = run_export(store, ledger, store_dir, out_dir, seed=5,
+                         batch_rows=2, part_bytes=1, resume=True)
+    assert summary["resumed_parts"] == 1 and summary["parts_written"] == 1
+    assert _corpus_bytes(out_dir) == want
+
+
+@pytest.mark.parametrize("fault,n_committed", [
+    ("export.commit:1:raise", 0),   # dies staging part 0
+    ("export.commit:2:raise", 1),   # dies staging part 1 (part 0 durable)
+    ("export.commit:2:eio", 1),
+    ("export.commit:3:raise", 2),   # dies on the manifest temp, parts done
+])
+def test_export_commit_fault_strands_only_debris_resume_identical(
+        export_refs, tmp_path, fault, n_committed):
+    """export.commit fires on every staged temp (each part's, then the
+    manifest's) after the body is written, before its fsync/rename: a
+    death there strands exactly one ``*.export.tmp*`` temp next to the
+    committed prefix — never a torn part — and resume prunes the debris
+    and completes to reference bytes."""
+    from annotatedvdb_tpu.export.core import run_export
+    from annotatedvdb_tpu.export.writer import is_export_tmp
+
+    store, ledger, store_dir, want = export_refs
+    out_dir = str(tmp_path / "out")
+    faults.reset(fault)
+    try:
+        with pytest.raises((faults.InjectedFault, OSError)):
+            run_export(store, ledger, store_dir, out_dir, seed=5,
+                       batch_rows=2, part_bytes=1)
+    finally:
+        faults.reset("")
+    debris = [f for f in os.listdir(out_dir) if is_export_tmp(f)]
+    assert len(debris) == 1, debris
+    got = _corpus_bytes(out_dir)
+    assert set(got) == {f"part-{n:06d}.npz" for n in range(n_committed)}
+    for fname, body in got.items():
+        assert body == want[fname]
+    summary = run_export(store, ledger, store_dir, out_dir, seed=5,
+                         batch_rows=2, part_bytes=1, resume=True)
+    assert summary["resumed_parts"] == n_committed
+    assert _corpus_bytes(out_dir) == want
+    assert [f for f in os.listdir(out_dir) if is_export_tmp(f)] == []

@@ -156,7 +156,7 @@ def test_corrupt_fixture_repairs_end_to_end(tmp_path):
     assert report["exit_code"] == 2
     assert {"segment-torn", "segment-orphan", "stale-tmp", "compact-tmp",
             "wal-pending", "wal-tmp", "flush-tmp",
-            "repl-tmp", "repl-cursor",
+            "repl-tmp", "repl-cursor", "export-tmp",
             "ledger-torn", "undo-intent-dangling"} <= _codes(report)
     # the abandoned compaction/flush temps and the WAL are attributed,
     # never "foreign"

@@ -47,6 +47,10 @@ from annotatedvdb_tpu.ops.dedup import (
     mark_batch_duplicates_np,
     mix_chrom_hash,
 )
+from annotatedvdb_tpu.ops.export_pack import (
+    export_pack_host,
+    export_pack_kernel_jit,
+)
 from annotatedvdb_tpu.ops.hashing import (
     allele_hash_jit,
     allele_hash_mesh,
@@ -508,6 +512,35 @@ def test_pack_vep_outputs_vs_np_twin():
     dev = np.asarray(pack_vep_outputs_jit(h, prefix, fb))
     host = pack_vep_outputs_np(h, prefix, fb)
     np.testing.assert_array_equal(dev, host)
+
+
+def test_export_pack_vs_host_twin():
+    """Corpus-export batch packing: elementwise int32/int8 arithmetic on
+    both sides, so padded-lane masking and bin derivation are byte-exact
+    (the corpus-level battery lives in tests/test_export.py)."""
+    rng = np.random.RandomState(11)
+    b, n_valid = 64, 41
+    pos = np.sort(rng.randint(1, 3_000_000, b)).astype(np.int32)
+    end = (pos + rng.randint(0, 8, b)).astype(np.int32)
+    ref_code = rng.randint(0, 50, b).astype(np.int32)
+    alt_code = rng.randint(0, 50, b).astype(np.int32)
+    af_fp = rng.randint(-1, 10**6, b).astype(np.int32)
+    cadd_fp = rng.randint(-1, 4000, b).astype(np.int32)
+    rank_i = rng.randint(-1, 30, b).astype(np.int32)
+    dev = export_pack_kernel_jit(pos, end, ref_code, alt_code, af_fp,
+                                 cadd_fp, rank_i, n_valid)
+    host = export_pack_host(pos, end, ref_code, alt_code, af_fp,
+                            cadd_fp, rank_i, n_valid)
+    names = ("mask", "bin_level", "leaf_bin", "pos", "ref_code",
+             "alt_code", "af_fp", "cadd_fp", "rank_i")
+    for d, h, name in zip(dev, host, names):
+        d, h = np.asarray(d), np.asarray(h)
+        assert d.dtype == h.dtype, name
+        np.testing.assert_array_equal(d, h, err_msg=name)
+    # padded lanes are uniformly dead on both sides
+    assert not np.asarray(dev[0])[n_valid:].any()
+    for lane in dev[1:]:
+        assert (np.asarray(lane)[n_valid:] == -1).all()
 
 
 # ---------------------------------------------------------------------------

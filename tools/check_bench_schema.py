@@ -907,6 +907,55 @@ def _check_open_loop(ol, where: str, errors: list) -> None:
                 errors.append(f"{sw}: p99_ms below p50_ms")
 
 
+def _check_export(ex, where: str, errors: list) -> None:
+    """The ``export`` block of a ``mode: "export"`` record: the one-shot
+    throughput leg plus the determinism battery — every byte-compare
+    flag must be literally ``true`` (an export bench whose corpus is not
+    reproducible is a failed record, not a slow one)."""
+    ew = f"{where}.export"
+    if not isinstance(ex, dict):
+        errors.append(f"{ew}: must be an object")
+        return
+    _check_fields(
+        ex,
+        {"rows": _is_int, "seed": _is_int, "batch_rows": _is_int,
+         "one_shot": lambda v: isinstance(v, dict),
+         "replay_identical": lambda v: v is True,
+         "host_twin_identical": lambda v: v is True,
+         "resume": lambda v: isinstance(v, dict)},
+        ew, errors,
+        required=("rows", "seed", "batch_rows", "one_shot",
+                  "replay_identical", "host_twin_identical", "resume"),
+    )
+    one = ex.get("one_shot")
+    if isinstance(one, dict):
+        _check_fields(
+            one,
+            {"tokens_per_sec": _is_num, "device_idle_frac": _is_num,
+             "rows": _is_int, "tokens": _is_int, "parts": _is_int,
+             "seconds": _is_num,
+             "complete": lambda v: isinstance(v, bool)},
+            f"{ew}.one_shot", errors,
+            required=("tokens_per_sec", "device_idle_frac", "rows",
+                      "tokens", "parts", "seconds", "complete"),
+        )
+        if _is_num(one.get("device_idle_frac")) \
+                and not 0 <= one["device_idle_frac"] <= 1:
+            errors.append(f"{ew}.one_shot: device_idle_frac out of [0, 1]")
+    res = ex.get("resume")
+    if isinstance(res, dict) and "error" not in res:
+        _check_fields(
+            res,
+            {"killed_rc": _is_int, "resume_rc": lambda v: v == 0,
+             "identical": lambda v: v is True},
+            f"{ew}.resume", errors,
+            required=("killed_rc", "resume_rc", "identical"),
+        )
+        if _is_int(res.get("killed_rc")) and res["killed_rc"] == 0:
+            errors.append(f"{ew}.resume: killed_rc is 0 — the injected "
+                          "SIGKILL never landed")
+
+
 def validate_record(rec: dict, where: str = "record") -> list[str]:
     """Validate one RAW bench record; returns a list of error strings."""
     errors: list[str] = []
@@ -919,6 +968,25 @@ def validate_record(rec: dict, where: str = "record") -> list[str]:
             rec, {"platform_pin": lambda v: isinstance(v, str)},
             where, errors, required=("platform_pin",),
         )
+    elif rec.get("mode") == "export":
+        # --export corpus records: the EXPORT block is the payload
+        _check_fields(
+            rec,
+            {"metric": lambda v: v == "export_tokens_per_sec",
+             "value": _is_num,
+             "unit": lambda v: v == "tokens/sec",
+             "vs_baseline": _is_num,
+             "backend": lambda v: isinstance(v, str)},
+            where, errors,
+            required=("metric", "value", "unit", "vs_baseline", "backend"),
+        )
+        if "error" not in rec:
+            if "export" not in rec:
+                errors.append(f"{where}: export record carries no "
+                              "export block")
+            else:
+                _check_export(rec["export"], where, errors)
+        return errors
     elif rec.get("mode") == "multichip":
         # --multichip scaling records: the MULTICHIP block is the payload
         _check_fields(

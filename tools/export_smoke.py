@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Corpus-export smoke: the replay-exactness + durability contract end
+to end (~10s; tier-1-gated via tools/run_checks.sh).
+
+Drives the full export subsystem against a tiny annotated store:
+
+1. REFERENCE: one uninterrupted `avdb export --commit` (in-process),
+   multi-part via a small ``--partBytes``;
+2. CRASH: the real CLI in a subprocess with ``AVDB_FAULT=
+   export.commit:2:kill`` — SIGKILL lands mid-part-commit, leaving a
+   committed-part prefix plus ``*.export.tmp*`` debris;
+3. ATTRIBUTION: ``store.fsck`` names export debris landing in a store
+   directory with the dedicated ``export-tmp`` finding (never
+   ``foreign-file``);
+4. RESUME: ``avdb export --resume`` prunes the debris, skips the
+   committed prefix, completes — and every part AND the manifest must
+   equal the reference byte-for-byte;
+5. REPLAY: a same-seed re-run from scratch is byte-identical too.
+
+Runs under AVDB_IO_TRACE=1 in run_checks.sh: any rename-before-fsync /
+missing dir fsync in the part/manifest commit path fails the smoke.
+
+Exit: 0 contract held, 1 violated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AVDB_JAX_PLATFORM", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SEED = 9
+BATCH_ROWS = 64
+PART_BYTES = "24k"  # 64*(7*4+2+24)=3456 b/batch -> ~7 batches/part
+
+
+def log(msg: str) -> None:
+    print(f"export_smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def build_store(store_dir: str) -> int:
+    """A tiny two-chromosome annotated store (af/cadd/rank present on a
+    sampling of rows, like the serving fixtures); returns row count."""
+    import numpy as np
+
+    from annotatedvdb_tpu.loaders.lookup import identity_hashes
+    from annotatedvdb_tpu.store import VariantStore
+    from annotatedvdb_tpu.types import encode_allele_array
+
+    width = 8
+    bases = "ACGT"
+    store = VariantStore(width=width)
+    total = 0
+    for code in (1, 7):
+        shard = store.shard(code)
+        for base in (1_000, 500_000):
+            n = 450
+            refs = [bases[(i + code) % 4] for i in range(n)]
+            alts = [bases[(i + code + 1) % 4] for i in range(n)]
+            ref, ref_len = encode_allele_array(refs, width)
+            alt, alt_len = encode_allele_array(alts, width)
+            h = identity_hashes(width, ref, alt, ref_len, alt_len,
+                                refs, alts)
+            shard.append(
+                {"pos": np.asarray([base + 631 * i for i in range(n)],
+                                   np.int32),
+                 "h": h, "ref_len": ref_len, "alt_len": alt_len},
+                ref, alt,
+                annotations={
+                    "cadd_scores": [
+                        {"CADD_phred": round(0.25 * i, 2)}
+                        if i % 3 == 0 else None for i in range(n)
+                    ],
+                    "adsp_most_severe_consequence": [
+                        {"conseq": "missense_variant", "rank": i % 30 + 1}
+                        if i % 4 == 0 else None for i in range(n)
+                    ],
+                    "allele_frequencies": [
+                        {"GnomAD": {"af": round((i % 50) / 50.0, 4)}}
+                        if i % 2 == 0 else None for i in range(n)
+                    ],
+                },
+            )
+            total += n
+    store.save(store_dir)
+    return total
+
+
+def corpus_bytes(out_dir: str) -> dict:
+    """{name: bytes} for every committed corpus file."""
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.endswith(".npz") or name == "corpus.manifest.json":
+            with open(os.path.join(out_dir, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def cli(store_dir: str, out: str, *extra: str, fault: str | None = None):
+    argv = [
+        sys.executable, "-m", "annotatedvdb_tpu", "export",
+        "--storeDir", store_dir, "--out", out, "--commit",
+        "--seed", str(SEED), "--batchRows", str(BATCH_ROWS),
+        "--partBytes", PART_BYTES, *extra,
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if fault:
+        env["AVDB_FAULT"] = fault
+    else:
+        env.pop("AVDB_FAULT", None)
+    return subprocess.run(argv, env=env, capture_output=True, text=True,
+                          timeout=300)
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="avdb_export_smoke_")
+    store_dir = os.path.join(work, "store")
+    rows = build_store(store_dir)
+    log(f"store built: {rows} rows")
+
+    from annotatedvdb_tpu.config import StoreConfig
+    from annotatedvdb_tpu.export.core import run_export
+
+    store, ledger = StoreConfig(store_dir).open(create=False,
+                                                readonly=True)
+    ref_dir = os.path.join(work, "ref")
+    summary = run_export(store, ledger, store_dir, ref_dir, seed=SEED,
+                         batch_rows=BATCH_ROWS, part_bytes=PART_BYTES)
+    ref = corpus_bytes(ref_dir)
+    log(f"reference: {summary['parts_written']} parts, "
+        f"{summary['rows']} rows, "
+        f"{summary['tokens_per_sec']:.0f} tokens/s")
+    if summary["parts_written"] < 3:
+        log(f"FAIL: want >= 3 parts, got {summary['parts_written']}")
+        return 1
+
+    out_dir = os.path.join(work, "out")
+    killed = cli(store_dir, out_dir, fault="export.commit:2:kill")
+    if killed.returncode != -9:
+        log(f"FAIL: kill run exited rc={killed.returncode} "
+            f"(want SIGKILL): {killed.stderr[-400:]}")
+        return 1
+    debris = [f for f in os.listdir(out_dir) if ".export.tmp" in f]
+    if not debris:
+        log("FAIL: SIGKILL mid-commit left no export tmp debris")
+        return 1
+    log(f"killed mid-part (debris: {', '.join(debris)})")
+
+    # fsck must attribute export debris in a store dir by name: plant a
+    # copy of the real debris next to the segments and scan
+    import shutil
+
+    from annotatedvdb_tpu.store.fsck import fsck
+
+    planted = os.path.join(store_dir, debris[0])
+    shutil.copyfile(os.path.join(out_dir, debris[0]), planted)
+    try:
+        report = fsck(store_dir, log=lambda m: None)
+    finally:
+        os.remove(planted)
+    codes = {f["code"] for f in report["findings"]}
+    if "export-tmp" not in codes:
+        log(f"FAIL: fsck names {sorted(codes)}, no export-tmp finding")
+        return 1
+    if "foreign-file" in codes:
+        log("FAIL: fsck misattributes export debris as foreign-file")
+        return 1
+    log("fsck attributes debris: export-tmp")
+
+    resumed = cli(store_dir, out_dir, "--resume")
+    if resumed.returncode != 0:
+        log(f"FAIL: resume rc={resumed.returncode}: "
+            f"{resumed.stderr[-400:]}")
+        return 1
+    doc = json.loads(resumed.stdout.strip().splitlines()[-1])
+    if not doc.get("complete") or doc.get("resumed_parts", 0) < 1:
+        log(f"FAIL: resume summary {doc}")
+        return 1
+    got = corpus_bytes(out_dir)
+    if got != ref:
+        diff = [n for n in ref if got.get(n) != ref[n]]
+        log(f"FAIL: resumed corpus differs from reference: {diff}")
+        return 1
+    log(f"resume after SIGKILL byte-identical "
+        f"({doc['resumed_parts']} resumed + {doc['parts_written']} new)")
+
+    replay_dir = os.path.join(work, "replay")
+    run_export(store, ledger, store_dir, replay_dir, seed=SEED,
+               batch_rows=BATCH_ROWS, part_bytes=PART_BYTES)
+    if corpus_bytes(replay_dir) != ref:
+        log("FAIL: same-seed replay differs from reference")
+        return 1
+    log("same-seed replay byte-identical")
+
+    shutil.rmtree(work, ignore_errors=True)
+    log("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,7 +53,13 @@
 #                    `doctor promote` fails over, and every acknowledged
 #                    upsert answers byte-identical from the new leader;
 #                    io-order traced under AVDB_IO_TRACE=1
-#  13. check_bench_regress — the newest committed BENCH record's
+#  13. export_smoke — the training-corpus export subsystem: multi-part
+#                    reference export, the real CLI SIGKILLed mid-part-
+#                    commit, fsck attributing the debris (export-tmp,
+#                    never foreign-file), --resume byte-identical to the
+#                    uninterrupted run, same-seed replay byte-identical;
+#                    io-order traced under AVDB_IO_TRACE=1
+#  14. check_bench_regress — the newest committed BENCH record's
 #                    headlines (serving qps/p99, load variants/sec)
 #                    against the trailing median of their own history
 #
@@ -106,6 +112,9 @@ python "$root/tools/slo_smoke.py" || rc=1
 
 echo "== repl smoke (io-order traced) ==" >&2
 AVDB_IO_TRACE=1 python "$root/tools/repl_smoke.py" || rc=1
+
+echo "== export smoke (io-order traced) ==" >&2
+AVDB_IO_TRACE=1 python "$root/tools/export_smoke.py" || rc=1
 
 echo "== bench regression watchdog ==" >&2
 python "$root/tools/check_bench_regress.py" || rc=1
